@@ -1,0 +1,47 @@
+"""ray_tpu — a TPU-native distributed ML runtime.
+
+Same capability surface as the reference Ray (tasks, actors, objects,
+placement groups + Data/Train/Tune/Serve/RLlib), re-designed TPU-first: the
+tensor plane is XLA collectives over ICI meshes (jax/pjit/shard_map/pallas)
+rather than NCCL, and the ML libraries are JAX-native.
+"""
+
+from ray_tpu._version import version as __version__  # noqa: F401
+from ray_tpu.core.api import (  # noqa: F401
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.exceptions import (  # noqa: F401
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    ObjectStoreFullError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
+from ray_tpu.core.runtime_context import get_runtime_context  # noqa: F401
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "method", "available_resources",
+    "cluster_resources", "nodes", "ObjectRef", "get_runtime_context",
+    "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
+    "ObjectLostError", "ObjectStoreFullError", "TaskCancelledError",
+    "WorkerCrashedError", "GetTimeoutError", "__version__",
+]

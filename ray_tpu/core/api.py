@@ -1,0 +1,160 @@
+"""Public driver API: init/shutdown/remote/get/put/wait/kill/cancel/...
+
+Analog of ``python/ray/_private/worker.py`` (ray.init :1227, get/put/wait
+wrappers) in the reference, minus process spawning for the control plane —
+the head runs in the driver process and worker processes are forked per node
+(see node.py).
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from . import object_ref as object_ref_mod
+from . import runtime as runtime_mod
+from .actor import ActorClass, ActorHandle, method  # noqa: F401
+from .exceptions import GetTimeoutError
+from .ids import ActorID
+from .object_ref import ObjectRef
+from .remote_function import RemoteFunction
+from .runtime import DriverRuntime, Head
+
+
+_head: Optional[Head] = None
+_namespace: str = "default"
+
+
+def is_initialized() -> bool:
+    return runtime_mod.get_current_runtime() is not None
+
+
+def init(
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    num_gpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    namespace: str = "default",
+    labels: Optional[Dict[str, str]] = None,
+    ignore_reinit_error: bool = False,
+    **_kwargs,
+):
+    """Start a single-node cluster in-process and connect the driver."""
+    global _head, _namespace
+    if is_initialized():
+        if ignore_reinit_error:
+            return runtime_mod.get_current_runtime()
+        raise RuntimeError("ray_tpu.init() called twice")
+    from .config import global_config
+    from .accelerators import detect_resources
+
+    if object_store_memory:
+        global_config().object_store_memory = int(object_store_memory)
+    total = detect_resources(num_cpus=num_cpus, num_tpus=num_tpus,
+                             num_gpus=num_gpus, extra=resources)
+    _namespace = namespace
+    _head = Head(total, labels=labels)
+    rt = DriverRuntime(_head)
+    runtime_mod.set_current_runtime(rt)
+    object_ref_mod.set_runtime(rt)
+    return rt
+
+
+def shutdown():
+    global _head
+    rt = runtime_mod.get_current_runtime()
+    if rt is None:
+        return
+    runtime_mod.set_current_runtime(None)
+    object_ref_mod.set_runtime(None)
+    if _head is not None:
+        _head.shutdown()
+        _head = None
+
+
+def _get_head() -> Head:
+    if _head is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return _head
+
+
+def remote(*args, **options):
+    """``@remote`` decorator for functions and classes (reference:
+    python/ray/_private/worker.py remote)."""
+
+    def make(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, options)
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and not options and (inspect.isfunction(args[0])
+                                           or inspect.isclass(args[0])):
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only")
+    return make
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    rt = runtime_mod.get_current_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    single = isinstance(refs, ObjectRef)
+    lst = [refs] if single else list(refs)
+    for r in lst:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRefs, got {type(r)}")
+    values = rt.get(lst, timeout=timeout)
+    return values[0] if single else values
+
+
+def put(value: Any) -> ObjectRef:
+    rt = runtime_mod.get_current_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() on an ObjectRef is not allowed")
+    return rt.put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    rt = runtime_mod.get_current_runtime()
+    lst = list(refs)
+    if num_returns > len(lst):
+        raise ValueError("num_returns exceeds number of refs")
+    return rt.wait(lst, num_returns=num_returns, timeout=timeout,
+                   fetch_local=fetch_local)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    rt = runtime_mod.get_current_runtime()
+    rt.kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    rt = runtime_mod.get_current_runtime()
+    rt.cancel_task(ref.id, force)
+
+
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    rt = runtime_mod.get_current_runtime()
+    info = rt.get_actor_info(name, namespace or _namespace)
+    if info is None:
+        raise ValueError(f"Failed to look up actor {name!r}")
+    return ActorHandle(info["actor_id"], info["class_name"])
+
+
+def available_resources() -> Dict[str, float]:
+    return runtime_mod.get_current_runtime().available_resources()
+
+
+def cluster_resources() -> Dict[str, float]:
+    return runtime_mod.get_current_runtime().cluster_resources()
+
+
+def nodes() -> List[dict]:
+    return runtime_mod.get_current_runtime().nodes()

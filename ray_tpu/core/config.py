@@ -1,0 +1,114 @@
+"""Config/flag system.
+
+Analog of the reference's ``src/ray/common/ray_config_def.h`` (216 RAY_CONFIG
+entries overridable by ``RAY_<name>`` env vars) — a single typed registry of
+every runtime tunable, overridable with ``RAY_TPU_<NAME>`` environment
+variables, snapshotted at cluster start and shipped to workers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields, asdict
+from typing import Any, Dict
+
+
+def _env(name: str, default, typ):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+@dataclass
+class Config:
+    # ---- object store / plasma (reference: ray_config_def.h:199,345,398,614) ----
+    max_direct_call_object_size: int = 100 * 1024  # inline vs shared-mem threshold
+    object_store_memory: int = 512 * 1024 * 1024  # default shm arena bytes
+    object_store_full_delay_ms: int = 10
+    object_transfer_chunk_size: int = 5 * 1024 * 1024
+    object_spilling_enabled: bool = True
+    object_spilling_dir: str = ""  # defaults to session dir /spill
+    min_spilling_size: int = 1 * 1024 * 1024
+    max_io_workers: int = 4
+
+    # ---- scheduler (reference: ray_config_def.h:179,185,190) ----
+    scheduler_spread_threshold: float = 0.5
+    scheduler_top_k_fraction: float = 0.2
+    scheduler_top_k_absolute: int = 1
+    raylet_report_resources_period_ms: int = 100
+
+    # ---- workers ----
+    num_workers_soft_limit: int = -1  # -1 => num_cpus
+    worker_maximum_startup_concurrency: int = 0  # 0 => num_cpus
+    worker_prestart_count: int = 2  # eagerly forked at node start (reference:
+    # worker_pool.h:163 num_prestarted_python_workers)
+    worker_register_timeout_s: float = 60.0
+    worker_lease_idle_timeout_s: float = 5.0
+
+    # ---- tasks / fault tolerance (reference: ray_config_def.h:138,414,835) ----
+    task_retry_delay_ms: int = 0
+    lineage_pinning_enabled: bool = True
+    actor_restart_delay_ms: int = 0
+    health_check_period_ms: int = 1000
+    health_check_failure_threshold: int = 5
+
+    # ---- observability ----
+    task_events_enabled: bool = True
+    task_events_max_buffered: int = 100_000
+    metrics_report_interval_ms: int = 10_000
+    event_log_enabled: bool = True
+
+    # ---- fault injection (reference: testing_asio_delay_us :824) ----
+    testing_delay_ms: str = ""  # "handler1=ms,handler2=ms" injected latency
+
+    # ---- TPU (reference: custom_unit_instance_resources :735) ----
+    # Resources tracked per unit instance (index-assignable like CUDA devices).
+    unit_instance_resources: str = "TPU,GPU,neuron_cores,NPU,HPU"
+
+    # ---- collective ----
+    collective_timeout_s: float = 300.0
+
+    def __post_init__(self):
+        for f in fields(self):
+            cur = getattr(self, f.name)
+            setattr(self, f.name, _env(f.name, cur, type(cur)))
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self))
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        cfg = cls()
+        for k, v in json.loads(s).items():
+            setattr(cfg, k, v)
+        return cfg
+
+    def delay_for(self, handler: str) -> float:
+        """Fault-injection latency (seconds) for a named handler, 0 if none."""
+        if not self.testing_delay_ms:
+            return 0.0
+        for part in self.testing_delay_ms.split(","):
+            if "=" in part:
+                name, ms = part.split("=", 1)
+                if name == handler:
+                    return float(ms) / 1000.0
+        return 0.0
+
+
+_global_config: Config | None = None
+
+
+def global_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def set_global_config(cfg: Config):
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,91 @@
+"""Error hierarchy, analog of ``python/ray/exceptions.py`` in the reference."""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception; re-raised at ``get`` on the caller side.
+
+    Mirrors the reference's RayTaskError (python/ray/exceptions.py): carries the
+    remote traceback text and the original exception (when picklable).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        return (type(self), (self.function_name, self.traceback_str, self.cause))
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: Exception) -> "TaskError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        try:
+            import pickle
+
+            pickle.dumps(exc)
+            cause = exc
+        except Exception:
+            cause = None
+        return cls(function_name, tb, cause)
+
+
+class ActorError(TaskError):
+    """An actor method raised."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead (crashed, killed, or exceeded max_restarts)."""
+
+    def __init__(self, actor_id=None, reason: str = "actor died"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(reason)
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object cannot be found/reconstructed anywhere in the cluster."""
+
+    def __init__(self, object_id=None, reason: str = "object lost"):
+        self.object_id = object_id
+        super().__init__(reason)
+
+
+class ObjectStoreFullError(RayTpuError):
+    """Shared-memory store is out of memory even after eviction/spilling."""
+
+
+class TaskCancelledError(RayTpuError):
+    """Task was cancelled via ``cancel()``."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get(timeout=...)`` expired."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Runtime environment failed to materialize."""
+
+
+class NodeDiedError(RayTpuError):
+    """The node hosting the computation died."""
+
+
+class PlacementGroupError(RayTpuError):
+    """Placement group creation/scheduling failure."""

@@ -1,0 +1,243 @@
+"""Global Control Service — the cluster control plane.
+
+Analog of the reference's GCS server (``src/ray/gcs/gcs_server/``): node table
+with health, actor table + lifecycle FSM, job table, function table, internal
+KV, object directory, named-actor registry, pubsub, and a task-event sink for
+observability (reference: gcs_task_manager.h:86). Here it is an in-process
+thread-safe service owned by the head; workers reach it through their node's
+RPC channel, exactly as raylets/workers reach the GCS over gRPC in the
+reference. Pluggable persistence (in-memory now; the interface mirrors
+``store_client`` so a redis/file backend can drop in for GCS fault tolerance).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from .config import global_config
+from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
+
+
+@dataclass
+class ActorInfo:
+    actor_id: ActorID
+    name: Optional[str]
+    namespace: str
+    class_name: str
+    state: str  # PENDING_CREATION | ALIVE | RESTARTING | DEAD
+    node_hex: Optional[str] = None
+    worker_id: Optional[bytes] = None
+    max_restarts: int = 0
+    num_restarts: int = 0
+    max_task_retries: int = 0
+    death_cause: Optional[str] = None
+    detached: bool = False
+    creation_spec: Any = None  # retained for restart (lineage)
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    hex: str
+    alive: bool = True
+    resources_total: Dict[str, float] = field(default_factory=dict)
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class JobInfo:
+    job_id: JobID
+    entrypoint: str = "driver"
+    state: str = "RUNNING"
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+
+
+@dataclass
+class TaskEvent:
+    task_id: bytes
+    name: str
+    state: str
+    node_hex: Optional[str]
+    ts: float
+    attempt: int = 0
+    error: Optional[str] = None
+
+
+class PubSub:
+    """In-process publisher with per-channel subscriptions (reference:
+    src/ray/pubsub/ long-poll publisher; here callbacks fire inline)."""
+
+    def __init__(self):
+        self._subs: Dict[str, List[Callable]] = defaultdict(list)
+        self._lock = threading.Lock()
+
+    def subscribe(self, channel: str, callback: Callable) -> None:
+        with self._lock:
+            self._subs[channel].append(callback)
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+        for cb in subs:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+
+class GCS:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)  # namespace -> kv
+        self.functions: Dict[str, bytes] = {}  # function_id -> pickled fn/class
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[tuple, ActorID] = {}  # (namespace, name) -> id
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.jobs: Dict[JobID, JobInfo] = {}
+        self.object_dir: Dict[ObjectID, Set[str]] = defaultdict(set)  # oid -> node hexes
+        self.pubsub = PubSub()
+        cfg = global_config()
+        self.task_events: deque = deque(maxlen=cfg.task_events_max_buffered)
+        self.placement_groups: Dict[PlacementGroupID, Any] = {}
+
+    # ---- KV (reference: gcs_kv_manager.cc) ----
+    def kv_put(self, key: bytes, value: bytes, namespace: str = "default", overwrite=True) -> bool:
+        with self._lock:
+            ns = self.kv[namespace]
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
+        with self._lock:
+            return self.kv[namespace].get(key)
+
+    def kv_del(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return self.kv[namespace].pop(key, None) is not None
+
+    def kv_keys(self, prefix: bytes, namespace: str = "default") -> List[bytes]:
+        with self._lock:
+            return [k for k in self.kv[namespace] if k.startswith(prefix)]
+
+    def kv_exists(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return key in self.kv[namespace]
+
+    # ---- functions (reference: gcs_function_manager.h) ----
+    def register_function(self, function_id: str, payload: bytes) -> None:
+        with self._lock:
+            self.functions[function_id] = payload
+
+    def get_function(self, function_id: str) -> Optional[bytes]:
+        with self._lock:
+            return self.functions.get(function_id)
+
+    # ---- nodes (reference: gcs_node_manager.cc) ----
+    def register_node(self, info: NodeInfo) -> None:
+        with self._lock:
+            self.nodes[info.hex] = info
+        self.pubsub.publish("node", ("added", info.hex))
+
+    def mark_node_dead(self, node_hex: str) -> None:
+        with self._lock:
+            info = self.nodes.get(node_hex)
+            if info is None or not info.alive:
+                return
+            info.alive = False
+        self.pubsub.publish("node", ("removed", node_hex))
+
+    def alive_nodes(self) -> List[NodeInfo]:
+        with self._lock:
+            return [n for n in self.nodes.values() if n.alive]
+
+    # ---- actors (reference: gcs_actor_manager.cc FSM) ----
+    def register_actor(self, info: ActorInfo) -> None:
+        with self._lock:
+            self.actors[info.actor_id] = info
+            if info.name:
+                key = (info.namespace, info.name)
+                if key in self.named_actors:
+                    raise ValueError(f"actor name {info.name!r} already taken")
+                self.named_actors[key] = info.actor_id
+
+    def update_actor(self, actor_id: ActorID, **fields_) -> None:
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info is None:
+                return
+            for k, v in fields_.items():
+                setattr(info, k, v)
+            state = fields_.get("state")
+        if state:
+            self.pubsub.publish("actor", (actor_id, state))
+
+    def get_actor(self, actor_id: ActorID) -> Optional[ActorInfo]:
+        with self._lock:
+            return self.actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str = "default") -> Optional[ActorInfo]:
+        with self._lock:
+            aid = self.named_actors.get((namespace, name))
+            return self.actors.get(aid) if aid else None
+
+    def remove_actor_name(self, actor_id: ActorID) -> None:
+        with self._lock:
+            info = self.actors.get(actor_id)
+            if info and info.name:
+                self.named_actors.pop((info.namespace, info.name), None)
+
+    def list_actors(self) -> List[ActorInfo]:
+        with self._lock:
+            return list(self.actors.values())
+
+    # ---- jobs ----
+    def add_job(self, info: JobInfo) -> None:
+        with self._lock:
+            self.jobs[info.job_id] = info
+
+    # ---- object directory (reference: ownership_based_object_directory.cc) ----
+    def add_object_location(self, oid: ObjectID, node_hex: str) -> None:
+        with self._lock:
+            self.object_dir[oid].add(node_hex)
+        self.pubsub.publish("object", (oid, node_hex))
+
+    def remove_object_location(self, oid: ObjectID, node_hex: str) -> None:
+        with self._lock:
+            locs = self.object_dir.get(oid)
+            if locs:
+                locs.discard(node_hex)
+                if not locs:
+                    del self.object_dir[oid]
+
+    def get_object_locations(self, oid: ObjectID) -> Set[str]:
+        with self._lock:
+            return set(self.object_dir.get(oid, ()))
+
+    def drop_node_objects(self, node_hex: str) -> List[ObjectID]:
+        """On node death: purge its locations; return objects now location-less."""
+        lost = []
+        with self._lock:
+            for oid in list(self.object_dir):
+                locs = self.object_dir[oid]
+                locs.discard(node_hex)
+                if not locs:
+                    del self.object_dir[oid]
+                    lost.append(oid)
+        return lost
+
+    # ---- task events (reference: gcs_task_manager.h) ----
+    def record_task_event(self, ev: TaskEvent) -> None:
+        if global_config().task_events_enabled:
+            self.task_events.append(ev)
+
+    def list_task_events(self, limit: int = 1000) -> List[TaskEvent]:
+        with self._lock:
+            return list(self.task_events)[-limit:]

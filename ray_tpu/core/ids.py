@@ -1,0 +1,132 @@
+"""Unique identifiers for tasks, objects, actors, nodes, jobs, placement groups.
+
+TPU-native analog of the reference's ``src/ray/common/id.h`` ID hierarchy:
+fixed-width random IDs with cheap hashing and hex round-trip. Unlike the
+reference (which derives ObjectIDs from TaskID + return index in C++), we keep
+the same *derivation scheme* but implement it with Python ``os.urandom`` /
+``hashlib`` — the IDs only need to be unique within a cluster session.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+
+class BaseID:
+    """Fixed-size binary id with hex repr. Subclasses set SIZE and PREFIX."""
+
+    SIZE = 16
+    PREFIX = "id"
+    __slots__ = ("_bin", "_hash")
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
+            )
+        self._bin = binary
+        self._hash = hash(binary)
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bin == b"\x00" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bin
+
+    def hex(self) -> str:
+        return self._bin.hex()
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bin == self._bin
+
+    def __lt__(self, other):
+        return self._bin < other._bin
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bin,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+    PREFIX = "job"
+
+
+class NodeID(BaseID):
+    SIZE = 16
+    PREFIX = "node"
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+    PREFIX = "worker"
+
+
+class ActorID(BaseID):
+    SIZE = 16
+    PREFIX = "actor"
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16
+    PREFIX = "pg"
+
+
+class TaskID(BaseID):
+    SIZE = 16
+    PREFIX = "task"
+
+    _counter_lock = threading.Lock()
+    _counter = 0
+
+    @classmethod
+    def for_driver_task(cls, job_id: JobID):
+        with cls._counter_lock:
+            cls._counter += 1
+            n = cls._counter
+        h = hashlib.blake2b(
+            job_id.binary() + n.to_bytes(8, "little"), digest_size=cls.SIZE
+        )
+        return cls(h.digest())
+
+
+class ObjectID(BaseID):
+    """Derived from parent task id + return/put index (reference: id.h ObjectID)."""
+
+    SIZE = 20
+    PREFIX = "obj"
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int):
+        return cls(task_id.binary() + index.to_bytes(4, "little"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int):
+        # High bit of the index distinguishes puts from returns.
+        return cls(task_id.binary() + (put_index | 0x80000000).to_bytes(4, "little"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bin[: TaskID.SIZE])
+
+
+# Backwards-friendly aliases matching the public reference naming.
+ObjectRefID = ObjectID

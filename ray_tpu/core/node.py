@@ -1,0 +1,353 @@
+"""Node — per-node daemon state: worker pool, local dispatch, object store.
+
+Analog of the reference's raylet (``src/ray/raylet/node_manager.cc`` +
+``worker_pool.cc``): owns the node's shared-memory store, spawns/leases worker
+processes, dispatches tasks the cluster scheduler routed here, detects worker
+death via connection EOF, and serves worker store/control RPCs (delegating
+control-plane ops to the head, as raylets delegate to the GCS). In multi-node
+tests several Node objects live in the driver process, each with its own
+worker processes and arena — the analog of ``cluster_utils.Cluster`` running
+several raylets on one machine.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .config import global_config
+from .ids import NodeID, WorkerID
+from .object_store import LocalObjectStore
+from .protocol import Channel, make_listener
+from .resources import NodeResources
+from .task_spec import TaskSpec
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    channel: Channel
+    pid: int
+    proc: Optional[subprocess.Popen] = None
+    state: str = "starting"  # starting | idle | busy | actor | dead
+    current_task: Optional[TaskSpec] = None
+    current_binding: Optional[dict] = None
+    actor_id: Optional[object] = None
+    reader: Optional[threading.Thread] = None
+
+
+class Node:
+    def __init__(self, head, node_id: NodeID, resources: Dict[str, float],
+                 session_dir: str, labels: Optional[Dict[str, str]] = None):
+        cfg = global_config()
+        self.head = head
+        self.node_id = node_id
+        self.hex = node_id.hex()
+        self.session_dir = session_dir
+        self.labels = labels or {}
+        unit_names = set(cfg.unit_instance_resources.split(","))
+        self.resources = NodeResources(resources, unit_instance_names=unit_names)
+        self.resources.labels = self.labels
+        self.store = LocalObjectStore(session_dir, self.hex)
+        self.max_workers = max(1, int(resources.get("CPU", 1)))
+        self._workers: Dict[WorkerID, WorkerHandle] = {}
+        self._idle: deque = deque()
+        self._local_queue: deque = deque()  # (spec, binding) waiting for a worker
+        self._lock = threading.RLock()
+        self._handler_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix=f"node-{self.hex[:6]}"
+        )
+        self.alive = True
+        self._authkey = os.urandom(16)
+        self._sock_path = os.path.join(session_dir, f"node_{self.hex[:12]}.sock")
+        self._listener = make_listener(self._sock_path, self._authkey)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"accept-{self.hex[:6]}"
+        )
+        self._accept_thread.start()
+        self._num_starting = 0
+        with self._lock:
+            for _ in range(min(cfg.worker_prestart_count, self.max_workers)):
+                self._start_worker_locked()
+
+    # ------------------------------------------------------------ dispatch
+
+    def dispatch(self, spec: TaskSpec, binding: dict) -> None:
+        """Called by the cluster scheduler once resources are acquired."""
+        with self._lock:
+            if not self.alive:
+                raise RuntimeError("node is dead")
+            self._local_queue.append((spec, binding))
+        self._pump()
+
+    def dispatch_to_worker(self, worker_id: WorkerID, spec: TaskSpec) -> bool:
+        """Direct dispatch to a specific (actor) worker, bypassing leasing."""
+        with self._lock:
+            w = self._workers.get(worker_id)
+            if w is None or w.state == "dead":
+                return False
+        try:
+            w.channel.send("exec", pickle.dumps(spec), {})
+            return True
+        except OSError:
+            return False
+
+    def _pump(self) -> None:
+        """Match queued tasks with idle workers; start workers as needed."""
+        to_send: List[Tuple[WorkerHandle, TaskSpec, dict]] = []
+        with self._lock:
+            while self._local_queue:
+                w = None
+                while self._idle:
+                    cand = self._idle.popleft()
+                    if cand.state == "idle":
+                        w = cand
+                        break
+                if w is None:
+                    # Start a new worker if under limit. Queued actor
+                    # creations each get a dedicated worker beyond the pool.
+                    active = sum(1 for x in self._workers.values()
+                                 if x.state in ("idle", "busy")) + self._num_starting
+                    limit = self.max_workers + sum(
+                        1 for s, _ in self._local_queue if s.is_actor_creation)
+                    if active < limit:
+                        self._start_worker_locked()
+                    break
+                spec, binding = self._local_queue.popleft()
+                w.state = "busy"
+                w.current_task = spec
+                w.current_binding = binding
+                to_send.append((w, spec, binding))
+        for w, spec, binding in to_send:
+            try:
+                w.channel.send("exec", pickle.dumps(spec), binding)
+            except OSError:
+                self._on_worker_dead(w)
+
+    # ------------------------------------------------------------ workers
+
+    def _start_worker_locked(self) -> None:
+        self._num_starting += 1
+        env = dict(os.environ)
+        env["RAY_TPU_NODE_HEX"] = self.hex
+        if self.resources.total.get("TPU") == 0:
+            # CPU-only node: skip the TPU plugin registration in sitecustomize
+            # (it imports jax, ~2s per process start)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        # make ray_tpu importable in the worker regardless of driver cwd
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        log_path = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_path, exist_ok=True)
+        out = open(os.path.join(log_path, f"worker-{time.time_ns()}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.worker_runtime",
+             "--address", self._sock_path, "--authkey", self._authkey.hex()],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            cwd=os.getcwd(),
+        )
+        # handle registered on accept
+        threading.Thread(
+            target=self._reap, args=(proc,), daemon=True
+        ).start()
+
+    def _reap(self, proc: subprocess.Popen) -> None:
+        proc.wait()
+
+    def _accept_loop(self) -> None:
+        import multiprocessing.context as _mpctx
+
+        while self.alive:
+            try:
+                conn = self._listener.accept()
+            except _mpctx.AuthenticationError:
+                # worker killed mid-handshake (node/cluster shutdown race)
+                continue
+            except (OSError, EOFError):
+                return
+            channel = Channel(conn)
+            try:
+                tag, (pid,) = channel.recv()
+                assert tag == "register"
+            except Exception:
+                channel.close()
+                continue
+            wid = WorkerID.from_random()
+            w = WorkerHandle(worker_id=wid, channel=channel, pid=pid, state="idle")
+            with self._lock:
+                self._num_starting = max(0, self._num_starting - 1)
+                self._workers[wid] = w
+                self._idle.append(w)
+            init_info = {
+                "worker_id": wid.binary(),
+                "node_hex": self.hex,
+                "job_id": self.head.job_id.binary(),
+                "arena_path": self.store.arena_path,
+                "arena_capacity": self.store.capacity,
+                "config": global_config().to_json(),
+            }
+            channel.send("init", init_info)
+            w.reader = threading.Thread(
+                target=self._reader_loop, args=(w,), daemon=True,
+                name=f"reader-{wid.hex()[:6]}",
+            )
+            w.reader.start()
+            self._pump()
+
+    def _reader_loop(self, w: WorkerHandle) -> None:
+        while True:
+            try:
+                tag, payload = w.channel.recv()
+            except (EOFError, OSError):
+                self._on_worker_dead(w)
+                return
+            if tag == "done":
+                task_id, results, err_name = payload
+                self._on_task_done(w, task_id, results, err_name)
+            elif tag == "store":
+                req_id, op, *args = payload
+                if op in ("get", "wait", "create"):
+                    self._handler_pool.submit(self._handle_store, w, req_id, op, args)
+                else:
+                    self._handle_store(w, req_id, op, args)
+            elif tag == "rpc":
+                req_id, op, *args = payload
+                self._handler_pool.submit(self._handle_rpc, w, req_id, op, args)
+            elif tag == "release":
+                for oid in payload[0]:
+                    self.store.remove_ref(oid)
+            elif tag == "exit":
+                # graceful actor exit
+                self._on_worker_exit(w)
+                return
+
+    def _reply(self, w: WorkerHandle, req_id: int, ok: bool, value) -> None:
+        try:
+            w.channel.send("rep", req_id, ok, value)
+        except OSError:
+            pass
+
+    def _handle_store(self, w: WorkerHandle, req_id: int, op: str, args) -> None:
+        try:
+            if op == "get":
+                oid, timeout = args
+                rep = self.head.get_object_for_node(self, oid, timeout)
+                self._reply(w, req_id, True, rep)
+            elif op == "wait":
+                oids, num_returns, timeout = args
+                ready = self.head.wait_objects(oids, num_returns, timeout)
+                self._reply(w, req_id, True, ready)
+            elif op == "create":
+                oid, size = args
+                offset, _ = self.store.create(oid, size)
+                self._reply(w, req_id, True, offset)
+            elif op == "seal":
+                oid, is_error = args
+                self.store.seal(oid, is_error)
+                self.head.on_object_sealed(oid, self.hex)
+                self._reply(w, req_id, True, None)
+            elif op == "put_inline":
+                oid, data, is_error = args
+                self.store.put_inline(oid, data, is_error)
+                self.head.on_object_sealed(oid, self.hex)
+                self._reply(w, req_id, True, None)
+            else:
+                self._reply(w, req_id, False, ValueError(f"bad store op {op}"))
+        except Exception as e:  # noqa: BLE001
+            self._reply(w, req_id, False, e)
+
+    def _handle_rpc(self, w: WorkerHandle, req_id: int, op: str, args) -> None:
+        try:
+            result = self.head.handle_worker_rpc(self, w, op, args)
+            self._reply(w, req_id, True, result)
+        except Exception as e:  # noqa: BLE001
+            self._reply(w, req_id, False, e)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _on_task_done(self, w: WorkerHandle, task_id, results, err_name) -> None:
+        spec = w.current_task
+        with self._lock:
+            if spec is not None and spec.task_id == task_id:
+                w.current_task = None
+                binding = w.current_binding
+                w.current_binding = None
+                if spec.is_actor_creation and err_name is None:
+                    w.state = "actor"
+                    w.actor_id = spec.actor_id
+                elif w.state == "busy":
+                    w.state = "idle"
+                    self._idle.append(w)
+            else:
+                binding = None
+                # actor task done (worker stays "actor") or stale
+                spec = None
+        # The head decides whether to seal results (it may retry instead).
+        self.head.on_task_finished(self, task_id, err_name, spec, binding, results)
+        self._pump()
+
+    def _on_worker_exit(self, w: WorkerHandle) -> None:
+        with self._lock:
+            w.state = "dead"
+            self._workers.pop(w.worker_id, None)
+        self.head.on_worker_exit(self, w)
+
+    def _on_worker_dead(self, w: WorkerHandle) -> None:
+        with self._lock:
+            if w.state == "dead":
+                return
+            prev_state = w.state
+            w.state = "dead"
+            self._workers.pop(w.worker_id, None)
+            spec = w.current_task
+            binding = w.current_binding
+        w.channel.close()
+        self.head.on_worker_crashed(self, w, spec, binding, prev_state)
+        self._pump()
+
+    def kill_worker(self, worker_id: WorkerID) -> None:
+        with self._lock:
+            w = self._workers.get(worker_id)
+        if w is None:
+            return
+        try:
+            w.channel.send("shutdown")
+        except OSError:
+            pass
+        try:
+            os.kill(w.pid, 9)
+        except (OSError, ProcessLookupError):
+            pass
+
+    def num_workers(self) -> int:
+        with self._lock:
+            return len(self._workers)
+
+    def shutdown(self) -> None:
+        self.alive = False
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            try:
+                w.channel.send("shutdown")
+            except OSError:
+                pass
+            try:
+                os.kill(w.pid, 9)
+            except (OSError, ProcessLookupError):
+                pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.store.close()
+        self._handler_pool.shutdown(wait=False)

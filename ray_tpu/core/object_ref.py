@@ -1,0 +1,94 @@
+"""ObjectRef — the client-side future handle.
+
+Analog of the reference's ``ray.ObjectRef`` (Cython, _raylet.pyx): a handle to
+an immutable object somewhere in the cluster. Refs are serializable (they
+travel inside task args and other objects); deserializing registers a borrow
+with the owner via the contained-ids mechanism in serialization.py
+(reference: reference_count.h borrower bookkeeping).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import serialization
+from .ids import ObjectID
+
+# Installed by the runtime (driver api or worker runtime) so that refs can
+# resolve `.get()`/release without importing the runtime module (avoids cycle).
+_runtime = None
+
+
+def set_runtime(rt) -> None:
+    global _runtime
+    _runtime = rt
+
+
+def get_runtime():
+    return _runtime
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_node", "_weak")
+
+    def __init__(self, oid: ObjectID, owner_node: Optional[bytes] = None, _register: bool = True):
+        self.id = oid
+        self.owner_node = owner_node
+        self._weak = not _register
+        if _register and _runtime is not None:
+            _runtime.add_local_ref(oid)
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def future(self):
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+
+        def _resolve():
+            try:
+                fut.set_result(_runtime.get([self], timeout=None)[0])
+            except Exception as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        import threading
+
+        threading.Thread(target=_resolve, daemon=True).start()
+        return fut
+
+    def __await__(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        fut = loop.run_in_executor(None, lambda: _runtime.get([self], timeout=None)[0])
+        return fut.__await__()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __reduce__(self):
+        serialization.note_contained_ref(self)
+        if _runtime is not None:
+            _runtime.add_borrow_ref(self.id)
+        return (_deserialize_ref, (self.id, self.owner_node))
+
+    def __del__(self):
+        if not self._weak and _runtime is not None:
+            try:
+                _runtime.remove_local_ref(self.id)
+            except Exception:  # interpreter shutdown
+                pass
+
+
+def _deserialize_ref(oid: ObjectID, owner_node):
+    return ObjectRef(oid, owner_node)

@@ -1,0 +1,361 @@
+"""Node-local object store: shared-memory arena + in-process memory store.
+
+Analog of the reference's plasma store (``src/ray/object_manager/plasma/``) and
+the CoreWorker in-process memory store (``store_provider/memory_store/``):
+
+- Small objects (< ``max_direct_call_object_size``) live inline in the owner's
+  memory store and travel inside RPC replies (reference: ray_config_def.h:199).
+- Large objects are written into a node-wide mmap'd arena on /dev/shm so every
+  worker process on the node reads them zero-copy (reference: plasma fd-passing
+  via fling.cc; here all workers map the same session file).
+- Allocation uses the native C++ allocator (``ray_tpu._native.plasma``) when
+  built, else a Python first-fit free list (reference: dlmalloc arena).
+- When the arena fills, sealed objects are spilled to disk files and restored
+  on demand (reference: local_object_manager.h SpillObjects / fallback
+  allocation plasma_allocator.h:83-97).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .config import global_config
+from .exceptions import ObjectStoreFullError, ObjectLostError
+from .ids import ObjectID
+
+
+# --------------------------------------------------------------------------- #
+# Allocator
+# --------------------------------------------------------------------------- #
+
+
+class FreeListAllocator:
+    """First-fit free-list allocator over a fixed arena (Python fallback)."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        # sorted list of (offset, size) free extents
+        self._free: List[Tuple[int, int]] = [(0, capacity)]
+        self._allocated: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def allocate(self, size: int) -> Optional[int]:
+        size = max(8, (size + 63) & ~63)  # 64B alignment
+        with self._lock:
+            for i, (off, sz) in enumerate(self._free):
+                if sz >= size:
+                    if sz == size:
+                        self._free.pop(i)
+                    else:
+                        self._free[i] = (off + size, sz - size)
+                    self._allocated[off] = size
+                    return off
+        return None
+
+    def free(self, offset: int) -> None:
+        with self._lock:
+            size = self._allocated.pop(offset)
+            self._free.append((offset, size))
+            self._free.sort()
+            # coalesce
+            merged: List[Tuple[int, int]] = []
+            for off, sz in self._free:
+                if merged and merged[-1][0] + merged[-1][1] == off:
+                    merged[-1] = (merged[-1][0], merged[-1][1] + sz)
+                else:
+                    merged.append((off, sz))
+            self._free = merged
+
+    def bytes_allocated(self) -> int:
+        with self._lock:
+            return sum(self._allocated.values())
+
+
+def _make_allocator(capacity: int):
+    try:
+        from ray_tpu._native.plasma import NativeAllocator
+
+        return NativeAllocator(capacity)
+    except Exception:
+        return FreeListAllocator(capacity)
+
+
+# --------------------------------------------------------------------------- #
+# Arena (one per node, mapped by every worker on that node)
+# --------------------------------------------------------------------------- #
+
+
+class PlasmaArena:
+    """A single mmap'd file on /dev/shm holding all large-object payloads."""
+
+    def __init__(self, path: str, capacity: int, create: bool):
+        self.path = path
+        self.capacity = capacity
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        self._fd = os.open(path, flags, 0o600)
+        if create:
+            os.ftruncate(self._fd, capacity)
+        self._mm = mmap.mmap(self._fd, capacity)
+        self.allocator = _make_allocator(capacity) if create else None
+
+    def view(self, offset: int, size: int) -> memoryview:
+        return memoryview(self._mm)[offset : offset + size]
+
+    def close(self, unlink: bool = False):
+        # Zero-copy readers may still hold memoryviews into the map; in that
+        # case leave the mapping to the GC and just unlink the backing file.
+        try:
+            self._mm.close()
+        except BufferError:
+            pass
+        try:
+            os.close(self._fd)
+        except OSError:
+            pass
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+# --------------------------------------------------------------------------- #
+# Store
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class ObjectEntry:
+    object_id: ObjectID
+    size: int = 0
+    inline: Optional[bytes] = None  # small objects
+    offset: int = -1  # arena offset for large objects
+    sealed: bool = False
+    is_error: bool = False  # payload is a serialized exception
+    spilled_path: Optional[str] = None
+    owner_node: Optional[bytes] = None
+    ref_count: int = 0
+    last_access: float = field(default_factory=time.monotonic)
+    creating: bool = False  # allocated, being written
+
+
+class LocalObjectStore:
+    """Node-local store combining inline memory store + shared arena.
+
+    Thread-safe; the node's RPC threads and driver call into it concurrently.
+    """
+
+    def __init__(self, session_dir: str, node_hex: str, capacity: Optional[int] = None):
+        cfg = global_config()
+        self.capacity = capacity or cfg.object_store_memory
+        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else session_dir
+        self.arena_path = os.path.join(shm_dir, f"raytpu_plasma_{node_hex}")
+        self.arena = PlasmaArena(self.arena_path, self.capacity, create=True)
+        self.spill_dir = cfg.object_spilling_dir or os.path.join(session_dir, "spill")
+        os.makedirs(self.spill_dir, exist_ok=True)
+        self._entries: Dict[ObjectID, ObjectEntry] = {}
+        self._lock = threading.RLock()
+        self._sealed_cv = threading.Condition(self._lock)
+
+    # -- creation ----------------------------------------------------------
+
+    def put_inline(self, oid: ObjectID, payload: bytes, is_error: bool = False):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None and e.sealed:
+                return  # idempotent re-put (retries)
+            self._entries[oid] = ObjectEntry(
+                oid, size=len(payload), inline=bytes(payload), sealed=True,
+                is_error=is_error,
+            )
+            self._sealed_cv.notify_all()
+
+    def create(self, oid: ObjectID, size: int) -> Tuple[int, memoryview]:
+        """Allocate arena space; returns (offset, writable view). Spills/evicts
+        under pressure (reference: create_request_queue.cc backpressure)."""
+        cfg = global_config()
+        deadline = time.monotonic() + 30.0
+        while True:
+            off = self.arena.allocator.allocate(size)
+            if off is not None:
+                break
+            if not self._reclaim(size):
+                if time.monotonic() > deadline:
+                    raise ObjectStoreFullError(
+                        f"object store full: need {size} bytes "
+                        f"(capacity {self.capacity})"
+                    )
+                time.sleep(cfg.object_store_full_delay_ms / 1000.0)
+        with self._lock:
+            stale = self._entries.get(oid)
+            if stale is not None and stale.offset >= 0 and stale.spilled_path is None:
+                self.arena.allocator.free(stale.offset)  # retry overwrote entry
+            self._entries[oid] = ObjectEntry(oid, size=size, offset=off, creating=True)
+        return off, self.arena.view(off, size)
+
+    def seal(self, oid: ObjectID, is_error: bool = False):
+        with self._lock:
+            e = self._entries[oid]
+            e.sealed = True
+            e.creating = False
+            e.is_error = is_error
+            self._sealed_cv.notify_all()
+
+    # -- reads -------------------------------------------------------------
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(oid)
+            return e is not None and e.sealed
+
+    def wait_sealed(self, oid: ObjectID, timeout: Optional[float] = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._sealed_cv:
+            while True:
+                e = self._entries.get(oid)
+                if e is not None and e.sealed:
+                    return True
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._sealed_cv.wait(remaining if remaining is not None else 1.0)
+
+    def get_payload(self, oid: ObjectID) -> Tuple[object, bool]:
+        """Returns (buffer, is_error). Buffer is bytes (inline) or a zero-copy
+        memoryview into the arena; restores from spill if needed."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None or not e.sealed:
+                raise ObjectLostError(oid, f"object {oid.hex()} not in local store")
+            e.last_access = time.monotonic()
+            if e.inline is not None:
+                return e.inline, e.is_error
+            if e.spilled_path is not None:
+                self._restore_locked(e)
+            return self.arena.view(e.offset, e.size), e.is_error
+
+    def entry_info(self, oid: ObjectID) -> Optional[Tuple[int, int, bool]]:
+        """(offset, size, is_error) for sealed arena objects, for direct worker
+        mmap reads; None if inline/absent/spilled."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None or not e.sealed or e.inline is not None:
+                return None
+            if e.spilled_path is not None:
+                self._restore_locked(e)
+            e.last_access = time.monotonic()
+            return e.offset, e.size, e.is_error
+
+    # -- lifetime ----------------------------------------------------------
+
+    def add_ref(self, oid: ObjectID, n: int = 1):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None:
+                e.ref_count += n
+
+    def remove_ref(self, oid: ObjectID, n: int = 1):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None:
+                e.ref_count = max(0, e.ref_count - n)
+
+    def delete(self, oid: ObjectID):
+        with self._lock:
+            e = self._entries.pop(oid, None)
+            if e is None:
+                return
+            if e.offset >= 0 and e.spilled_path is None:
+                self.arena.allocator.free(e.offset)
+            if e.spilled_path:
+                try:
+                    os.unlink(e.spilled_path)
+                except OSError:
+                    pass
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "num_objects": len(self._entries),
+                "bytes_allocated": self.arena.allocator.bytes_allocated(),
+                "capacity": self.capacity,
+                "num_spilled": sum(1 for e in self._entries.values() if e.spilled_path),
+            }
+
+    # -- spilling / eviction ----------------------------------------------
+
+    def _reclaim(self, need: int) -> bool:
+        """Evict unreferenced sealed objects (LRU), then spill referenced ones."""
+        cfg = global_config()
+        with self._lock:
+            candidates = sorted(
+                (e for e in self._entries.values()
+                 if e.sealed and e.offset >= 0 and e.spilled_path is None),
+                key=lambda e: e.last_access,
+            )
+            freed = 0
+            for e in candidates:
+                if freed >= need:
+                    break
+                if e.ref_count <= 0:
+                    self.arena.allocator.free(e.offset)
+                    del self._entries[e.object_id]
+                    freed += e.size
+            if freed >= need:
+                return True
+            if not cfg.object_spilling_enabled:
+                return freed > 0
+            for e in candidates:
+                if freed >= need:
+                    break
+                if e.object_id not in self._entries:
+                    continue
+                self._spill_locked(e)
+                freed += e.size
+            return freed > 0
+
+    def _spill_locked(self, e: ObjectEntry):
+        path = os.path.join(self.spill_dir, e.object_id.hex())
+        with open(path, "wb") as f:
+            f.write(self.arena.view(e.offset, e.size))
+        self.arena.allocator.free(e.offset)
+        e.spilled_path = path
+        e.offset = -1
+
+    def _restore_locked(self, e: ObjectEntry):
+        off = self.arena.allocator.allocate(e.size)
+        if off is None:
+            self._reclaim(e.size)
+            off = self.arena.allocator.allocate(e.size)
+            if off is None:
+                raise ObjectStoreFullError("cannot restore spilled object")
+        with open(e.spilled_path, "rb") as f:
+            data = f.read()
+        self.arena.view(off, e.size)[:] = data
+        try:
+            os.unlink(e.spilled_path)
+        except OSError:
+            pass
+        e.spilled_path = None
+        e.offset = off
+
+    def close(self):
+        self.arena.close(unlink=True)
+
+
+class ArenaClient:
+    """Worker-side read/write mapping of a node's arena (plasma client analog)."""
+
+    def __init__(self, arena_path: str, capacity: int):
+        self.arena = PlasmaArena(arena_path, capacity, create=False)
+
+    def view(self, offset: int, size: int) -> memoryview:
+        return self.arena.view(offset, size)
+
+    def close(self):
+        self.arena.close(unlink=False)

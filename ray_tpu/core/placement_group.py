@@ -1,0 +1,73 @@
+"""Public placement-group API.
+
+Analog of ``python/ray/util/placement_group.py`` (:145) in the reference:
+atomic gang reservation of resource bundles across nodes with
+PACK/SPREAD/STRICT_PACK/STRICT_SPREAD strategies, consumed by tasks/actors
+via ``PlacementGroupSchedulingStrategy``. The TPU-specific idiom: one bundle
+per pod-slice host with ``{"TPU": chips_per_host, "CPU": ...}`` and
+STRICT_SPREAD, giving JAX gang scheduling (one worker process per host).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .ids import PlacementGroupID
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str = "PACK", name: str = ""):
+        self.id = pg_id
+        self.bundle_specs = bundles
+        self.strategy = strategy
+        self.name = name
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def ready(self, timeout: Optional[float] = None) -> bool:
+        from .runtime import get_current_runtime
+
+        rt = get_current_runtime()
+        return rt.placement_group_op("ready", self.id,
+                                     timeout if timeout is not None else 3600.0)
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        return self.ready(timeout_seconds)
+
+    def state(self) -> Optional[dict]:
+        from .runtime import get_current_runtime
+
+        return get_current_runtime().placement_group_op("state", self.id)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundle_specs, self.strategy, self.name))
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "", lifetime: Optional[str] = None) -> PlacementGroup:
+    from .runtime import get_current_runtime
+
+    rt = get_current_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    pg_id = rt.create_placement_group(bundles, strategy, name)
+    return PlacementGroup(pg_id, bundles, strategy, name)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    from .runtime import get_current_runtime
+
+    get_current_runtime().placement_group_op("remove", pg.id)
+
+
+def placement_group_table(pg: Optional[PlacementGroup] = None):
+    from .runtime import get_current_runtime
+
+    rt = get_current_runtime()
+    if pg is not None:
+        return rt.placement_group_op("state", pg.id)
+    return None

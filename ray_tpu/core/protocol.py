@@ -1,0 +1,119 @@
+"""Worker <-> node wire protocol.
+
+Analog of the reference's gRPC ``CoreWorkerService``/``NodeManagerService``
+split, collapsed for a single-machine node: each worker process holds one
+authenticated unix-socket connection to its node (``multiprocessing.connection``
+framing, pickle payloads). Messages are tagged tuples; both ends run a reader
+thread and dispatch by tag, so calls in both directions interleave freely
+(needed for async actors and nested task submission — reference:
+core_worker.proto:439 direct worker push).
+
+Tags (worker -> node):
+    register(worker_id, pid)        -- handshake
+    done(task_id, results, err)     -- task finished; results inline or sealed
+    store(req_id, op, *args)        -- blocking store ops (get/create/seal/..)
+    rpc(req_id, op, *args)          -- control-plane ops (submit, actors, kv)
+    release(object_ids)             -- batched ref releases
+
+Tags (node -> worker):
+    exec(task_payload)              -- run a task
+    cancel(task_id)
+    rep(req_id, ok, value)          -- reply to store/rpc
+    shutdown()
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from multiprocessing import connection as mpc
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+class Channel:
+    """Thread-safe duplex message channel over a multiprocessing Connection."""
+
+    def __init__(self, conn):
+        self.conn = conn
+        self._send_lock = threading.Lock()
+        self.closed = False
+
+    def send(self, tag: str, *payload) -> None:
+        with self._send_lock:
+            self.conn.send((tag, payload))
+
+    def recv(self) -> Tuple[str, tuple]:
+        return self.conn.recv()
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class RpcClient:
+    """Request/reply layer over a Channel (used by workers toward the node)."""
+
+    def __init__(self, channel: Channel):
+        self.channel = channel
+        self._counter = itertools.count()
+        self._pending: Dict[int, "Future"] = {}
+        self._lock = threading.Lock()
+
+    def call(self, tag: str, op: str, *args, timeout: Optional[float] = None) -> Any:
+        req_id = next(self._counter)
+        fut = Future()
+        with self._lock:
+            self._pending[req_id] = fut
+        self.channel.send(tag, req_id, op, *args)
+        return fut.result(timeout)
+
+    def handle_reply(self, req_id: int, ok: bool, value: Any) -> None:
+        with self._lock:
+            fut = self._pending.pop(req_id, None)
+        if fut is None:
+            return
+        if ok:
+            fut.set_result(value)
+        else:
+            fut.set_exception(value)
+
+    def fail_all(self, exc: Exception) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            fut.set_exception(exc)
+
+
+class Future:
+    __slots__ = ("_event", "_value", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def set_result(self, v):
+        self._value = v
+        self._event.set()
+
+    def set_exception(self, e):
+        self._exc = e
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("rpc timeout")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+def make_listener(path: str, authkey: bytes) -> mpc.Listener:
+    return mpc.Listener(address=path, family="AF_UNIX", authkey=authkey)
+
+
+def connect(path: str, authkey: bytes) -> Channel:
+    return Channel(mpc.Client(address=path, family="AF_UNIX", authkey=authkey))

@@ -1,0 +1,147 @@
+"""@remote functions.
+
+Analog of ``python/ray/remote_function.py`` in the reference: wraps a Python
+function, registers its cloudpickle payload in the GCS function table once
+(reference: function_manager.py export), and turns ``.remote(...)`` calls into
+TaskSpec submissions. Small args are inlined into the spec; args above the
+inline threshold are promoted to the object store and passed by reference
+(reference: core_worker.cc:2166 + max_direct_call_object_size).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from . import serialization
+from .config import global_config
+from .ids import ObjectID, TaskID
+from .object_ref import ObjectRef
+from .resources import parse_task_resources
+from .task_spec import SchedulingStrategy, TaskSpec
+
+
+def _function_id(payload: bytes) -> str:
+    return hashlib.blake2b(payload, digest_size=16).hexdigest()
+
+
+def prepare_args(runtime, args, kwargs) -> Tuple[list, dict, List[ObjectID]]:
+    cfg = global_config()
+    pinned: List[ObjectID] = []
+
+    def conv(a):
+        if isinstance(a, ObjectRef):
+            return ("ref", a.id)
+        s = serialization.serialize(a)
+        if s.total_bytes > cfg.max_direct_call_object_size:
+            ref = runtime.put(a)
+            pinned.append(ref.id)
+            return ("ref", ref.id)
+        return ("v", s.to_bytes())
+
+    out_args = [conv(a) for a in args]
+    out_kwargs = {k: conv(v) for k, v in kwargs.items()}
+    return out_args, out_kwargs, pinned
+
+
+def resolve_scheduling_strategy(strategy) -> SchedulingStrategy:
+    if strategy is None or strategy == "DEFAULT":
+        return SchedulingStrategy("DEFAULT")
+    if strategy == "SPREAD":
+        return SchedulingStrategy("SPREAD")
+    if isinstance(strategy, SchedulingStrategy):
+        return strategy
+    # duck-typed public strategies from util.scheduling_strategies
+    kind = type(strategy).__name__
+    if kind == "NodeAffinitySchedulingStrategy":
+        nid = strategy.node_id
+        return SchedulingStrategy("NODE_AFFINITY",
+                                  node_id=nid if isinstance(nid, str) else nid,
+                                  soft=strategy.soft)
+    if kind == "PlacementGroupSchedulingStrategy":
+        pg = strategy.placement_group
+        return SchedulingStrategy(
+            "PLACEMENT_GROUP",
+            placement_group_id=pg.id,
+            bundle_index=strategy.placement_group_bundle_index
+            if strategy.placement_group_bundle_index is not None else -1,
+            capture_child_tasks=strategy.placement_group_capture_child_tasks or False,
+        )
+    raise ValueError(f"unsupported scheduling strategy {strategy!r}")
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._fn = fn
+        self._options = dict(options or {})
+        self._payload = cloudpickle.dumps(fn)
+        self._function_id = _function_id(self._payload)
+        self._registered_with = None
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def options(self, **overrides) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(overrides)
+        clone = RemoteFunction.__new__(RemoteFunction)
+        clone._fn = self._fn
+        clone._options = merged
+        clone._payload = self._payload
+        clone._function_id = self._function_id
+        clone._registered_with = self._registered_with
+        clone.__name__ = self.__name__
+        clone.__doc__ = self.__doc__
+        return clone
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self.__name__}() cannot be called directly; "
+            f"use {self.__name__}.remote()."
+        )
+
+    def _ensure_registered(self, runtime) -> None:
+        if self._registered_with is not runtime:
+            runtime.register_function(self._function_id, self._payload)
+            self._registered_with = runtime
+
+    def remote(self, *args, **kwargs):
+        from .runtime import get_current_runtime
+
+        runtime = get_current_runtime()
+        if runtime is None:
+            raise RuntimeError("ray_tpu.init() has not been called")
+        self._ensure_registered(runtime)
+        opt = self._options
+        out_args, out_kwargs, pinned = prepare_args(runtime, args, kwargs)
+        num_returns = opt.get("num_returns", 1)
+        spec = TaskSpec(
+            task_id=runtime.next_task_id(),
+            job_id=runtime.runtime_context()["job_id"],
+            function_id=self._function_id,
+            function_name=self.__name__,
+            args=out_args,
+            kwargs=out_kwargs,
+            num_returns=num_returns,
+            resources=parse_task_resources(
+                num_cpus=opt.get("num_cpus"),
+                num_tpus=opt.get("num_tpus"),
+                num_gpus=opt.get("num_gpus"),
+                resources=opt.get("resources"),
+                memory=opt.get("memory"),
+                default_num_cpus=1.0,
+            ),
+            max_retries=opt.get("max_retries", 3),
+            retry_exceptions=bool(opt.get("retry_exceptions", False)),
+            scheduling_strategy=resolve_scheduling_strategy(
+                opt.get("scheduling_strategy")),
+            runtime_env=opt.get("runtime_env"),
+            pinned_args=pinned,
+        )
+        refs = runtime.submit_task(spec)
+        if num_returns == 0:
+            return None
+        if num_returns == 1:
+            return refs[0]
+        return refs

@@ -1,0 +1,153 @@
+"""Resource model with TPU unit instances.
+
+Analog of ``src/ray/common/scheduling/cluster_resource_data.h`` and
+``fixed_point.h`` in the reference: resource quantities are fixed-point
+(1/10000 granularity) so fractional resources compare exactly; resources named
+in ``Config.unit_instance_resources`` (TPU, GPU, ...) are tracked as *unit
+instances* — each whole unit is an indexable device slot, so a task asking for
+``num_tpus=4`` is bound to concrete chip indices and gets
+``TPU_VISIBLE_CHIPS``-style isolation (reference: accelerators/tpu.py:155-195).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+GRANULARITY = 10_000  # fixed-point denominator (reference fixed_point.h)
+
+
+def to_fixed(v: float) -> int:
+    return int(round(v * GRANULARITY))
+
+
+def from_fixed(v: int) -> float:
+    return v / GRANULARITY
+
+
+class ResourceSet:
+    """A bag of named fixed-point resource quantities."""
+
+    __slots__ = ("_map",)
+
+    def __init__(self, resources: Optional[Dict[str, float]] = None):
+        self._map: Dict[str, int] = {}
+        if resources:
+            for k, v in resources.items():
+                if v:
+                    self._map[k] = to_fixed(v)
+
+    @classmethod
+    def _from_fixed_map(cls, m: Dict[str, int]) -> "ResourceSet":
+        rs = cls()
+        rs._map = dict(m)
+        return rs
+
+    def get(self, name: str) -> float:
+        return from_fixed(self._map.get(name, 0))
+
+    def to_dict(self) -> Dict[str, float]:
+        return {k: from_fixed(v) for k, v in self._map.items()}
+
+    def is_empty(self) -> bool:
+        return not any(self._map.values())
+
+    def is_subset_of(self, other: "ResourceSet") -> bool:
+        return all(other._map.get(k, 0) >= v for k, v in self._map.items())
+
+    def __iter__(self):
+        return iter(self._map.items())
+
+    def __repr__(self):
+        return f"ResourceSet({self.to_dict()})"
+
+    def __eq__(self, other):
+        return isinstance(other, ResourceSet) and self._map == other._map
+
+
+class NodeResources:
+    """Total + available resources of one node, with unit-instance tracking.
+
+    Reference: NodeResources / LocalResourceManager instance-level accounting
+    (``local_resource_manager.h``). Unit-instance resources also carry a free
+    list of device indices so leases bind to concrete chips.
+    """
+
+    def __init__(self, total: Dict[str, float], unit_instance_names=("TPU", "GPU")):
+        self.total = ResourceSet(total)
+        self.available: Dict[str, int] = {k: v for k, v in self.total}
+        self.unit_instance_names = set(unit_instance_names)
+        self.free_instances: Dict[str, List[int]] = {}
+        self.labels: Dict[str, str] = {}
+        for name, fixed_amt in self.total:
+            if name in self.unit_instance_names:
+                n = int(from_fixed(fixed_amt))
+                self.free_instances[name] = list(range(n))
+
+    def can_fit(self, req: ResourceSet) -> bool:
+        return all(self.available.get(k, 0) >= v for k, v in req)
+
+    def utilization(self) -> float:
+        """Critical-resource utilization in [0,1] (for the hybrid policy)."""
+        utils = []
+        for name, tot in self.total:
+            if tot <= 0:
+                continue
+            avail = self.available.get(name, 0)
+            utils.append(1.0 - avail / tot)
+        return max(utils) if utils else 0.0
+
+    def allocate(self, req: ResourceSet) -> Optional[Dict[str, List[int]]]:
+        """Acquire; returns {resource: [instance indices]} for unit resources,
+        or None if it doesn't fit. Fractional requests of unit resources
+        (e.g. 0.5 TPU) share instance 0-style binding like the reference."""
+        if not self.can_fit(req):
+            return None
+        binding: Dict[str, List[int]] = {}
+        for name, amt in req:
+            self.available[name] = self.available.get(name, 0) - amt
+            if name in self.free_instances:
+                whole = int(from_fixed(amt))
+                if whole > 0:
+                    idxs = self.free_instances[name][:whole]
+                    self.free_instances[name] = self.free_instances[name][whole:]
+                    binding[name] = idxs
+                else:
+                    # fractional: bind to the first (possibly shared) instance
+                    binding[name] = self.free_instances[name][:1]
+        return binding
+
+    def release(self, req: ResourceSet, binding: Optional[Dict[str, List[int]]] = None):
+        for name, amt in req:
+            self.available[name] = self.available.get(name, 0) + amt
+            if binding and name in binding and int(from_fixed(amt)) > 0:
+                self.free_instances[name] = sorted(
+                    self.free_instances.get(name, []) + binding[name]
+                )
+
+    def view(self) -> Dict[str, float]:
+        return {k: from_fixed(v) for k, v in self.available.items()}
+
+
+def parse_task_resources(
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    num_gpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    memory: Optional[int] = None,
+    default_num_cpus: float = 1.0,
+) -> ResourceSet:
+    """Merge per-task options into a ResourceSet (reference: ray_option_utils.py)."""
+    out: Dict[str, float] = {}
+    out["CPU"] = default_num_cpus if num_cpus is None else num_cpus
+    if num_tpus:
+        out["TPU"] = num_tpus
+    if num_gpus:
+        out["GPU"] = num_gpus
+    if memory:
+        out["memory"] = float(memory)
+    if resources:
+        for k, v in resources.items():
+            if k in ("CPU", "TPU", "GPU"):
+                raise ValueError(f"Use num_cpus/num_tpus/num_gpus for {k}")
+            out[k] = v
+    return ResourceSet(out)

@@ -1,0 +1,864 @@
+"""Head (control-plane hub) + driver runtime.
+
+The Head is the analog of the reference's GCS server process *plus* the
+driver-side CoreWorker ownership machinery collapsed into the driver process:
+
+- task records with retries & dependency resolution before scheduling
+  (reference: task_manager.cc + transport/dependency_resolver.cc),
+- actor lifecycle FSM with restarts (reference: gcs_actor_manager.cc),
+- object directory + node-to-node transfer on demand (reference:
+  object_manager.cc pull/push),
+- lineage-based object reconstruction: lost large objects are re-created by
+  resubmitting the task that produced them (reference:
+  object_recovery_manager.h:90, lineage_pinning_enabled),
+- the public driver API surface: put/get/wait/submit (reference:
+  python/ray/_private/worker.py).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import threading
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from . import serialization
+from .config import global_config
+from .exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .gcs import GCS, ActorInfo, JobInfo, NodeInfo, TaskEvent
+from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .node import Node, WorkerHandle
+from .object_ref import ObjectRef
+from .scheduler import ClusterScheduler, PlacementGroup
+from .task_spec import TaskSpec
+
+
+@dataclass
+class TaskRecord:
+    spec: TaskSpec
+    state: str = "PENDING"  # PENDING | WAITING_DEPS | QUEUED | RUNNING | FINISHED | FAILED
+    node_hex: Optional[str] = None
+    binding: Optional[dict] = None
+    worker_id: Optional[WorkerID] = None
+    missing_deps: Set[ObjectID] = field(default_factory=set)
+    cancelled: bool = False
+
+
+@dataclass
+class ActorRecord:
+    actor_id: ActorID
+    creation_spec: TaskSpec
+    state: str = "PENDING_CREATION"
+    node_hex: Optional[str] = None
+    worker_id: Optional[WorkerID] = None
+    pending: deque = field(default_factory=deque)  # queued method specs
+    inflight: Set[TaskID] = field(default_factory=set)
+    max_restarts: int = 0
+    num_restarts: int = 0
+    death_cause: Optional[str] = None
+
+
+class Head:
+    """Cluster brain living in the driver process."""
+
+    def __init__(self, resources: Dict[str, float], session_dir: Optional[str] = None,
+                 labels: Optional[Dict[str, str]] = None):
+        self.session_dir = session_dir or tempfile.mkdtemp(prefix="raytpu_session_")
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.job_id = JobID.from_random()
+        self.gcs = GCS()
+        self.gcs.add_job(JobInfo(self.job_id))
+        self.scheduler = ClusterScheduler(self._dispatch_to_node)
+        self.nodes: Dict[str, Node] = {}
+        self._lock = threading.RLock()
+        self._object_cv = threading.Condition(self._lock)
+        self.tasks: Dict[TaskID, TaskRecord] = {}
+        self.actors: Dict[ActorID, ActorRecord] = {}
+        self._waiting_on: Dict[ObjectID, Set[TaskID]] = defaultdict(set)
+        self.ref_counts: Dict[ObjectID, int] = defaultdict(int)
+        self._stopped = False
+        # head node (the driver's node)
+        self.head_node = self.add_node(resources, labels=labels)
+
+    # ------------------------------------------------------------ membership
+
+    def add_node(self, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None) -> Node:
+        node = Node(self, NodeID.from_random(), resources, self.session_dir, labels)
+        with self._lock:
+            self.nodes[node.hex] = node
+        self.gcs.register_node(NodeInfo(node.node_id, node.hex,
+                                        resources_total=dict(resources),
+                                        labels=labels or {}))
+        self.scheduler.add_node(node.hex, node.resources)
+        return node
+
+    def remove_node(self, node_hex: str) -> None:
+        """Simulate/handle node death (reference: gcs_node_manager node death
+        broadcast + object/actor failover)."""
+        with self._lock:
+            node = self.nodes.pop(node_hex, None)
+        if node is None:
+            return
+        self.scheduler.remove_node(node_hex)
+        self.gcs.mark_node_dead(node_hex)
+        node.shutdown()
+        lost = self.gcs.drop_node_objects(node_hex)
+        # fail/retry running tasks that were on the node
+        with self._lock:
+            affected = [r for r in self.tasks.values()
+                        if r.state == "RUNNING" and r.node_hex == node_hex]
+            dead_actors = [a for a in self.actors.values()
+                           if a.node_hex == node_hex and a.state in ("ALIVE", "PENDING_CREATION")]
+        for rec in affected:
+            self._handle_task_failure(rec, WorkerCrashedError("node died"), results=None)
+        for arec in dead_actors:
+            self._handle_actor_failure(arec, "node died")
+        with self._object_cv:
+            self._object_cv.notify_all()
+
+    # ------------------------------------------------------------ submission
+
+    def submit_spec(self, spec: TaskSpec) -> None:
+        rec = TaskRecord(spec)
+        with self._lock:
+            self.tasks[spec.task_id] = rec
+        self._record_event(spec, "PENDING")
+        if spec.actor_id is not None and not spec.is_actor_creation:
+            self._submit_actor_task(rec)
+        else:
+            self._resolve_then_queue(rec)
+
+    def _resolve_then_queue(self, rec: TaskRecord) -> None:
+        spec = rec.spec
+        missing = set()
+        with self._lock:
+            for oid in spec.arg_object_ids():
+                if not self.gcs.get_object_locations(oid):
+                    missing.add(oid)
+            if missing:
+                rec.state = "WAITING_DEPS"
+                rec.missing_deps = missing
+                for oid in missing:
+                    self._waiting_on[oid].add(spec.task_id)
+                return
+            rec.state = "QUEUED"
+        self.scheduler.submit(spec)
+
+    def _submit_actor_task(self, rec: TaskRecord) -> None:
+        spec = rec.spec
+        with self._lock:
+            arec = self.actors.get(spec.actor_id)
+            if arec is None:
+                self._fail_task_now(rec, ActorDiedError(spec.actor_id, "unknown actor"))
+                return
+            if arec.state == "DEAD":
+                self._fail_task_now(
+                    rec, ActorDiedError(spec.actor_id, arec.death_cause or "actor is dead")
+                )
+                return
+            if arec.state in ("PENDING_CREATION", "RESTARTING"):
+                arec.pending.append(spec)
+                return
+            arec.inflight.add(spec.task_id)
+            node = self.nodes.get(arec.node_hex)
+            worker_id = arec.worker_id
+        rec.state = "RUNNING"
+        rec.node_hex = arec.node_hex
+        rec.worker_id = worker_id
+        if node is None or not node.dispatch_to_worker(worker_id, spec):
+            self._handle_task_failure(rec, ActorDiedError(spec.actor_id, "actor node/worker gone"),
+                                      results=None)
+
+    def create_actor(self, spec: TaskSpec, name: Optional[str], namespace: str,
+                     max_restarts: int, detached: bool) -> None:
+        arec = ActorRecord(spec.actor_id, creation_spec=spec, max_restarts=max_restarts)
+        with self._lock:
+            self.actors[spec.actor_id] = arec
+        self.gcs.register_actor(ActorInfo(
+            actor_id=spec.actor_id, name=name, namespace=namespace,
+            class_name=spec.function_name, state="PENDING_CREATION",
+            max_restarts=max_restarts, detached=detached, creation_spec=None,
+        ))
+        self.submit_spec(spec)
+
+    # ------------------------------------------------------------ dispatch cb
+
+    def _dispatch_to_node(self, node_hex: str, spec: TaskSpec, binding: dict) -> None:
+        with self._lock:
+            rec = self.tasks.get(spec.task_id)
+            node = self.nodes.get(node_hex)
+            if rec is not None and rec.cancelled:
+                self.scheduler.release(node_hex, spec, binding)
+                return
+            if rec is not None:
+                rec.state = "RUNNING"
+                rec.node_hex = node_hex
+                rec.binding = binding
+        self._record_event(spec, "RUNNING", node_hex)
+        if node is None:
+            if rec:
+                self._handle_task_failure(rec, WorkerCrashedError("node gone"), None)
+            return
+        node.dispatch(spec, binding)
+
+    # ------------------------------------------------------------ completion
+
+    def on_task_finished(self, node: Node, task_id: TaskID, err_name: Optional[str],
+                         node_spec: Optional[TaskSpec], node_binding: Optional[dict],
+                         results: List[Tuple[ObjectID, Optional[bytes], bool]]) -> None:
+        with self._lock:
+            rec = self.tasks.get(task_id)
+        if rec is None:
+            self._seal_results(node, results)
+            return
+        spec = rec.spec
+        # Release resources for non-actor-method tasks. A successful actor
+        # creation keeps its resources for the actor's lifetime; a failed one
+        # must give them back.
+        if spec.actor_id is None or spec.is_actor_creation:
+            if not (spec.is_actor_creation and err_name is None):
+                self.scheduler.release(rec.node_hex or node.hex, spec,
+                                       rec.binding or node_binding or {})
+        if rec.cancelled:
+            # already sealed TaskCancelledError; drop the late results
+            return
+        if err_name is not None:
+            retriable = self._is_retriable(spec, err_name)
+            if retriable:
+                self._retry_task(rec, results)
+                return
+            rec.state = "FAILED"
+            self._record_event(spec, "FAILED", node.hex, error=err_name)
+            self._seal_results(node, results)
+            if spec.is_actor_creation:
+                self._on_actor_creation_failed(spec, err_name)
+            self._after_seal(spec)
+            return
+        rec.state = "FINISHED"
+        self._record_event(spec, "FINISHED", node.hex)
+        self._seal_results(node, results)
+        if spec.is_actor_creation:
+            self._on_actor_alive(spec, node)
+        if spec.actor_id is not None and not spec.is_actor_creation:
+            with self._lock:
+                arec = self.actors.get(spec.actor_id)
+                if arec:
+                    arec.inflight.discard(task_id)
+        self._after_seal(spec)
+
+    def _seal_results(self, node: Node, results) -> None:
+        for oid, payload, is_error in results:
+            if payload is not None:
+                node.store.put_inline(oid, payload, is_error)
+            self.on_object_sealed(oid, node.hex)
+
+    def _after_seal(self, spec: TaskSpec) -> None:
+        self.scheduler.kick()
+
+    def _is_retriable(self, spec: TaskSpec, err_name: str) -> bool:
+        if spec.attempt >= spec.max_retries:
+            return False
+        system_errors = ("WorkerCrashedError", "NodeDiedError", "ActorDiedError")
+        if err_name in system_errors:
+            return spec.actor_id is None or spec.is_actor_creation
+        return spec.retry_exceptions
+
+    def _retry_task(self, rec: TaskRecord, results) -> None:
+        cfg = global_config()
+        spec = rec.spec
+        spec.attempt += 1
+        rec.state = "PENDING"
+        rec.node_hex = None
+        rec.binding = None
+        self._record_event(spec, "RETRY")
+        delay = cfg.task_retry_delay_ms / 1000.0
+
+        def _resubmit():
+            if delay:
+                time.sleep(delay)
+            if spec.actor_id is not None and not spec.is_actor_creation:
+                self._submit_actor_task(rec)
+            else:
+                self._resolve_then_queue(rec)
+
+        threading.Thread(target=_resubmit, daemon=True).start()
+
+    def _fail_task_now(self, rec: TaskRecord, exc: Exception) -> None:
+        rec.state = "FAILED"
+        err = exc if isinstance(exc, (ActorDiedError, TaskCancelledError, ObjectLostError)) \
+            else TaskError.from_exception(rec.spec.function_name, exc)
+        payload = serialization.serialize(err).to_bytes()
+        node = self.head_node
+        for oid in rec.spec.return_ids():
+            node.store.put_inline(oid, payload, is_error=True)
+            self.on_object_sealed(oid, node.hex)
+
+    def _handle_task_failure(self, rec: TaskRecord, exc: Exception, results) -> None:
+        spec = rec.spec
+        if spec.actor_id is None or spec.is_actor_creation:
+            self.scheduler.release(rec.node_hex or "", spec, rec.binding or {})
+        if self._is_retriable(spec, type(exc).__name__):
+            self._retry_task(rec, results)
+        else:
+            self._record_event(spec, "FAILED", rec.node_hex, error=str(exc))
+            self._fail_task_now(rec, exc)
+            if spec.is_actor_creation:
+                self._on_actor_creation_failed(spec, str(exc))
+
+    # ------------------------------------------------------------ actors
+
+    def _on_actor_alive(self, spec: TaskSpec, node: Node) -> None:
+        flush = []
+        with self._lock:
+            arec = self.actors.get(spec.actor_id)
+            if arec is None:
+                return
+            arec.state = "ALIVE"
+            arec.node_hex = node.hex
+            with node._lock:
+                for w in node._workers.values():
+                    if w.actor_id == spec.actor_id:
+                        arec.worker_id = w.worker_id
+                        break
+            while arec.pending:
+                flush.append(arec.pending.popleft())
+        self.gcs.update_actor(spec.actor_id, state="ALIVE", node_hex=node.hex)
+        for mspec in flush:
+            rec = self.tasks.get(mspec.task_id)
+            if rec is not None:
+                self._submit_actor_task(rec)
+
+    def _on_actor_creation_failed(self, spec: TaskSpec, cause: str) -> None:
+        with self._lock:
+            arec = self.actors.get(spec.actor_id)
+            if arec is None:
+                return
+            arec.state = "DEAD"
+            arec.death_cause = f"creation failed: {cause}"
+            pending = list(arec.pending)
+            arec.pending.clear()
+        self.gcs.update_actor(spec.actor_id, state="DEAD", death_cause=cause)
+        self.gcs.remove_actor_name(spec.actor_id)
+        for mspec in pending:
+            rec = self.tasks.get(mspec.task_id)
+            if rec is not None:
+                self._fail_task_now(rec, ActorDiedError(spec.actor_id, arec.death_cause))
+
+    def _handle_actor_failure(self, arec: ActorRecord, cause: str) -> None:
+        """Worker/node hosting the actor died (reference: ReconstructActor)."""
+        with self._lock:
+            if arec.state == "DEAD":
+                return
+            restart = arec.num_restarts < arec.max_restarts or arec.max_restarts == -1
+            inflight = list(arec.inflight)
+            arec.inflight.clear()
+            if restart:
+                arec.state = "RESTARTING"
+                arec.num_restarts += 1
+            else:
+                arec.state = "DEAD"
+                arec.death_cause = cause
+                pending = list(arec.pending)
+                arec.pending.clear()
+        # fail in-flight method calls (they may be retried onto the new
+        # incarnation per max_task_retries -> retry_exceptions semantics)
+        for tid in inflight:
+            rec = self.tasks.get(tid)
+            if rec is not None and rec.state == "RUNNING":
+                if rec.spec.max_retries > rec.spec.attempt and rec.spec.retry_exceptions:
+                    self._retry_task(rec, None)
+                else:
+                    self._fail_task_now(rec, ActorDiedError(arec.actor_id, cause))
+        if restart:
+            self.gcs.update_actor(arec.actor_id, state="RESTARTING")
+            # release old incarnation's resources and resubmit creation
+            cspec = arec.creation_spec
+            crec_old = self.tasks.get(cspec.task_id)
+            if crec_old is not None:
+                self.scheduler.release(crec_old.node_hex or "", cspec,
+                                       crec_old.binding or {})
+            import copy
+
+            new_spec = copy.deepcopy(cspec)
+            new_spec.task_id = TaskID.from_random()
+            arec.creation_spec = new_spec
+            with self._lock:
+                self.tasks[new_spec.task_id] = TaskRecord(new_spec)
+            self._resolve_then_queue(self.tasks[new_spec.task_id])
+        else:
+            self.gcs.update_actor(arec.actor_id, state="DEAD", death_cause=cause)
+            self.gcs.remove_actor_name(arec.actor_id)
+            for mspec in pending:
+                rec = self.tasks.get(mspec.task_id)
+                if rec is not None:
+                    self._fail_task_now(rec, ActorDiedError(arec.actor_id, cause))
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        with self._lock:
+            arec = self.actors.get(actor_id)
+            if arec is None:
+                return
+            if no_restart:
+                arec.max_restarts = arec.num_restarts  # exhaust restarts
+            node = self.nodes.get(arec.node_hex)
+            worker_id = arec.worker_id
+        if node is not None and worker_id is not None:
+            node.kill_worker(worker_id)
+        # crash path in node reader will drive _handle_actor_failure
+
+    # ------------------------------------------------------------ worker events
+
+    def on_worker_exit(self, node: Node, w: WorkerHandle) -> None:
+        """Graceful actor termination (__ray_terminate__)."""
+        if w.actor_id is not None:
+            with self._lock:
+                arec = self.actors.get(w.actor_id)
+                if arec is not None:
+                    arec.state = "DEAD"
+                    arec.death_cause = "actor exited gracefully"
+                    pending = list(arec.pending)
+                    arec.pending.clear()
+                else:
+                    pending = []
+            self.gcs.update_actor(w.actor_id, state="DEAD",
+                                  death_cause="exited gracefully")
+            self.gcs.remove_actor_name(w.actor_id)
+            cspec = arec.creation_spec if arec else None
+            if cspec is not None:
+                crec = self.tasks.get(cspec.task_id)
+                if crec is not None:
+                    self.scheduler.release(crec.node_hex or "", cspec, crec.binding or {})
+            for mspec in pending:
+                rec = self.tasks.get(mspec.task_id)
+                if rec is not None:
+                    self._fail_task_now(rec, ActorDiedError(w.actor_id, "actor exited"))
+
+    def on_worker_crashed(self, node: Node, w: WorkerHandle,
+                          spec: Optional[TaskSpec], binding: Optional[dict],
+                          prev_state: str) -> None:
+        if self._stopped or not node.alive:
+            return
+        if w.actor_id is not None:
+            with self._lock:
+                arec = self.actors.get(w.actor_id)
+            if arec is not None:
+                self._handle_actor_failure(arec, "actor worker process died")
+            return
+        if spec is not None:
+            rec = self.tasks.get(spec.task_id)
+            if rec is not None:
+                self._handle_task_failure(
+                    rec, WorkerCrashedError(
+                        f"worker pid={w.pid} died executing {spec.function_name}"),
+                    None)
+
+    # ------------------------------------------------------------ objects
+
+    def on_object_sealed(self, oid: ObjectID, node_hex: str) -> None:
+        self.gcs.add_object_location(oid, node_hex)
+        waiters: List[TaskID] = []
+        with self._object_cv:
+            if oid in self._waiting_on:
+                for tid in self._waiting_on.pop(oid):
+                    rec = self.tasks.get(tid)
+                    if rec is None:
+                        continue
+                    rec.missing_deps.discard(oid)
+                    if not rec.missing_deps and rec.state == "WAITING_DEPS":
+                        waiters.append(tid)
+            self._object_cv.notify_all()
+        for tid in waiters:
+            rec = self.tasks.get(tid)
+            rec.state = "QUEUED"
+            self.scheduler.submit(rec.spec)
+
+    def get_object_payload(self, oid: ObjectID, timeout: Optional[float]):
+        """Driver-side read: returns (buffer, is_error). Blocks until sealed."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        attempted_reconstruction = False
+        while True:
+            with self._lock:
+                locs = self.gcs.get_object_locations(oid)
+                node = None
+                for h in locs:
+                    if h in self.nodes:
+                        node = self.nodes[h]
+                        break
+            if node is not None:
+                try:
+                    return node.store.get_payload(oid)
+                except ObjectLostError:
+                    self.gcs.remove_object_location(oid, node.hex)
+                    continue
+            # no live location: try lineage reconstruction once
+            if not attempted_reconstruction and locs == set():
+                if self._maybe_reconstruct(oid):
+                    attempted_reconstruction = True
+            with self._object_cv:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"get() timed out on {oid.hex()}")
+                self._object_cv.wait(min(remaining, 0.2) if remaining else 0.2)
+
+    def _maybe_reconstruct(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction (reference: object_recovery_manager.h)."""
+        if not global_config().lineage_pinning_enabled:
+            return False
+        tid = oid.task_id()
+        rec = self.tasks.get(tid)
+        if rec is None or rec.state in ("PENDING", "QUEUED", "RUNNING", "WAITING_DEPS"):
+            return False
+        spec = rec.spec
+        if spec.actor_id is not None:
+            return False  # actor results are not reconstructable
+        spec.attempt += 1
+        rec.state = "PENDING"
+        self._record_event(spec, "RECONSTRUCTING")
+        self._resolve_then_queue(rec)
+        return True
+
+    def get_object_for_node(self, node: Node, oid: ObjectID, timeout: Optional[float]):
+        """Worker get: ensure the object is readable on `node`; return either
+        ("inline", bytes, is_err) or ("arena", offset, size, is_err).
+        Transfers from a remote node's store when needed (reference:
+        object_manager.cc chunked pull)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if node.store.contains(oid):
+                info = node.store.entry_info(oid)
+                if info is None:
+                    payload, is_err = node.store.get_payload(oid)
+                    return ("inline", bytes(payload), is_err)
+                off, size, is_err = info
+                return ("arena", off, size, is_err)
+            with self._lock:
+                locs = [h for h in self.gcs.get_object_locations(oid) if h in self.nodes]
+            if locs:
+                src = self.nodes[locs[0]]
+                try:
+                    payload, is_err = src.store.get_payload(oid)
+                except ObjectLostError:
+                    continue
+                data = bytes(payload)
+                if len(data) <= global_config().max_direct_call_object_size:
+                    return ("inline", data, is_err)
+                off, view = node.store.create(oid, len(data))
+                view[: len(data)] = data
+                node.store.seal(oid, is_err)
+                self.on_object_sealed(oid, node.hex)
+                return ("arena", off, len(data), is_err)
+            with self._object_cv:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return ("timeout",)
+                self._object_cv.wait(min(remaining, 0.2) if remaining else 0.2)
+
+    def wait_objects(self, oids: List[ObjectID], num_returns: int,
+                     timeout: Optional[float]) -> List[ObjectID]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                ready = [oid for oid in oids if self.gcs.get_object_locations(oid)]
+            if len(ready) >= num_returns:
+                return ready[:num_returns]
+            with self._object_cv:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return ready
+                self._object_cv.wait(min(remaining, 0.2) if remaining else 0.2)
+
+    def delete_object(self, oid: ObjectID) -> None:
+        with self._lock:
+            locs = self.gcs.get_object_locations(oid)
+            for h in locs:
+                node = self.nodes.get(h)
+                if node:
+                    node.store.delete(oid)
+                self.gcs.remove_object_location(oid, h)
+
+    # ------------------------------------------------------------ worker RPC
+
+    def handle_worker_rpc(self, node: Node, w: WorkerHandle, op: str, args):
+        if op == "submit_task":
+            spec = pickle.loads(args[0])
+            self.submit_spec(spec)
+            return None
+        if op == "create_actor":
+            spec, name, namespace, max_restarts, detached = pickle.loads(args[0])
+            self.create_actor(spec, name, namespace, max_restarts, detached)
+            return None
+        if op == "register_function":
+            self.gcs.register_function(args[0], args[1])
+            return None
+        if op == "get_function":
+            return self.gcs.get_function(args[0])
+        if op == "get_named_actor":
+            info = self.gcs.get_named_actor(args[0], args[1])
+            if info is None or info.state == "DEAD":
+                return None
+            return {"actor_id": info.actor_id, "class_name": info.class_name,
+                    "max_task_retries": info.max_task_retries}
+        if op == "kill_actor":
+            self.kill_actor(args[0], args[1])
+            return None
+        if op == "cancel_task":
+            self.cancel_task(args[0], args[1])
+            return None
+        if op == "kv":
+            sub, rest = args[0], args[1:]
+            return getattr(self.gcs, "kv_" + sub)(*rest)
+        if op == "register_owned_object":
+            with self._lock:
+                self.ref_counts[args[0]] += 1
+            return None
+        if op == "available_resources":
+            return self.scheduler.available_resources()
+        if op == "cluster_resources":
+            return self.scheduler.total_resources()
+        if op == "nodes":
+            return [
+                {"NodeID": n.hex, "Alive": n.alive,
+                 "Resources": n.resources_total, "Labels": n.labels}
+                for n in self.gcs.nodes.values()
+            ]
+        if op == "create_placement_group":
+            pg = self.scheduler.create_placement_group(args[0], args[1], args[2])
+            return pg.pg_id
+        if op == "pg_ready":
+            pg = self.scheduler.get_placement_group(args[0])
+            if pg is None:
+                return False
+            return pg.ready_event.wait(timeout=args[1])
+        if op == "pg_remove":
+            self.scheduler.remove_placement_group(args[0])
+            return None
+        if op == "pg_state":
+            pg = self.scheduler.get_placement_group(args[0])
+            if pg is None:
+                return None
+            return {"state": pg.state, "bundles": [b.resources.to_dict() for b in pg.bundles],
+                    "bundle_nodes": [b.node_hex for b in pg.bundles]}
+        raise ValueError(f"unknown rpc op {op!r}")
+
+    # ------------------------------------------------------------ misc
+
+    def cancel_task(self, oid_or_tid, force: bool = False) -> None:
+        tid = oid_or_tid.task_id() if isinstance(oid_or_tid, ObjectID) else oid_or_tid
+        with self._lock:
+            rec = self.tasks.get(tid)
+            if rec is None:
+                return
+            if rec.state in ("PENDING", "QUEUED", "WAITING_DEPS"):
+                rec.cancelled = True
+                rec.state = "FAILED"
+                self._fail_task_now(rec, TaskCancelledError("task cancelled"))
+                return
+            node = self.nodes.get(rec.node_hex) if rec.node_hex else None
+            worker_id = rec.worker_id  # set for actor tasks at dispatch
+        if rec.state == "RUNNING" and node is not None:
+            with node._lock:
+                target = None
+                if worker_id is not None:
+                    target = node._workers.get(worker_id)
+                else:
+                    for w in node._workers.values():
+                        if w.current_task is not None and w.current_task.task_id == tid:
+                            target = w
+                            break
+            if target is not None:
+                try:
+                    target.channel.send("cancel", tid)
+                except OSError:
+                    pass
+                if force:
+                    node.kill_worker(target.worker_id)
+
+    def _record_event(self, spec: TaskSpec, state: str, node_hex=None, error=None):
+        self.gcs.record_task_event(TaskEvent(
+            task_id=spec.task_id.binary(), name=spec.function_name, state=state,
+            node_hex=node_hex, ts=time.time(), attempt=spec.attempt, error=error,
+        ))
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        self.scheduler.stop()
+        with self._lock:
+            nodes = list(self.nodes.values())
+            self.nodes.clear()
+        for node in nodes:
+            node.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# Driver runtime (public API backend in the driver process)
+# --------------------------------------------------------------------------- #
+
+
+class DriverRuntime:
+    def __init__(self, head: Head):
+        self.head = head
+        self.job_id = head.job_id
+        self._driver_task_id = TaskID.for_driver_task(self.job_id)
+        self._put_counter = 0
+        self._lock = threading.Lock()
+        self._fn_cache: Dict[str, Any] = {}
+
+    @property
+    def mode(self) -> str:
+        return "DRIVER"
+
+    def is_initialized(self) -> bool:
+        return True
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.from_random()
+
+    # ---- objects ----
+    def put(self, value: Any, _owner=None) -> ObjectRef:
+        with self._lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        oid = ObjectID.for_put(self._driver_task_id, idx)
+        sobj = serialization.serialize(value)
+        node = self.head.head_node
+        cfg = global_config()
+        if sobj.total_bytes <= cfg.max_direct_call_object_size:
+            node.store.put_inline(oid, sobj.to_bytes(), False)
+        else:
+            _, view = node.store.create(oid, sobj.total_bytes)
+            buf = bytearray()
+            sobj.write_into(buf)
+            view[: len(buf)] = buf
+            node.store.seal(oid, False)
+        self.head.on_object_sealed(oid, node.hex)
+        with self.head._lock:
+            self.head.ref_counts[oid] += 1
+        return ObjectRef(oid, _register=False)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for r in refs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            payload, is_error = self.head.get_object_payload(r.id, remaining)
+            value = serialization.deserialize(payload)
+            if is_error:
+                raise value
+            out.append(value)
+        return out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ready_ids = set(self.head.wait_objects([r.id for r in refs], num_returns, timeout))
+        ready = [r for r in refs if r.id in ready_ids]
+        not_ready = [r for r in refs if r.id not in ready_ids]
+        return ready[:len(ready)], not_ready
+
+    # ---- tasks ----
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        self.head.submit_spec(spec)
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def register_function(self, function_id: str, payload: bytes) -> None:
+        self.head.gcs.register_function(function_id, payload)
+
+    def get_function(self, function_id: str):
+        if function_id not in self._fn_cache:
+            payload = self.head.gcs.get_function(function_id)
+            if payload is None:
+                raise RuntimeError(f"function {function_id} not registered")
+            self._fn_cache[function_id] = pickle.loads(payload)
+        return self._fn_cache[function_id]
+
+    def create_actor_record(self, spec, name, namespace, max_restarts, detached):
+        self.head.create_actor(spec, name, namespace, max_restarts, detached)
+
+    def get_actor_info(self, name: str, namespace: str):
+        info = self.head.gcs.get_named_actor(name, namespace)
+        if info is None or info.state == "DEAD":
+            return None
+        return {"actor_id": info.actor_id, "class_name": info.class_name,
+                "max_task_retries": info.max_task_retries}
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.head.kill_actor(actor_id, no_restart)
+
+    def cancel_task(self, oid: ObjectID, force: bool = False):
+        self.head.cancel_task(oid, force)
+
+    def kv(self, op: str, *args):
+        return getattr(self.head.gcs, "kv_" + op)(*args)
+
+    # ---- refs ----
+    def add_local_ref(self, oid: ObjectID) -> None:
+        with self.head._lock:
+            self.head.ref_counts[oid] += 1
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        with self.head._lock:
+            self.head.ref_counts[oid] -= 1
+            should_delete = self.head.ref_counts[oid] <= 0
+        if should_delete and not self.head._stopped:
+            self.head.delete_object(oid)
+
+    def add_borrow_ref(self, oid: ObjectID) -> None:
+        with self.head._lock:
+            self.head.ref_counts[oid] += 1
+
+    # ---- cluster info ----
+    def runtime_context(self) -> dict:
+        return {
+            "job_id": self.job_id,
+            "node_id": self.head.head_node.hex,
+            "worker_id": b"driver",
+            "task_id": self._driver_task_id,
+            "actor_id": None,
+            "accelerator_ids": {},
+            "mode": "DRIVER",
+        }
+
+    def available_resources(self):
+        return self.head.scheduler.available_resources()
+
+    def cluster_resources(self):
+        return self.head.scheduler.total_resources()
+
+    def nodes(self):
+        return [
+            {"NodeID": n.hex, "Alive": n.alive, "Resources": n.resources_total,
+             "Labels": n.labels}
+            for n in self.head.gcs.nodes.values()
+        ]
+
+    def actor_method_call(self, spec: TaskSpec) -> List[ObjectRef]:
+        return self.submit_task(spec)
+
+    def create_placement_group(self, bundles, strategy, name=""):
+        pg = self.head.scheduler.create_placement_group(bundles, strategy, name)
+        return pg.pg_id
+
+    def placement_group_op(self, op: str, *args):
+        return self.head.handle_worker_rpc(None, None, "pg_" + op, args)
+
+
+_current_runtime = None
+
+
+def set_current_runtime(rt) -> None:
+    global _current_runtime
+    _current_runtime = rt
+
+
+def get_current_runtime():
+    return _current_runtime

@@ -1,0 +1,48 @@
+"""Runtime context (reference: ``python/ray/runtime_context.py``)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class RuntimeContext:
+    def __init__(self, info: dict):
+        self._info = info
+
+    def get_job_id(self) -> str:
+        return self._info["job_id"].hex()
+
+    def get_node_id(self) -> str:
+        return self._info["node_id"]
+
+    def get_worker_id(self) -> str:
+        wid = self._info["worker_id"]
+        return wid.hex() if isinstance(wid, bytes) else str(wid)
+
+    def get_task_id(self) -> Optional[str]:
+        tid = self._info.get("task_id")
+        return tid.hex() if tid is not None else None
+
+    def get_actor_id(self) -> Optional[str]:
+        aid = self._info.get("actor_id")
+        return aid.hex() if aid is not None else None
+
+    def get_accelerator_ids(self) -> Dict[str, List[str]]:
+        return {k: [str(i) for i in v]
+                for k, v in self._info.get("accelerator_ids", {}).items()}
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        return False
+
+    def get_assigned_resources(self) -> Dict[str, float]:
+        return self._info.get("assigned_resources", {})
+
+
+def get_runtime_context() -> RuntimeContext:
+    from .runtime import get_current_runtime
+
+    rt = get_current_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return RuntimeContext(rt.runtime_context())

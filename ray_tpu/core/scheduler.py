@@ -1,0 +1,390 @@
+"""Cluster scheduler: resource-based node selection + placement groups.
+
+Analog of the reference's two-level scheduler
+(``src/ray/raylet/scheduling/``): a cluster resource view picks a node
+(`ClusterResourceScheduler` + policies), then the node's local dispatch binds
+resource instances and a worker. Policies implemented (reference
+``policy/``): hybrid (pack until ``scheduler_spread_threshold`` utilization,
+then least-utilized with top-k randomization), SPREAD (round-robin),
+node-affinity, and placement-group bundle scheduling with
+PACK/SPREAD/STRICT_PACK/STRICT_SPREAD (reference:
+bundle_scheduling_policy.cc, 2-phase reserve/commit in
+gcs_placement_group_scheduler.cc).
+
+TPU-topology awareness: nodes carry labels (e.g. ``tpu-slice``,
+``tpu-topology``) and unit-instance TPU resources; STRICT_SPREAD over
+slice hosts is what the Train layer uses to gang-schedule one worker per
+host of a pod slice.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .config import global_config
+from .exceptions import PlacementGroupError
+from .ids import PlacementGroupID
+from .resources import NodeResources, ResourceSet
+from .task_spec import TaskSpec
+
+
+@dataclass
+class Bundle:
+    index: int
+    resources: ResourceSet
+    node_hex: Optional[str] = None
+    # resources currently available inside the reservation
+    available: Optional[Dict[str, int]] = None
+
+    def fits(self, req: ResourceSet) -> bool:
+        return all(self.available.get(k, 0) >= v for k, v in req)
+
+    def acquire(self, req: ResourceSet) -> None:
+        for k, v in req:
+            self.available[k] = self.available.get(k, 0) - v
+
+    def release(self, req: ResourceSet) -> None:
+        for k, v in req:
+            self.available[k] = self.available.get(k, 0) + v
+
+
+@dataclass
+class PlacementGroup:
+    pg_id: PlacementGroupID
+    bundles: List[Bundle]
+    strategy: str = "PACK"
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED
+    name: str = ""
+    ready_event: threading.Event = field(default_factory=threading.Event)
+
+
+class ClusterScheduler:
+    """Holds the cluster resource view; picks nodes; queues pending work."""
+
+    def __init__(self, dispatch_fn: Callable[[str, TaskSpec, dict], None]):
+        # dispatch_fn(node_hex, spec, instance_binding) actually executes.
+        self._dispatch = dispatch_fn
+        self._nodes: Dict[str, NodeResources] = {}
+        self._node_order: List[str] = []
+        self._lock = threading.RLock()
+        self._pending: deque = deque()
+        self._pgs: Dict[PlacementGroupID, PlacementGroup] = {}
+        self._pending_pgs: deque = deque()
+        self._spread_rr = 0
+        self._wake = threading.Condition(self._lock)
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="scheduler")
+        self._thread.start()
+
+    # ---- node membership -------------------------------------------------
+
+    def add_node(self, node_hex: str, resources: NodeResources) -> None:
+        with self._lock:
+            self._nodes[node_hex] = resources
+            self._node_order.append(node_hex)
+            self._wake.notify_all()
+
+    def remove_node(self, node_hex: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_hex, None)
+            if node_hex in self._node_order:
+                self._node_order.remove(node_hex)
+            # kill reservations on that node
+            for pg in self._pgs.values():
+                for b in pg.bundles:
+                    if b.node_hex == node_hex:
+                        b.node_hex = None
+            self._wake.notify_all()
+
+    def node_resources(self, node_hex: str) -> Optional[NodeResources]:
+        with self._lock:
+            return self._nodes.get(node_hex)
+
+    def available_resources(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {}
+            for nr in self._nodes.values():
+                for k, v in nr.view().items():
+                    out[k] = out.get(k, 0) + v
+            return out
+
+    def total_resources(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {}
+            for nr in self._nodes.values():
+                for k, v in nr.total.to_dict().items():
+                    out[k] = out.get(k, 0) + v
+            return out
+
+    # ---- task scheduling -------------------------------------------------
+
+    def submit(self, spec: TaskSpec) -> None:
+        with self._lock:
+            self._pending.append(spec)
+            self._wake.notify_all()
+
+    def release(self, node_hex: str, spec: TaskSpec, binding: dict) -> None:
+        """Return a finished task's resources; wakes the dispatch loop."""
+        with self._lock:
+            st = spec.scheduling_strategy
+            if st.kind == "PLACEMENT_GROUP" and st.placement_group_id in self._pgs:
+                pg = self._pgs[st.placement_group_id]
+                if pg.state == "REMOVED":
+                    # bundle reservation already returned its unused part;
+                    # the in-use part comes back directly to the node here
+                    nr = self._nodes.get(node_hex)
+                    if nr is not None:
+                        nr.release(spec.resources)
+                elif 0 <= st.bundle_index < len(pg.bundles):
+                    pg.bundles[st.bundle_index].release(spec.resources)
+            else:
+                nr = self._nodes.get(node_hex)
+                if nr is not None:
+                    nr.release(spec.resources, binding)
+            self._wake.notify_all()
+
+    def kick(self) -> None:
+        with self._lock:
+            self._wake.notify_all()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._wake.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._stopped:
+                    return
+                progress = self._try_schedule_pgs_locked()
+                ready: List[Tuple[str, TaskSpec, dict]] = []
+                still_pending = deque()
+                while self._pending:
+                    spec = self._pending.popleft()
+                    placed = self._try_place_locked(spec)
+                    if placed is None:
+                        still_pending.append(spec)
+                    else:
+                        ready.append(placed)
+                self._pending = still_pending
+                if not ready and not progress:
+                    self._wake.wait(timeout=0.25)
+            for node_hex, spec, binding in ready:
+                try:
+                    self._dispatch(node_hex, spec, binding)
+                except Exception:
+                    with self._lock:
+                        nr = self._nodes.get(node_hex)
+                        if nr is not None:
+                            nr.release(spec.resources, binding)
+
+    def _try_place_locked(self, spec: TaskSpec) -> Optional[Tuple[str, TaskSpec, dict]]:
+        st = spec.scheduling_strategy
+        if st.kind == "PLACEMENT_GROUP":
+            pg = self._pgs.get(st.placement_group_id)
+            if pg is None or pg.state == "REMOVED":
+                return None
+            if pg.state != "CREATED":
+                return None
+            indices = (
+                [st.bundle_index]
+                if st.bundle_index >= 0
+                else list(range(len(pg.bundles)))
+            )
+            for i in indices:
+                b = pg.bundles[i]
+                if b.node_hex is not None and b.fits(spec.resources):
+                    b.acquire(spec.resources)
+                    if st.bundle_index < 0:
+                        st.bundle_index = i
+                    # instance binding comes from the node's reservation
+                    return b.node_hex, spec, {}
+            return None
+
+        if st.kind == "NODE_AFFINITY" and st.node_id is not None:
+            hexes = [st.node_id.hex() if isinstance(st.node_id, bytes) else st.node_id]
+            if not st.soft:
+                nr = self._nodes.get(hexes[0])
+                if nr is None:
+                    return None
+                binding = nr.allocate(spec.resources)
+                if binding is None:
+                    return None
+                return hexes[0], spec, binding
+            # soft: fall through to default with preference
+            preferred = hexes[0]
+        else:
+            preferred = None
+
+        candidates = self._feasible_locked(spec.resources)
+        if not candidates:
+            return None
+        if st.kind == "SPREAD":
+            order = candidates[self._spread_rr % len(candidates):] + \
+                candidates[: self._spread_rr % len(candidates)]
+            self._spread_rr += 1
+            chosen = order[0]
+        else:
+            chosen = self._hybrid_pick_locked(candidates, preferred)
+        nr = self._nodes[chosen]
+        binding = nr.allocate(spec.resources)
+        if binding is None:
+            return None
+        return chosen, spec, binding
+
+    def _feasible_locked(self, req: ResourceSet) -> List[str]:
+        return [
+            h for h in self._node_order
+            if h in self._nodes and self._nodes[h].can_fit(req)
+        ]
+
+    def _hybrid_pick_locked(self, candidates: List[str], preferred: Optional[str]) -> str:
+        """Reference hybrid_scheduling_policy.cc: pack onto low-utilization
+        nodes in fixed order; above the spread threshold, choose randomly
+        among the top-k least utilized."""
+        cfg = global_config()
+        if preferred and preferred in candidates:
+            return preferred
+        below = [h for h in candidates
+                 if self._nodes[h].utilization() < cfg.scheduler_spread_threshold]
+        if below:
+            return below[0]
+        ranked = sorted(candidates, key=lambda h: self._nodes[h].utilization())
+        k = max(int(len(ranked) * cfg.scheduler_top_k_fraction),
+                cfg.scheduler_top_k_absolute)
+        return random.choice(ranked[:k])
+
+    # ---- placement groups ------------------------------------------------
+
+    def create_placement_group(
+        self,
+        bundles: List[Dict[str, float]],
+        strategy: str = "PACK",
+        name: str = "",
+    ) -> PlacementGroup:
+        pg = PlacementGroup(
+            pg_id=PlacementGroupID.from_random(),
+            bundles=[Bundle(i, ResourceSet(b)) for i, b in enumerate(bundles)],
+            strategy=strategy,
+            name=name,
+        )
+        with self._lock:
+            self._pgs[pg.pg_id] = pg
+            self._pending_pgs.append(pg)
+            self._wake.notify_all()
+        return pg
+
+    def get_placement_group(self, pg_id: PlacementGroupID) -> Optional[PlacementGroup]:
+        with self._lock:
+            return self._pgs.get(pg_id)
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        from .resources import ResourceSet
+
+        with self._lock:
+            pg = self._pgs.get(pg_id)
+            if pg is None or pg.state == "REMOVED":
+                return
+            pg.state = "REMOVED"
+            for b in pg.bundles:
+                if (b.node_hex is not None and b.node_hex in self._nodes
+                        and b.available is not None):
+                    # return only the unused part now; resources held by
+                    # still-running tasks come back via release()
+                    self._nodes[b.node_hex].release(
+                        ResourceSet._from_fixed_map(b.available))
+                    b.available = {k: 0 for k in b.available}
+            self._wake.notify_all()
+
+    def _try_schedule_pgs_locked(self) -> bool:
+        """2-phase: tentatively pick nodes for all bundles; commit only if all
+        fit (reference: gcs_placement_group_scheduler.cc prepare/commit)."""
+        progress = False
+        still = deque()
+        while self._pending_pgs:
+            pg = self._pending_pgs.popleft()
+            if pg.state == "REMOVED":
+                continue
+            plan = self._plan_bundles_locked(pg)
+            if plan is None:
+                still.append(pg)
+                continue
+            for b, node_hex in zip(pg.bundles, plan):
+                nr = self._nodes[node_hex]
+                nr.allocate(b.resources)  # commit reservation
+                b.node_hex = node_hex
+                b.available = {k: v for k, v in b.resources}
+            pg.state = "CREATED"
+            pg.ready_event.set()
+            progress = True
+        self._pending_pgs = still
+        return progress
+
+    def _plan_bundles_locked(self, pg: PlacementGroup) -> Optional[List[str]]:
+        # Work on a scratch copy of availability so planning doesn't mutate.
+        scratch: Dict[str, Dict[str, int]] = {
+            h: dict(nr.available) for h, nr in self._nodes.items()
+        }
+
+        def fits(h: str, rs: ResourceSet) -> bool:
+            return all(scratch[h].get(k, 0) >= v for k, v in rs)
+
+        def take(h: str, rs: ResourceSet) -> None:
+            for k, v in rs:
+                scratch[h][k] = scratch[h].get(k, 0) - v
+
+        nodes = list(self._node_order)
+        if not nodes:
+            return None
+        plan: List[str] = []
+        if pg.strategy == "STRICT_PACK":
+            for h in nodes:
+                trial = dict(scratch[h])
+                ok = True
+                for b in pg.bundles:
+                    if all(trial.get(k, 0) >= v for k, v in b.resources):
+                        for k, v in b.resources:
+                            trial[k] = trial.get(k, 0) - v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return [h] * len(pg.bundles)
+            return None
+        if pg.strategy == "STRICT_SPREAD":
+            if len(nodes) < len(pg.bundles):
+                return None
+            used = set()
+            for b in pg.bundles:
+                placed = None
+                for h in nodes:
+                    if h in used:
+                        continue
+                    if fits(h, b.resources):
+                        placed = h
+                        break
+                if placed is None:
+                    return None
+                used.add(placed)
+                take(placed, b.resources)
+                plan.append(placed)
+            return plan
+        # PACK / SPREAD: best-effort orderings
+        prefer_spread = pg.strategy == "SPREAD"
+        for i, b in enumerate(pg.bundles):
+            ordered = nodes if not prefer_spread else nodes[i % len(nodes):] + nodes[: i % len(nodes)]
+            placed = None
+            for h in ordered:
+                if fits(h, b.resources):
+                    placed = h
+                    break
+            if placed is None:
+                return None
+            take(placed, b.resources)
+            plan.append(placed)
+        return plan

@@ -1,0 +1,115 @@
+"""Serialization: pickle protocol 5 with out-of-band buffers.
+
+Analog of ``python/ray/_private/serialization.py`` in the reference: values are
+pickled once with protocol 5; large contiguous buffers (numpy arrays, bytes,
+jax host arrays) are extracted out-of-band so the shared-memory object store
+can hold them without an extra copy, and readers can reconstruct numpy arrays
+zero-copy over the store's memoryview.
+
+Wire format of a sealed object:
+    [u32 meta_len][meta pickle][u64 nbuf][u64 len_i ...][buf_0][buf_1]...
+ObjectRefs contained in a value are serialized by id (ownership piggybacks on
+the driver-side reference table; reference: contained-object-ids tracking).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import numpy as np
+
+# Threading of "which ObjectRefs were found inside this value" — used by the
+# caller to pin contained objects (reference: serialization.py contained ids).
+_contained_refs_ctx: List[Any] = []
+
+
+class SerializedObject:
+    __slots__ = ("meta", "buffers", "contained_ids")
+
+    def __init__(self, meta: bytes, buffers: List, contained_ids: List):
+        self.meta = meta
+        self.buffers = buffers
+        self.contained_ids = contained_ids
+
+    @property
+    def total_bytes(self) -> int:
+        return (
+            4
+            + len(self.meta)
+            + 8
+            + 8 * len(self.buffers)
+            + sum(len(b.raw()) if isinstance(b, pickle.PickleBuffer) else len(b) for b in self.buffers)
+        )
+
+    def to_bytes(self) -> bytes:
+        out = bytearray()
+        self.write_into(out)
+        return bytes(out)
+
+    def write_into(self, out) -> None:
+        """Append the wire format into a bytearray / writable buffer proxy."""
+        bufs = [
+            b.raw() if isinstance(b, pickle.PickleBuffer) else memoryview(b)
+            for b in self.buffers
+        ]
+        out += struct.pack("<I", len(self.meta))
+        out += self.meta
+        out += struct.pack("<Q", len(bufs))
+        for b in bufs:
+            out += struct.pack("<Q", b.nbytes)
+        for b in bufs:
+            out += b
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+    contained: List[Any] = []
+    _contained_refs_ctx.append(contained)
+    try:
+        meta = pickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    finally:
+        _contained_refs_ctx.pop()
+    return SerializedObject(meta, buffers, contained)
+
+
+def deserialize(data) -> Any:
+    """Deserialize from bytes/memoryview produced by SerializedObject.
+
+    When ``data`` is a memoryview over shared memory, reconstructed numpy
+    arrays alias it (zero-copy) — same contract as plasma's immutable reads.
+    """
+    view = memoryview(data)
+    if not view.readonly:
+        view = view.toreadonly()  # sealed objects are immutable (plasma contract)
+    (meta_len,) = struct.unpack_from("<I", view, 0)
+    off = 4
+    meta = view[off : off + meta_len]
+    off += meta_len
+    (nbuf,) = struct.unpack_from("<Q", view, off)
+    off += 8
+    lens = struct.unpack_from(f"<{nbuf}Q", view, off)
+    off += 8 * nbuf
+    bufs = []
+    for ln in lens:
+        bufs.append(view[off : off + ln])
+        off += ln
+    return pickle.loads(bytes(meta) if not isinstance(meta, bytes) else meta, buffers=bufs)
+
+
+def dumps(value: Any) -> bytes:
+    return serialize(value).to_bytes()
+
+
+loads = deserialize
+
+
+def note_contained_ref(ref) -> None:
+    if _contained_refs_ctx:
+        _contained_refs_ctx[-1].append(ref)
+
+
+def is_zero_copy_type(value: Any) -> bool:
+    """True if the value serializes with a dominant out-of-band buffer."""
+    return isinstance(value, np.ndarray) and value.dtype != object

@@ -1,0 +1,70 @@
+"""Task specification — the unit shipped from caller to executor.
+
+Analog of the reference's ``TaskSpecification``
+(``src/ray/common/task/task_spec.h:247``): function descriptor, serialized
+args (small args inline, large args promoted to the shared store and passed by
+reference — reference: core_worker.cc:2166 + ray_config_def.h:199), resource
+demand, retry policy, actor linkage, and scheduling strategy.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from .ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+from .resources import ResourceSet
+
+
+@dataclass
+class SchedulingStrategy:
+    """DEFAULT | SPREAD | node-affinity | placement group (reference:
+    python/ray/util/scheduling_strategies.py:15,41,135)."""
+
+    kind: str = "DEFAULT"
+    node_id: Optional[bytes] = None  # node affinity
+    soft: bool = False
+    placement_group_id: Optional[PlacementGroupID] = None
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    function_id: str  # key into the GCS function table
+    function_name: str
+    # each arg: ("v", bytes) inline serialized | ("ref", ObjectID)
+    args: List[Tuple[str, Any]] = field(default_factory=list)
+    kwargs: Dict[str, Tuple[str, Any]] = field(default_factory=dict)
+    num_returns: int = 1
+    resources: ResourceSet = field(default_factory=ResourceSet)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    runtime_env: Optional[dict] = None
+
+    # actor linkage
+    actor_id: Optional[ActorID] = None  # actor task -> target actor
+    is_actor_creation: bool = False
+    actor_max_concurrency: int = 1
+    actor_is_async: bool = False
+    concurrency_group: str = ""
+
+    # args promoted to the store for this call; pinned until the task settles
+    pinned_args: List[ObjectID] = field(default_factory=list)
+
+    # bookkeeping
+    attempt: int = 0
+    submitted_at: float = field(default_factory=time.time)
+    owner_is_driver: bool = True
+
+    def return_ids(self) -> List[ObjectID]:
+        return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def arg_object_ids(self) -> List[ObjectID]:
+        out = [v for k, v in self.args if k == "ref"]
+        out += [v for k, v in self.kwargs.values() if k == "ref"]
+        return out

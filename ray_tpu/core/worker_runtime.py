@@ -1,0 +1,401 @@
+"""Worker process runtime + executor.
+
+Analog of the reference's CoreWorker in WORKER mode plus the Python worker
+shell (``python/ray/_private/workers/default_worker.py`` +
+``core_worker/transport/task_receiver.cc``): connects to its node over a unix
+socket, registers, then serves ``exec`` messages. Holds actor instances,
+enforces actor ordering / max_concurrency / asyncio execution (reference:
+actor_scheduling_queue.cc, concurrency groups), performs ``get``/``put``
+against the node store (zero-copy arena reads), and forwards nested task
+submissions to the head (workers are full API clients — reference: workers own
+submitted tasks; here the head tracks ownership for them).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import os
+import pickle
+import sys
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import object_ref as object_ref_mod
+from . import serialization
+from .config import Config, set_global_config, global_config
+from .exceptions import ObjectLostError, TaskCancelledError, TaskError, GetTimeoutError
+from .ids import ActorID, JobID, ObjectID, TaskID
+from .object_ref import ObjectRef
+from .object_store import ArenaClient
+from .protocol import Channel, RpcClient, connect
+from .task_spec import TaskSpec
+
+
+class _ActorState:
+    def __init__(self, instance, max_concurrency: int, is_async: bool):
+        self.instance = instance
+        self.is_async = is_async
+        if is_async:
+            self.loop = asyncio.new_event_loop()
+            self.loop_thread = threading.Thread(
+                target=self.loop.run_forever, daemon=True, name="actor-asyncio"
+            )
+            self.loop_thread.start()
+            self.pool = ThreadPoolExecutor(max_workers=1)  # for sync methods
+        else:
+            self.loop = None
+            self.pool = ThreadPoolExecutor(max_workers=max_concurrency)
+
+
+class WorkerRuntime:
+    """Runtime installed as the process-global API backend inside workers."""
+
+    def __init__(self, channel: Channel, init_info: dict):
+        self.channel = channel
+        self.rpc = RpcClient(channel)
+        self.worker_id: bytes = init_info["worker_id"]
+        self.node_hex: str = init_info["node_hex"]
+        self.job_id = JobID(init_info["job_id"])
+        set_global_config(Config.from_json(init_info["config"]))
+        self.arena = ArenaClient(init_info["arena_path"], init_info["arena_capacity"])
+        self._fn_cache: Dict[str, Any] = {}
+        self._actors: Dict[ActorID, _ActorState] = {}
+        self._task_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="exec")
+        self._put_counter = 0
+        self._put_lock = threading.Lock()
+        self._current_task = threading.local()
+        self._cancelled: set = set()
+        self._shutdown = threading.Event()
+        self.accelerator_binding: Dict[str, List[int]] = {}
+
+    # ------------------------------------------------------------------ API
+    # (same surface the driver runtime exposes; public api dispatches here)
+
+    def is_initialized(self) -> bool:
+        return True
+
+    @property
+    def mode(self) -> str:
+        return "WORKER"
+
+    def put(self, value: Any, _owner=None) -> ObjectRef:
+        with self._put_lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        tid = getattr(self._current_task, "task_id", None)
+        if tid is not None:
+            oid = ObjectID.for_put(tid, idx)
+        else:
+            oid = ObjectID.from_random()  # put outside a task context
+        self._store_object(oid, serialization.serialize(value), is_error=False)
+        self.rpc.call("rpc", "register_owned_object", oid)
+        return ObjectRef(oid)
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for r in refs:
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            out.append(self._get_one(r.id, remaining))
+        return out
+
+    def _get_one(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+        rep = self.rpc.call("store", "get", oid, timeout, timeout=None)
+        kind = rep[0]
+        if kind == "timeout":
+            raise GetTimeoutError(f"get timed out on {oid.hex()}")
+        if kind == "inline":
+            _, payload, is_error = rep
+            value = serialization.deserialize(payload)
+        else:
+            _, offset, size, is_error = rep
+            view = self.arena.view(offset, size)
+            value = serialization.deserialize(view)
+        if is_error:
+            raise value
+        return value
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        oids = [r.id for r in refs]
+        ready_ids = self.rpc.call("store", "wait", oids, num_returns, timeout, timeout=None)
+        ready_set = set(ready_ids)
+        ready = [r for r in refs if r.id in ready_set]
+        not_ready = [r for r in refs if r.id not in ready_set]
+        return ready, not_ready
+
+    def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
+        self.rpc.call("rpc", "submit_task", pickle.dumps(spec))
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def register_function(self, function_id: str, payload: bytes) -> None:
+        self.rpc.call("rpc", "register_function", function_id, payload)
+
+    def get_function(self, function_id: str):
+        if function_id not in self._fn_cache:
+            payload = self.rpc.call("rpc", "get_function", function_id)
+            if payload is None:
+                raise RuntimeError(f"function {function_id} not found in GCS")
+            self._fn_cache[function_id] = pickle.loads(payload)
+        return self._fn_cache[function_id]
+
+    def get_actor_info(self, name: str, namespace: str):
+        return self.rpc.call("rpc", "get_named_actor", name, namespace)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.rpc.call("rpc", "kill_actor", actor_id, no_restart)
+
+    def cancel_task(self, oid: ObjectID, force: bool = False):
+        self.rpc.call("rpc", "cancel_task", oid, force)
+
+    def kv(self, op: str, *args):
+        return self.rpc.call("rpc", "kv", op, *args)
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.from_random()
+
+    # reference counting: workers batch releases to the owner (head)
+    def add_local_ref(self, oid: ObjectID) -> None:
+        pass  # head-side counting covers worker borrows conservatively
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        pass
+
+    def add_borrow_ref(self, oid: ObjectID) -> None:
+        pass
+
+    def runtime_context(self) -> dict:
+        tid = getattr(self._current_task, "task_id", None)
+        aid = getattr(self._current_task, "actor_id", None)
+        return {
+            "job_id": self.job_id,
+            "node_id": self.node_hex,
+            "worker_id": self.worker_id,
+            "task_id": tid,
+            "actor_id": aid,
+            "accelerator_ids": dict(self.accelerator_binding),
+            "mode": "WORKER",
+        }
+
+    def available_resources(self):
+        return self.rpc.call("rpc", "available_resources")
+
+    def cluster_resources(self):
+        return self.rpc.call("rpc", "cluster_resources")
+
+    def nodes(self):
+        return self.rpc.call("rpc", "nodes")
+
+    def actor_method_call(self, spec: TaskSpec) -> List[ObjectRef]:
+        return self.submit_task(spec)
+
+    def create_placement_group(self, bundles, strategy, name=""):
+        return self.rpc.call("rpc", "create_placement_group", bundles, strategy, name)
+
+    def placement_group_op(self, op, *args):
+        return self.rpc.call("rpc", "pg_" + op, *args)
+
+    # --------------------------------------------------------------- storage
+
+    def _store_object(self, oid: ObjectID, sobj: serialization.SerializedObject,
+                      is_error: bool) -> None:
+        cfg = global_config()
+        size = sobj.total_bytes
+        if size <= cfg.max_direct_call_object_size:
+            self.rpc.call("store", "put_inline", oid, sobj.to_bytes(), is_error)
+        else:
+            offset = self.rpc.call("store", "create", oid, size)
+            view = self.arena.view(offset, size)
+            buf = bytearray()
+            sobj.write_into(buf)
+            view[: len(buf)] = buf
+            self.rpc.call("store", "seal", oid, is_error)
+
+    # --------------------------------------------------------------- serve
+
+    def serve_forever(self) -> None:
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    tag, payload = self.channel.recv()
+                except (EOFError, OSError):
+                    break
+                if tag == "rep":
+                    self.rpc.handle_reply(*payload)
+                elif tag == "exec":
+                    spec: TaskSpec = pickle.loads(payload[0])
+                    binding = payload[1]
+                    self._dispatch_exec(spec, binding)
+                elif tag == "cancel":
+                    self._cancelled.add(payload[0])
+                elif tag == "shutdown":
+                    break
+        finally:
+            self._shutdown.set()
+            os._exit(0)
+
+    def _dispatch_exec(self, spec: TaskSpec, binding: Dict[str, List[int]]) -> None:
+        if spec.actor_id is not None and not spec.is_actor_creation:
+            st = self._actors.get(spec.actor_id)
+            if st is None:
+                self._send_error(spec, RuntimeError("actor instance not found"))
+                return
+            fn_name = spec.function_name.rsplit(".", 1)[-1]
+            method = getattr(type(st.instance), fn_name, None)
+            if st.is_async and method is not None and asyncio.iscoroutinefunction(method):
+                fut = asyncio.run_coroutine_threadsafe(
+                    self._execute_async(spec, st), st.loop
+                )
+                fut.add_done_callback(lambda f: f.exception())
+            else:
+                st.pool.submit(self._execute, spec, binding)
+        else:
+            self._task_pool.submit(self._execute, spec, binding)
+
+    async def _execute_async(self, spec: TaskSpec, st: _ActorState) -> None:
+        try:
+            args, kwargs = self._resolve_args(spec)
+            fn_name = spec.function_name.rsplit(".", 1)[-1]
+            method = getattr(st.instance, fn_name)
+            self._current_task.task_id = spec.task_id
+            self._current_task.actor_id = spec.actor_id
+            result = await method(*args, **kwargs)
+            self._finish(spec, result)
+        except Exception as e:  # noqa: BLE001
+            self._send_error(spec, e)
+
+    def _resolve_args(self, spec: TaskSpec):
+        def resolve(v):
+            kind, payload = v
+            if kind == "ref":
+                return self._get_one(payload, None)
+            return serialization.deserialize(payload)
+
+        args = [resolve(a) for a in spec.args]
+        kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def _execute(self, spec: TaskSpec, binding: Dict[str, List[int]]) -> None:
+        try:
+            if spec.task_id in self._cancelled:
+                raise TaskCancelledError(f"task {spec.task_id.hex()} cancelled")
+            if binding:
+                self._apply_accelerator_binding(binding)
+            args, kwargs = self._resolve_args(spec)
+            self._current_task.task_id = spec.task_id
+            self._current_task.actor_id = spec.actor_id
+            if spec.is_actor_creation:
+                cls = self.get_function(spec.function_id)
+                instance = cls(*args, **kwargs)
+                self._actors[spec.actor_id] = _ActorState(
+                    instance, spec.actor_max_concurrency, spec.actor_is_async
+                )
+                self._finish(spec, None)
+            elif spec.actor_id is not None:
+                st = self._actors[spec.actor_id]
+                fn_name = spec.function_name.rsplit(".", 1)[-1]
+                if fn_name == "__ray_terminate__":
+                    self._finish(spec, None)
+                    self.channel.send("exit")
+                    time.sleep(0.2)
+                    os._exit(0)
+                method = getattr(st.instance, fn_name)
+                result = method(*args, **kwargs)
+                self._finish(spec, result)
+            else:
+                fn = self.get_function(spec.function_id)
+                result = fn(*args, **kwargs)
+                self._finish(spec, result)
+        except Exception as e:  # noqa: BLE001
+            self._send_error(spec, e)
+        finally:
+            self._current_task.task_id = None
+            self._current_task.actor_id = None
+
+    def _apply_accelerator_binding(self, binding: Dict[str, List[int]]) -> None:
+        """Set accelerator visibility env vars before user code imports jax.
+
+        Reference: accelerators/tpu.py:155-195 sets TPU_VISIBLE_CHIPS etc;
+        nvidia_gpu.py sets CUDA_VISIBLE_DEVICES.
+        """
+        self.accelerator_binding = binding
+        if "TPU" in binding and "jax" not in sys.modules:
+            chips = ",".join(str(i) for i in binding["TPU"])
+            os.environ.setdefault("TPU_VISIBLE_CHIPS", chips)
+        if "GPU" in binding:
+            os.environ.setdefault(
+                "CUDA_VISIBLE_DEVICES", ",".join(str(i) for i in binding["GPU"])
+            )
+
+    def _finish(self, spec: TaskSpec, result: Any) -> None:
+        rids = spec.return_ids()
+        if spec.num_returns == 1:
+            values = [result]
+        elif spec.num_returns == 0:
+            values = []
+        else:
+            values = list(result)
+            if len(values) != spec.num_returns:
+                self._send_error(
+                    spec,
+                    ValueError(
+                        f"task returned {len(values)} values, expected {spec.num_returns}"
+                    ),
+                )
+                return
+        results = []
+        cfg = global_config()
+        for oid, val in zip(rids, values):
+            sobj = serialization.serialize(val)
+            if sobj.total_bytes <= cfg.max_direct_call_object_size:
+                results.append((oid, sobj.to_bytes(), False))
+            else:
+                offset = self.rpc.call("store", "create", oid, sobj.total_bytes)
+                view = self.arena.view(offset, sobj.total_bytes)
+                buf = bytearray()
+                sobj.write_into(buf)
+                view[: len(buf)] = buf
+                self.rpc.call("store", "seal", oid, False)
+                results.append((oid, None, False))
+        self.channel.send("done", spec.task_id, results, None)
+
+    def _send_error(self, spec: TaskSpec, exc: Exception) -> None:
+        if isinstance(exc, TaskError):
+            err = exc
+        else:
+            err = TaskError.from_exception(spec.function_name, exc)
+        payload = serialization.serialize(err).to_bytes()
+        results = [(oid, payload, True) for oid in spec.return_ids()]
+        self.channel.send("done", spec.task_id, results,
+                          type(exc).__name__)
+
+
+def worker_main(argv=None) -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--address", required=True)
+    parser.add_argument("--authkey", required=True)
+    args = parser.parse_args(argv)
+    try:
+        channel = connect(args.address, bytes.fromhex(args.authkey))
+    except (OSError, EOFError, Exception) as e:
+        # node shut down while we were starting; exit quietly
+        if "Authentication" in type(e).__name__ or isinstance(e, (OSError, EOFError)):
+            sys.exit(0)
+        raise
+    channel.send("register", os.getpid())
+    tag, payload = channel.recv()
+    assert tag == "init", tag
+    runtime = WorkerRuntime(channel, payload[0])
+    object_ref_mod.set_runtime(runtime)
+    from . import runtime as runtime_mod
+
+    runtime_mod.set_current_runtime(runtime)
+    runtime.serve_forever()
+
+
+if __name__ == "__main__":
+    worker_main()

@@ -1,0 +1,8 @@
+"""Distributed utilities (reference: ``python/ray/util/``)."""
+
+from ray_tpu.core.placement_group import (  # noqa: F401
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)

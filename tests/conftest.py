@@ -1,0 +1,38 @@
+"""Test config: force a virtual 8-device CPU mesh for all jax-using tests.
+
+Mirrors the reference's test strategy (SURVEY.md §4): scheduler/Train logic is
+tested against fake multi-device topology — here JAX's
+``xla_force_host_platform_device_count`` gives 8 virtual CPU devices, so
+multi-chip sharding paths compile and run without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Single-node cluster fixture (reference: conftest.py ray_start_regular)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-node in-process cluster (reference: conftest.py ray_start_cluster)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield cluster
+    cluster.shutdown()

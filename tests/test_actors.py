@@ -1,0 +1,203 @@
+"""Actor tests (reference model: python/ray/tests/test_actor.py,
+test_actor_failures.py)."""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_basic_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    assert ray_tpu.get(c.inc.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return len(self.items)
+
+        def get(self):
+            return self.items
+
+    a = Appender.remote()
+    for i in range(20):
+        a.add.remote(i)
+    assert ray_tpu.get(a.get.remote()) == list(range(20))
+
+
+def test_actor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return "fine"
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(b.fail.remote())
+    # actor survives an application-level method failure
+    assert ray_tpu.get(b.ok.remote()) == "fine"
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Registry:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+
+        def get(self, k):
+            return self.d.get(k)
+
+    Registry.options(name="registry").remote()
+    h = ray_tpu.get_actor("registry")
+    ray_tpu.get(h.set.remote("x", 42))
+    assert ray_tpu.get(h.get.remote("x")) == 42
+
+
+def test_actor_handle_in_task(ray_start_regular):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @ray_tpu.remote
+    def writer(store, v):
+        ray_tpu.get(store.set.remote(v))
+        return True
+
+    s = Store.remote()
+    ray_tpu.get(writer.remote(s, 99))
+    assert ray_tpu.get(s.get.remote()) == 99
+
+
+def test_kill_actor(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    ray_tpu.kill(a)
+    time.sleep(0.5)
+    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.TaskError)):
+        ray_tpu.get(a.ping.remote(), timeout=10)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Fragile:
+        def __init__(self):
+            self.count = 0
+
+        def crash(self):
+            import os
+            os._exit(1)
+
+        def ping(self):
+            self.count += 1
+            return self.count
+
+    f = Fragile.remote()
+    assert ray_tpu.get(f.ping.remote()) == 1
+    f.crash.remote()
+    time.sleep(1.0)
+    # restarted incarnation: state reset
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            assert ray_tpu.get(f.ping.remote(), timeout=10) >= 1
+            break
+        except (ray_tpu.ActorDiedError, ray_tpu.RayTpuError):
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart")
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, x):
+            await asyncio.sleep(0.05)
+            return x * 2
+
+    w = AsyncWorker.remote()
+    ray_tpu.get(w.work.remote(0))  # warm up (actor creation)
+    start = time.time()
+    refs = [w.work.remote(i) for i in range(8)]
+    assert ray_tpu.get(refs, timeout=30) == [i * 2 for i in range(8)]
+    # concurrency: 8 x 50ms sleeps overlap in the event loop
+    assert time.time() - start < 2
+
+
+def test_max_concurrency(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def work(self):
+            time.sleep(0.4)
+            return 1
+
+    s = Slow.remote()
+    ray_tpu.get(s.work.remote())  # warm up (actor creation)
+    start = time.time()
+    ray_tpu.get([s.work.remote() for _ in range(4)], timeout=30)
+    assert time.time() - start < 1.5  # would be 1.6s serial
+
+
+def test_actor_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    class M:
+        @ray_tpu.method(num_returns=2)
+        def two(self):
+            return 1, 2
+
+    m = M.remote()
+    a, b = m.two.remote()
+    assert ray_tpu.get([a, b]) == [1, 2]
+
+
+def test_actor_creation_failure(ray_start_regular):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("cannot construct")
+
+        def ping(self):
+            return "?"
+
+    b = Broken.remote()
+    with pytest.raises((ray_tpu.TaskError, ray_tpu.ActorDiedError)):
+        ray_tpu.get(b.ping.remote(), timeout=30)

@@ -1,0 +1,171 @@
+"""Multi-node cluster tests (reference model: python/ray/tests/test_multi_node.py,
+test_placement_group.py, test_object_spilling.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+def test_multi_node_scheduling(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"special": 1})
+
+    @ray_tpu.remote(resources={"special": 1})
+    def on_special():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    @ray_tpu.remote
+    def anywhere():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    special_node = ray_tpu.get(on_special.remote(), timeout=60)
+    assert special_node is not None
+    assert ray_tpu.cluster_resources()["CPU"] == 4.0
+
+
+def test_node_affinity(ray_start_cluster):
+    cluster = ray_start_cluster
+    n2 = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    strat = NodeAffinitySchedulingStrategy(node_id=n2.hex, soft=False)
+    assert ray_tpu.get(where.options(scheduling_strategy=strat).remote(),
+                       timeout=60) == n2.hex
+
+
+def test_spread_strategy(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        time.sleep(0.2)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    refs = [where.options(scheduling_strategy="SPREAD").remote() for _ in range(6)]
+    nodes = set(ray_tpu.get(refs, timeout=60))
+    assert len(nodes) >= 2
+
+
+def test_placement_group_pack(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=1)
+    def in_pg():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    strat = PlacementGroupSchedulingStrategy(placement_group=pg,
+                                             placement_group_bundle_index=0)
+    n = ray_tpu.get(in_pg.options(scheduling_strategy=strat).remote(), timeout=60)
+    assert n is not None
+    remove_placement_group(pg)
+
+
+def test_placement_group_strict_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+    st = pg.state()
+    assert len(set(st["bundle_nodes"])) == 3
+
+
+def test_placement_group_infeasible_until_node_added(ray_start_cluster):
+    cluster = ray_start_cluster
+    pg = placement_group([{"CPU": 8}], strategy="PACK")
+    assert not pg.ready(timeout=0.5)
+    cluster.add_node(num_cpus=8)
+    assert pg.ready(timeout=30)
+
+
+def test_actor_on_specific_resources(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"accel": 4})
+
+    @ray_tpu.remote(resources={"accel": 2})
+    class A:
+        def where(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = A.remote()
+    assert ray_tpu.get(a.where.remote(), timeout=60)
+    # two such actors consume all 4 "accel" units
+    b = A.remote()
+    assert ray_tpu.get(b.where.remote(), timeout=60)
+    avail = ray_tpu.available_resources()
+    assert avail.get("accel", 0) == 0
+
+
+def test_object_transfer_between_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    n2 = cluster.add_node(num_cpus=2, resources={"there": 1})
+
+    @ray_tpu.remote(resources={"there": 1})
+    def produce():
+        return np.full((300_000,), 3.0)
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(x):
+        return float(x.sum())
+
+    data = produce.remote()
+    assert ray_tpu.get(consume.remote(data), timeout=60) == 900_000.0
+
+
+def test_node_death_task_retry(ray_start_cluster):
+    cluster = ray_start_cluster
+    doomed = cluster.add_node(num_cpus=2, resources={"doomed": 2})
+
+    @ray_tpu.remote(max_retries=2, num_cpus=1)
+    def slow_task():
+        time.sleep(3)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    # prefer the doomed node via affinity(soft) so the first attempt lands there
+    strat = NodeAffinitySchedulingStrategy(node_id=doomed.hex, soft=True)
+    ref = slow_task.options(scheduling_strategy=strat).remote()
+    time.sleep(1.0)
+    cluster.remove_node(doomed)
+    result = ray_tpu.get(ref, timeout=90)
+    assert result != doomed.hex
+
+
+def test_actor_restart_on_node_death(ray_start_cluster):
+    cluster = ray_start_cluster
+    doomed = cluster.add_node(num_cpus=2, resources={"spot": 1})
+
+    @ray_tpu.remote(max_restarts=1, resources={"spot": 1})
+    class Pinned:
+        def ping(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    # restartable actor needs the resource available elsewhere after death
+    backup = cluster.add_node(num_cpus=2, resources={"spot": 1})
+    a = Pinned.remote()
+    first = ray_tpu.get(a.ping.remote(), timeout=60)
+    cluster.remove_node(doomed if first == doomed.hex else backup)
+    deadline = time.time() + 60
+    second = None
+    while time.time() < deadline:
+        try:
+            second = ray_tpu.get(a.ping.remote(), timeout=10)
+            break
+        except ray_tpu.RayTpuError:
+            time.sleep(0.5)
+    assert second is not None and second != first

@@ -1,0 +1,184 @@
+"""Core task/object API tests (reference model: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_kwargs_and_defaults(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 111
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def f():
+        return 1, 2, 3
+
+    a, b, c = f.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_dependencies(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    r = f.remote(1)
+    for _ in range(5):
+        r = f.remote(r)
+    assert ray_tpu.get(r) == 64
+
+
+def test_nested_object_ref_in_arg(ray_start_regular):
+    """Top-level refs are resolved; nested refs pass through as refs."""
+
+    @ray_tpu.remote
+    def produce():
+        return 7
+
+    @ray_tpu.remote
+    def consume_nested(d):
+        return ray_tpu.get(d["ref"]) + 1
+
+    ref = produce.remote()
+    assert ray_tpu.get(consume_nested.remote({"ref": ref})) == 8
+
+
+def test_task_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom!")
+
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "boom!" in str(ei.value)
+
+
+def test_large_args_and_returns(ray_start_regular):
+    @ray_tpu.remote
+    def echo(x):
+        return x.sum(), x
+
+    arr = np.ones((512, 1024), dtype=np.float32)  # 2 MB -> plasma path
+    s, back = ray_tpu.get(echo.remote(arr))
+    assert s == arr.size
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_put_get_roundtrip(ray_start_regular):
+    for value in [1, "s", {"a": [1, 2]}, np.arange(10), None]:
+        ref = ray_tpu.put(value)
+        out = ray_tpu.get(ref)
+        if isinstance(value, np.ndarray):
+            np.testing.assert_array_equal(out, value)
+        else:
+            assert out == value
+
+
+def test_zero_copy_numpy(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.float64)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    # zero-copy reads are read-only views over the shared arena
+    assert not out.flags.writeable
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 2
+
+    ray_tpu.get(fast.remote())  # warm up the worker pool
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.3)
+
+
+def test_nested_task_submission(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(4)) == 41
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    assert isinstance(ray_tpu.get(f.options(num_cpus=2).remote()), str)
+
+
+def test_many_small_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(200)]
+    assert ray_tpu.get(refs) == list(range(200))
+
+
+def test_retry_on_worker_crash(ray_start_regular):
+    @ray_tpu.remote(max_retries=2)
+    def flaky(path):
+        import os
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)  # simulate worker crash on first attempt
+        return "recovered"
+
+    import tempfile, os
+    path = os.path.join(tempfile.mkdtemp(), "marker")
+    assert ray_tpu.get(flaky.remote(path), timeout=60) == "recovered"
+
+
+def test_runtime_context(ray_start_regular):
+    @ray_tpu.remote
+    def ctx():
+        c = ray_tpu.get_runtime_context()
+        return c.get_job_id(), c.get_node_id(), c.get_task_id()
+
+    job, node, task = ray_tpu.get(ctx.remote())
+    assert job and node and task
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4.0

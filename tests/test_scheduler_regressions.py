@@ -1,0 +1,90 @@
+"""Regression tests for resource-accounting and cancellation bugs found in
+review of the initial core runtime."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+def test_failed_actor_creation_releases_resources(ray_start_regular):
+    @ray_tpu.remote(num_cpus=3)
+    class Broken:
+        def __init__(self):
+            raise ValueError("nope")
+
+        def ping(self):
+            return 1
+
+    for _ in range(3):  # would exhaust 4 CPUs if creations leaked
+        b = Broken.remote()
+        with pytest.raises(ray_tpu.RayTpuError):
+            ray_tpu.get(b.ping.remote(), timeout=60)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) == 4.0:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources().get("CPU", 0) == 4.0
+
+
+def test_wait_returns_exactly_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(4)]
+    ray_tpu.get(refs)  # all sealed
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1)
+    assert len(ready) == 1
+    assert len(not_ready) == 3
+
+
+def test_cancel_queued_task_never_runs(ray_start_regular, tmp_path):
+    marker = tmp_path / "ran"
+
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(2)
+
+    @ray_tpu.remote(num_cpus=4)
+    def side_effect(path):
+        open(path, "w").close()
+        return True
+
+    h = hog.remote()  # occupies all CPUs so next task queues
+    ref = side_effect.remote(str(marker))
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    ray_tpu.get(h)
+    time.sleep(1.0)
+    assert not marker.exists(), "cancelled task still executed"
+
+
+def test_remove_pg_with_running_tasks_no_double_credit(ray_start_regular):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=2)
+    def slow():
+        time.sleep(2)
+        return 1
+
+    strat = PlacementGroupSchedulingStrategy(placement_group=pg,
+                                             placement_group_bundle_index=0)
+    ref = slow.options(scheduling_strategy=strat).remote()
+    time.sleep(1.0)  # task is running inside the bundle
+    remove_placement_group(pg)
+    ray_tpu.get(ref, timeout=60)
+    time.sleep(0.5)
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) <= 4.0, f"over-credited: {avail}"
+    deadline = time.time() + 10
+    while time.time() < deadline and avail.get("CPU", 0) != 4.0:
+        time.sleep(0.2)
+        avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) == 4.0
