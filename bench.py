@@ -1,0 +1,110 @@
+"""Headline benchmark: flagship-model training throughput on this chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is measured MFU / 0.35 — the BASELINE.md north-star target
+(>=35% MFU via GSPMD). The reference publishes no model-level tokens/sec
+numbers (BASELINE.json "published": {}), so the MFU target is the baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak FLOPs of the local accelerator."""
+    env = os.environ.get("RAY_TPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    table = {
+        "tpu v5 lite": 197e12,   # v5e
+        "tpu v5e": 197e12,
+        "tpu v5": 459e12,        # v5p
+        "tpu v4": 275e12,
+        "tpu v6 lite": 918e12,   # v6e (Trillium)
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return 197e12 if d.platform == "tpu" else 1e12  # CPU: nominal
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="tiny config for CPU smoke-testing")
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--batch", type=int, default=0)
+    parser.add_argument("--seq", type=int, default=0)
+    parser.add_argument("--config", default="medium",
+                        choices=["debug", "small", "medium"])
+    args = parser.parse_args()
+
+    import jax
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig, make_train_step
+    from ray_tpu.parallel import MeshConfig, make_mesh
+
+    n_dev = len(jax.devices())
+    if args.quick or jax.devices()[0].platform == "cpu":
+        cfg = LlamaConfig.debug()
+        batch, seq, steps = 8, 128, max(3, args.steps // 4)
+    else:
+        cfg = getattr(LlamaConfig, args.config)()
+        batch, seq, steps = (8 if args.config == "medium" else 16), 2048, args.steps
+    if args.batch:
+        batch = args.batch
+    if args.seq:
+        seq = args.seq
+
+    # single-host mesh over all local chips: fsdp over chips
+    mesh = make_mesh(MeshConfig(data=1, fsdp=n_dev, seq=1, tensor=1))
+    init, step, data_sharding, _ = make_train_step(cfg, mesh)
+    state = init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jax.device_put(
+        rng.randint(0, cfg.vocab_size, (batch, seq + 1)).astype(np.int32),
+        data_sharding)
+
+    # warmup (compile) then timed steps. NOTE: sync via host fetch —
+    # block_until_ready is a no-op on the experimental axon TPU platform.
+    for _ in range(3):
+        state, loss = step(state, tokens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, tokens)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n_params = cfg.num_params()
+    model_flops = 6.0 * n_params * tokens_per_sec  # fwd+bwd matmul FLOPs
+    peak = peak_flops_per_chip() * n_dev
+    mfu = model_flops / peak
+    out = {
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec / n_dev, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.35, 4),
+    }
+    print(json.dumps(out))
+    print(f"# cfg={cfg.dim}d/{cfg.n_layers}L params={n_params/1e6:.1f}M "
+          f"batch={batch} seq={seq} steps={steps} dt={dt:.2f}s "
+          f"mfu={mfu:.3f} loss={float(loss):.3f} devices={n_dev}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

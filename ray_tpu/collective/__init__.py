@@ -1,0 +1,35 @@
+"""Collective communication API — XLA-native replacement for NCCL groups.
+
+Same API shape as the reference's ``ray.util.collective``
+(``python/ray/util/collective/collective.py``: init_collective_group :120,
+allreduce :258, reduce :311, broadcast :373, allgather :423, reducescatter
+:472, send/recv :531/:594, barrier :298), with the NCCL backend replaced by
+XLA ICI collectives:
+
+- backend="xla": the caller process owns N local devices (a TPU host's chips,
+  or virtual CPU devices); collectives execute as tiny jitted shard_map
+  programs over a 1-D device mesh, compiled once per (op, shape, dtype) and
+  riding ICI. This is the TPU-native analog of NCCL's ring kernels.
+- backend="store": cross-process fallback over the distributed object store
+  (analog of the reference's Gloo/pygloo CPU backend) — used when group
+  members are separate worker actors without a shared XLA runtime. Rendezvous
+  goes through the head KV, like the reference's named-actor NCCLUniqueID
+  store (``collective_group/util.py:9,46``).
+"""
+
+from ray_tpu.collective.collective import (  # noqa: F401
+    GroupManager,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_group_handle,
+    init_collective_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.collective.types import ReduceOp  # noqa: F401

@@ -1,0 +1,34 @@
+"""Collective op types (reference: python/ray/util/collective/types.py)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"
+
+
+class Backend:
+    XLA = "xla"
+    STORE = "store"
+    NCCL = "nccl"  # rejected with a helpful error (no GPUs in a TPU cluster)
+    GLOO = "gloo"  # alias of STORE
+
+    @staticmethod
+    def normalize(name: str) -> str:
+        name = (name or "xla").lower()
+        if name == "nccl":
+            raise ValueError(
+                "NCCL is not available in a TPU cluster; use backend='xla' "
+                "(ICI collectives) or backend='store' (cross-process fallback)"
+            )
+        if name == "gloo":
+            return Backend.STORE
+        if name not in (Backend.XLA, Backend.STORE):
+            raise ValueError(f"unknown collective backend {name!r}")
+        return name

@@ -59,7 +59,8 @@ class ActorHandle:
         object.__setattr__(self, "_method_num_returns", method_num_returns or {})
 
     def __getattr__(self, name: str):
-        if name.startswith("__") and name.endswith("__") and name != "__ray_terminate__":
+        if (name.startswith("__") and name.endswith("__")
+                and name not in ("__ray_terminate__", "__collective_init__")):
             raise AttributeError(name)
         return ActorMethod(self, name, self._method_num_returns.get(name, 1))
 
@@ -69,7 +70,7 @@ class ActorHandle:
         runtime = get_current_runtime()
         if runtime is None:
             raise RuntimeError("ray_tpu.init() has not been called")
-        out_args, out_kwargs, pinned = prepare_args(runtime, args, kwargs)
+        out_args, out_kwargs, keepalive = prepare_args(runtime, args, kwargs)
         spec = TaskSpec(
             task_id=runtime.next_task_id(),
             job_id=runtime.runtime_context()["job_id"],
@@ -81,7 +82,7 @@ class ActorHandle:
             resources=parse_task_resources(num_cpus=0, default_num_cpus=0.0),
             max_retries=0,
             actor_id=self._actor_id,
-            pinned_args=pinned,
+            pinned_args=[r.id for r in keepalive],
         )
         refs = runtime.actor_method_call(spec)
         if num_returns == 0:
@@ -144,7 +145,7 @@ class ActorClass:
             self._registered_with = runtime
         opt = self._options
         actor_id = ActorID.from_random()
-        out_args, out_kwargs, pinned = prepare_args(runtime, args, kwargs)
+        out_args, out_kwargs, keepalive = prepare_args(runtime, args, kwargs)
         num_cpus = opt.get("num_cpus")
         if num_cpus is None:
             # reference semantics: actors default to 1 CPU for creation+life
@@ -173,9 +174,8 @@ class ActorClass:
             actor_id=actor_id,
             is_actor_creation=True,
             actor_max_concurrency=opt.get("max_concurrency", 1),
-            actor_is_async=self._is_async or opt.get("max_concurrency", 1) > 1
-            and self._is_async,
-            pinned_args=pinned,
+            actor_is_async=self._is_async,
+            pinned_args=[r.id for r in keepalive],
         )
         name = opt.get("name")
         namespace = opt.get("namespace", "default")

@@ -56,7 +56,9 @@ class Node:
         unit_names = set(cfg.unit_instance_resources.split(","))
         self.resources = NodeResources(resources, unit_instance_names=unit_names)
         self.resources.labels = self.labels
-        self.store = LocalObjectStore(session_dir, self.hex)
+        self.store = LocalObjectStore(
+            session_dir, self.hex,
+            pin_check=lambda oid: head.ref_counts.get(oid, 0) > 0)
         self.max_workers = max(1, int(resources.get("CPU", 1)))
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle: deque = deque()

@@ -137,6 +137,7 @@ class ObjectEntry:
     offset: int = -1  # arena offset for large objects
     sealed: bool = False
     is_error: bool = False  # payload is a serialized exception
+    mapped: bool = False  # a zero-copy view was handed out; do not move
     spilled_path: Optional[str] = None
     owner_node: Optional[bytes] = None
     ref_count: int = 0
@@ -150,7 +151,12 @@ class LocalObjectStore:
     Thread-safe; the node's RPC threads and driver call into it concurrently.
     """
 
-    def __init__(self, session_dir: str, node_hex: str, capacity: Optional[int] = None):
+    def __init__(self, session_dir: str, node_hex: str, capacity: Optional[int] = None,
+                 pin_check=None):
+        # pin_check(oid) -> bool: owner-side liveness (head ref counts). Read
+        # lock-free by design: called under the store lock, and the head may
+        # call into the store while holding its own lock (ABBA otherwise).
+        self._pin_check = pin_check or (lambda oid: False)
         cfg = global_config()
         self.capacity = capacity or cfg.object_store_memory
         shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else session_dir
@@ -237,6 +243,7 @@ class LocalObjectStore:
                 return e.inline, e.is_error
             if e.spilled_path is not None:
                 self._restore_locked(e)
+            e.mapped = True
             return self.arena.view(e.offset, e.size), e.is_error
 
     def entry_info(self, oid: ObjectID) -> Optional[Tuple[int, int, bool]]:
@@ -249,6 +256,7 @@ class LocalObjectStore:
             if e.spilled_path is not None:
                 self._restore_locked(e)
             e.last_access = time.monotonic()
+            e.mapped = True
             return e.offset, e.size, e.is_error
 
     # -- lifetime ----------------------------------------------------------
@@ -302,7 +310,7 @@ class LocalObjectStore:
             for e in candidates:
                 if freed >= need:
                     break
-                if e.ref_count <= 0:
+                if e.ref_count <= 0 and not self._pin_check(e.object_id):
                     self.arena.allocator.free(e.offset)
                     del self._entries[e.object_id]
                     freed += e.size
@@ -313,7 +321,8 @@ class LocalObjectStore:
             for e in candidates:
                 if freed >= need:
                     break
-                if e.object_id not in self._entries:
+                if e.object_id not in self._entries or e.mapped:
+                    # never move an object a zero-copy reader may alias
                     continue
                 self._spill_locked(e)
                 freed += e.size

@@ -27,9 +27,13 @@ def _function_id(payload: bytes) -> str:
     return hashlib.blake2b(payload, digest_size=16).hexdigest()
 
 
-def prepare_args(runtime, args, kwargs) -> Tuple[list, dict, List[ObjectID]]:
+def prepare_args(runtime, args, kwargs) -> Tuple[list, dict, List[ObjectRef]]:
+    """Returns (args, kwargs, keepalive). ``keepalive`` holds the ObjectRefs
+    of promoted large args — the caller must keep it alive until after
+    submission, when the head pins them via spec.pinned_args (otherwise GC
+    could delete the object between put and submit)."""
     cfg = global_config()
-    pinned: List[ObjectID] = []
+    keepalive: List[ObjectRef] = []
 
     def conv(a):
         if isinstance(a, ObjectRef):
@@ -37,13 +41,13 @@ def prepare_args(runtime, args, kwargs) -> Tuple[list, dict, List[ObjectID]]:
         s = serialization.serialize(a)
         if s.total_bytes > cfg.max_direct_call_object_size:
             ref = runtime.put(a)
-            pinned.append(ref.id)
+            keepalive.append(ref)
             return ("ref", ref.id)
         return ("v", s.to_bytes())
 
     out_args = [conv(a) for a in args]
     out_kwargs = {k: conv(v) for k, v in kwargs.items()}
-    return out_args, out_kwargs, pinned
+    return out_args, out_kwargs, keepalive
 
 
 def resolve_scheduling_strategy(strategy) -> SchedulingStrategy:
@@ -114,7 +118,7 @@ class RemoteFunction:
             raise RuntimeError("ray_tpu.init() has not been called")
         self._ensure_registered(runtime)
         opt = self._options
-        out_args, out_kwargs, pinned = prepare_args(runtime, args, kwargs)
+        out_args, out_kwargs, keepalive = prepare_args(runtime, args, kwargs)
         num_returns = opt.get("num_returns", 1)
         spec = TaskSpec(
             task_id=runtime.next_task_id(),
@@ -137,7 +141,7 @@ class RemoteFunction:
             scheduling_strategy=resolve_scheduling_strategy(
                 opt.get("scheduling_strategy")),
             runtime_env=opt.get("runtime_env"),
-            pinned_args=pinned,
+            pinned_args=[r.id for r in keepalive],
         )
         refs = runtime.submit_task(spec)
         if num_returns == 0:

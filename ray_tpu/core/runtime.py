@@ -53,6 +53,7 @@ class TaskRecord:
     worker_id: Optional[WorkerID] = None
     missing_deps: Set[ObjectID] = field(default_factory=set)
     cancelled: bool = False
+    unpinned: bool = False
 
 
 @dataclass
@@ -134,6 +135,8 @@ class Head:
         rec = TaskRecord(spec)
         with self._lock:
             self.tasks[spec.task_id] = rec
+            for oid in spec.pinned_args:  # keep promoted args alive
+                self.ref_counts[oid] += 1
         self._record_event(spec, "PENDING")
         if spec.actor_id is not None and not spec.is_actor_creation:
             self._submit_actor_task(rec)
@@ -240,6 +243,7 @@ class Head:
                 self._retry_task(rec, results)
                 return
             rec.state = "FAILED"
+            self._unpin_args(rec)
             self._record_event(spec, "FAILED", node.hex, error=err_name)
             self._seal_results(node, results)
             if spec.is_actor_creation:
@@ -247,6 +251,7 @@ class Head:
             self._after_seal(spec)
             return
         rec.state = "FINISHED"
+        self._unpin_args(rec)
         self._record_event(spec, "FINISHED", node.hex)
         self._seal_results(node, results)
         if spec.is_actor_creation:
@@ -295,8 +300,21 @@ class Head:
 
         threading.Thread(target=_resubmit, daemon=True).start()
 
+    def _unpin_args(self, rec: TaskRecord) -> None:
+        """Release promoted-arg pins once the task settles for good."""
+        if rec.unpinned or not rec.spec.pinned_args:
+            return
+        rec.unpinned = True
+        for oid in rec.spec.pinned_args:
+            with self._lock:
+                self.ref_counts[oid] -= 1
+                dead = self.ref_counts[oid] <= 0
+            if dead and not self._stopped:
+                self.delete_object(oid)
+
     def _fail_task_now(self, rec: TaskRecord, exc: Exception) -> None:
         rec.state = "FAILED"
+        self._unpin_args(rec)
         err = exc if isinstance(exc, (ActorDiedError, TaskCancelledError, ObjectLostError)) \
             else TaskError.from_exception(rec.spec.function_name, exc)
         payload = serialization.serialize(err).to_bytes()
@@ -535,6 +553,7 @@ class Head:
         Transfers from a remote node's store when needed (reference:
         object_manager.cc chunked pull)."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        attempted_reconstruction = False
         while True:
             if node.store.contains(oid):
                 info = node.store.entry_info(oid)
@@ -559,6 +578,11 @@ class Head:
                 node.store.seal(oid, is_err)
                 self.on_object_sealed(oid, node.hex)
                 return ("arena", off, len(data), is_err)
+            if not attempted_reconstruction and not locs:
+                # object lost with its node: lineage reconstruction, same as
+                # the driver get path (reference: object_recovery_manager.h)
+                if self._maybe_reconstruct(oid):
+                    attempted_reconstruction = True
             with self._object_cv:
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
@@ -743,9 +767,8 @@ class DriverRuntime:
             view[: len(buf)] = buf
             node.store.seal(oid, False)
         self.head.on_object_sealed(oid, node.hex)
-        with self.head._lock:
-            self.head.ref_counts[oid] += 1
-        return ObjectRef(oid, _register=False)
+        # registered ref: +1 now, -1 when the ObjectRef is GC'd -> deletable
+        return ObjectRef(oid)
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout

@@ -39,17 +39,38 @@ class Bundle:
     node_hex: Optional[str] = None
     # resources currently available inside the reservation
     available: Optional[Dict[str, int]] = None
+    # unit-instance indices reserved from the node (e.g. TPU chip ids) and
+    # the subset currently free inside the bundle
+    reserved_instances: Dict[str, List[int]] = field(default_factory=dict)
+    free_instances: Dict[str, List[int]] = field(default_factory=dict)
 
     def fits(self, req: ResourceSet) -> bool:
         return all(self.available.get(k, 0) >= v for k, v in req)
 
-    def acquire(self, req: ResourceSet) -> None:
+    def acquire(self, req: ResourceSet) -> Dict[str, List[int]]:
+        """Take resources + concrete device indices from the reservation."""
+        from .resources import from_fixed
+
+        binding: Dict[str, List[int]] = {}
         for k, v in req:
             self.available[k] = self.available.get(k, 0) - v
+            if k in self.free_instances:
+                whole = int(from_fixed(v))
+                if whole > 0:
+                    binding[k] = self.free_instances[k][:whole]
+                    self.free_instances[k] = self.free_instances[k][whole:]
+                elif self.free_instances[k]:
+                    binding[k] = self.free_instances[k][:1]
+        return binding
 
-    def release(self, req: ResourceSet) -> None:
+    def release(self, req: ResourceSet, binding: Optional[Dict[str, List[int]]] = None) -> None:
+        from .resources import from_fixed
+
         for k, v in req:
             self.available[k] = self.available.get(k, 0) + v
+            if binding and k in binding and int(from_fixed(v)) > 0:
+                self.free_instances[k] = sorted(
+                    self.free_instances.get(k, []) + binding[k])
 
 
 @dataclass
@@ -138,9 +159,9 @@ class ClusterScheduler:
                     # the in-use part comes back directly to the node here
                     nr = self._nodes.get(node_hex)
                     if nr is not None:
-                        nr.release(spec.resources)
+                        nr.release(spec.resources, binding)
                 elif 0 <= st.bundle_index < len(pg.bundles):
-                    pg.bundles[st.bundle_index].release(spec.resources)
+                    pg.bundles[st.bundle_index].release(spec.resources, binding)
             else:
                 nr = self._nodes.get(node_hex)
                 if nr is not None:
@@ -199,11 +220,10 @@ class ClusterScheduler:
             for i in indices:
                 b = pg.bundles[i]
                 if b.node_hex is not None and b.fits(spec.resources):
-                    b.acquire(spec.resources)
+                    binding = b.acquire(spec.resources)
                     if st.bundle_index < 0:
                         st.bundle_index = i
-                    # instance binding comes from the node's reservation
-                    return b.node_hex, spec, {}
+                    return b.node_hex, spec, binding
             return None
 
         if st.kind == "NODE_AFFINITY" and st.node_id is not None:
@@ -294,11 +314,14 @@ class ClusterScheduler:
             for b in pg.bundles:
                 if (b.node_hex is not None and b.node_hex in self._nodes
                         and b.available is not None):
-                    # return only the unused part now; resources held by
-                    # still-running tasks come back via release()
+                    # return only the unused part now (with its free device
+                    # indices); resources held by still-running tasks come
+                    # back via release()
                     self._nodes[b.node_hex].release(
-                        ResourceSet._from_fixed_map(b.available))
+                        ResourceSet._from_fixed_map(b.available),
+                        binding=b.free_instances)
                     b.available = {k: 0 for k in b.available}
+                    b.free_instances = {}
             self._wake.notify_all()
 
     def _try_schedule_pgs_locked(self) -> bool:
@@ -316,9 +339,11 @@ class ClusterScheduler:
                 continue
             for b, node_hex in zip(pg.bundles, plan):
                 nr = self._nodes[node_hex]
-                nr.allocate(b.resources)  # commit reservation
+                inst = nr.allocate(b.resources) or {}  # commit reservation
                 b.node_hex = node_hex
                 b.available = {k: v for k, v in b.resources}
+                b.reserved_instances = {k: list(v) for k, v in inst.items()}
+                b.free_instances = {k: list(v) for k, v in inst.items()}
             pg.state = "CREATED"
             pg.ready_event.set()
             progress = True

@@ -258,6 +258,8 @@ class WorkerRuntime:
 
     async def _execute_async(self, spec: TaskSpec, st: _ActorState) -> None:
         try:
+            if spec.task_id in self._cancelled:
+                raise TaskCancelledError(f"task {spec.task_id.hex()} cancelled")
             args, kwargs = self._resolve_args(spec)
             fn_name = spec.function_name.rsplit(".", 1)[-1]
             method = getattr(st.instance, fn_name)
@@ -267,6 +269,9 @@ class WorkerRuntime:
             self._finish(spec, result)
         except Exception as e:  # noqa: BLE001
             self._send_error(spec, e)
+        finally:
+            self._current_task.task_id = None
+            self._current_task.actor_id = None
 
     def _resolve_args(self, spec: TaskSpec):
         def resolve(v):
@@ -303,6 +308,15 @@ class WorkerRuntime:
                     self.channel.send("exit")
                     time.sleep(0.2)
                     os._exit(0)
+                if fn_name == "__collective_init__":
+                    # runtime-level hook so any actor can join a collective
+                    # group without declaring a method (reference:
+                    # create_collective_group's declarative setup)
+                    from ray_tpu.collective import init_collective_group
+
+                    init_collective_group(*args, **kwargs)
+                    self._finish(spec, None)
+                    return
                 method = getattr(st.instance, fn_name)
                 result = method(*args, **kwargs)
                 self._finish(spec, result)

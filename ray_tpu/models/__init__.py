@@ -1,0 +1,10 @@
+"""JAX-native model zoo: Llama-family decoder (flagship), MLP, ResNet."""
+
+from ray_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    param_logical_axes,
+)

@@ -1,0 +1,305 @@
+"""Llama-3-family decoder, TPU-first.
+
+Functional pytree model (no framework classes): params are nested dicts with
+per-leaf logical axes consumed by ray_tpu.parallel.sharding rules, so one
+model definition runs dp/fsdp/tp/sp via GSPMD. Design choices for the MXU:
+
+- layers stacked and scanned (``lax.scan``) — one compiled layer body,
+  constant compile time in depth;
+- bf16 matmuls with fp32 accumulation (``preferred_element_type``), params
+  stored fp32, gradients/optimizer fp32;
+- ``jax.checkpoint`` per layer (remat) to trade FLOPs for HBM;
+- attention: GQA + RoPE; ring attention over the ``seq`` mesh axis for long
+  context, plain (XLA-fused, or Pallas flash) otherwise;
+- static shapes everywhere; causal masking is position arithmetic, no
+  dynamic control flow.
+
+The reference delegates all of this to torch/DeepSpeed (SURVEY.md §2.3);
+here it is the in-framework flagship used by Train/Serve/bench.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.layers import rms_norm, rotary_embedding
+from ray_tpu.parallel.ring_attention import plain_attention, ring_attention_local
+from ray_tpu.parallel.sharding import DEFAULT_RULES, logical_sharding
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16  # compute dtype (params stored fp32)
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_1b() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, dim=2048, n_layers=16,
+                           n_heads=32, n_kv_heads=8, mlp_dim=8192)
+
+    @staticmethod
+    def small(vocab_size: int = 32000) -> "LlamaConfig":
+        """~110M params — single-chip bench size."""
+        return LlamaConfig(vocab_size=vocab_size, dim=768, n_layers=12,
+                           n_heads=12, n_kv_heads=4, mlp_dim=2048,
+                           max_seq_len=2048)
+
+    @staticmethod
+    def medium(vocab_size: int = 32000) -> "LlamaConfig":
+        """~500M params — fills a single v5e chip's MXU better."""
+        return LlamaConfig(vocab_size=vocab_size, dim=1280, n_layers=20,
+                           n_heads=16, n_kv_heads=8, mlp_dim=5120,
+                           max_seq_len=2048)
+
+    @staticmethod
+    def debug() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                           n_kv_heads=2, mlp_dim=128, max_seq_len=128,
+                           remat=False)
+
+    def num_params(self) -> int:
+        d, v, l = self.dim, self.vocab_size, self.n_layers
+        attn = d * d + 2 * d * (self.n_kv_heads * self.head_dim) + d * d
+        mlp = 3 * d * self.mlp_dim
+        per_layer = attn + mlp + 2 * d
+        emb = v * d * (1 if self.tie_embeddings else 2)
+        return emb + l * per_layer + d
+
+
+# --------------------------------------------------------------------------- #
+# Params
+# --------------------------------------------------------------------------- #
+
+
+def param_logical_axes(cfg: LlamaConfig) -> Dict[str, Any]:
+    """Pytree of per-leaf logical axis names (leading 'layers' = scan axis)."""
+    layer = {
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+        "attn_norm": ("layers", None),
+        "mlp_norm": ("layers", None),
+    }
+    out = {
+        "embedding": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": (None,),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = ("embed", "vocab")
+    return out
+
+
+def init_params(cfg: LlamaConfig, key) -> Dict[str, Any]:
+    d, hd = cfg.dim, cfg.head_dim
+    nq, nkv, L = cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    k = iter(jax.random.split(key, 16))
+
+    def dense(rng, shape, fan_in):
+        return (jax.random.normal(rng, shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in)))
+
+    params = {
+        "embedding": dense(next(k), (cfg.vocab_size, d), d),
+        "layers": {
+            "wq": dense(next(k), (L, d, nq * hd), d),
+            "wk": dense(next(k), (L, d, nkv * hd), d),
+            "wv": dense(next(k), (L, d, nkv * hd), d),
+            "wo": dense(next(k), (L, nq * hd, d), nq * hd),
+            "w_gate": dense(next(k), (L, d, cfg.mlp_dim), d),
+            "w_up": dense(next(k), (L, d, cfg.mlp_dim), d),
+            "w_down": dense(next(k), (L, cfg.mlp_dim, d), cfg.mlp_dim),
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "mlp_norm": jnp.ones((L, d), jnp.float32),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(next(k), (d, cfg.vocab_size), d)
+    return params
+
+
+# --------------------------------------------------------------------------- #
+# Forward
+# --------------------------------------------------------------------------- #
+
+
+def _attention(cfg: LlamaConfig, q, k, v, mesh):
+    """Dispatch: ring attention when the mesh shards sequence, else plain."""
+    B, T, H, D = q.shape
+    # GQA: repeat kv heads up to q heads
+    rep = cfg.n_heads // cfg.n_kv_heads
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if mesh is not None and "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
+        from jax.sharding import PartitionSpec as P
+
+        batch_axes = tuple(a for a in ("slice", "data", "fsdp")
+                           if a in mesh.axis_names)
+        ha = "tensor" if "tensor" in mesh.axis_names else None
+        spec = P(batch_axes if batch_axes else None, "seq", ha, None)
+        fn = jax.shard_map(
+            partial(ring_attention_local, axis_name="seq", causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v)
+    return plain_attention(q, k, v, causal=True)
+
+
+def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
+    """One decoder layer. x: [B, T, dim] (residual stream, cfg.dtype)."""
+    p = layer_params
+    cd = cfg.dtype
+    B, T, d = x.shape
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps).astype(cd)
+    q = (h @ p["wq"].astype(cd)).reshape(B, T, cfg.n_heads, cfg.head_dim)
+    kk = (h @ p["wk"].astype(cd)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    vv = (h @ p["wv"].astype(cd)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q, kk = rotary_embedding(q, kk, positions, cfg.rope_theta)
+    attn = _attention(cfg, q, kk, vv, mesh)
+    attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
+    x = x + (attn @ p["wo"].astype(cd)).astype(x.dtype)
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps).astype(cd)
+    g = jax.nn.silu(h @ p["w_gate"].astype(cd))
+    u = h @ p["w_up"].astype(cd)
+    x = x + ((g * u) @ p["w_down"].astype(cd)).astype(x.dtype)
+    return x
+
+
+def forward(cfg: LlamaConfig, params, tokens, mesh=None):
+    """tokens [B, T] int32 -> logits [B, T, vocab] (cfg.dtype)."""
+    B, T = tokens.shape
+    x = params["embedding"].astype(cfg.dtype)[tokens]
+    if mesh is not None:
+        from ray_tpu.parallel.sharding import constraint
+
+        x = constraint(x, ("batch", "seq", None), mesh)
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+
+    layer_fn = partial(_layer, cfg, mesh)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, static_argnums=())
+
+    def scan_body(carry, layer_params):
+        return layer_fn(carry, layer_params, positions), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    head = (params["embedding"].T if cfg.tie_embeddings
+            else params["lm_head"])
+    return (x.astype(cfg.dtype) @ head.astype(cfg.dtype))
+
+
+def loss_fn(cfg: LlamaConfig, params, tokens, mesh=None):
+    """Next-token cross-entropy; fp32 log-softmax. tokens [B, T+1]."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(cfg, params, inputs, mesh).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+# --------------------------------------------------------------------------- #
+# Train step (GSPMD)
+# --------------------------------------------------------------------------- #
+
+
+def make_train_step(cfg: LlamaConfig, mesh, optimizer=None, rules=None):
+    """Build (init_state, train_step) jitted over the mesh.
+
+    State = {params, opt_state, step}; shardings derive from logical axes.
+    XLA inserts all collectives (grad psum over data/fsdp, all-gathers for
+    fsdp params, tensor-parallel reduce-scatters) from the shardings alone.
+    """
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rules = rules or DEFAULT_RULES
+    optimizer = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95,
+                                         weight_decay=0.1)
+    axes = param_logical_axes(cfg)
+    param_shardings = jax.tree.map(
+        lambda ax: logical_sharding(ax, mesh, rules), axes,
+        is_leaf=lambda x: isinstance(x, tuple))
+    repl = NamedSharding(mesh, P())
+    batch_axes = tuple(a for a in ("slice", "data", "fsdp")
+                       if a in mesh.axis_names)
+    # tokens shard over batch only; the seq axis shards *activations* (a
+    # sharding constraint inside forward) — raw token length is T+1, not
+    # necessarily divisible by the seq axis
+    data_sharding = NamedSharding(mesh, P(batch_axes if batch_axes else None))
+
+    def opt_shardings(params_shardings, sample_params):
+        opt_state = jax.eval_shape(optimizer.init, sample_params)
+
+        def match(leaf):
+            # optimizer moments mirror param shapes; scalars replicate
+            shape = getattr(leaf, "shape", ())
+            for ps, pl in zip(jax.tree.leaves(params_shardings),
+                              jax.tree.leaves(sample_params)):
+                if getattr(pl, "shape", None) == shape and len(shape) > 0:
+                    return ps
+            return repl
+
+        return jax.tree.map(match, opt_state)
+
+    def init_state(key):
+        params = init_params(cfg, key)
+        opt_state = optimizer.init(params)
+        return {"params": params, "opt_state": opt_state,
+                "step": jnp.zeros((), jnp.int32)}
+
+    sample = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    state_shardings = {
+        "params": param_shardings,
+        "opt_state": opt_shardings(param_shardings, sample["params"]),
+        "step": repl,
+    }
+
+    init_jit = jax.jit(init_state, out_shardings=state_shardings)
+
+    def step_fn(state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, mesh))(state["params"])
+        updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                            state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return ({"params": new_params, "opt_state": new_opt,
+                 "step": state["step"] + 1}, loss)
+
+    train_step = jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, data_sharding),
+        out_shardings=(state_shardings, repl),
+        donate_argnums=(0,),
+    )
+    return init_jit, train_step, data_sharding, state_shardings

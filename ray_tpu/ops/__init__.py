@@ -1,0 +1,7 @@
+"""TPU compute ops: norms, rotary embeddings, attention kernels.
+
+jnp implementations everywhere (XLA fuses these well); Pallas TPU kernels
+underneath for the ops where hand-tiling beats XLA (flash attention).
+"""
+
+from ray_tpu.ops.layers import rms_norm, rotary_embedding, swiglu  # noqa: F401

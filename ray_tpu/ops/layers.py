@@ -1,0 +1,50 @@
+"""Elementwise / normalization / positional ops.
+
+These are deliberately plain jnp: XLA fuses them into surrounding matmuls on
+TPU (HBM-bandwidth-optimal), so Pallas here would be counterproductive.
+fp32 accumulation where it matters (norm statistics, rope trig).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-5):
+    """RMSNorm with fp32 statistics (llama-family norm)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return ((x32 * rms) * weight.astype(jnp.float32)).astype(dtype)
+
+
+def rotary_embedding(q, k, positions, theta: float = 500000.0):
+    """Apply RoPE to q,k of shape [B, T, H, D]; positions [B, T] or [T].
+
+    theta=500000 is the Llama-3 base frequency.
+    """
+    dtype = q.dtype
+    D = q.shape[-1]
+    if positions.ndim == 1:
+        positions = positions[None, :]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, D, 2, dtype=jnp.float32) / D))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x32 = x.astype(jnp.float32)
+        x1, x2 = jnp.split(x32, 2, axis=-1)
+        return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                               axis=-1).astype(dtype)
+
+    return rot(q), rot(k)
+
+
+def swiglu(x, w_gate, w_up, w_down, compute_dtype=jnp.bfloat16):
+    """SwiGLU MLP: down( silu(x@gate) * (x@up) ). Matmuls in bf16 for MXU."""
+    xc = x.astype(compute_dtype)
+    g = jax.nn.silu(xc @ w_gate.astype(compute_dtype))
+    u = xc @ w_up.astype(compute_dtype)
+    return ((g * u) @ w_down.astype(compute_dtype)).astype(x.dtype)

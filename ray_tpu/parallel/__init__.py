@@ -1,0 +1,15 @@
+"""Parallelism machinery: meshes, shardings, ring attention, pipeline.
+
+This is the subsystem the reference *delegates* to DeepSpeed/Megatron
+(SURVEY.md §2.3: TP/PP/SP/EP not implemented in-repo) made first-class and
+TPU-native: GSPMD shardings over a ``jax.sharding.Mesh``, with XLA inserting
+ICI/DCN collectives.
+"""
+
+from ray_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
+from ray_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    fsdp_sharding,
+    logical_sharding,
+    shard_pytree,
+)

@@ -1,0 +1,100 @@
+"""Device meshes for dp/fsdp/sp/tp/ep/pp parallelism.
+
+The mental model (How to Scale Your Model / GSPMD): pick a mesh whose axes
+match the parallelism strategy, annotate array shardings, let XLA insert the
+collectives. Axis order matters on TPU: the innermost (last) mesh axes map to
+physically-adjacent devices on the ICI torus, so put the
+bandwidth-hungry axis (tensor) last and the DCN-crossing axis (data or pipe)
+first. Multi-slice: a leading ``slice`` axis maps to DCN.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# canonical axis order: DCN-most-friendly first, ICI-bandwidth-hungry last
+AXIS_ORDER = ("slice", "pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+
+@dataclass
+class MeshConfig:
+    """Sizes of each parallelism axis; -1 infers from device count.
+
+    data    : pure data parallel (params replicated)
+    fsdp    : data parallel with params sharded (ZeRO-3 / FSDP analog)
+    seq     : sequence/context parallelism (ring attention axis)
+    tensor  : tensor (megatron-style) model parallelism
+    expert  : MoE expert parallelism
+    pipe    : pipeline stages
+    slice   : multi-slice (DCN) replicas
+    """
+
+    data: int = 1
+    fsdp: int = -1
+    seq: int = 1
+    tensor: int = 1
+    expert: int = 1
+    pipe: int = 1
+    slice: int = 1
+
+    def resolved(self, n_devices: int) -> Dict[str, int]:
+        sizes = {"slice": self.slice, "pipe": self.pipe, "data": self.data,
+                 "fsdp": self.fsdp, "expert": self.expert, "seq": self.seq,
+                 "tensor": self.tensor}
+        unknown = [k for k, v in sizes.items() if v == -1]
+        known = math.prod(v for v in sizes.values() if v != -1)
+        if n_devices % known:
+            raise ValueError(
+                f"mesh {sizes} incompatible with {n_devices} devices")
+        rest = n_devices // known
+        if not unknown:
+            # explicit sizes may use a subset of local devices
+            if known > n_devices:
+                raise ValueError(
+                    f"mesh size {known} > device count {n_devices}")
+        elif len(unknown) == 1:
+            sizes[unknown[0]] = rest
+        else:
+            # fill the first unknown with the remainder, others with 1
+            sizes[unknown[0]] = rest
+            for k in unknown[1:]:
+                sizes[k] = 1
+        return sizes
+
+
+def make_mesh(config: Optional[MeshConfig] = None, devices: Optional[list] = None,
+              axis_sizes: Optional[Dict[str, int]] = None):
+    """Build a jax Mesh. Either a MeshConfig or explicit {axis: size}."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = devices if devices is not None else jax.devices()
+    if axis_sizes is None:
+        config = config or MeshConfig()
+        axis_sizes = config.resolved(len(devs))
+    names = tuple(a for a in AXIS_ORDER if axis_sizes.get(a, 1) > 1)
+    if not names:
+        names = ("data",)
+        axis_sizes = {"data": 1}
+    shape = tuple(axis_sizes[a] for a in names)
+    n = math.prod(shape)
+    if n > len(devs):
+        raise ValueError(f"mesh needs {n} devices, have {len(devs)}")
+    arr = np.array(devs[:n]).reshape(shape)
+    return Mesh(arr, names)
+
+
+def data_axes(mesh) -> Tuple[str, ...]:
+    """Mesh axes a batch dimension shards over."""
+    return tuple(a for a in ("slice", "data", "fsdp") if a in mesh.axis_names)
+
+
+def batch_sharding(mesh):
+    """NamedSharding for a [batch, ...] host array."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    da = data_axes(mesh)
+    return NamedSharding(mesh, P(da if da else None))

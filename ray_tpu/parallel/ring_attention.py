@@ -1,0 +1,131 @@
+"""Ring attention: sequence-parallel exact attention over an ICI ring.
+
+Long-context capability the reference lacks in-repo (SURVEY.md §5
+"long-context / sequence parallelism: not implemented — delegated"). Here it
+is first-class: Q stays resident per device, K/V blocks rotate around the
+``seq`` mesh axis via ``ppermute`` (ICI neighbor exchanges), and softmax is
+accumulated online (flash-attention style max/sum carries), so attention over
+sequence length L costs O(L/n) memory per device with exact results.
+
+Implemented with jnp ops inside ``shard_map`` — XLA overlaps the ppermute
+with the block compute on TPU; a Pallas fused kernel can swap in underneath
+without changing this interface (see ray_tpu.ops.attention).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask, scale):
+    """One block of flash-style attention statistics.
+
+    q: [B, Tq, H, D]; k,v: [B, Tk, H, D]; mask: [Tq, Tk] bool or None.
+    Returns (o_unnorm [B,Tq,H,D], row_sum l [B,Tq,H], row_max m [B,Tq,H]).
+    """
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B,H,Tq,Tk]
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)  # [B,H,Tq]
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # [B,H,Tq]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, l, m
+
+
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = True):
+    """Per-device body; call inside shard_map with seq sharded on axis_name.
+
+    q, k, v: [B, T_local, H, D] (H = local heads, T_local = T/ring_size).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ring = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    q32 = q.astype(jnp.float32)
+
+    def step(i, carry):
+        o, l, m, kb, vb = carry
+        src = (my_idx - i) % ring  # which device this k/v block came from
+        if causal:
+            q_pos = my_idx * T + jnp.arange(T)[:, None]
+            kv_pos = src * T + jnp.arange(kb.shape[1])[None, :]
+            mask = q_pos >= kv_pos
+        else:
+            mask = None
+        ob, lb, mb = _block_attn(q32, kb.astype(jnp.float32),
+                                 vb.astype(jnp.float32), mask, scale)
+        ob = jnp.transpose(ob, (0, 2, 1, 3))  # [B,H,Tq,D] for f32 accum
+        m_new = jnp.maximum(m, mb)
+        corr = jnp.exp(m - m_new)
+        corr_b = jnp.exp(mb - m_new)
+        l = l * corr + lb * corr_b
+        o = o * corr[..., None] + ob * corr_b[..., None]
+        perm = [(j, (j + 1) % ring) for j in range(ring)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return o, l, m_new, kb, vb
+
+    o0 = jnp.zeros((B, H, T, D), jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    o, l, m, _, _ = lax.fori_loop(0, ring, step, (o0, l0, m0, k, v))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,T,H,D]
+
+
+def plain_attention(q, k, v, causal: bool = True):
+    """Reference full attention (no sequence sharding), fp32 softmax."""
+    import jax
+    import jax.numpy as jnp
+
+    B, T, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, k.shape[1]), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, causal: bool = True,
+                   seq_axis: str = "seq", head_axis: str = "tensor"):
+    """GSPMD-composable ring attention over a mesh.
+
+    q,k,v: global arrays [B, T, H, D] (sharded or not — shard_map will
+    repartition per the specs). Falls back to plain attention when the mesh
+    has no seq axis.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = functools.partial(jax.shard_map, check_vma=False)
+
+    batch_axes = tuple(a for a in ("slice", "data", "fsdp")
+                       if a in mesh.axis_names)
+    ha = head_axis if head_axis in mesh.axis_names else None
+    if seq_axis not in mesh.axis_names or mesh.shape.get(seq_axis, 1) == 1:
+        # no sequence sharding: plain attention; an enclosing jit's GSPMD
+        # partitions it over batch/head axes automatically
+        return plain_attention(q, k, v, causal)
+    spec = P(batch_axes if batch_axes else None, seq_axis, ha, None)
+    fn = shard_map(
+        functools.partial(ring_attention_local, axis_name=seq_axis,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

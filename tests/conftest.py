@@ -8,12 +8,18 @@ multi-chip sharding paths compile and run without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: env presets axon (the TPU tunnel)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # don't claim the TPU from tests
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# sitecustomize imported jax before us; force the platform at config level too
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

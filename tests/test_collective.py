@@ -1,0 +1,91 @@
+"""Collective API tests on the 8-virtual-device CPU mesh (reference model:
+python/ray/util/collective/tests)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.collective import (
+    ReduceOp,
+    allgather,
+    allreduce,
+    broadcast,
+    destroy_collective_group,
+    init_collective_group,
+    reducescatter,
+)
+
+
+@pytest.fixture
+def xla_group():
+    g = init_collective_group(world_size=8, backend="xla", group_name="t")
+    yield g
+    destroy_collective_group("t")
+
+
+def test_allreduce_sum(xla_group):
+    tensors = [np.full((4, 4), float(i)) for i in range(8)]
+    out = allreduce(tensors, group_name="t")
+    expected = np.full((4, 4), float(sum(range(8))))
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), expected)
+
+
+def test_allreduce_ops(xla_group):
+    tensors = [np.full((2,), float(i + 1)) for i in range(8)]
+    assert np.asarray(allreduce(tensors, "t", ReduceOp.MAX))[0][0] == 8.0
+    assert np.asarray(allreduce(tensors, "t", ReduceOp.MIN))[0][0] == 1.0
+    np.testing.assert_allclose(
+        np.asarray(allreduce(tensors, "t", ReduceOp.MEAN)[0]), [4.5, 4.5])
+
+
+def test_allgather(xla_group):
+    tensors = [np.array([float(i)]) for i in range(8)]
+    out = allgather(tensors, group_name="t")
+    np.testing.assert_allclose(np.asarray(out[0]).ravel(),
+                               np.arange(8, dtype=float))
+
+
+def test_reducescatter(xla_group):
+    tensors = [np.arange(16, dtype=float) for _ in range(8)]
+    out = reducescatter(tensors, group_name="t")
+    # each rank gets its 2-element chunk of the 8x summed vector
+    np.testing.assert_allclose(np.asarray(out[3]),
+                               np.arange(16, dtype=float)[6:8] * 8)
+
+
+def test_broadcast(xla_group):
+    tensors = [np.full((3,), float(i)) for i in range(8)]
+    out = broadcast(tensors, src_rank=5, group_name="t")
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), np.full((3,), 5.0))
+
+
+def test_nccl_backend_rejected():
+    with pytest.raises(ValueError, match="NCCL is not available"):
+        init_collective_group(world_size=2, backend="nccl", group_name="x")
+
+
+def test_store_group_across_actors(ray_start_regular):
+    """Cross-process collective over the object store (gloo-backend analog)."""
+    import ray_tpu
+    from ray_tpu.collective import create_collective_group
+
+    @ray_tpu.remote
+    class Rank:
+        def setup(self, ws, rank):
+            self.rank = rank
+
+        def do_allreduce(self, value):
+            from ray_tpu.collective import allreduce as ar
+            import numpy as np
+
+            return np.asarray(ar(np.full((2,), float(value)), group_name="g"))
+
+    actors = [Rank.remote() for _ in range(2)]
+    create_collective_group(actors, world_size=2, ranks=[0, 1],
+                            backend="store", group_name="g")
+    r0, r1 = ray_tpu.get(
+        [actors[0].do_allreduce.remote(1), actors[1].do_allreduce.remote(2)],
+        timeout=120)
+    np.testing.assert_allclose(r0, [3.0, 3.0])
+    np.testing.assert_allclose(r1, [3.0, 3.0])

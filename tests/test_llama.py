@@ -1,0 +1,109 @@
+"""Flagship model tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+)
+from ray_tpu.parallel import MeshConfig, make_mesh
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig.debug()
+
+
+def test_param_count_formula(cfg):
+    import jax
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    actual = sum(x.size for x in jax.tree.leaves(params))
+    assert actual == cfg.num_params()
+
+
+def test_forward_shape(cfg):
+    import jax
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens = np.zeros((2, 16), np.int32)
+    logits = forward(cfg, params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+
+
+def test_causality(cfg):
+    """Changing a future token must not change past logits."""
+    import jax
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    t1 = np.ones((1, 16), np.int32)
+    t2 = t1.copy()
+    t2[0, 10:] = 5
+    l1 = np.asarray(forward(cfg, params, t1), np.float32)
+    l2 = np.asarray(forward(cfg, params, t2), np.float32)
+    np.testing.assert_allclose(l1[0, :10], l2[0, :10], atol=1e-3)
+    assert not np.allclose(l1[0, 10:], l2[0, 10:], atol=1e-3)
+
+
+@pytest.mark.parametrize("mesh_cfg", [
+    MeshConfig(data=8),
+    MeshConfig(fsdp=8),
+    MeshConfig(data=2, fsdp=2, tensor=2),
+    MeshConfig(fsdp=2, seq=2, tensor=2),
+])
+def test_train_step_shardings(cfg, mesh_cfg):
+    """Full train step compiles + executes + reduces loss under every
+    parallelism combo (dp / fsdp / dp+fsdp+tp / fsdp+sp+tp)."""
+    import jax
+
+    mesh = make_mesh(mesh_cfg)
+    init, step, data_sharding, _ = make_train_step(cfg, mesh)
+    state = init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    tokens = jax.device_put(
+        rng.randint(0, cfg.vocab_size, (8, 33)).astype(np.int32),
+        data_sharding)
+    losses = []
+    for _ in range(5):
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+    assert np.isfinite(losses).all()
+
+
+def test_parallelism_consistency(cfg):
+    """Same seed + data → same loss trajectory under different shardings."""
+    import jax
+
+    rng = np.random.RandomState(1)
+    tokens_np = rng.randint(0, cfg.vocab_size, (8, 17)).astype(np.int32)
+    results = []
+    for mc in [MeshConfig(data=8), MeshConfig(fsdp=4, tensor=2)]:
+        mesh = make_mesh(mc)
+        init, step, data_sharding, _ = make_train_step(cfg, mesh)
+        state = init(jax.random.PRNGKey(42))
+        tokens = jax.device_put(tokens_np, data_sharding)
+        state, l1 = step(state, tokens)
+        state, l2 = step(state, tokens)
+        results.append((float(l1), float(l2)))
+    np.testing.assert_allclose(results[0], results[1], rtol=2e-3)
+
+
+def test_loss_decreases_quickly_overfit(cfg):
+    import jax
+
+    mesh = make_mesh(MeshConfig(data=1, fsdp=1))
+    init, step, data_sharding, _ = make_train_step(cfg, mesh)
+    state = init(jax.random.PRNGKey(0))
+    tokens = np.tile(np.arange(32, dtype=np.int32), (4, 1))
+    tokens = jax.device_put(tokens, data_sharding)
+    first = None
+    for i in range(30):
+        state, loss = step(state, tokens)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.5, f"{first} -> {float(loss)}"
