@@ -27,9 +27,12 @@ from ray_tpu.core.exceptions import (  # noqa: F401
     ActorDiedError,
     ActorError,
     GetTimeoutError,
+    NodeDiedError,
     ObjectLostError,
     ObjectStoreFullError,
+    PlacementGroupError,
     RayTpuError,
+    RuntimeEnvSetupError,
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,
@@ -43,5 +46,6 @@ __all__ = [
     "cluster_resources", "nodes", "ObjectRef", "get_runtime_context",
     "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
     "ObjectLostError", "ObjectStoreFullError", "TaskCancelledError",
-    "WorkerCrashedError", "GetTimeoutError", "__version__",
+    "WorkerCrashedError", "GetTimeoutError", "PlacementGroupError",
+    "NodeDiedError", "RuntimeEnvSetupError", "__version__",
 ]

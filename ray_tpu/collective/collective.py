@@ -204,6 +204,7 @@ class StoreGroup:
         self.rank = rank
         self.group_name = group_name
         self._seq = 0
+        self._p2p: Dict[tuple, int] = {}
         # register membership
         self._kv_put(f"member/{rank}", b"1")
         deadline = time.monotonic() + 60
@@ -285,20 +286,37 @@ class StoreGroup:
         return self._get_tensor(seq, src_rank)
 
     def send(self, tensor, dst_rank: int):
-        seq = self._seq
-        self._seq += 1
-        self._put_tensor(seq, self.rank, tensor)
+        """P2P ops use a per-pair keyspace so collective _seq counters stay
+        aligned across all ranks (pairwise traffic must not desynchronize
+        group-wide sequencing)."""
+        n = self._p2p.get((self.rank, dst_rank), 0)
+        self._p2p[(self.rank, dst_rank)] = n + 1
+        ref = self.rt.put(np.asarray(tensor))
+        self._kv_put(f"p2p/{self.rank}/{dst_rank}/{n}", ref.id.binary())
 
-    def recv(self, src_rank: int):
-        seq = self._seq
-        self._seq += 1
-        return self._get_tensor(seq, src_rank)
+    def recv(self, src_rank: int, timeout: float = 120.0):
+        from ray_tpu.core.ids import ObjectID
+        from ray_tpu.core.object_ref import ObjectRef
+
+        n = self._p2p.get((src_rank, self.rank), 0)
+        self._p2p[(src_rank, self.rank)] = n + 1
+        deadline = time.monotonic() + timeout
+        while True:
+            raw = self._kv_get(f"p2p/{src_rank}/{self.rank}/{n}")
+            if raw is not None:
+                return self.rt.get([ObjectRef(ObjectID(raw), _register=False)])[0]
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"recv from rank {src_rank} timed out")
+            time.sleep(0.005)
 
     def barrier(self):
         self.allreduce(np.zeros(1))
 
     def destroy(self):
-        pass
+        # drop all of this group's KV keys so a recreated group under the
+        # same name doesn't read stale tensors
+        for key in self.rt.kv("keys", self._key(""), self.NS):
+            self.rt.kv("del", key, self.NS)
 
 
 # --------------------------------------------------------------------------- #
@@ -380,17 +398,16 @@ def get_group_handle(group_name: str = "default"):
 
 def allreduce(tensor_or_list, group_name: str = "default",
               op: ReduceOp = ReduceOp.SUM):
-    g = get_group_handle(group_name)
-    if isinstance(g, XlaGroup):
-        return g.allreduce(tensor_or_list, op)
-    return g.allreduce(tensor_or_list, op)
+    return get_group_handle(group_name).allreduce(tensor_or_list, op)
 
 
 def reduce(tensor_or_list, dst_rank: int = 0, group_name: str = "default",
            op: ReduceOp = ReduceOp.SUM):
-    g = get_group_handle(group_name)
-    out = g.allreduce(tensor_or_list, op)
-    return out
+    """Implemented as allreduce (every rank gets the result); only dst_rank's
+    value is meaningful per the reference contract — on TPU the ICI
+    collective is all-to-all anyway, so there is no savings in a true
+    single-destination reduce."""
+    return get_group_handle(group_name).allreduce(tensor_or_list, op)
 
 
 def broadcast(tensor_or_list, src_rank: int = 0, group_name: str = "default"):

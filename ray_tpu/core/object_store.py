@@ -310,7 +310,11 @@ class LocalObjectStore:
             for e in candidates:
                 if freed >= need:
                     break
-                if e.ref_count <= 0 and not self._pin_check(e.object_id):
+                # never relocate/free an entry whose zero-copy view was handed
+                # out (a reader may alias the arena range); explicit delete()
+                # via refcount-0 is the user-driven path that still frees it
+                if (e.ref_count <= 0 and not e.mapped
+                        and not self._pin_check(e.object_id)):
                     self.arena.allocator.free(e.offset)
                     del self._entries[e.object_id]
                     freed += e.size

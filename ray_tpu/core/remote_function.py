@@ -37,7 +37,8 @@ def prepare_args(runtime, args, kwargs) -> Tuple[list, dict, List[ObjectRef]]:
 
     def conv(a):
         if isinstance(a, ObjectRef):
-            return ("ref", a.id)
+            keepalive.append(a)  # pin user refs too: the caller may drop
+            return ("ref", a.id)  # theirs while the task is still pending
         s = serialization.serialize(a)
         if s.total_bytes > cfg.max_direct_call_object_size:
             ref = runtime.put(a)

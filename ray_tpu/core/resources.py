@@ -112,8 +112,10 @@ class NodeResources:
                     self.free_instances[name] = self.free_instances[name][whole:]
                     binding[name] = idxs
                 else:
-                    # fractional: bind to the first (possibly shared) instance
-                    binding[name] = self.free_instances[name][:1]
+                    # fractional: share the LAST free instance (whole-unit
+                    # acquires pop from the front, minimizing collisions;
+                    # per-instance fractional accounting is a TODO)
+                    binding[name] = self.free_instances[name][-1:]
         return binding
 
     def release(self, req: ResourceSet, binding: Optional[Dict[str, List[int]]] = None):

@@ -301,15 +301,18 @@ class Head:
         threading.Thread(target=_resubmit, daemon=True).start()
 
     def _unpin_args(self, rec: TaskRecord) -> None:
-        """Release promoted-arg pins once the task settles for good."""
-        if rec.unpinned or not rec.spec.pinned_args:
-            return
-        rec.unpinned = True
-        for oid in rec.spec.pinned_args:
-            with self._lock:
+        """Release arg pins once the task settles for good."""
+        to_delete = []
+        with self._lock:
+            if rec.unpinned or not rec.spec.pinned_args:
+                return
+            rec.unpinned = True
+            for oid in rec.spec.pinned_args:
                 self.ref_counts[oid] -= 1
-                dead = self.ref_counts[oid] <= 0
-            if dead and not self._stopped:
+                if self.ref_counts[oid] <= 0:
+                    to_delete.append(oid)
+        if not self._stopped:
+            for oid in to_delete:
                 self.delete_object(oid)
 
     def _fail_task_now(self, rec: TaskRecord, exc: Exception) -> None:

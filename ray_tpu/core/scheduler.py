@@ -60,7 +60,9 @@ class Bundle:
                     binding[k] = self.free_instances[k][:whole]
                     self.free_instances[k] = self.free_instances[k][whole:]
                 elif self.free_instances[k]:
-                    binding[k] = self.free_instances[k][:1]
+                    # fractional: share the last free instance (see
+                    # NodeResources.allocate for rationale)
+                    binding[k] = self.free_instances[k][-1:]
         return binding
 
     def release(self, req: ResourceSet, binding: Optional[Dict[str, List[int]]] = None) -> None:

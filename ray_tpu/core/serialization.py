@@ -64,11 +64,21 @@ class SerializedObject:
 
 
 def serialize(value: Any) -> SerializedObject:
+    import cloudpickle
+
     buffers: List[pickle.PickleBuffer] = []
     contained: List[Any] = []
     _contained_refs_ctx.append(contained)
     try:
-        meta = pickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+        try:
+            # fast path: plain pickle (no bytecode scanning)
+            meta = pickle.dumps(value, protocol=5,
+                                buffer_callback=buffers.append)
+        except (pickle.PicklingError, AttributeError, TypeError):
+            buffers.clear()
+            # local classes / closures / lambdas (reference: ray cloudpickle)
+            meta = cloudpickle.dumps(value, protocol=5,
+                                     buffer_callback=buffers.append)
     finally:
         _contained_refs_ctx.pop()
     return SerializedObject(meta, buffers, contained)

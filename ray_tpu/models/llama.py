@@ -259,18 +259,28 @@ def make_train_step(cfg: LlamaConfig, mesh, optimizer=None, rules=None):
     data_sharding = NamedSharding(mesh, P(batch_axes if batch_axes else None))
 
     def opt_shardings(params_shardings, sample_params):
-        opt_state = jax.eval_shape(optimizer.init, sample_params)
+        """Match optimizer-state leaves to param shardings *structurally*:
+        optax moment pytrees mirror the params pytree, so a state leaf whose
+        path suffix equals a param path gets that param's sharding. (Shape
+        matching is wrong: wq/wo share a shape but have transposed specs.)"""
+        from jax.tree_util import tree_flatten_with_path, tree_map_with_path
 
-        def match(leaf):
-            # optimizer moments mirror param shapes; scalars replicate
-            shape = getattr(leaf, "shape", ())
-            for ps, pl in zip(jax.tree.leaves(params_shardings),
-                              jax.tree.leaves(sample_params)):
-                if getattr(pl, "shape", None) == shape and len(shape) > 0:
+        opt_state = jax.eval_shape(optimizer.init, sample_params)
+        flat_params, _ = tree_flatten_with_path(sample_params)
+        by_path = {}
+        for (path, leaf), ps in zip(
+                flat_params, jax.tree.leaves(params_shardings)):
+            by_path[tuple(str(k) for k in path)] = ps
+
+        def match(path, leaf):
+            p = tuple(str(k) for k in path)
+            for start in range(len(p)):
+                ps = by_path.get(p[start:])
+                if ps is not None:
                     return ps
             return repl
 
-        return jax.tree.map(match, opt_state)
+        return tree_map_with_path(match, opt_state)
 
     def init_state(key):
         params = init_params(cfg, key)

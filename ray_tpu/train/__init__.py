@@ -1,0 +1,27 @@
+"""Distributed training orchestration — the Ray Train analog, JAX-native.
+
+Reference surface (python/ray/train): Trainer.fit, ScalingConfig, RunConfig,
+FailureConfig, CheckpointConfig, Checkpoint, session report/get_context/
+get_dataset_shard. The torch/NCCL backends are replaced by JaxBackend
+(jax.distributed + GSPMD in-loop).
+"""
+
+from ray_tpu.train.backend_executor import (  # noqa: F401
+    Backend,
+    BackendExecutor,
+    JaxBackend,
+)
+from ray_tpu.train.checkpoint import Checkpoint  # noqa: F401
+from ray_tpu.train.config import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (  # noqa: F401
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401

@@ -1,0 +1,261 @@
+"""BackendExecutor + worker group + JAX backend.
+
+Analog of the reference's ``train/_internal/backend_executor.py:67`` (start
+:129: create placement group :213-236, spawn WorkerGroup actors, wire the
+framework process group) and ``worker_group.py:102``. The torch-NCCL backend
+(``train/torch/config.py:154 _TorchBackend`` → dist.init_process_group) maps
+to :class:`JaxBackend`: per-worker env vars + ``jax.distributed.initialize``
+for multi-host pods (pattern follows the reference's torch-xla backend,
+``train/torch/xla/config.py:41,67``, the closest in-repo TPU precedent).
+
+Gang scheduling: one bundle per worker inside a single placement group;
+worker loss tears down and recreates the whole group (SPMD programs cannot
+survive partial membership — SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext, set_context
+
+
+class Backend:
+    """Framework-backend plugin interface (reference: train/backend.py:16)."""
+
+    def on_start(self, worker_metadata: List[dict]) -> List[dict]:
+        """Compute per-worker env/setup payloads before training starts."""
+        return [{} for _ in worker_metadata]
+
+    def on_shutdown(self) -> None:
+        pass
+
+
+class JaxBackend(Backend):
+    """Wire a JAX distributed runtime across the worker gang.
+
+    Single-host (all chips visible to one worker): nothing to do — the
+    worker owns its chips. Multi-worker: worker 0 is the coordinator;
+    every worker gets coordinator_address/num_processes/process_id for
+    ``jax.distributed.initialize`` plus megascale-style env for multi-slice.
+    """
+
+    def __init__(self, coordinator_port: int = 8476):
+        self.coordinator_port = coordinator_port
+
+    def on_start(self, worker_metadata: List[dict]) -> List[dict]:
+        n = len(worker_metadata)
+        if n == 1:
+            return [{}]
+        coord_ip = worker_metadata[0].get("ip", "127.0.0.1")
+        coord = f"{coord_ip}:{self.coordinator_port}"
+        return [
+            {
+                "env": {
+                    "JAX_COORDINATOR_ADDRESS": coord,
+                    "JAX_NUM_PROCESSES": str(n),
+                    "JAX_PROCESS_ID": str(i),
+                },
+                "jax_distributed": {
+                    "coordinator_address": coord,
+                    "num_processes": n,
+                    "process_id": i,
+                },
+            }
+            for i in range(n)
+        ]
+
+
+class TrainWorker:
+    """Actor running one rank of the gang (reference: worker actors created
+    by WorkerGroup; the train thread + session live here)."""
+
+    def __init__(self, world_size: int, world_rank: int, local_rank: int,
+                 node_rank: int, experiment_name: str, trial_dir: str):
+        self.meta = dict(world_size=world_size, world_rank=world_rank,
+                         local_rank=local_rank, node_rank=node_rank)
+        self.experiment_name = experiment_name
+        self.trial_dir = trial_dir
+        self.ctx: Optional[TrainContext] = None
+        self._thread: Optional[threading.Thread] = None
+        self._done = False
+        self._error: Optional[str] = None
+
+    def get_metadata(self) -> dict:
+        import socket
+
+        ctx = ray_tpu.get_runtime_context()
+        return {"ip": "127.0.0.1", "hostname": socket.gethostname(),
+                "node_id": ctx.get_node_id(),
+                "accelerator_ids": ctx.get_accelerator_ids()}
+
+    def setup(self, backend_payload: dict,
+              latest_checkpoint_path: Optional[str],
+              dataset_shards: Optional[Dict[str, Any]]) -> bool:
+        for k, v in backend_payload.get("env", {}).items():
+            os.environ[k] = v
+        jd = backend_payload.get("jax_distributed")
+        if jd is not None:
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=jd["coordinator_address"],
+                num_processes=jd["num_processes"],
+                process_id=jd["process_id"])
+        ckpt = (Checkpoint(latest_checkpoint_path)
+                if latest_checkpoint_path else None)
+        self.ctx = TrainContext(
+            world_size=self.meta["world_size"],
+            world_rank=self.meta["world_rank"],
+            local_rank=self.meta["local_rank"],
+            local_world_size=1,
+            node_rank=self.meta["node_rank"],
+            experiment_name=self.experiment_name,
+            latest_checkpoint=ckpt,
+            dataset_shards=dataset_shards,
+            trial_dir=self.trial_dir,
+        )
+        return True
+
+    def start_training(self, train_fn_payload: bytes, config: dict) -> bool:
+        import cloudpickle
+
+        train_fn = cloudpickle.loads(train_fn_payload)
+        set_context(self.ctx)
+
+        def run():
+            try:
+                import inspect
+
+                sig = inspect.signature(train_fn)
+                if len(sig.parameters) >= 1:
+                    train_fn(config)
+                else:
+                    train_fn()
+            except BaseException:  # noqa: BLE001
+                self._error = traceback.format_exc()
+            finally:
+                self._done = True
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="train-loop")
+        self._thread.start()
+        return True
+
+    def poll(self) -> dict:
+        reports = self.ctx._drain() if self.ctx else []
+        return {
+            "reports": [(r.metrics, r.checkpoint_path) for r in reports],
+            "done": self._done,
+            "error": self._error,
+        }
+
+    def shutdown(self) -> bool:
+        return True
+
+
+@dataclass
+class WorkerGroupState:
+    actors: List[Any]
+    pg: Any
+
+
+class BackendExecutor:
+    """Drives the gang: placement group → actors → backend → train → poll.
+
+    Reference: backend_executor.py start/start_training/pause polling,
+    plus the trainer-side restart loop from base_trainer FailureConfig.
+    """
+
+    def __init__(self, scaling: ScalingConfig, backend: Optional[Backend],
+                 experiment_name: str, trial_dir: str):
+        self.scaling = scaling
+        self.backend = backend or JaxBackend()
+        self.experiment_name = experiment_name
+        self.trial_dir = trial_dir
+        self.state: Optional[WorkerGroupState] = None
+
+    def start(self, latest_checkpoint_path: Optional[str],
+              dataset_shards_per_worker: Optional[List[Dict[str, Any]]] = None):
+        n = self.scaling.num_workers
+        pg = placement_group(self.scaling.bundles(),
+                             strategy=self.scaling.placement_strategy)
+        if not pg.ready(timeout=120):
+            remove_placement_group(pg)
+            raise ray_tpu.PlacementGroupError(
+                f"cannot reserve {n} x {self.scaling.worker_resources()} "
+                f"(available: {ray_tpu.available_resources()})")
+        res = self.scaling.worker_resources()
+        WorkerActor = ray_tpu.remote(TrainWorker)
+        actors = []
+        for rank in range(n):
+            strat = PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=rank)
+            opts = dict(scheduling_strategy=strat,
+                        num_cpus=res.get("CPU", 1))
+            if "TPU" in res:
+                opts["num_tpus"] = res["TPU"]
+                opts["num_cpus"] = res.get("CPU", 1)
+            extra = {k: v for k, v in res.items()
+                     if k not in ("CPU", "TPU", "GPU")}
+            if extra:
+                opts["resources"] = extra
+            actors.append(WorkerActor.options(**opts).remote(
+                n, rank, 0, rank, self.experiment_name, self.trial_dir))
+        metadata = ray_tpu.get([a.get_metadata.remote() for a in actors],
+                               timeout=180)
+        payloads = self.backend.on_start(metadata)
+        shards = dataset_shards_per_worker or [None] * n
+        ray_tpu.get([
+            a.setup.remote(p, latest_checkpoint_path, s)
+            for a, p, s in zip(actors, payloads, shards)
+        ], timeout=180)
+        self.state = WorkerGroupState(actors, pg)
+
+    def run(self, train_fn, config: dict, on_report: Callable[[int, dict, Optional[str]], None],
+            poll_interval: float = 0.2) -> Optional[str]:
+        """Run the loop on all workers; stream reports. Returns error text."""
+        import cloudpickle
+
+        payload = cloudpickle.dumps(train_fn)
+        actors = self.state.actors
+        ray_tpu.get([a.start_training.remote(payload, config) for a in actors],
+                    timeout=120)
+        done = [False] * len(actors)
+        error: Optional[str] = None
+        while not all(done):
+            time.sleep(poll_interval)
+            polls = ray_tpu.get([a.poll.remote() for a in actors], timeout=120)
+            for rank, p in enumerate(polls):
+                for metrics, ckpt_path in p["reports"]:
+                    on_report(rank, metrics, ckpt_path)
+                if p["error"] and error is None:
+                    error = f"worker {rank}:\n{p['error']}"
+                done[rank] = p["done"]
+            if error:
+                break
+        return error
+
+    def shutdown(self):
+        if self.state is None:
+            return
+        for a in self.state.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self.state.pg)
+        except Exception:
+            pass
+        self.state = None

@@ -1,0 +1,42 @@
+"""Checkpoint handle (reference: python/ray/train/_checkpoint.py:56).
+
+A directory on (for now local/fsspec-style) storage. Frameworks layer their
+formats on top — JAX state goes through orbax (see JaxTrainer examples) or
+plain msgpack/npz.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+import uuid
+from typing import Iterator, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Copy checkpoint contents to a local directory and return it."""
+        dest = path or os.path.join(tempfile.gettempdir(),
+                                    f"ckpt_{uuid.uuid4().hex[:8]}")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))

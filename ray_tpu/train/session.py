@@ -1,0 +1,118 @@
+"""Per-worker training session (reference: train/_internal/session.py:111).
+
+``ray_tpu.train.report(metrics, checkpoint=...)`` (:403 in the reference)
+buffers results on the worker; the driver's BackendExecutor drains them via
+the worker actor. ``get_context()`` exposes world/local ranks (reference
+:147) and the dataset shard accessor.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclass
+class _Report:
+    metrics: Dict[str, Any]
+    checkpoint_path: Optional[str] = None
+
+
+class TrainContext:
+    def __init__(self, world_size: int, world_rank: int, local_rank: int,
+                 local_world_size: int, node_rank: int,
+                 experiment_name: str = "",
+                 latest_checkpoint: Optional[Checkpoint] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 trial_dir: str = ""):
+        self._world_size = world_size
+        self._world_rank = world_rank
+        self._local_rank = local_rank
+        self._local_world_size = local_world_size
+        self._node_rank = node_rank
+        self._experiment_name = experiment_name
+        self._latest_checkpoint = latest_checkpoint
+        self._dataset_shards = dataset_shards or {}
+        self._trial_dir = trial_dir
+        self._reports: List[_Report] = []
+        self._lock = threading.Lock()
+        self._stop_requested = False
+
+    # -- public api mirrored from the reference session ---------------------
+
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    def get_world_rank(self) -> int:
+        return self._world_rank
+
+    def get_local_rank(self) -> int:
+        return self._local_rank
+
+    def get_local_world_size(self) -> int:
+        return self._local_world_size
+
+    def get_node_rank(self) -> int:
+        return self._node_rank
+
+    def get_experiment_name(self) -> str:
+        return self._experiment_name
+
+    def get_trial_dir(self) -> str:
+        return self._trial_dir
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self._latest_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self._dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(
+                f"no dataset shard {name!r}; pass datasets={{'{name}': ds}} "
+                f"to the trainer")
+        return shard
+
+    # -- internal -----------------------------------------------------------
+
+    def _report(self, metrics: Dict[str, Any],
+                checkpoint: Optional[Checkpoint]) -> None:
+        with self._lock:
+            self._reports.append(
+                _Report(dict(metrics),
+                        checkpoint.path if checkpoint else None))
+
+    def _drain(self) -> List[_Report]:
+        with self._lock:
+            out, self._reports = self._reports, []
+            return out
+
+
+_context: Optional[TrainContext] = None
+
+
+def set_context(ctx: Optional[TrainContext]) -> None:
+    global _context
+    _context = ctx
+
+
+def get_context() -> TrainContext:
+    if _context is None:
+        raise RuntimeError(
+            "ray_tpu.train.get_context() called outside a training worker")
+    return _context
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    get_context()._report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    return get_context().get_checkpoint()
+
+
+def get_dataset_shard(name: str = "train"):
+    return get_context().get_dataset_shard(name)

@@ -1,0 +1,223 @@
+"""JaxTrainer tests (reference model: python/ray/train/tests)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+@pytest.fixture
+def train_cluster(tmp_path):
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield str(tmp_path)
+    ray_tpu.shutdown()
+
+
+def test_single_worker_fit(train_cluster):
+    def loop(config):
+        from ray_tpu import train
+
+        for i in range(config["steps"]):
+            train.report({"step": i, "loss": 1.0 / (i + 1)})
+
+    result = JaxTrainer(
+        loop,
+        train_loop_config={"steps": 3},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t1", storage_path=train_cluster),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 2
+    assert len(result.metrics_dataframe) == 3
+
+
+def test_multi_worker_ranks(train_cluster):
+    def loop(config):
+        from ray_tpu import train
+
+        ctx = train.get_context()
+        train.report({"rank": ctx.get_world_rank(),
+                      "world": ctx.get_world_size()})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t2", storage_path=train_cluster),
+    ).fit()
+    assert result.error is None
+    assert result.metrics["world"] == 2
+
+
+def test_checkpointing_and_topk(train_cluster):
+    def loop(config):
+        import os as _os
+        import tempfile
+
+        from ray_tpu import train
+
+        for i in range(4):
+            d = tempfile.mkdtemp()
+            with open(_os.path.join(d, "state.txt"), "w") as f:
+                f.write(str(i))
+            train.report({"acc": float(i)},
+                         checkpoint=Checkpoint.from_directory(d))
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="t3", storage_path=train_cluster,
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="acc")),
+    ).fit()
+    assert result.error is None
+    assert result.checkpoint is not None
+    assert len(result.best_checkpoints) == 2
+    best_ckpt, best_metrics = result.best_checkpoints[0]
+    assert best_metrics["acc"] == 3.0
+    with best_ckpt.as_directory() as d:
+        assert open(os.path.join(d, "state.txt")).read() == "3"
+
+
+def test_user_error_propagates(train_cluster):
+    def loop(config):
+        raise RuntimeError("train loop exploded")
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t4", storage_path=train_cluster),
+    ).fit()
+    assert result.error is not None
+    assert "train loop exploded" in result.error
+
+
+def test_failure_restart_from_checkpoint(train_cluster):
+    marker = os.path.join(train_cluster, "crashed_once")
+
+    def loop(config):
+        import os as _os
+        import tempfile
+
+        from ray_tpu import train
+
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                start = int(open(_os.path.join(d, "step.txt")).read()) + 1
+        for i in range(start, 4):
+            d = tempfile.mkdtemp()
+            with open(_os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(i))
+            train.report({"step": i},
+                         checkpoint=train.Checkpoint.from_directory(d)
+                         if hasattr(train, "Checkpoint") else None)
+            if i == 1 and not _os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                _os._exit(1)  # simulate worker crash mid-training
+
+    from ray_tpu import train as train_mod
+
+    def loop2(config):
+        import os as _os
+        import tempfile
+
+        from ray_tpu import train
+
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with ckpt.as_directory() as d:
+                start = int(open(_os.path.join(d, "step.txt")).read()) + 1
+        for i in range(start, 4):
+            d = tempfile.mkdtemp()
+            with open(_os.path.join(d, "step.txt"), "w") as f:
+                f.write(str(i))
+            from ray_tpu.train import Checkpoint as Ck
+
+            train.report({"step": i}, checkpoint=Ck.from_directory(d))
+            if i == 1 and not _os.path.exists(config["marker"]):
+                open(config["marker"], "w").close()
+                _os._exit(1)
+
+    result = JaxTrainer(
+        loop2,
+        train_loop_config={"marker": marker},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="t5", storage_path=train_cluster,
+            failure_config=FailureConfig(max_failures=1)),
+    ).fit()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 3
+    assert os.path.exists(marker)
+
+
+def test_jax_training_e2e(train_cluster):
+    """Real JAX model trained through the trainer (CPU devices in worker)."""
+
+    def loop(config):
+        import numpy as np
+
+        from ray_tpu import train
+        from ray_tpu.models.llama import LlamaConfig, make_train_step
+        from ray_tpu.parallel import MeshConfig, make_mesh
+
+        import jax
+
+        cfg = LlamaConfig.debug()
+        mesh = make_mesh(MeshConfig(data=1, fsdp=1),
+                         devices=jax.devices()[:1])
+        init, step, data_sharding, _ = make_train_step(cfg, mesh)
+        state = init(jax.random.PRNGKey(0))
+        tokens = jax.device_put(
+            np.random.RandomState(0).randint(
+                0, cfg.vocab_size, (4, 33)).astype(np.int32), data_sharding)
+        for i in range(3):
+            state, loss = step(state, tokens)
+            train.report({"loss": float(loss), "step": i})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="t6", storage_path=train_cluster),
+    ).fit()
+    assert result.error is None, result.error
+    assert np.isfinite(result.metrics["loss"])
+
+
+def test_dataset_shards_split(train_cluster):
+    class FakeDataset:
+        def __init__(self, items):
+            self.items = items
+
+        def split(self, n):
+            return [FakeDataset(self.items[i::n]) for i in range(n)]
+
+    def loop(config):
+        from ray_tpu import train
+
+        shard = train.get_dataset_shard("train")
+        train.report({"n": len(shard.items),
+                      "rank": train.get_context().get_world_rank()})
+
+    result = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="t7", storage_path=train_cluster),
+        datasets={"train": FakeDataset(list(range(10)))},
+    ).fit()
+    assert result.error is None
+    assert result.metrics["n"] == 5
