@@ -103,8 +103,9 @@ class ActorClass:
     def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
         self._cls = cls
         self._options = dict(options or {})
-        self._payload = cloudpickle.dumps(cls)
-        self._class_id = _class_id(self._payload)
+        # deferred to first .remote() — see RemoteFunction.__init__ for why
+        self._payload: Optional[bytes] = None
+        self._class_id: Optional[str] = None
         self._registered_with = None
         self.__name__ = cls.__name__
         # async actor iff any public method is a coroutine function
@@ -140,6 +141,9 @@ class ActorClass:
         runtime = get_current_runtime()
         if runtime is None:
             raise RuntimeError("ray_tpu.init() has not been called")
+        if self._payload is None:
+            self._payload = cloudpickle.dumps(self._cls)
+            self._class_id = _class_id(self._payload)
         if self._registered_with is not runtime:
             runtime.register_function(self._class_id, self._payload)
             self._registered_with = runtime

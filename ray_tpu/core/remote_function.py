@@ -81,11 +81,20 @@ class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
         self._fn = fn
         self._options = dict(options or {})
-        self._payload = cloudpickle.dumps(fn)
-        self._function_id = _function_id(self._payload)
+        # Pickling is deferred to first .remote(): at decoration time the
+        # defining module may still be mid-import, which would force
+        # cloudpickle to capture by value with an incomplete globals dict
+        # (later-defined helpers would raise NameError on the worker).
+        self._payload: Optional[bytes] = None
+        self._function_id: Optional[str] = None
         self._registered_with = None
         self.__name__ = getattr(fn, "__name__", "remote_fn")
         self.__doc__ = getattr(fn, "__doc__", None)
+
+    def _materialize_payload(self) -> None:
+        if self._payload is None:
+            self._payload = cloudpickle.dumps(self._fn)
+            self._function_id = _function_id(self._payload)
 
     def options(self, **overrides) -> "RemoteFunction":
         merged = dict(self._options)
@@ -107,6 +116,7 @@ class RemoteFunction:
         )
 
     def _ensure_registered(self, runtime) -> None:
+        self._materialize_payload()
         if self._registered_with is not runtime:
             runtime.register_function(self._function_id, self._payload)
             self._registered_with = runtime

@@ -1,0 +1,55 @@
+"""ray_tpu.data — lazy streaming distributed datasets for ML ingest.
+
+Reference: python/ray/data/ (Dataset, streaming executor, datasources).
+"""
+
+from ray_tpu.data.aggregate import (  # noqa: F401
+    AbsMax,
+    AggregateFn,
+    Count,
+    Max,
+    Mean,
+    Min,
+    Quantile,
+    Std,
+    Sum,
+)
+from ray_tpu.data.block import Block, BlockAccessor  # noqa: F401
+from ray_tpu.data.dataset import (  # noqa: F401
+    Dataset,
+    GroupedData,
+    MaterializedDataset,
+    from_arrow,
+    from_blocks,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+from ray_tpu.data.datasource import (  # noqa: F401
+    Datasink,
+    Datasource,
+    ReadTask,
+)
+from ray_tpu.data.executor import DataContext  # noqa: F401
+from ray_tpu.data.iterator import DataIterator  # noqa: F401
+from ray_tpu.data.logical import ActorPoolStrategy  # noqa: F401
+
+__all__ = [
+    "Dataset", "DataIterator", "DataContext", "MaterializedDataset",
+    "GroupedData", "Datasource", "Datasink", "ReadTask",
+    "ActorPoolStrategy", "range", "range_tensor", "from_items",
+    "from_blocks", "from_pandas", "from_arrow", "from_numpy",
+    "read_parquet", "read_csv", "read_json", "read_numpy", "read_text",
+    "read_binary_files", "read_datasource", "AggregateFn", "Count", "Sum",
+    "Min", "Max", "Mean", "Std", "AbsMax", "Quantile", "Block",
+    "BlockAccessor",
+]

@@ -1,0 +1,136 @@
+"""Aggregations for groupby / global aggregate.
+
+Reference: python/ray/data/aggregate.py (AggregateFn, Count/Sum/Min/Max/
+Mean/Std) — here implemented with a partial/merge scheme over pandas so the
+reduce phase is distributable: each partition computes mergeable partials.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from .block import Block, BlockAccessor, build_block
+
+
+class AggregateFn:
+    """name() labels the output column; partials computed per partition."""
+
+    def __init__(self, on: Optional[str] = None):
+        self.on = on
+
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def compute(self, values: np.ndarray) -> Any:
+        """Aggregate raw values of one complete group (single reduce)."""
+        raise NotImplementedError
+
+
+class Count(AggregateFn):
+    def name(self):
+        return "count()"
+
+    def compute(self, values):
+        return len(values)
+
+
+class Sum(AggregateFn):
+    def name(self):
+        return f"sum({self.on})"
+
+    def compute(self, values):
+        return values.sum()
+
+
+class Min(AggregateFn):
+    def name(self):
+        return f"min({self.on})"
+
+    def compute(self, values):
+        return values.min()
+
+
+class Max(AggregateFn):
+    def name(self):
+        return f"max({self.on})"
+
+    def compute(self, values):
+        return values.max()
+
+
+class Mean(AggregateFn):
+    def name(self):
+        return f"mean({self.on})"
+
+    def compute(self, values):
+        return values.mean()
+
+
+class Std(AggregateFn):
+    def __init__(self, on=None, ddof: int = 1):
+        super().__init__(on)
+        self.ddof = ddof
+
+    def name(self):
+        return f"std({self.on})"
+
+    def compute(self, values):
+        return float(np.std(values, ddof=self.ddof))
+
+
+class AbsMax(AggregateFn):
+    def name(self):
+        return f"abs_max({self.on})"
+
+    def compute(self, values):
+        return np.abs(values).max()
+
+
+class Quantile(AggregateFn):
+    def __init__(self, on=None, q: float = 0.5):
+        super().__init__(on)
+        self.q = q
+
+    def name(self):
+        return f"quantile({self.on})"
+
+    def compute(self, values):
+        return float(np.quantile(values, self.q))
+
+
+def aggregate_blocks(blocks: List[Block], keys: Optional[List[str]],
+                     aggs: List[AggregateFn]) -> Block:
+    """All rows for any given key are in ``blocks`` (hash-partitioned
+    upstream), so a single-pass groupby per partition is exact."""
+    import pandas as pd
+
+    frames = [BlockAccessor.for_block(b).to_pandas() for b in blocks
+              if BlockAccessor.for_block(b).num_rows() > 0]
+    if not frames:
+        return build_block([])
+    df = pd.concat(frames, ignore_index=True)
+    if not keys:
+        row = {}
+        for agg in aggs:
+            col = df[agg.on].to_numpy() if agg.on else df.index.to_numpy()
+            row[agg.name()] = _pyval(agg.compute(col))
+        return build_block([row])
+    out_rows = []
+    for key_vals, group in df.groupby(keys, sort=True):
+        if not isinstance(key_vals, tuple):
+            key_vals = (key_vals,)
+        row = dict(zip(keys, (_pyval(v) for v in key_vals)))
+        for agg in aggs:
+            col = group[agg.on].to_numpy() if agg.on \
+                else group.index.to_numpy()
+            row[agg.name()] = _pyval(agg.compute(col))
+        out_rows.append(row)
+    return build_block(out_rows)
+
+
+def _pyval(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    return v

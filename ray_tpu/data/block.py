@@ -1,0 +1,269 @@
+"""Block format for ray_tpu.data.
+
+A *block* is the unit of distributed data: an Arrow table (tabular fast
+path, reference: python/ray/data/block.py + _internal/arrow_ops/) or a plain
+Python list (fallback for non-tabular rows, reference's "simple" blocks).
+``BlockAccessor`` gives a uniform view over both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+    import pyarrow.compute as pac
+except ImportError:  # pragma: no cover
+    pa = None
+    pac = None
+
+Block = Union["pa.Table", List[Any]]
+
+
+def _is_tabular_row(row: Any) -> bool:
+    return isinstance(row, dict) and all(isinstance(k, str) for k in row)
+
+
+def build_block(rows: List[Any]) -> Block:
+    """Build a block from rows. Dict rows -> Arrow table; else list block."""
+    if pa is None or not rows:
+        return list(rows)
+    if all(_is_tabular_row(r) for r in rows):
+        cols: Dict[str, List[Any]] = {}
+        keys = list(rows[0].keys())
+        if all(list(r.keys()) == keys for r in rows):
+            for k in keys:
+                cols[k] = [r[k] for r in rows]
+            try:
+                return pa.table(
+                    {k: _to_arrow_array(v) for k, v in cols.items()})
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError,
+                    pa.ArrowTypeError, ValueError, TypeError):
+                return list(rows)
+    return list(rows)
+
+
+def _to_arrow_array(values: List[Any]):
+    if values and isinstance(values[0], np.ndarray):
+        arrs = [np.asarray(v) for v in values]
+        if all(a.shape == arrs[0].shape for a in arrs):
+            inner = pa.array(np.concatenate([a.ravel() for a in arrs]))
+            offsets = np.arange(len(arrs) + 1) * arrs[0].size
+            return pa.ListArray.from_arrays(
+                pa.array(offsets, pa.int32()), inner)
+    return pa.array(values)
+
+
+def block_from_arrow(table: "pa.Table") -> Block:
+    return table
+
+
+def block_from_numpy(data: Dict[str, np.ndarray]) -> Block:
+    if pa is None:
+        n = len(next(iter(data.values())))
+        return [{k: v[i] for k, v in data.items()} for i in range(n)]
+    cols = {}
+    meta = {}
+    for k, v in data.items():
+        v = np.asarray(v)
+        if v.ndim <= 1:
+            cols[k] = pa.array(v)
+        else:
+            # multi-dim tensors: flattened list column + shape in metadata
+            inner = pa.array(v.reshape(len(v), -1).ravel())
+            offsets = np.arange(len(v) + 1) * int(np.prod(v.shape[1:]))
+            cols[k] = pa.ListArray.from_arrays(
+                pa.array(offsets, pa.int32()), inner)
+            meta[f"shape:{k}".encode()] = ",".join(
+                str(d) for d in v.shape[1:]).encode()
+    t = pa.table(cols)
+    if meta:
+        t = t.replace_schema_metadata({**(t.schema.metadata or {}), **meta})
+    return t
+
+
+class BlockAccessor:
+    """Uniform accessor over Arrow-table and list blocks.
+
+    Reference: python/ray/data/block.py BlockAccessor.
+    """
+
+    def __init__(self, block: Block):
+        self._block = block
+        self._is_arrow = pa is not None and isinstance(block, pa.Table)
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    @property
+    def block(self) -> Block:
+        return self._block
+
+    @property
+    def is_arrow(self) -> bool:
+        return self._is_arrow
+
+    def num_rows(self) -> int:
+        if self._is_arrow:
+            return self._block.num_rows
+        return len(self._block)
+
+    def size_bytes(self) -> int:
+        if self._is_arrow:
+            return self._block.nbytes
+        try:
+            import sys
+
+            return sum(sys.getsizeof(r) for r in self._block)
+        except Exception:
+            return 8 * len(self._block)
+
+    def schema(self):
+        if self._is_arrow:
+            return self._block.schema
+        if self._block:
+            r = self._block[0]
+            return type(r).__name__ if not isinstance(r, dict) else {
+                k: type(v).__name__ for k, v in r.items()}
+        return None
+
+    def iter_rows(self) -> Iterator[Any]:
+        if self._is_arrow:
+            cols = self._block.column_names
+            data = [self._block.column(c) for c in cols]
+            for i in range(self._block.num_rows):
+                yield {c: data[j][i].as_py() for j, c in enumerate(cols)}
+        else:
+            yield from iter(self._block)
+
+    def slice(self, start: int, end: int) -> Block:
+        if self._is_arrow:
+            return self._block.slice(start, end - start)
+        return self._block[start:end]
+
+    def take_indices(self, indices: List[int]) -> Block:
+        if self._is_arrow:
+            return self._block.take(pa.array(indices, type=pa.int64()))
+        return [self._block[i] for i in indices]
+
+    def to_pandas(self):
+        import pandas as pd
+
+        if self._is_arrow:
+            return self._block.to_pandas()
+        if self._block and isinstance(self._block[0], dict):
+            return pd.DataFrame(self._block)
+        return pd.DataFrame({"item": self._block})
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        if self._is_arrow:
+            out = {}
+            meta = self._block.schema.metadata or {}
+            for name in self._block.column_names:
+                col = self._block.column(name)
+                if pa.types.is_list(col.type):
+                    arr = np.array([np.asarray(x) for x in col.to_pylist()])
+                    shape_key = f"shape:{name}".encode()
+                    if shape_key in meta and len(arr):
+                        dims = tuple(int(d) for d in
+                                     meta[shape_key].decode().split(","))
+                        arr = arr.reshape((len(arr),) + dims)
+                    out[name] = arr
+                else:
+                    out[name] = col.to_numpy(zero_copy_only=False)
+            return out
+        if self._block and isinstance(self._block[0], dict):
+            keys = self._block[0].keys()
+            return {k: np.array([r[k] for r in self._block]) for k in keys}
+        return {"item": np.array(self._block, dtype=object)}
+
+    def to_arrow(self) -> "pa.Table":
+        if self._is_arrow:
+            return self._block
+        return build_block(list(self._block)) if pa is not None else None
+
+    def to_batch(self, batch_format: Optional[str]):
+        """Materialize the whole block in the requested batch format."""
+        if batch_format in (None, "default"):
+            batch_format = "numpy" if self._is_arrow else "list"
+        if batch_format == "numpy":
+            return self.to_numpy()
+        if batch_format == "pandas":
+            return self.to_pandas()
+        if batch_format in ("pyarrow", "arrow"):
+            return self.to_arrow()
+        if batch_format == "list":
+            return list(self.iter_rows())
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def select_columns(self, cols: List[str]) -> Block:
+        if self._is_arrow:
+            return self._block.select(cols)
+        return [{k: r[k] for k in cols} for r in self._block]
+
+    def sort_indices(self, key, descending: bool) -> List[int]:
+        if self._is_arrow and isinstance(key, str):
+            order = "descending" if descending else "ascending"
+            return pac.sort_indices(
+                self._block, sort_keys=[(key, order)]).to_pylist()
+        rows = list(self.iter_rows())
+        keyfn = (lambda r: r[key]) if isinstance(key, str) else key
+        return sorted(range(len(rows)), key=lambda i: keyfn(rows[i]),
+                      reverse=descending)
+
+
+def batch_to_block(batch: Any) -> Block:
+    """Convert a user-returned batch (dict of arrays / pandas / arrow / list)
+    back into a block."""
+    import pandas as pd
+
+    if pa is not None and isinstance(batch, (pa.Table, pa.RecordBatch)):
+        return batch if isinstance(batch, pa.Table) else pa.Table.from_batches(
+            [batch])
+    if isinstance(batch, pd.DataFrame):
+        return pa.Table.from_pandas(batch, preserve_index=False) \
+            if pa is not None else batch.to_dict("records")
+    if isinstance(batch, dict):
+        return block_from_numpy(
+            {k: np.asarray(v) for k, v in batch.items()})
+    if isinstance(batch, list):
+        return build_block(batch)
+    raise TypeError(
+        f"batch must be dict/pandas/pyarrow/list, got {type(batch)}")
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
+    if not blocks:
+        return []
+    if pa is not None and all(isinstance(b, pa.Table) for b in blocks):
+        try:
+            return pa.concat_tables(blocks, promote_options="default")
+        except (pa.ArrowInvalid, pa.ArrowTypeError):
+            pass
+    rows: List[Any] = []
+    for b in blocks:
+        rows.extend(BlockAccessor(b).iter_rows())
+    return build_block(rows)
+
+
+class DelegatingBlockBuilder:
+    """Accumulate rows, emit a block (reference: delegating_block_builder.py)."""
+
+    def __init__(self):
+        self._rows: List[Any] = []
+
+    def add(self, row: Any) -> None:
+        self._rows.append(row)
+
+    def add_block(self, block: Block) -> None:
+        self._rows.extend(BlockAccessor(block).iter_rows())
+
+    def num_rows(self) -> int:
+        return len(self._rows)
+
+    def build(self) -> Block:
+        return build_block(self._rows)

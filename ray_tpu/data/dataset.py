@@ -1,0 +1,666 @@
+"""Dataset — the lazy, streaming distributed dataset facade.
+
+Reference: python/ray/data/dataset.py (Dataset, map_batches :383 building a
+LogicalPlan :367,663, streaming_split :1236), grouped_data.py, read_api.py.
+Transforms append logical operators; execution happens on consumption via
+the streaming executor.
+"""
+
+from __future__ import annotations
+
+import builtins
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+_range = builtins.range  # the module exports data.range(); keep the builtin
+
+import numpy as np
+
+import ray_tpu
+
+from . import logical as L
+from .aggregate import (
+    AbsMax,
+    AggregateFn,
+    Count,
+    Max,
+    Mean,
+    Min,
+    Quantile,
+    Std,
+    Sum,
+)
+from .block import Block, BlockAccessor, build_block, concat_blocks
+from .datasource import (
+    BinaryDatasource,
+    BlockMetadata,
+    CSVDatasink,
+    CSVDatasource,
+    Datasink,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasink,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasink,
+    ParquetDatasource,
+    RangeDatasource,
+    TextDatasource,
+)
+from .executor import DataContext, RefBundle, StreamingExecutor
+from .iterator import DataIterator
+from .logical import ActorPoolStrategy, ComputeStrategy
+
+
+class Dataset:
+    def __init__(self, plan: L.LogicalPlan):
+        self._plan = plan
+
+    # ------------------------------------------------------------ plumbing
+    def _with_op(self, op: L.LogicalOperator) -> "Dataset":
+        return Dataset(L.LogicalPlan(op))
+
+    def _execute(self) -> Iterator[RefBundle]:
+        return StreamingExecutor(self._plan).execute()
+
+    @staticmethod
+    def _compute_kwargs(compute, concurrency, num_cpus, num_tpus,
+                        fn_constructor_args, fn_constructor_kwargs, fn):
+        kw: Dict[str, Any] = {}
+        if compute is not None:
+            kw["compute"] = compute
+        elif isinstance(fn, type) or concurrency is not None and isinstance(
+                fn, type):
+            kw["compute"] = ActorPoolStrategy(size=concurrency or 2)
+        if concurrency is not None:
+            kw["concurrency"] = concurrency
+        if num_cpus is not None:
+            kw["num_cpus"] = num_cpus
+        if num_tpus is not None:
+            kw["num_tpus"] = num_tpus
+        if fn_constructor_args:
+            kw["fn_constructor_args"] = tuple(fn_constructor_args)
+        if fn_constructor_kwargs:
+            kw["fn_constructor_kwargs"] = dict(fn_constructor_kwargs)
+        return kw
+
+    # ---------------------------------------------------------- transforms
+    def map_batches(self, fn, *, batch_size: Optional[int] = None,
+                    batch_format: str = "default",
+                    compute: Optional[ComputeStrategy] = None,
+                    concurrency: Optional[int] = None,
+                    num_cpus: Optional[float] = None,
+                    num_tpus: Optional[float] = None,
+                    fn_constructor_args: Optional[tuple] = None,
+                    fn_constructor_kwargs: Optional[dict] = None,
+                    **_ignored) -> "Dataset":
+        kw = self._compute_kwargs(compute, concurrency, num_cpus, num_tpus,
+                                  fn_constructor_args, fn_constructor_kwargs,
+                                  fn)
+        return self._with_op(L.MapBatches(
+            self._plan.dag, fn, batch_size=batch_size,
+            batch_format=batch_format, **kw))
+
+    def map(self, fn, *, compute=None, concurrency=None, num_cpus=None,
+            num_tpus=None, **_ignored) -> "Dataset":
+        kw = self._compute_kwargs(compute, concurrency, num_cpus, num_tpus,
+                                  None, None, fn)
+        return self._with_op(L.MapRows(self._plan.dag, fn, **kw))
+
+    def filter(self, fn, *, compute=None, concurrency=None,
+               **_ignored) -> "Dataset":
+        kw = self._compute_kwargs(compute, concurrency, None, None, None,
+                                  None, fn)
+        return self._with_op(L.Filter(self._plan.dag, fn, **kw))
+
+    def flat_map(self, fn, *, compute=None, concurrency=None,
+                 **_ignored) -> "Dataset":
+        kw = self._compute_kwargs(compute, concurrency, None, None, None,
+                                  None, fn)
+        return self._with_op(L.FlatMap(self._plan.dag, fn, **kw))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_op(L.Project(self._plan.dag, select=list(cols)))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        return self._with_op(L.Project(self._plan.dag, drop=list(cols)))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self._with_op(L.Project(self._plan.dag, rename=dict(mapping)))
+
+    def add_column(self, name: str, fn: Callable[[Any], Any]) -> "Dataset":
+        def add(batch):
+            batch = dict(batch)
+            batch[name] = fn(batch)
+            return batch
+
+        return self.map_batches(add, batch_format="pandas")
+
+    def repartition(self, num_blocks: int, *, shuffle: bool = False
+                    ) -> "Dataset":
+        return self._with_op(
+            L.Repartition(self._plan.dag, num_blocks, shuffle))
+
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        return self._with_op(
+            L.RandomShuffle(self._plan.dag, seed, num_blocks))
+
+    def randomize_block_order(self, *, seed: Optional[int] = None
+                              ) -> "Dataset":
+        return self._with_op(L.RandomizeBlocks(self._plan.dag, seed))
+
+    def sort(self, key, descending: bool = False) -> "Dataset":
+        return self._with_op(L.Sort(self._plan.dag, key, descending))
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        return self._with_op(L.Zip(self._plan.dag, other._plan.dag))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return self._with_op(L.Union(
+            [self._plan.dag] + [o._plan.dag for o in others]))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with_op(L.Limit(self._plan.dag, n))
+
+    def groupby(self, keys) -> "GroupedData":
+        if isinstance(keys, str):
+            keys = [keys]
+        return GroupedData(self, keys)
+
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Dataset":
+        def sample(row, _frac=fraction, _seed=seed):
+            rng = np.random  # per-row hash sampling is deterministic w/ seed
+            if _seed is not None:
+                h = hash((repr(sorted(row.items())
+                               if isinstance(row, dict) else row), _seed))
+                return (h % 10_000_000) / 10_000_000 < _frac
+            return rng.random() < _frac
+
+        return self.filter(sample)
+
+    # --------------------------------------------------------- consumption
+    def iter_internal_ref_bundles(self) -> Iterator[RefBundle]:
+        return self._execute()
+
+    def to_block_refs(self) -> List[Any]:
+        return [b.ref for b in self._execute()]
+
+    def iterator(self) -> DataIterator:
+        ds = self
+
+        def source():
+            for b in ds._execute():
+                yield b.ref
+
+        return DataIterator(source)
+
+    def iter_rows(self) -> Iterator[Any]:
+        return self.iterator().iter_rows()
+
+    def iter_batches(self, **kwargs) -> Iterator[Any]:
+        return self.iterator().iter_batches(**kwargs)
+
+    def to_jax(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        return self.iterator().to_jax(**kwargs)
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.limit(limit).iter_rows():
+            out.append(row)
+            if len(out) >= limit:
+                break
+        return out
+
+    def take_all(self, limit: Optional[int] = None) -> List[Any]:
+        out = list(self.iter_rows())
+        if limit is not None and len(out) > limit:
+            raise ValueError(f"dataset has more than {limit} rows")
+        return out
+
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: str = "default") -> Any:
+        for batch in self.limit(batch_size).iter_batches(
+                batch_size=batch_size, batch_format=batch_format,
+                prefetch_batches=0):
+            return batch
+        return {}
+
+    def show(self, limit: int = 20) -> None:
+        for row in self.take(limit):
+            print(row)
+
+    def count(self) -> int:
+        from .executor import _count_task
+
+        refs = [b.ref for b in self._execute()]
+        return sum(ray_tpu.get([_count_task.remote(r) for r in refs]))
+
+    def schema(self):
+        for bundle in self._execute():
+            block = ray_tpu.get(bundle.ref)
+            acc = BlockAccessor.for_block(block)
+            if acc.num_rows() > 0:
+                return acc.schema()
+        return None
+
+    def columns(self) -> Optional[List[str]]:
+        s = self.schema()
+        if s is None:
+            return None
+        names = getattr(s, "names", None)
+        if names is not None:
+            return list(names)
+        if isinstance(s, dict):
+            return list(s)
+        return None
+
+    def num_blocks(self) -> int:
+        return len(self.to_block_refs())
+
+    def size_bytes(self) -> int:
+        total = 0
+        for bundle in self._execute():
+            total += BlockAccessor.for_block(
+                ray_tpu.get(bundle.ref)).size_bytes()
+        return total
+
+    def materialize(self) -> "MaterializedDataset":
+        bundles = list(self._execute())
+        from .executor import _count_task
+
+        counts = ray_tpu.get(
+            [_count_task.remote(b.ref) for b in bundles])
+        refs = [b.ref for b in bundles]
+        meta = [BlockMetadata(num_rows=c) for c in counts]
+        return MaterializedDataset(
+            L.LogicalPlan(L.InputData(refs, meta)), refs, counts)
+
+    # -------------------------------------------------------------- splits
+    def split(self, n: int, *, equal: bool = False,
+              locality_hints=None) -> List["MaterializedDataset"]:
+        mat = self.materialize()
+        total = sum(mat._counts)
+        per = total // n if equal else None
+        out = []
+        # row-range split over materialized blocks
+        starts = [(total * i) // n for i in _range(n)] + [total]
+        if equal:
+            starts = [per * i for i in _range(n)] + [per * n]
+        from .executor import _slice_range_task
+
+        for i in _range(n):
+            s, e = starts[i], starts[i + 1]
+            ref = _slice_range_task.remote(s, e, mat._counts, *mat._refs)
+            out.append(MaterializedDataset(
+                L.LogicalPlan(L.InputData(
+                    [ref], [BlockMetadata(num_rows=e - s)])),
+                [ref], [e - s]))
+        return out
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        mat = ds.materialize()
+        total = sum(mat._counts)
+        n_test = int(total * test_size) if test_size < 1 else int(test_size)
+        from .executor import _slice_range_task
+
+        train_ref = _slice_range_task.remote(
+            0, total - n_test, mat._counts, *mat._refs)
+        test_ref = _slice_range_task.remote(
+            total - n_test, total, mat._counts, *mat._refs)
+        mk = lambda ref, n: MaterializedDataset(
+            L.LogicalPlan(L.InputData([ref], [BlockMetadata(num_rows=n)])),
+            [ref], [n])
+        return mk(train_ref, total - n_test), mk(test_ref, n_test)
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List[DataIterator]:
+        """n coordinated iterators, one per consumer (Train workers).
+
+        Reference: dataset.py:1236 + _internal/execution/operators/
+        output_splitter.py — here a coordinator actor executes the plan and
+        deals output blocks round-robin to per-split queues.
+        """
+        coordinator = _SplitCoordinator.options(max_concurrency=n + 2) \
+            .remote(self, n)
+
+        def make_source(idx: int):
+            epoch_box = [0]
+
+            def source():
+                my_epoch = epoch_box[0]
+                epoch_box[0] += 1
+                coordinator.start_epoch.remote(idx, my_epoch)
+                while True:
+                    status, ref = ray_tpu.get(
+                        coordinator.get_next.remote(idx, my_epoch))
+                    if status == "done":
+                        return
+                    if status == "wait":
+                        time.sleep(0.005)
+                        continue
+                    yield ref
+
+            return source
+
+        return [DataIterator(make_source(i)) for i in _range(n)]
+
+    # -------------------------------------------------------------- writes
+    def write_datasink(self, datasink: Datasink) -> None:
+        results = []
+        for bundle in Dataset(L.LogicalPlan(
+                L.Write(self._plan.dag, datasink)))._execute():
+            results.append(ray_tpu.get(bundle.ref))
+        datasink.on_write_complete(results)
+
+    def write_parquet(self, path: str) -> None:
+        self.write_datasink(ParquetDatasink(path))
+
+    def write_csv(self, path: str) -> None:
+        self.write_datasink(CSVDatasink(path))
+
+    def write_json(self, path: str) -> None:
+        self.write_datasink(JSONDatasink(path))
+
+    # ------------------------------------------------------------- exports
+    def to_pandas(self, limit: Optional[int] = None):
+        import pandas as pd
+
+        frames = [BlockAccessor.for_block(b).to_pandas()
+                  for b in self.iterator().iter_blocks()]
+        if not frames:
+            return pd.DataFrame()
+        df = pd.concat(frames, ignore_index=True)
+        if limit is not None and len(df) > limit:
+            raise ValueError(f"dataset has more than {limit} rows")
+        return df
+
+    def to_arrow_refs(self) -> List[Any]:
+        return self.to_block_refs()
+
+    def to_numpy(self) -> Dict[str, np.ndarray]:
+        blocks = list(self.iterator().iter_blocks())
+        merged = concat_blocks(blocks)
+        return BlockAccessor.for_block(merged).to_numpy()
+
+    # -------------------------------------------------------------- dunder
+    def __iter__(self):
+        return self.iter_rows()
+
+    def __repr__(self):
+        return f"Dataset(plan={self._plan!r})"
+
+    # aggregates (global)
+    def aggregate(self, *aggs: AggregateFn) -> Dict[str, Any]:
+        rows = Dataset(L.LogicalPlan(L.GroupAggregate(
+            self._plan.dag, None, list(aggs)))).take_all()
+        return rows[0] if rows else {}
+
+    def sum(self, on: str):
+        return self.aggregate(Sum(on)).get(f"sum({on})")
+
+    def min(self, on: str):
+        return self.aggregate(Min(on)).get(f"min({on})")
+
+    def max(self, on: str):
+        return self.aggregate(Max(on)).get(f"max({on})")
+
+    def mean(self, on: str):
+        return self.aggregate(Mean(on)).get(f"mean({on})")
+
+    def std(self, on: str, ddof: int = 1):
+        return self.aggregate(Std(on, ddof=ddof)).get(f"std({on})")
+
+    def unique(self, column: str) -> List[Any]:
+        seen = []
+        seen_set = set()
+        for row in self.select_columns([column]).iter_rows():
+            v = row[column]
+            if v not in seen_set:
+                seen_set.add(v)
+                seen.append(v)
+        return seen
+
+
+class MaterializedDataset(Dataset):
+    """Fully-executed dataset pinned in the object store
+    (reference: MaterializedDataset)."""
+
+    def __init__(self, plan: L.LogicalPlan, refs: List[Any],
+                 counts: List[int]):
+        super().__init__(plan)
+        self._refs = refs
+        self._counts = counts
+
+    def materialize(self) -> "MaterializedDataset":
+        return self
+
+    def count(self) -> int:
+        return sum(self._counts)
+
+    def num_blocks(self) -> int:
+        return len(self._refs)
+
+
+class GroupedData:
+    """Reference: python/ray/data/grouped_data.py."""
+
+    def __init__(self, ds: Dataset, keys: List[str]):
+        self._ds = ds
+        self._keys = keys
+
+    def aggregate(self, *aggs: AggregateFn) -> Dataset:
+        return Dataset(L.LogicalPlan(L.GroupAggregate(
+            self._ds._plan.dag, self._keys, list(aggs))))
+
+    def count(self) -> Dataset:
+        return self.aggregate(Count())
+
+    def sum(self, on: str) -> Dataset:
+        return self.aggregate(Sum(on))
+
+    def min(self, on: str) -> Dataset:
+        return self.aggregate(Min(on))
+
+    def max(self, on: str) -> Dataset:
+        return self.aggregate(Max(on))
+
+    def mean(self, on: str) -> Dataset:
+        return self.aggregate(Mean(on))
+
+    def std(self, on: str, ddof: int = 1) -> Dataset:
+        return self.aggregate(Std(on, ddof=ddof))
+
+    def map_groups(self, fn, *, batch_format: str = "default") -> Dataset:
+        keys = self._keys
+
+        def apply_groups(batch):
+            import pandas as pd
+
+            df = batch if isinstance(batch, pd.DataFrame) else \
+                pd.DataFrame(batch)
+            if df.empty or any(k not in df.columns for k in keys):
+                return df.head(0)
+            outs = []
+            for _, group in df.groupby(keys, sort=True):
+                if batch_format in ("default", "numpy"):
+                    g = {c: group[c].to_numpy() for c in group.columns}
+                elif batch_format == "pandas":
+                    g = group.reset_index(drop=True)
+                else:
+                    g = group
+                res = fn(g)
+                if isinstance(res, dict):
+                    res = pd.DataFrame(res)
+                outs.append(res)
+            return pd.concat(outs, ignore_index=True) if outs else df.head(0)
+
+        # hash-partition by key so each group lands wholly in one partition,
+        # then apply fn per group within each partition
+        regrouped = Dataset(L.LogicalPlan(L.HashRepartition(
+            self._ds._plan.dag, keys, 8)))
+        return regrouped.map_batches(apply_groups, batch_format="pandas",
+                                     batch_size=None)
+
+
+# ---------------------------------------------------------------- split
+# coordinator actor for streaming_split
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """Executes the plan once per epoch, dealing block refs round-robin to
+    n consumer queues. A new epoch starts once every split requests it
+    (gang barrier — Train workers iterate epochs in lockstep)."""
+
+    def __init__(self, ds: Dataset, n: int):
+        import collections
+
+        self._ds = ds
+        self._n = n
+        self._queues = [collections.deque() for _ in _range(n)]
+        self._done = False
+        self._epoch = -1
+        self._requests: Dict[int, set] = {}
+        self._lock = threading.Lock()
+
+    def _pump(self):
+        def run():
+            try:
+                i = 0
+                for bundle in self._ds._execute():
+                    with self._lock:
+                        self._queues[i % self._n].append(bundle.ref)
+                    i += 1
+            finally:
+                self._done = True
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def start_epoch(self, idx: int, epoch: int) -> None:
+        with self._lock:
+            reqs = self._requests.setdefault(epoch, set())
+            reqs.add(idx)
+            # epoch 0 starts on first request (allows sequential
+            # consumption); later epochs gang-barrier on all n splits.
+            ready = (epoch == self._epoch + 1 and self._done
+                     and len(reqs) >= self._n) or (epoch == 0
+                                                   and self._epoch < 0)
+            if ready:
+                self._epoch = epoch
+                self._done = False
+                self._pump()
+
+    def get_next(self, idx: int, epoch: int):
+        with self._lock:
+            if epoch > self._epoch:
+                return ("wait", None)
+            if epoch < self._epoch:
+                return ("done", None)
+            q = self._queues[idx]
+            if q:
+                return ("ok", q.popleft())
+            if self._done:
+                return ("done", None)
+        return ("wait", None)
+
+
+# ------------------------------------------------------------- read API
+
+
+def _ctx_parallelism(parallelism: int) -> int:
+    if parallelism and parallelism > 0:
+        return parallelism
+    try:
+        return max(2, int(ray_tpu.cluster_resources().get("CPU", 4)))
+    except Exception:
+        return 4
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = -1
+                    ) -> Dataset:
+    return Dataset(L.LogicalPlan(
+        L.Read(datasource, _ctx_parallelism(parallelism))))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1) -> Dataset:
+    ds = range(n, parallelism=parallelism)
+
+    def to_tensor(batch):
+        ids = batch["id"]
+        reps = int(np.prod(shape))
+        data = np.repeat(ids[:, None], reps, axis=1).reshape(
+            (len(ids),) + tuple(shape))
+        return {"data": data}
+
+    return ds.map_batches(to_tensor, batch_format="numpy")
+
+
+def from_items(items: List[Any], *, parallelism: int = -1) -> Dataset:
+    return read_datasource(ItemsDatasource(items), parallelism=parallelism)
+
+
+def from_blocks(blocks: List[Block]) -> Dataset:
+    refs = [ray_tpu.put(b) for b in blocks]
+    meta = [BlockMetadata(num_rows=BlockAccessor.for_block(b).num_rows())
+            for b in blocks]
+    return Dataset(L.LogicalPlan(L.InputData(refs, meta)))
+
+
+def from_pandas(dfs) -> Dataset:
+    import pyarrow as pa
+
+    if not isinstance(dfs, list):
+        dfs = [dfs]
+    return from_blocks([
+        pa.Table.from_pandas(df, preserve_index=False) for df in dfs])
+
+
+def from_arrow(tables) -> Dataset:
+    if not isinstance(tables, list):
+        tables = [tables]
+    return from_blocks(list(tables))
+
+
+def from_numpy(arrays, *, column: str = "data") -> Dataset:
+    from .block import block_from_numpy
+
+    if not isinstance(arrays, list):
+        arrays = [arrays]
+    return from_blocks([block_from_numpy({column: a}) for a in arrays])
+
+
+def read_parquet(paths, *, columns=None, parallelism: int = -1) -> Dataset:
+    return read_datasource(ParquetDatasource(paths, columns=columns),
+                           parallelism=parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(CSVDatasource(paths), parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(JSONDatasource(paths), parallelism=parallelism)
+
+
+def read_numpy(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(NumpyDatasource(paths), parallelism=parallelism)
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      parallelism: int = -1) -> Dataset:
+    return read_datasource(
+        BinaryDatasource(paths, include_paths=include_paths),
+        parallelism=parallelism)
+
+
+def read_text(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(TextDatasource(paths), parallelism=parallelism)

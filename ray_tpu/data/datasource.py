@@ -1,0 +1,305 @@
+"""Datasource / ReadTask / Datasink plugin API + built-in implementations.
+
+Reference: python/ray/data/datasource/datasource.py:11,127 (Datasource,
+ReadTask), file_based_datasource.py, _internal/datasource/* (parquet, csv,
+json, numpy, binary, range).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .block import Block, BlockAccessor, block_from_numpy, build_block
+
+try:
+    import pyarrow as pa
+except ImportError:  # pragma: no cover
+    pa = None
+
+
+@dataclass
+class BlockMetadata:
+    num_rows: Optional[int] = None
+    size_bytes: Optional[int] = None
+    input_files: List[str] = field(default_factory=list)
+
+
+class ReadTask:
+    """A serializable thunk producing one or more blocks on a worker.
+
+    Reference: datasource.py:127 — ``ReadTask`` carries metadata so the
+    planner can estimate sizes without executing.
+    """
+
+    def __init__(self, read_fn: Callable[[], Iterable[Block]],
+                 metadata: Optional[BlockMetadata] = None):
+        self._read_fn = read_fn
+        self.metadata = metadata or BlockMetadata()
+
+    def __call__(self) -> Iterable[Block]:
+        return self._read_fn()
+
+
+class Datasource:
+    """Custom source plugin (reference: datasource.py:11)."""
+
+    def get_name(self) -> str:
+        return type(self).__name__
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+
+class Datasink:
+    """Custom sink plugin (reference: datasource.py Datasink)."""
+
+    def on_write_start(self) -> None:
+        pass
+
+    def write(self, blocks: List[Block], ctx: Dict[str, Any]) -> Any:
+        raise NotImplementedError
+
+    def on_write_complete(self, results: List[Any]) -> None:
+        pass
+
+
+# ---------------------------------------------------------------- built-ins
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, *, column: str = "id"):
+        self._n = n
+        self._column = column
+
+    def estimate_inmemory_data_size(self) -> int:
+        return self._n * 8
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self._n or 1))
+        tasks = []
+        chunk = (self._n + parallelism - 1) // parallelism if self._n else 0
+        for start in range(0, self._n, chunk or 1):
+            end = min(start + chunk, self._n)
+            col = self._column
+
+            def fn(start=start, end=end):
+                return [block_from_numpy(
+                    {col: np.arange(start, end, dtype=np.int64)})]
+
+            tasks.append(ReadTask(fn, BlockMetadata(
+                num_rows=end - start, size_bytes=(end - start) * 8)))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: List[Any]):
+        self._items = list(items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        items = self._items
+        n = len(items)
+        parallelism = max(1, min(parallelism, n or 1))
+        chunk = (n + parallelism - 1) // parallelism if n else 0
+        tasks = []
+        for start in range(0, n, chunk or 1):
+            part = items[start:start + chunk]
+
+            def fn(part=part):
+                rows = [r if isinstance(r, dict) else {"item": r}
+                        for r in part]
+                return [build_block(rows)]
+
+            tasks.append(ReadTask(fn, BlockMetadata(num_rows=len(part))))
+        if not tasks:
+            tasks.append(ReadTask(lambda: [build_block([])],
+                                  BlockMetadata(num_rows=0)))
+        return tasks
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in sorted(files)
+                           if not f.startswith((".", "_")))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files found for {paths}")
+    return out
+
+
+class FileBasedDatasource(Datasource):
+    """Shared path-expansion + per-file read tasks
+    (reference: file_based_datasource.py)."""
+
+    def __init__(self, paths):
+        self._paths = _expand_paths(paths)
+
+    def _read_file(self, path: str) -> Iterable[Block]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for path in self._paths:
+            size = os.path.getsize(path) if os.path.exists(path) else None
+
+            def fn(path=path):
+                return list(self._read_file(path))
+
+            tasks.append(ReadTask(fn, BlockMetadata(
+                size_bytes=size, input_files=[path])))
+        return tasks
+
+
+class ParquetDatasource(FileBasedDatasource):
+    def __init__(self, paths, *, columns: Optional[List[str]] = None):
+        super().__init__(paths)
+        self._columns = columns
+
+    def _read_file(self, path: str):
+        import pyarrow.parquet as pq
+
+        yield pq.read_table(path, columns=self._columns)
+
+
+class CSVDatasource(FileBasedDatasource):
+    def _read_file(self, path: str):
+        from pyarrow import csv as pacsv
+
+        yield pacsv.read_csv(path)
+
+
+class JSONDatasource(FileBasedDatasource):
+    def _read_file(self, path: str):
+        import json as _json
+
+        rows = []
+        with open(path) as f:
+            text = f.read().strip()
+        if text.startswith("["):
+            rows = _json.loads(text)
+        else:  # jsonl
+            rows = [_json.loads(line) for line in text.splitlines() if line]
+        yield build_block(rows)
+
+
+class NumpyDatasource(FileBasedDatasource):
+    def __init__(self, paths, *, column: str = "data"):
+        super().__init__(paths)
+        self._column = column
+
+    def _read_file(self, path: str):
+        arr = np.load(path)
+        yield block_from_numpy({self._column: arr})
+
+
+class BinaryDatasource(FileBasedDatasource):
+    def __init__(self, paths, *, include_paths: bool = False):
+        super().__init__(paths)
+        self._include_paths = include_paths
+
+    def _read_file(self, path: str):
+        with open(path, "rb") as f:
+            data = f.read()
+        row = {"bytes": data}
+        if self._include_paths:
+            row["path"] = path
+        yield build_block([row])
+
+
+class TextDatasource(FileBasedDatasource):
+    def __init__(self, paths, *, drop_empty_lines: bool = True):
+        super().__init__(paths)
+        self._drop_empty = drop_empty_lines
+
+    def _read_file(self, path: str):
+        with open(path) as f:
+            lines = f.read().splitlines()
+        if self._drop_empty:
+            lines = [ln for ln in lines if ln.strip()]
+        yield build_block([{"text": ln} for ln in lines])
+
+
+# ---------------------------------------------------------------- sinks
+
+
+class _FileDatasink(Datasink):
+    def __init__(self, path: str, *, file_format: str):
+        self._path = path
+        self._format = file_format
+
+    def on_write_start(self) -> None:
+        os.makedirs(self._path, exist_ok=True)
+
+    def write(self, blocks: List[Block], ctx: Dict[str, Any]) -> Any:
+        written = []
+        for i, block in enumerate(blocks):
+            acc = BlockAccessor.for_block(block)
+            if acc.num_rows() == 0:
+                continue
+            name = f"{ctx.get('task_idx', 0)}_{i:06d}.{self._format}"
+            fpath = os.path.join(self._path, name)
+            self._write_one(acc, fpath)
+            written.append(fpath)
+        return written
+
+    def _write_one(self, acc: BlockAccessor, fpath: str) -> None:
+        raise NotImplementedError
+
+
+class ParquetDatasink(_FileDatasink):
+    def __init__(self, path: str):
+        super().__init__(path, file_format="parquet")
+
+    def _write_one(self, acc: BlockAccessor, fpath: str) -> None:
+        import pyarrow.parquet as pq
+
+        pq.write_table(acc.to_arrow(), fpath)
+
+
+class CSVDatasink(_FileDatasink):
+    def __init__(self, path: str):
+        super().__init__(path, file_format="csv")
+
+    def _write_one(self, acc: BlockAccessor, fpath: str) -> None:
+        from pyarrow import csv as pacsv
+
+        pacsv.write_csv(acc.to_arrow(), fpath)
+
+
+class JSONDatasink(_FileDatasink):
+    def __init__(self, path: str):
+        super().__init__(path, file_format="json")
+
+    def _write_one(self, acc: BlockAccessor, fpath: str) -> None:
+        import json as _json
+
+        with open(fpath, "w") as f:
+            for row in acc.iter_rows():
+                f.write(_json.dumps(_json_safe(row)) + "\n")
+
+
+def _json_safe(row: Any) -> Any:
+    if isinstance(row, dict):
+        return {k: _json_safe(v) for k, v in row.items()}
+    if isinstance(row, (np.integer,)):
+        return int(row)
+    if isinstance(row, (np.floating,)):
+        return float(row)
+    if isinstance(row, np.ndarray):
+        return row.tolist()
+    return row

@@ -1,0 +1,965 @@
+"""Pull-based streaming executor over the ray_tpu task/actor runtime.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py:48
+(scheduling loop :272), streaming_executor_state.py:165 (OpState,
+select_operator_to_run :517), operators/ (TaskPoolMapOperator,
+ActorPoolMapOperator, all-to-all ops), resource_manager.py (backpressure).
+
+Design: each logical op lowers to a ``PhysicalOperator`` holding an input
+queue of block refs, in-flight remote tasks, and an output queue. The driver
+loop polls completions, moves outputs downstream (bounded queues =
+backpressure), dispatches new tasks, and yields final-op outputs as they
+stream out — consumption pulls the loop.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .block import (
+    Block,
+    BlockAccessor,
+    batch_to_block,
+    build_block,
+    concat_blocks,
+)
+from . import logical as L
+
+
+@dataclass
+class RefBundle:
+    ref: Any  # ObjectRef of one block
+    num_rows: Optional[int] = None
+
+
+@dataclass
+class DataContext:
+    """Execution knobs (reference: python/ray/data/context.py DataContext)."""
+
+    max_tasks_per_op: int = 0        # 0 = #cluster CPUs
+    op_output_queue_cap: int = 32    # bounded queues => backpressure
+    actor_pool_size: int = 2
+    target_min_rows_per_block: int = 1
+
+    _current: "DataContext" = None
+
+    @staticmethod
+    def get_current() -> "DataContext":
+        if DataContext._current is None:
+            DataContext._current = DataContext()
+        return DataContext._current
+
+
+# ---------------------------------------------------------- remote helpers
+# Module-level remote functions: registered once per driver, small payloads.
+
+@ray_tpu.remote
+def _map_task(transform, *blocks):
+    return transform(list(blocks))
+
+
+@ray_tpu.remote
+def _count_task(block):
+    return BlockAccessor.for_block(block).num_rows()
+
+
+@ray_tpu.remote
+def _slice_range_task(start, end, counts, *blocks):
+    """Rows [start, end) of the concatenated stream, given per-block counts."""
+    out = []
+    offset = 0
+    for cnt, block in zip(counts, blocks):
+        lo, hi = max(start - offset, 0), min(end - offset, cnt)
+        if lo < hi:
+            out.append(BlockAccessor.for_block(block).slice(lo, hi))
+        offset += cnt
+    return concat_blocks(out)
+
+
+@ray_tpu.remote
+def _split_random_task(seed, n_out, block):
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    rng = np.random.RandomState(seed)
+    assignment = rng.randint(0, n_out, n)
+    parts = [acc.take_indices(np.nonzero(assignment == i)[0].tolist())
+             for i in range(n_out)]
+    return tuple(parts) if n_out > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _concat_shuffle_task(seed, *blocks):
+    merged = concat_blocks(list(blocks))
+    acc = BlockAccessor.for_block(merged)
+    n = acc.num_rows()
+    rng = np.random.RandomState(seed)
+    return acc.take_indices(rng.permutation(n).tolist())
+
+
+@ray_tpu.remote
+def _concat_task(*blocks):
+    return concat_blocks(list(blocks))
+
+
+@ray_tpu.remote
+def _sample_task(key, k, block):
+    acc = BlockAccessor.for_block(block)
+    n = acc.num_rows()
+    if n == 0:
+        return []
+    idx = np.linspace(0, n - 1, min(k, n)).astype(int).tolist()
+    rows = list(BlockAccessor.for_block(acc.take_indices(idx)).iter_rows())
+    keyfn = (lambda r: r[key]) if isinstance(key, str) else key
+    return [keyfn(r) for r in rows]
+
+
+@ray_tpu.remote
+def _partition_by_task(key, boundaries, descending, block):
+    """Split a block into len(boundaries)+1 sorted ranges."""
+    acc = BlockAccessor.for_block(block)
+    order = acc.sort_indices(key, descending)
+    sorted_block = acc.take_indices(order)
+    sacc = BlockAccessor.for_block(sorted_block)
+    rows = list(sacc.iter_rows())
+    keyfn = (lambda r: r[key]) if isinstance(key, str) else key
+    keys = [keyfn(r) for r in rows]
+    parts = []
+    lo = 0
+    for b in boundaries:
+        hi = lo
+        while hi < len(keys) and (
+                keys[hi] > b if descending else keys[hi] < b):
+            hi += 1
+        parts.append(sacc.slice(lo, hi))
+        lo = hi
+    parts.append(sacc.slice(lo, len(keys)))
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _merge_sorted_task(key, descending, *blocks):
+    merged = concat_blocks(list(blocks))
+    acc = BlockAccessor.for_block(merged)
+    return acc.take_indices(acc.sort_indices(key, descending))
+
+
+def _stable_hash(value) -> int:
+    """Deterministic across processes (Python's str hash is seeded)."""
+    import zlib
+
+    return zlib.crc32(repr(value).encode())
+
+
+@ray_tpu.remote
+def _hash_partition_task(keys, n_out, block):
+    acc = BlockAccessor.for_block(block)
+    rows = list(acc.iter_rows())
+    buckets: List[List[int]] = [[] for _ in range(n_out)]
+    for i, r in enumerate(rows):
+        h = _stable_hash(tuple(r[k] for k in keys)) % n_out
+        buckets[h].append(i)
+    parts = [acc.take_indices(b) for b in buckets]
+    return tuple(parts) if n_out > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _agg_partition_task(keys, aggs, *blocks):
+    from .aggregate import aggregate_blocks
+
+    return aggregate_blocks(list(blocks), keys, aggs)
+
+
+@ray_tpu.remote
+def _zip_task(right_counts, left_start, left_rows, left_block, *right_blocks):
+    """Column-concat rows [left_start, left_start+left_rows) of the right
+    stream onto left_block."""
+    right = _slice_rows(right_blocks, right_counts, left_start,
+                        left_start + left_rows)
+    return _concat_columns(left_block, right)
+
+
+def _slice_rows(blocks, counts, start, end):
+    out = []
+    offset = 0
+    for cnt, block in zip(counts, blocks):
+        lo, hi = max(start - offset, 0), min(end - offset, cnt)
+        if lo < hi:
+            out.append(BlockAccessor.for_block(block).slice(lo, hi))
+        offset += cnt
+    return concat_blocks(out)
+
+
+def _concat_columns(left: Block, right: Block) -> Block:
+    try:
+        import pyarrow as pa
+    except ImportError:
+        pa = None
+    if pa is not None and isinstance(left, pa.Table) and isinstance(
+            right, pa.Table):
+        t = left
+        for name in right.column_names:
+            col = right.column(name)
+            out_name = name if name not in t.column_names else name + "_1"
+            t = t.append_column(out_name, col)
+        return t
+    lrows = list(BlockAccessor.for_block(left).iter_rows())
+    rrows = list(BlockAccessor.for_block(right).iter_rows())
+    out = []
+    for a, b in zip(lrows, rrows):
+        d = dict(a)
+        for k, v in b.items():
+            d[k if k not in d else k + "_1"] = v
+        out.append(d)
+    return build_block(out)
+
+
+@ray_tpu.remote
+def _write_task(datasink, task_idx, *blocks):
+    return datasink.write(list(blocks), {"task_idx": task_idx})
+
+
+# ------------------------------------------------------------- transforms
+
+
+def make_map_transform(kind: str, fn, batch_size=None, batch_format="default",
+                       ctor_args=(), ctor_kwargs=None):
+    """Build the picklable block->block transform for map-family ops."""
+    ctor_kwargs = ctor_kwargs or {}
+    is_class = isinstance(fn, type)
+
+    def transform(blocks: List[Block]) -> Block:
+        call = fn(*ctor_args, **ctor_kwargs) if is_class else fn
+        outs: List[Block] = []
+        for block in blocks:
+            acc = BlockAccessor.for_block(block)
+            if kind == "map_batches":
+                n = acc.num_rows()
+                bs = batch_size or max(n, 1)
+                for start in range(0, max(n, 1), bs):
+                    if n == 0 and start > 0:
+                        break
+                    sub = BlockAccessor.for_block(
+                        acc.slice(start, min(start + bs, n)))
+                    batch = sub.to_batch(batch_format)
+                    res = call(batch)
+                    if hasattr(res, "__next__"):  # generator of batches
+                        for item in res:
+                            outs.append(batch_to_block(item))
+                    else:
+                        outs.append(batch_to_block(res))
+            elif kind == "map":
+                outs.append(build_block(
+                    [call(row) for row in acc.iter_rows()]))
+            elif kind == "filter":
+                outs.append(build_block(
+                    [row for row in acc.iter_rows() if call(row)]))
+            elif kind == "flat_map":
+                rows = []
+                for row in acc.iter_rows():
+                    rows.extend(call(row))
+                outs.append(build_block(rows))
+            else:
+                raise ValueError(kind)
+        return concat_blocks(outs)
+
+    return transform
+
+
+def make_project_transform(select, drop, rename):
+    def transform(blocks: List[Block]) -> Block:
+        out = []
+        for block in blocks:
+            acc = BlockAccessor.for_block(block)
+            rows = []
+            for row in acc.iter_rows():
+                if select is not None:
+                    row = {k: row[k] for k in select}
+                if drop:
+                    row = {k: v for k, v in row.items() if k not in drop}
+                if rename:
+                    row = {rename.get(k, k): v for k, v in row.items()}
+                rows.append(row)
+            out.append(build_block(rows))
+        return concat_blocks(out)
+
+    return transform
+
+
+@ray_tpu.remote
+def _read_task_exec(read_task):
+    return concat_blocks(list(read_task()))
+
+
+# --------------------------------------------------------------- operators
+
+
+class PhysicalOperator:
+    def __init__(self, name: str, ctx: DataContext):
+        self.name = name
+        self.ctx = ctx
+        self.input_queue: deque = deque()
+        self.output_queue: deque = deque()
+        self.inputs_complete = False
+        self.pending: Dict[Any, Any] = {}  # ref -> context
+        # ordered emission: outputs leave in dispatch order even when tasks
+        # finish out of order (Ray Data preserves block order)
+        self._seq_in = 0
+        self._seq_out = 0
+        self._ready_bufs: Dict[int, RefBundle] = {}
+
+    def _next_seq(self) -> int:
+        s = self._seq_in
+        self._seq_in += 1
+        return s
+
+    def _emit(self, seq: int, bundle: RefBundle) -> None:
+        self._ready_bufs[seq] = bundle
+        while self._seq_out in self._ready_bufs:
+            self.output_queue.append(self._ready_bufs.pop(self._seq_out))
+            self._seq_out += 1
+
+    # -- upstream interface
+    def add_input(self, bundle: RefBundle) -> None:
+        self.input_queue.append(bundle)
+
+    def input_backpressure(self) -> bool:
+        return len(self.input_queue) >= self.ctx.op_output_queue_cap
+
+    def mark_inputs_done(self) -> None:
+        self.inputs_complete = True
+
+    # -- downstream interface
+    def has_next(self) -> bool:
+        return bool(self.output_queue)
+
+    def get_next(self) -> RefBundle:
+        return self.output_queue.popleft()
+
+    # -- execution
+    def poll(self) -> bool:
+        """Collect finished remote tasks; return True on progress."""
+        if not self.pending:
+            return False
+        ready, _ = ray_tpu.wait(list(self.pending.keys()),
+                                num_returns=len(self.pending), timeout=0)
+        progress = False
+        for ref in ready:
+            ctx = self.pending.pop(ref)
+            self._on_task_done(ref, ctx)
+            progress = True
+        return progress
+
+    def _on_task_done(self, ref, task_ctx) -> None:
+        self._emit(task_ctx, RefBundle(ref))
+
+    def dispatch(self, out_backpressure: bool) -> bool:
+        return False
+
+    def completed(self) -> bool:
+        return (self.inputs_complete and not self.input_queue
+                and not self.pending and not self.output_queue
+                and not self._ready_bufs)
+
+    def shutdown(self) -> None:
+        pass
+
+    def work_remaining(self) -> bool:
+        return bool(self.input_queue or self.pending)
+
+
+class InputDataBuffer(PhysicalOperator):
+    def __init__(self, ctx, bundles: List[RefBundle]):
+        super().__init__("Input", ctx)
+        self.output_queue.extend(bundles)
+        self.inputs_complete = True
+
+
+class ReadOperator(PhysicalOperator):
+    """Executes ReadTasks as remote tasks (reference fuses Read into Map)."""
+
+    def __init__(self, ctx, read_tasks, max_tasks: int):
+        super().__init__("Read", ctx)
+        self._read_tasks = deque(read_tasks)
+        self._max_tasks = max_tasks
+        self.inputs_complete = True
+
+    def dispatch(self, out_backpressure: bool) -> bool:
+        progress = False
+        while (self._read_tasks and len(self.pending) < self._max_tasks
+               and not out_backpressure
+               and len(self.output_queue) + len(self.pending)
+               < self.ctx.op_output_queue_cap):
+            rt = self._read_tasks.popleft()
+            ref = _read_task_exec.remote(rt)
+            self.pending[ref] = self._next_seq()
+            progress = True
+        return progress
+
+    def completed(self) -> bool:
+        return (not self._read_tasks and not self.pending
+                and not self.output_queue and not self._ready_bufs)
+
+    def work_remaining(self) -> bool:
+        return bool(self._read_tasks or self.pending)
+
+
+class TaskPoolMapOperator(PhysicalOperator):
+    """Stateless map via remote tasks (reference: task_pool_map_operator)."""
+
+    def __init__(self, ctx, name, transform, max_tasks: int,
+                 num_cpus: float = 1.0, num_tpus: float = 0.0):
+        super().__init__(name, ctx)
+        self._transform = transform
+        self._max_tasks = max_tasks
+        self._opts = {}
+        if num_cpus != 1.0:
+            self._opts["num_cpus"] = num_cpus
+        if num_tpus:
+            self._opts["num_tpus"] = num_tpus
+
+    def dispatch(self, out_backpressure: bool) -> bool:
+        progress = False
+        while (self.input_queue and len(self.pending) < self._max_tasks
+               and not out_backpressure
+               and len(self.output_queue) + len(self.pending)
+               < self.ctx.op_output_queue_cap):
+            bundle = self.input_queue.popleft()
+            fn = _map_task.options(**self._opts) if self._opts else _map_task
+            ref = fn.remote(self._transform, bundle.ref)
+            self.pending[ref] = self._next_seq()
+            progress = True
+        return progress
+
+
+class _MapWorker:
+    """Actor hosting a stateful transform (reference: _MapWorker in
+    actor_pool_map_operator.py)."""
+
+    def __init__(self, transform):
+        self._transform = transform
+
+    def ready(self):
+        return "ok"
+
+    def map_block(self, *blocks):
+        return self._transform(list(blocks))
+
+
+class ActorPoolMapOperator(PhysicalOperator):
+    def __init__(self, ctx, name, transform, pool_size: int,
+                 num_cpus: float = 1.0, num_tpus: float = 0.0):
+        super().__init__(name, ctx)
+        self._transform = transform
+        self._pool_size = max(1, pool_size)
+        self._num_cpus = num_cpus
+        self._num_tpus = num_tpus
+        self._actors: List[Any] = []
+        self._idle: deque = deque()
+        self._started = False
+
+    def _start(self) -> None:
+        cls = ray_tpu.remote(_MapWorker)
+        for _ in range(self._pool_size):
+            a = cls.options(num_cpus=self._num_cpus,
+                            num_tpus=self._num_tpus).remote(self._transform)
+            self._actors.append(a)
+            self._idle.append(a)
+        self._started = True
+
+    def dispatch(self, out_backpressure: bool) -> bool:
+        if not self._started:
+            self._start()
+        progress = False
+        while (self.input_queue and self._idle and not out_backpressure
+               and len(self.output_queue) + len(self.pending)
+               < self.ctx.op_output_queue_cap):
+            bundle = self.input_queue.popleft()
+            actor = self._idle.popleft()
+            ref = actor.map_block.remote(bundle.ref)
+            self.pending[ref] = (self._next_seq(), actor)
+            progress = True
+        return progress
+
+    def _on_task_done(self, ref, ctx) -> None:
+        seq, actor = ctx
+        self._emit(seq, RefBundle(ref))
+        self._idle.append(actor)
+
+    def shutdown(self) -> None:
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors.clear()
+
+
+class AllToAllOperator(PhysicalOperator):
+    """Barrier op: collects every input ref, then runs ``bulk_fn(refs) ->
+    List[refs]`` (reference: all-to-all ops materialize their input)."""
+
+    def __init__(self, ctx, name, bulk_fn: Callable[[List[RefBundle]],
+                                                    List[RefBundle]]):
+        super().__init__(name, ctx)
+        self._bulk_fn = bulk_fn
+        self._collected: List[RefBundle] = []
+        self._executed = False
+
+    def add_input(self, bundle: RefBundle) -> None:
+        self._collected.append(bundle)
+
+    def input_backpressure(self) -> bool:
+        return False  # must absorb everything
+
+    def dispatch(self, out_backpressure: bool) -> bool:
+        if self.inputs_complete and not self._executed:
+            self._executed = True
+            for b in self._bulk_fn(self._collected):
+                self.output_queue.append(b)
+            return True
+        return False
+
+    def completed(self) -> bool:
+        return self._executed and not self.output_queue
+
+    def work_remaining(self) -> bool:
+        return self.inputs_complete and not self._executed
+
+
+class LimitOperator(PhysicalOperator):
+    """Streaming limit with upstream short-circuit."""
+
+    def __init__(self, ctx, limit: int):
+        super().__init__("Limit", ctx)
+        self._remaining = limit
+        self.satisfied = limit == 0
+
+    def dispatch(self, out_backpressure: bool) -> bool:
+        progress = False
+        while self.input_queue and self._remaining > 0:
+            bundle = self.input_queue.popleft()
+            n = bundle.num_rows
+            if n is None:
+                n = BlockAccessor.for_block(
+                    ray_tpu.get(bundle.ref)).num_rows()
+            if n <= self._remaining:
+                self._remaining -= n
+                self.output_queue.append(RefBundle(bundle.ref, n))
+            else:
+                block = ray_tpu.get(bundle.ref)
+                sliced = BlockAccessor.for_block(block).slice(
+                    0, self._remaining)
+                self.output_queue.append(
+                    RefBundle(ray_tpu.put(sliced), self._remaining))
+                self._remaining = 0
+            progress = True
+        if self._remaining == 0:
+            self.satisfied = True
+            self.input_queue.clear()
+        return progress
+
+    def completed(self) -> bool:
+        return ((self.satisfied or (self.inputs_complete
+                                    and not self.input_queue))
+                and not self.output_queue)
+
+
+class UnionOperator(PhysicalOperator):
+    def dispatch(self, out_backpressure: bool) -> bool:
+        progress = False
+        while self.input_queue and not out_backpressure:
+            self.output_queue.append(self.input_queue.popleft())
+            progress = True
+        return progress
+
+
+# ----------------------------------------------------------- bulk (a2a) fns
+
+
+def _counts_for(refs: List[Any]) -> List[int]:
+    return ray_tpu.get([_count_task.remote(r) for r in refs])
+
+
+def repartition_bulk(bundles: List[RefBundle], n: int,
+                     shuffle: bool) -> List[RefBundle]:
+    refs = [b.ref for b in bundles]
+    if shuffle:
+        return random_shuffle_bulk(bundles, seed=0, num_outputs=n)
+    if not refs:
+        return [RefBundle(ray_tpu.put(build_block([])), 0)
+                for _ in range(n)]
+    counts = _counts_for(refs)
+    total = sum(counts)
+    out = []
+    for i in range(n):
+        start = (total * i) // n
+        end = (total * (i + 1)) // n
+        ref = _slice_range_task.remote(start, end, counts, *refs)
+        out.append(RefBundle(ref, end - start))
+    return out
+
+
+def random_shuffle_bulk(bundles: List[RefBundle], seed: Optional[int],
+                        num_outputs: Optional[int]) -> List[RefBundle]:
+    refs = [b.ref for b in bundles]
+    if not refs:
+        return []
+    n_out = num_outputs or len(refs)
+    base = seed if seed is not None else int(time.time() * 1000) % (1 << 30)
+    parts = []
+    for i, r in enumerate(refs):
+        res = _split_random_task.options(num_returns=n_out).remote(
+            base + i, n_out, r)
+        parts.append(res if isinstance(res, list) else [res])
+    outs = []
+    for j in range(n_out):
+        shards = [parts[i][j] for i in range(len(refs))]
+        outs.append(RefBundle(
+            _concat_shuffle_task.remote(base ^ (j + 1), *shards)))
+    return outs
+
+
+def sort_bulk(bundles: List[RefBundle], key, descending) -> List[RefBundle]:
+    refs = [b.ref for b in bundles]
+    if not refs:
+        return []
+    p = len(refs)
+    samples: List[Any] = []
+    for s in ray_tpu.get([_sample_task.remote(key, 20, r) for r in refs]):
+        samples.extend(s)
+    if not samples:
+        return [RefBundle(r) for r in refs]
+    samples.sort(reverse=descending)
+    boundaries = []
+    for i in range(1, p):
+        boundaries.append(samples[(len(samples) * i) // p])
+    parts = []
+    for r in refs:
+        res = _partition_by_task.options(num_returns=p).remote(
+            key, boundaries, descending, r)
+        parts.append(res if isinstance(res, list) else [res])
+    outs = []
+    for j in range(p):
+        shards = [parts[i][j] for i in range(p)]
+        outs.append(RefBundle(
+            _merge_sorted_task.remote(key, descending, *shards)))
+    return outs
+
+
+def aggregate_bulk(bundles: List[RefBundle], keys, aggs) -> List[RefBundle]:
+    refs = [b.ref for b in bundles]
+    if not refs:
+        return []
+    if not keys:
+        ref = _agg_partition_task.remote(keys, aggs, *refs)
+        return [RefBundle(ref)]
+    p = max(1, min(len(refs), 8))
+    parts = []
+    for r in refs:
+        res = _hash_partition_task.options(num_returns=p).remote(keys, p, r)
+        parts.append(res if isinstance(res, list) else [res])
+    outs = []
+    for j in range(p):
+        shards = [parts[i][j] for i in range(len(refs))]
+        outs.append(RefBundle(_agg_partition_task.remote(keys, aggs, *shards)))
+    return outs
+
+
+def hash_repartition_bulk(bundles: List[RefBundle], keys: List[str],
+                          num_outputs: int) -> List[RefBundle]:
+    refs = [b.ref for b in bundles]
+    if not refs:
+        return []
+    p = max(1, min(num_outputs, max(len(refs), 1)))
+    parts = []
+    for r in refs:
+        res = _hash_partition_task.options(num_returns=p).remote(keys, p, r)
+        parts.append(res if isinstance(res, list) else [res])
+    outs = []
+    for j in range(p):
+        shards = [parts[i][j] for i in range(len(refs))]
+        outs.append(RefBundle(_concat_task.remote(*shards)))
+    return outs
+
+
+def zip_bulk(left: List[RefBundle], right: List[RefBundle]) -> List[RefBundle]:
+    lrefs = [b.ref for b in left]
+    rrefs = [b.ref for b in right]
+    lcounts = _counts_for(lrefs)
+    rcounts = _counts_for(rrefs)
+    if sum(lcounts) != sum(rcounts):
+        raise ValueError(
+            f"zip requires equal row counts: {sum(lcounts)} vs {sum(rcounts)}")
+    outs = []
+    offset = 0
+    for lref, lcount in zip(lrefs, lcounts):
+        outs.append(RefBundle(_zip_task.remote(
+            rcounts, offset, lcount, lref, *rrefs), lcount))
+        offset += lcount
+    return outs
+
+
+def randomize_blocks_bulk(bundles: List[RefBundle],
+                          seed: Optional[int]) -> List[RefBundle]:
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(bundles))
+    return [bundles[i] for i in order]
+
+
+# ----------------------------------------------------------------- planner
+
+
+def _default_max_tasks(ctx: DataContext) -> int:
+    if ctx.max_tasks_per_op:
+        return ctx.max_tasks_per_op
+    try:
+        return max(2, int(ray_tpu.cluster_resources().get("CPU", 4)))
+    except Exception:
+        return 4
+
+
+def build_physical_plan(plan: L.LogicalPlan, ctx: DataContext):
+    """Lower the logical DAG to physical operators; returns (ops_topo,
+    edges: op -> consumer)."""
+    ops: Dict[int, PhysicalOperator] = {}
+    consumers: Dict[int, List[PhysicalOperator]] = {}
+    topo = plan.ops_topo()
+    max_tasks = _default_max_tasks(ctx)
+
+    for lop in topo:
+        if isinstance(lop, L.Read):
+            tasks = lop.datasource.get_read_tasks(lop.parallelism)
+            phys = ReadOperator(ctx, tasks, max_tasks)
+        elif isinstance(lop, L.InputData):
+            phys = InputDataBuffer(ctx, [
+                RefBundle(r, m.num_rows if m else None)
+                for r, m in zip(lop.block_refs, lop.metadata)])
+        elif isinstance(lop, L.MapBatches):
+            transform = make_map_transform(
+                "map_batches", lop.fn, lop.batch_size, lop.batch_format,
+                lop.fn_constructor_args, lop.fn_constructor_kwargs)
+            phys = _make_map_phys(ctx, lop, transform, max_tasks)
+        elif isinstance(lop, L.MapRows):
+            phys = _make_map_phys(ctx, lop, make_map_transform(
+                "map", lop.fn), max_tasks)
+        elif isinstance(lop, L.Filter):
+            phys = _make_map_phys(ctx, lop, make_map_transform(
+                "filter", lop.fn), max_tasks)
+        elif isinstance(lop, L.FlatMap):
+            phys = _make_map_phys(ctx, lop, make_map_transform(
+                "flat_map", lop.fn), max_tasks)
+        elif isinstance(lop, L.Project):
+            phys = TaskPoolMapOperator(
+                ctx, "Project", make_project_transform(
+                    lop.select, lop.drop, lop.rename), max_tasks)
+        elif isinstance(lop, L.Repartition):
+            phys = AllToAllOperator(
+                ctx, "Repartition",
+                lambda bs, lop=lop: repartition_bulk(
+                    bs, lop.num_blocks, lop.shuffle))
+        elif isinstance(lop, L.RandomShuffle):
+            phys = AllToAllOperator(
+                ctx, "RandomShuffle",
+                lambda bs, lop=lop: random_shuffle_bulk(
+                    bs, lop.seed, lop.num_outputs))
+        elif isinstance(lop, L.Sort):
+            phys = AllToAllOperator(
+                ctx, "Sort",
+                lambda bs, lop=lop: sort_bulk(bs, lop.key, lop.descending))
+        elif isinstance(lop, L.GroupAggregate):
+            phys = AllToAllOperator(
+                ctx, "Aggregate",
+                lambda bs, lop=lop: aggregate_bulk(bs, lop.keys, lop.aggs))
+        elif isinstance(lop, L.HashRepartition):
+            phys = AllToAllOperator(
+                ctx, "HashRepartition",
+                lambda bs, lop=lop: hash_repartition_bulk(
+                    bs, lop.keys, lop.num_outputs))
+        elif isinstance(lop, L.RandomizeBlocks):
+            phys = AllToAllOperator(
+                ctx, "RandomizeBlocks",
+                lambda bs, lop=lop: randomize_blocks_bulk(bs, lop.seed))
+        elif isinstance(lop, L.Zip):
+            phys = _ZipOperator(ctx)
+        elif isinstance(lop, L.Union):
+            phys = UnionOperator("Union", ctx)
+        elif isinstance(lop, L.Limit):
+            phys = LimitOperator(ctx, lop.limit)
+        elif isinstance(lop, L.Write):
+            phys = _WriteOperator(ctx, lop.datasink, max_tasks)
+        else:
+            raise ValueError(f"cannot lower {lop}")
+        ops[id(lop)] = phys
+        for parent in lop.inputs:
+            consumers.setdefault(id(parent), []).append(phys)
+
+    ordered = [ops[id(lop)] for lop in topo]
+    edges = {id(ops[k]): v for k, v in consumers.items()}
+    # Zip needs to know which input is left vs right
+    for lop in topo:
+        if isinstance(lop, L.Zip):
+            zop = ops[id(lop)]
+            zop.left_op = ops[id(lop.inputs[0])]
+            zop.right_op = ops[id(lop.inputs[1])]
+    return ordered, edges, ops[id(topo[-1])]
+
+
+def _make_map_phys(ctx, lop: L.AbstractMap, transform, max_tasks):
+    if lop.compute.kind == "actors":
+        size = lop.concurrency or lop.compute.max_size or ctx.actor_pool_size
+        return ActorPoolMapOperator(ctx, lop.name, transform, size,
+                                    lop.num_cpus, lop.num_tpus)
+    cap = lop.concurrency or max_tasks
+    return TaskPoolMapOperator(ctx, lop.name, transform, cap,
+                               lop.num_cpus, lop.num_tpus)
+
+
+class _ZipOperator(PhysicalOperator):
+    """Barrier zip: buffers both sides keyed by producing op."""
+
+    def __init__(self, ctx):
+        super().__init__("Zip", ctx)
+        self.left_op = None
+        self.right_op = None
+        self._left: List[RefBundle] = []
+        self._right: List[RefBundle] = []
+        self._executed = False
+        self._done_count = 0
+
+    def add_input_from(self, src: PhysicalOperator, bundle: RefBundle) -> None:
+        if src is self.left_op:
+            self._left.append(bundle)
+        else:
+            self._right.append(bundle)
+
+    def input_backpressure(self) -> bool:
+        return False
+
+    def dispatch(self, out_backpressure: bool) -> bool:
+        if self.inputs_complete and not self._executed:
+            self._executed = True
+            for b in zip_bulk(self._left, self._right):
+                self.output_queue.append(b)
+            return True
+        return False
+
+    def completed(self) -> bool:
+        return self._executed and not self.output_queue
+
+    def work_remaining(self) -> bool:
+        return self.inputs_complete and not self._executed
+
+
+class _WriteOperator(PhysicalOperator):
+    def __init__(self, ctx, datasink, max_tasks):
+        super().__init__("Write", ctx)
+        self._datasink = datasink
+        self._max_tasks = max_tasks
+        self._task_idx = 0
+        self._started = False
+
+    def dispatch(self, out_backpressure: bool) -> bool:
+        if not self._started:
+            self._datasink.on_write_start()
+            self._started = True
+        progress = False
+        while self.input_queue and len(self.pending) < self._max_tasks:
+            bundle = self.input_queue.popleft()
+            ref = _write_task.remote(self._datasink, self._task_idx,
+                                     bundle.ref)
+            self._task_idx += 1
+            self.pending[ref] = self._next_seq()
+            progress = True
+        return progress
+
+
+# ---------------------------------------------------------------- executor
+
+
+class StreamingExecutor:
+    """The driver-side scheduling loop (reference:
+    streaming_executor.py:272 _scheduling_loop_step)."""
+
+    def __init__(self, plan: L.LogicalPlan,
+                 ctx: Optional[DataContext] = None):
+        self.ctx = ctx or DataContext.get_current()
+        self.ops, self.edges, self.final_op = build_physical_plan(
+            plan, self.ctx)
+        self._producers_done: Dict[int, int] = {}
+        self._num_producers: Dict[int, int] = {}
+        self._done_markers: set = set()
+        for op in self.ops:
+            for consumer in self.edges.get(id(op), []):
+                self._num_producers[id(consumer)] = \
+                    self._num_producers.get(id(consumer), 0) + 1
+        for op in self.ops:
+            if self._num_producers.get(id(op), 0) == 0 \
+                    and not op.inputs_complete:
+                op.mark_inputs_done()
+
+    def _move_outputs(self) -> bool:
+        progress = False
+        for op in self.ops:
+            consumers = self.edges.get(id(op), [])
+            if not consumers:
+                continue
+            while op.has_next():
+                if any(c.input_backpressure() for c in consumers):
+                    break
+                bundle = op.get_next()
+                for consumer in consumers:
+                    if isinstance(consumer, _ZipOperator):
+                        consumer.add_input_from(op, bundle)
+                    else:
+                        consumer.add_input(bundle)
+                progress = True
+            # propagate completion
+            if op.completed() and not op.has_next():
+                for consumer in consumers:
+                    marker = (id(op), id(consumer))
+                    if marker not in self._done_markers:
+                        self._done_markers.add(marker)
+                        key = id(consumer)
+                        self._producers_done[key] = \
+                            self._producers_done.get(key, 0) + 1
+                        if self._producers_done[key] >= \
+                                self._num_producers.get(key, 1):
+                            consumer.mark_inputs_done()
+        return progress
+
+    def execute(self) -> Iterator[RefBundle]:
+        """Run to completion, yielding final-op outputs as they stream."""
+        try:
+            while True:
+                progress = False
+                for op in self.ops:
+                    progress |= op.poll()
+                progress |= self._move_outputs()
+                for op in self.ops:
+                    out_bp = False
+                    consumers = self.edges.get(id(op), [])
+                    if consumers and consumers[0].input_backpressure():
+                        out_bp = True
+                    progress |= op.dispatch(out_bp)
+                while self.final_op.has_next():
+                    yield self.final_op.get_next()
+                    progress = True
+                if all(op.completed() for op in self.ops):
+                    break
+                # Limit short-circuit: if the final chain is satisfied, stop.
+                if isinstance(self.final_op, LimitOperator) \
+                        and self.final_op.satisfied \
+                        and not self.final_op.has_next():
+                    break
+                if not progress:
+                    time.sleep(0.002)
+        finally:
+            for op in self.ops:
+                op.shutdown()

@@ -1,0 +1,160 @@
+"""DataIterator — batched iteration over streams of block refs.
+
+Reference: python/ray/data/iterator.py + _internal/block_batching/.
+``iter_batches`` re-chunks the block stream to exact batch sizes, with
+background prefetch (thread) and optional local shuffle buffer; ``to_jax``
+adds device placement (``jax.device_put`` with an optional NamedSharding) —
+the TPU-native replacement for iter_torch_batches' pin_memory path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from .block import Block, BlockAccessor, concat_blocks
+
+
+class DataIterator:
+    """Iterates batches pulled from a (re-startable) block-ref source."""
+
+    def __init__(self, source_fn: Callable[[], Iterator[Any]]):
+        """source_fn: returns a fresh iterator of block *refs* per epoch."""
+        self._source_fn = source_fn
+
+    # -- raw access
+    def iter_block_refs(self) -> Iterator[Any]:
+        return self._source_fn()
+
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref in self._source_fn():
+            yield ray_tpu.get(ref)
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor.for_block(block).iter_rows()
+
+    # -- batched access
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        batch_format: Optional[str] = "default",
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+        prefetch_batches: int = 1,
+    ) -> Iterator[Any]:
+        def gen():
+            carry: List[Block] = []
+            carry_rows = 0
+            shuffle_rng = (np.random.RandomState(local_shuffle_seed)
+                           if local_shuffle_buffer_size else None)
+            min_buf = local_shuffle_buffer_size or 0
+            for block in self.iter_blocks():
+                n = BlockAccessor.for_block(block).num_rows()
+                if n == 0:
+                    continue
+                carry.append(block)
+                carry_rows += n
+                threshold = max(batch_size or 1, min_buf)
+                while carry_rows >= threshold and (batch_size or carry_rows):
+                    merged = concat_blocks(carry)
+                    acc = BlockAccessor.for_block(merged)
+                    if shuffle_rng is not None:
+                        merged = acc.take_indices(
+                            shuffle_rng.permutation(
+                                acc.num_rows()).tolist())
+                        acc = BlockAccessor.for_block(merged)
+                    bs = batch_size or acc.num_rows()
+                    out = acc.slice(0, bs)
+                    rest = acc.slice(bs, acc.num_rows())
+                    carry = [rest]
+                    carry_rows = BlockAccessor.for_block(rest).num_rows()
+                    yield BlockAccessor.for_block(out).to_batch(batch_format)
+            if carry_rows:
+                merged = concat_blocks(carry)
+                acc = BlockAccessor.for_block(merged)
+                if shuffle_rng is not None:
+                    merged = acc.take_indices(
+                        shuffle_rng.permutation(acc.num_rows()).tolist())
+                    acc = BlockAccessor.for_block(merged)
+                bs = batch_size or acc.num_rows()
+                for start in range(0, acc.num_rows(), bs):
+                    end = min(start + bs, acc.num_rows())
+                    if drop_last and end - start < bs:
+                        break
+                    yield BlockAccessor.for_block(
+                        acc.slice(start, end)).to_batch(batch_format)
+
+        if prefetch_batches and prefetch_batches > 0:
+            return _prefetch(gen(), prefetch_batches)
+        return gen()
+
+    def to_jax(
+        self,
+        *,
+        batch_size: int = 256,
+        columns: Optional[List[str]] = None,
+        sharding: Optional[Any] = None,
+        dtypes: Optional[Dict[str, Any]] = None,
+        drop_last: bool = True,
+        prefetch_batches: int = 2,
+        local_shuffle_buffer_size: Optional[int] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Yield dict-of-jax.Array batches placed on device.
+
+        Double-buffered H2D: the prefetch thread materializes numpy batches
+        while the device consumes the current one (SURVEY.md §7.6).
+        """
+        import jax
+
+        def place(batch: Dict[str, np.ndarray]):
+            if columns:
+                batch = {k: batch[k] for k in columns}
+            if dtypes:
+                batch = {k: v.astype(dtypes[k]) if k in dtypes else v
+                         for k, v in batch.items()}
+            if sharding is not None:
+                return {k: jax.device_put(v, sharding)
+                        for k, v in batch.items()}
+            return {k: jax.device_put(v) for k, v in batch.items()}
+
+        for batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                drop_last=drop_last, prefetch_batches=prefetch_batches,
+                local_shuffle_buffer_size=local_shuffle_buffer_size):
+            yield place(batch)
+
+    def materialize_blocks(self) -> List[Any]:
+        return list(self._source_fn())
+
+
+def _prefetch(it: Iterator[Any], depth: int) -> Iterator[Any]:
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _SENTINEL = object()
+    err: List[BaseException] = []
+
+    def worker():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:  # noqa: BLE001 - propagate to consumer
+            err.append(e)
+        finally:
+            q.put(_SENTINEL)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _SENTINEL:
+            if err:
+                raise err[0]
+            return
+        yield item

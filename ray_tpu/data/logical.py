@@ -1,0 +1,203 @@
+"""Logical plan: operator DAG built lazily by Dataset transforms.
+
+Reference: python/ray/data/_internal/logical/ (LogicalPlan, operators/).
+Physical planning collapses each logical op onto a streaming physical
+operator in ``executor.py``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .datasource import Datasink, Datasource
+
+
+class LogicalOperator:
+    """A node in the logical DAG; ``inputs`` are upstream operators."""
+
+    def __init__(self, name: str, inputs: List["LogicalOperator"]):
+        self.name = name
+        self.inputs = inputs
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(i.name for i in self.inputs)})"
+
+
+class Read(LogicalOperator):
+    def __init__(self, datasource: Datasource, parallelism: int):
+        super().__init__(f"Read{datasource.get_name()}", [])
+        self.datasource = datasource
+        self.parallelism = parallelism
+
+
+class InputData(LogicalOperator):
+    """Pre-materialized blocks (from_blocks / materialized datasets)."""
+
+    def __init__(self, block_refs: List[Any], metadata: List[Any]):
+        super().__init__("InputData", [])
+        self.block_refs = block_refs
+        self.metadata = metadata
+
+
+@dataclass
+class ComputeStrategy:
+    """tasks (default) or a fixed/autoscaling actor pool."""
+    kind: str = "tasks"  # tasks | actors
+    min_size: int = 1
+    max_size: int = 1
+
+
+def ActorPoolStrategy(size: Optional[int] = None, *, min_size: int = 1,
+                      max_size: Optional[int] = None) -> ComputeStrategy:
+    if size is not None:
+        return ComputeStrategy("actors", size, size)
+    return ComputeStrategy("actors", min_size, max_size or max(min_size, 2))
+
+
+class AbstractMap(LogicalOperator):
+    def __init__(self, name: str, input_op: LogicalOperator,
+                 fn: Any,
+                 compute: Optional[ComputeStrategy] = None,
+                 fn_constructor_args: Tuple = (),
+                 fn_constructor_kwargs: Optional[Dict] = None,
+                 num_cpus: float = 1.0,
+                 num_tpus: float = 0.0,
+                 concurrency: Optional[int] = None):
+        super().__init__(name, [input_op])
+        self.fn = fn
+        self.compute = compute or ComputeStrategy()
+        self.fn_constructor_args = fn_constructor_args
+        self.fn_constructor_kwargs = fn_constructor_kwargs or {}
+        self.num_cpus = num_cpus
+        self.num_tpus = num_tpus
+        self.concurrency = concurrency
+
+
+class MapBatches(AbstractMap):
+    def __init__(self, input_op, fn, *, batch_size: Optional[int] = None,
+                 batch_format: Optional[str] = "default", zero_copy_batch=False,
+                 **kwargs):
+        super().__init__("MapBatches", input_op, fn, **kwargs)
+        self.batch_size = batch_size
+        self.batch_format = batch_format
+
+
+class MapRows(AbstractMap):
+    def __init__(self, input_op, fn, **kwargs):
+        super().__init__("Map", input_op, fn, **kwargs)
+
+
+class Filter(AbstractMap):
+    def __init__(self, input_op, fn, **kwargs):
+        super().__init__("Filter", input_op, fn, **kwargs)
+
+
+class FlatMap(AbstractMap):
+    def __init__(self, input_op, fn, **kwargs):
+        super().__init__("FlatMap", input_op, fn, **kwargs)
+
+
+class Project(LogicalOperator):
+    """select_columns / drop_columns / rename_columns."""
+
+    def __init__(self, input_op, select: Optional[List[str]] = None,
+                 drop: Optional[List[str]] = None,
+                 rename: Optional[Dict[str, str]] = None):
+        super().__init__("Project", [input_op])
+        self.select = select
+        self.drop = drop
+        self.rename = rename
+
+
+class Repartition(LogicalOperator):
+    def __init__(self, input_op, num_blocks: int, shuffle: bool = False):
+        super().__init__("Repartition", [input_op])
+        self.num_blocks = num_blocks
+        self.shuffle = shuffle
+
+
+class RandomShuffle(LogicalOperator):
+    def __init__(self, input_op, seed: Optional[int] = None,
+                 num_outputs: Optional[int] = None):
+        super().__init__("RandomShuffle", [input_op])
+        self.seed = seed
+        self.num_outputs = num_outputs
+
+
+class Sort(LogicalOperator):
+    def __init__(self, input_op, key, descending: bool = False):
+        super().__init__("Sort", [input_op])
+        self.key = key
+        self.descending = descending
+
+
+class GroupAggregate(LogicalOperator):
+    def __init__(self, input_op, keys: Optional[List[str]], aggs: List[Any]):
+        super().__init__("Aggregate", [input_op])
+        self.keys = keys
+        self.aggs = aggs
+
+
+class HashRepartition(LogicalOperator):
+    """Partition rows so equal keys land in the same output block."""
+
+    def __init__(self, input_op, keys: List[str], num_outputs: int):
+        super().__init__("HashRepartition", [input_op])
+        self.keys = keys
+        self.num_outputs = num_outputs
+
+
+class Zip(LogicalOperator):
+    def __init__(self, left, right):
+        super().__init__("Zip", [left, right])
+
+
+class Union(LogicalOperator):
+    def __init__(self, input_ops: List[LogicalOperator]):
+        super().__init__("Union", list(input_ops))
+
+
+class Limit(LogicalOperator):
+    def __init__(self, input_op, limit: int):
+        super().__init__("Limit", [input_op])
+        self.limit = limit
+
+
+class RandomizeBlocks(LogicalOperator):
+    def __init__(self, input_op, seed: Optional[int] = None):
+        super().__init__("RandomizeBlocks", [input_op])
+        self.seed = seed
+
+
+class Write(LogicalOperator):
+    def __init__(self, input_op, datasink: Datasink):
+        super().__init__("Write", [input_op])
+        self.datasink = datasink
+
+
+class LogicalPlan:
+    def __init__(self, dag: LogicalOperator):
+        self.dag = dag
+
+    def with_op(self, op: LogicalOperator) -> "LogicalPlan":
+        return LogicalPlan(op)
+
+    def ops_topo(self) -> List[LogicalOperator]:
+        """Post-order (inputs before consumers), deduplicated."""
+        seen: Dict[int, LogicalOperator] = {}
+        order: List[LogicalOperator] = []
+
+        def visit(op: LogicalOperator):
+            if id(op) in seen:
+                return
+            seen[id(op)] = op
+            for i in op.inputs:
+                visit(i)
+            order.append(op)
+
+        visit(self.dag)
+        return order
+
+    def __repr__(self):
+        return " -> ".join(o.name for o in self.ops_topo())

@@ -1,0 +1,52 @@
+"""ray_tpu.tune — hyperparameter optimisation engine.
+
+Reference: python/ray/tune/ (Tuner, TuneController, searchers, schedulers).
+"""
+
+from ray_tpu.tune.controller import Trainable, Trial, TuneController  # noqa: F401
+from ray_tpu.tune.sample import (  # noqa: F401
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qloguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (  # noqa: F401
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+)
+from ray_tpu.tune.session import get_checkpoint, get_trial_dir, report  # noqa: F401
+from ray_tpu.tune.tuner import (  # noqa: F401
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    run,
+    with_parameters,
+    with_resources,
+)
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "run", "Trainable", "Trial",
+    "TuneController", "report", "get_checkpoint", "get_trial_dir",
+    "uniform", "quniform", "loguniform", "qloguniform", "randint",
+    "qrandint", "lograndint", "randn", "choice", "sample_from",
+    "grid_search", "Searcher", "BasicVariantGenerator",
+    "ConcurrencyLimiter", "TrialScheduler", "FIFOScheduler",
+    "AsyncHyperBandScheduler", "HyperBandScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining", "with_parameters", "with_resources",
+]

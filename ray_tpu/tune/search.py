@@ -1,0 +1,152 @@
+"""Searchers: BasicVariantGenerator (grid+random), Searcher plugin API,
+ConcurrencyLimiter.
+
+Reference: python/ray/tune/search/ (basic_variant.py, searcher.py,
+concurrency_limiter.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import sample as S
+
+
+class Searcher:
+    """Plugin interface (reference: search/searcher.py Searcher)."""
+
+    def __init__(self, metric: Optional[str] = None,
+                 mode: Optional[str] = None):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        self.metric = self.metric or metric
+        self.mode = self.mode or mode
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product × num_samples random sampling
+    (reference: basic_variant.py)."""
+
+    def __init__(self, space: Optional[Dict[str, Any]] = None,
+                 num_samples: int = 1, seed: Optional[int] = None,
+                 points_to_evaluate: Optional[List[Dict]] = None):
+        super().__init__()
+        self._space = space or {}
+        self._num_samples = num_samples
+        self._rng = np.random.RandomState(seed)
+        self._points = list(points_to_evaluate or [])
+        self._queue: List[Dict[str, Any]] = []
+        self._generated = False
+
+    def set_space(self, space: Dict[str, Any]) -> None:
+        self._space = space
+        self._generated = False
+
+    def _generate(self) -> None:
+        self._queue = []
+        for point in self._points:
+            cfg = S.resolve(self._space, self._rng)
+            cfg.update(point)
+            self._queue.append(cfg)
+        grid_variants = S.expand_grid(self._space)
+        for _ in range(self._num_samples):
+            for variant in grid_variants:
+                self._queue.append(S.resolve(variant, self._rng))
+        self._generated = True
+
+    def total_trials(self) -> int:
+        if not self._generated:
+            self._generate()
+        return len(self._queue) + self._consumed if hasattr(
+            self, "_consumed") else len(self._queue)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if not self._generated:
+            self._generate()
+        if not self._queue:
+            return None
+        return self._queue.pop(0)
+
+
+class SearchGenerator(Searcher):
+    """Adapts a Searcher producing one config per suggest() to a bounded
+    number of samples."""
+
+    def __init__(self, searcher: Searcher, space: Dict[str, Any],
+                 num_samples: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self._searcher = searcher
+        self._space = space
+        self._remaining = num_samples
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._remaining <= 0:
+            return None
+        cfg = self._searcher.suggest(trial_id)
+        if cfg is None:
+            return None
+        self._remaining -= 1
+        merged = S.resolve(self._space, np.random.RandomState())
+        merged.update(cfg)
+        return merged
+
+    def on_trial_result(self, trial_id, result):
+        self._searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._searcher.on_trial_complete(trial_id, result, error)
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps concurrent suggestions (reference: concurrency_limiter.py)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        super().__init__(searcher.metric, searcher.mode)
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return "PENDING"  # sentinel: try again later
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg != "PENDING":
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class HyperOptSearch(Searcher):  # pragma: no cover - optional dep
+    def __init__(self, *a, **k):
+        raise ImportError(
+            "hyperopt is not available in this environment; use "
+            "BasicVariantGenerator or implement a custom Searcher")
+
+
+class OptunaSearch(Searcher):  # pragma: no cover - optional dep
+    def __init__(self, *a, **k):
+        raise ImportError(
+            "optuna is not available in this environment; use "
+            "BasicVariantGenerator or implement a custom Searcher")

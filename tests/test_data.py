@@ -1,0 +1,319 @@
+"""ray_tpu.data tests (reference model: python/ray/data/tests/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_take(ray_init):
+    ds = rd.range(100)
+    assert ds.count() == 100
+    rows = ds.take(5)
+    assert rows == [{"id": i} for i in range(5)]
+
+
+def test_from_items(ray_init):
+    ds = rd.from_items([1, 2, 3])
+    assert sorted(r["item"] for r in ds.take_all()) == [1, 2, 3]
+    ds2 = rd.from_items([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}])
+    assert ds2.count() == 2
+    assert ds2.take(1)[0] == {"a": 1, "b": "x"}
+
+
+def test_map_batches(ray_init):
+    ds = rd.range(64, parallelism=4).map_batches(
+        lambda b: {"id": b["id"] * 2}, batch_format="numpy")
+    out = sorted(r["id"] for r in ds.take_all())
+    assert out == [2 * i for i in range(64)]
+
+
+def test_map_batches_batch_size(ray_init):
+    seen_sizes = []
+
+    def f(batch):
+        return {"n": np.array([len(batch["id"])])}
+
+    ds = rd.range(100, parallelism=2).map_batches(
+        f, batch_size=16, batch_format="numpy")
+    sizes = [r["n"] for r in ds.take_all()]
+    assert sum(sizes) == 100
+    assert max(sizes) <= 16
+
+
+def test_map_and_filter_and_flat_map(ray_init):
+    ds = rd.range(20).map(lambda r: {"id": r["id"] + 1})
+    ds = ds.filter(lambda r: r["id"] % 2 == 0)
+    ds = ds.flat_map(lambda r: [{"id": r["id"]}, {"id": -r["id"]}])
+    vals = sorted(r["id"] for r in ds.take_all())
+    n_even = len([i for i in range(1, 21) if i % 2 == 0])
+    assert len(vals) == 2 * n_even
+
+
+def test_actor_pool_map(ray_init):
+    class AddConst:
+        def __init__(self, c):
+            self.c = c
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.c}
+
+    ds = rd.range(32, parallelism=4).map_batches(
+        AddConst, batch_format="numpy",
+        compute=rd.ActorPoolStrategy(size=2), fn_constructor_args=(100,))
+    out = sorted(r["id"] for r in ds.take_all())
+    assert out == [100 + i for i in range(32)]
+
+
+def test_repartition(ray_init):
+    ds = rd.range(100, parallelism=10).repartition(3)
+    assert ds.num_blocks() == 3
+    assert ds.count() == 100
+
+
+def test_random_shuffle(ray_init):
+    ds = rd.range(100, parallelism=4).random_shuffle(seed=42)
+    vals = [r["id"] for r in ds.take_all()]
+    assert sorted(vals) == list(range(100))
+    assert vals != list(range(100))
+
+
+def test_sort(ray_init):
+    rng = np.random.RandomState(7)
+    items = [{"v": int(v)} for v in rng.permutation(200)]
+    ds = rd.from_items(items).repartition(4).sort("v")
+    vals = [r["v"] for r in ds.take_all()]
+    assert vals == sorted(vals)
+    desc = rd.from_items(items).repartition(4).sort("v", descending=True)
+    dvals = [r["v"] for r in desc.take_all()]
+    assert dvals == sorted(dvals, reverse=True)
+
+
+def test_groupby_aggregate(ray_init):
+    items = [{"k": i % 3, "v": i} for i in range(30)]
+    ds = rd.from_items(items).repartition(4)
+    out = ds.groupby("k").sum("v").take_all()
+    expect = {k: sum(i for i in range(30) if i % 3 == k) for k in range(3)}
+    got = {r["k"]: r["sum(v)"] for r in out}
+    assert got == expect
+
+
+def test_groupby_count_mean(ray_init):
+    items = [{"k": "a" if i < 10 else "b", "v": float(i)}
+             for i in range(30)]
+    ds = rd.from_items(items)
+    counts = {r["k"]: r["count()"]
+              for r in ds.groupby("k").count().take_all()}
+    assert counts == {"a": 10, "b": 20}
+    means = {r["k"]: r["mean(v)"]
+             for r in ds.groupby("k").mean("v").take_all()}
+    assert means["a"] == pytest.approx(np.mean(np.arange(10)))
+
+
+def test_global_aggregates(ray_init):
+    ds = rd.range(50)
+    assert ds.sum("id") == sum(range(50))
+    assert ds.min("id") == 0
+    assert ds.max("id") == 49
+    assert ds.mean("id") == pytest.approx(24.5)
+
+
+def test_zip(ray_init):
+    a = rd.range(10, parallelism=2)
+    b = rd.range(10, parallelism=3).map(lambda r: {"other": r["id"] * 10})
+    z = a.zip(b)
+    rows = z.take_all()
+    assert len(rows) == 10
+    for r in rows:
+        assert r["other"] == r["id"] * 10
+
+
+def test_union(ray_init):
+    a = rd.range(5)
+    b = rd.range(5).map(lambda r: {"id": r["id"] + 5})
+    assert sorted(r["id"] for r in a.union(b).take_all()) == list(range(10))
+
+
+def test_limit_streaming(ray_init):
+    ds = rd.range(1000, parallelism=10).limit(17)
+    assert ds.count() == 17
+
+
+def test_select_drop_rename(ray_init):
+    ds = rd.from_items([{"a": 1, "b": 2, "c": 3}] * 5)
+    assert ds.select_columns(["a", "b"]).take(1)[0] == {"a": 1, "b": 2}
+    assert ds.drop_columns(["c"]).take(1)[0] == {"a": 1, "b": 2}
+    assert ds.rename_columns({"a": "x"}).take(1)[0] == {
+        "x": 1, "b": 2, "c": 3}
+
+
+def test_iter_batches(ray_init):
+    ds = rd.range(100, parallelism=5)
+    batches = list(ds.iter_batches(batch_size=32, batch_format="numpy"))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 100
+    assert all(s == 32 for s in sizes[:-1])
+    ids = np.concatenate([b["id"] for b in batches])
+    assert sorted(ids.tolist()) == list(range(100))
+
+
+def test_iter_batches_drop_last(ray_init):
+    ds = rd.range(100)
+    batches = list(ds.iter_batches(batch_size=32, drop_last=True,
+                                   batch_format="numpy"))
+    assert all(len(b["id"]) == 32 for b in batches)
+
+
+def test_iter_batches_pandas_format(ray_init):
+    import pandas as pd
+
+    ds = rd.range(10)
+    batch = next(iter(ds.iter_batches(batch_size=10,
+                                      batch_format="pandas")))
+    assert isinstance(batch, pd.DataFrame)
+
+
+def test_to_pandas_from_pandas(ray_init):
+    import pandas as pd
+
+    df = pd.DataFrame({"x": [1, 2, 3], "y": ["a", "b", "c"]})
+    ds = rd.from_pandas(df)
+    out = ds.to_pandas()
+    assert list(out["x"]) == [1, 2, 3]
+
+
+def test_from_numpy_to_numpy(ray_init):
+    arr = np.arange(12, dtype=np.float32)
+    ds = rd.from_numpy(arr, column="x")
+    out = ds.to_numpy()
+    np.testing.assert_array_equal(np.sort(out["x"]), arr)
+
+
+def test_parquet_roundtrip(ray_init, tmp_path):
+    ds = rd.range(100, parallelism=4)
+    path = str(tmp_path / "pq")
+    ds.write_parquet(path)
+    files = os.listdir(path)
+    assert files
+    back = rd.read_parquet(path)
+    assert back.count() == 100
+    assert sorted(r["id"] for r in back.take_all()) == list(range(100))
+
+
+def test_csv_roundtrip(ray_init, tmp_path):
+    ds = rd.from_items([{"a": i, "b": f"s{i}"} for i in range(20)])
+    path = str(tmp_path / "csv")
+    ds.write_csv(path)
+    back = rd.read_csv(path)
+    assert back.count() == 20
+
+
+def test_json_roundtrip(ray_init, tmp_path):
+    ds = rd.from_items([{"a": i} for i in range(10)])
+    path = str(tmp_path / "json")
+    ds.write_json(path)
+    back = rd.read_json(path)
+    assert sorted(r["a"] for r in back.take_all()) == list(range(10))
+
+
+def test_split(ray_init):
+    splits = rd.range(100, parallelism=4).split(3)
+    counts = [s.count() for s in splits]
+    assert sum(counts) == 100
+    assert max(counts) - min(counts) <= 1
+
+
+def test_split_equal(ray_init):
+    splits = rd.range(100).split(3, equal=True)
+    counts = [s.count() for s in splits]
+    assert counts == [33, 33, 33]
+
+
+def test_streaming_split(ray_init):
+    its = rd.range(100, parallelism=4).streaming_split(2)
+    rows0 = list(its[0].iter_rows())
+    rows1 = list(its[1].iter_rows())
+    ids = sorted(r["id"] for r in rows0 + rows1)
+    assert ids == list(range(100))
+
+
+def test_train_test_split(ray_init):
+    train, test = rd.range(100).train_test_split(test_size=0.25)
+    assert train.count() == 75
+    assert test.count() == 25
+
+
+def test_schema_and_columns(ray_init):
+    ds = rd.from_items([{"a": 1, "b": "x"}])
+    assert ds.columns() == ["a", "b"]
+
+
+def test_unique(ray_init):
+    ds = rd.from_items([{"c": i % 4} for i in range(40)])
+    assert sorted(ds.unique("c")) == [0, 1, 2, 3]
+
+
+def test_random_sample(ray_init):
+    ds = rd.range(1000)
+    n = ds.random_sample(0.5, seed=3).count()
+    assert 300 < n < 700
+
+
+def test_map_groups(ray_init):
+    items = [{"k": i % 3, "v": float(i)} for i in range(30)]
+
+    def normalize(group):
+        import pandas as pd
+
+        return pd.DataFrame({"k": group["k"],
+                             "v": group["v"] - group["v"].mean()})
+
+    out = rd.from_items(items).repartition(3).groupby("k").map_groups(
+        normalize, batch_format="pandas")
+    rows = out.take_all()
+    assert len(rows) == 30
+    by_k = {}
+    for r in rows:
+        by_k.setdefault(r["k"], []).append(r["v"])
+    for vs in by_k.values():
+        assert abs(np.mean(vs)) < 1e-9
+
+
+def test_custom_datasource(ray_init):
+    class TenRows(rd.Datasource):
+        def get_read_tasks(self, parallelism):
+            def fn():
+                from ray_tpu.data.block import build_block
+
+                return [build_block([{"x": i} for i in range(10)])]
+
+            return [rd.ReadTask(fn)]
+
+    ds = rd.read_datasource(TenRows())
+    assert ds.count() == 10
+
+
+def test_lazy_no_execute_on_transform(ray_init):
+    calls = []
+
+    def boom(batch):
+        raise RuntimeError("should not run")
+
+    ds = rd.range(10).map_batches(boom)  # no execution yet
+    assert isinstance(ds, rd.Dataset)
+
+
+def test_range_tensor(ray_init):
+    ds = rd.range_tensor(8, shape=(2, 2))
+    batch = ds.take_batch(8, batch_format="numpy")
+    assert batch["data"].shape == (8, 2, 2)

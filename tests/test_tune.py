@@ -1,0 +1,219 @@
+"""ray_tpu.tune tests (reference model: python/ray/tune/tests/)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import (
+    AsyncHyperBandScheduler,
+    BasicVariantGenerator,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    Trainable,
+    TuneConfig,
+    Tuner,
+)
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_sample_domains():
+    rng = np.random.RandomState(0)
+    assert 0 <= tune.uniform(0, 1).sample(rng) <= 1
+    v = tune.loguniform(1e-4, 1e-1).sample(rng)
+    assert 1e-4 <= v <= 1e-1
+    assert tune.randint(0, 10).sample(rng) in range(10)
+    assert tune.choice(["a", "b"]).sample(rng) in ("a", "b")
+    assert tune.quniform(0, 10, 2).sample(rng) % 2 == 0
+
+
+def test_grid_expansion():
+    from ray_tpu.tune.sample import expand_grid
+
+    space = {"a": tune.grid_search([1, 2, 3]),
+             "b": tune.grid_search(["x", "y"]), "c": 7}
+    variants = expand_grid(space)
+    assert len(variants) == 6
+    assert all(v["c"] == 7 for v in variants)
+
+
+def test_function_trainable(ray_init, tmp_path):
+    def train_fn(config):
+        for i in range(5):
+            tune.report({"score": config["x"] * (i + 1),
+                         "training_iteration": i + 1})
+
+    results = tune.run(
+        train_fn, config={"x": tune.grid_search([1, 2, 3])},
+        metric="score", mode="max", storage_path=str(tmp_path))
+    assert len(results) == 3
+    best = results.get_best_result()
+    assert best.config["x"] == 3
+    assert best.metrics["score"] == 15
+
+
+def test_tuner_api(ray_init, tmp_path):
+    def train_fn(config):
+        tune.report({"loss": (config["lr"] - 0.1) ** 2})
+
+    from ray_tpu.train.config import RunConfig
+
+    tuner = Tuner(
+        train_fn,
+        param_space={"lr": tune.grid_search([0.01, 0.1, 1.0])},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=RunConfig(storage_path=str(tmp_path), name="t"))
+    grid = tuner.fit()
+    assert grid.get_best_result().config["lr"] == 0.1
+
+
+def test_num_samples(ray_init, tmp_path):
+    def train_fn(config):
+        tune.report({"v": config["x"]})
+
+    results = tune.run(train_fn, config={"x": tune.uniform(0, 1)},
+                       num_samples=5, metric="v", mode="max",
+                       storage_path=str(tmp_path))
+    assert len(results) == 5
+    xs = [r.config["x"] for r in [results[i] for i in range(5)]]
+    assert len(set(xs)) > 1
+
+
+def test_class_trainable(ray_init, tmp_path):
+    class MyTrainable(Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+            self.total = 0
+
+        def step(self):
+            self.total += self.x
+            return {"total": self.total}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "state.txt"), "w") as f:
+                f.write(str(self.total))
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "state.txt")) as f:
+                self.total = int(f.read())
+
+    results = tune.run(MyTrainable, config={"x": tune.grid_search([1, 5])},
+                       stop={"training_iteration": 4},
+                       metric="total", mode="max",
+                       storage_path=str(tmp_path))
+    best = results.get_best_result()
+    assert best.metrics["total"] == 20
+
+
+def test_asha_stops_bad_trials():
+    """Deterministic scheduler unit test: interleaved reports, the weak
+    trial is culled at a rung while the strong one survives."""
+    from ray_tpu.tune.controller import Trial
+    from ray_tpu.tune.schedulers import TrialScheduler
+
+    sched = AsyncHyperBandScheduler(
+        metric="score", mode="max", time_attr="training_iteration",
+        max_t=100, grace_period=2, reduction_factor=2)
+    trials = {q: Trial(trial_id=f"t{q}", config={"q": q}, trial_dir="")
+              for q in (1, 2, 4, 8)}
+    stopped = set()
+    for it in range(1, 21):
+        # strongest reports first so rung cutoffs are meaningful
+        for q in (8, 4, 2, 1):
+            if q in stopped:
+                continue
+            decision = sched.on_trial_result(
+                None, trials[q], {"score": q * it,
+                                  "training_iteration": it})
+            if decision == TrialScheduler.STOP:
+                stopped.add(q)
+    assert 8 not in stopped
+    assert 1 in stopped
+
+
+def test_median_stopping(ray_init, tmp_path):
+    def train_fn(config):
+        for i in range(10):
+            tune.report({"score": config["q"],
+                         "training_iteration": i + 1})
+
+    results = tune.run(
+        train_fn, config={"q": tune.grid_search([1, 1, 1, 10])},
+        metric="score", mode="max",
+        scheduler=MedianStoppingRule(grace_period=2,
+                                     min_samples_required=2),
+        storage_path=str(tmp_path))
+    assert len(results) == 4
+
+
+def test_experiment_state_saved(ray_init, tmp_path):
+    def train_fn(config):
+        tune.report({"a": 1})
+
+    tune.run(train_fn, config={}, name="exp1", storage_path=str(tmp_path),
+             metric="a", mode="max")
+    state = os.path.join(str(tmp_path), "exp1", "experiment_state.json")
+    assert os.path.exists(state)
+
+
+def test_trial_failure_marks_error(ray_init, tmp_path):
+    def train_fn(config):
+        if config["x"] == 1:
+            raise RuntimeError("boom")
+        tune.report({"ok": 1})
+
+    results = tune.run(train_fn, config={"x": tune.grid_search([0, 1])},
+                       metric="ok", mode="max",
+                       storage_path=str(tmp_path))
+    assert len(results.errors) == 1
+    assert results.get_best_result().config["x"] == 0
+
+
+def test_with_parameters(ray_init, tmp_path):
+    big = np.arange(1000)
+
+    def train_fn(config, data=None):
+        tune.report({"s": float(data.sum())})
+
+    results = tune.run(tune.with_parameters(train_fn, data=big),
+                       config={}, metric="s", mode="max",
+                       storage_path=str(tmp_path))
+    assert results.get_best_result().metrics["s"] == float(big.sum())
+
+
+def test_pbt_runs(ray_init, tmp_path):
+    class PBTTrainable(Trainable):
+        def setup(self, config):
+            self.lr = config["lr"]
+            self.score = 0.0
+
+        def step(self):
+            self.score += self.lr
+            return {"score": self.score}
+
+        def save_checkpoint(self, d):
+            with open(os.path.join(d, "s.txt"), "w") as f:
+                f.write(str(self.score))
+
+        def load_checkpoint(self, d):
+            with open(os.path.join(d, "s.txt")) as f:
+                self.score = float(f.read())
+
+    pbt = PopulationBasedTraining(
+        time_attr="training_iteration", perturbation_interval=2,
+        hyperparam_mutations={"lr": [0.1, 1.0, 10.0]}, seed=0)
+    results = tune.run(
+        PBTTrainable, config={"lr": tune.choice([0.1, 1.0, 10.0])},
+        num_samples=4, stop={"training_iteration": 6},
+        metric="score", mode="max", scheduler=pbt,
+        storage_path=str(tmp_path), checkpoint_freq=2)
+    assert len(results) == 4
+    assert results.get_best_result().metrics["score"] > 0
