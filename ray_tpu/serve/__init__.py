@@ -1,0 +1,28 @@
+"""ray_tpu.serve — online model serving on actors.
+
+Reference: python/ray/serve/ (controller, proxy, router, replicas,
+autoscaling, batching). XLA-compiled model replicas: deploy a class whose
+__init__ jits the model — each replica owns its compiled executable and
+serves requests with continuous batching via @serve.batch.
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    delete,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions  # noqa: F401
+from ray_tpu.serve.deployment import Application, Deployment, deployment  # noqa: F401
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve.proxy import Request  # noqa: F401
+
+__all__ = [
+    "deployment", "Deployment", "Application", "run", "start", "shutdown",
+    "status", "delete", "get_deployment_handle", "DeploymentHandle",
+    "DeploymentResponse", "AutoscalingConfig", "HTTPOptions", "batch",
+    "Request",
+]

@@ -1,0 +1,135 @@
+"""serve public API: run/start/shutdown/status/get_deployment_handle.
+
+Reference: python/ray/serve/api.py (serve.run :510, serve.start, delete).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Union
+
+import cloudpickle
+
+import ray_tpu
+
+from .config import HTTPOptions
+from .deployment import Application, Deployment
+from .handle import DeploymentHandle
+from .proxy import HTTPProxy
+
+_controller = None
+_proxy: Optional[HTTPProxy] = None
+
+
+def start(http_options: Optional[HTTPOptions] = None,
+          detached: bool = True):
+    """Start the Serve instance (controller actor + HTTP proxy)."""
+    global _controller, _proxy
+    if _controller is None:
+        from .controller import ServeController
+
+        _controller = ServeController.options(
+            name="SERVE_CONTROLLER", max_concurrency=16).remote()
+        ray_tpu.get(_controller.ping.remote())
+    if _proxy is None:
+        opts = http_options or HTTPOptions()
+        _proxy = HTTPProxy(_controller, opts.host, opts.port)
+    return _controller
+
+
+def _deploy_one(app_or_dep, route_prefix: Optional[str],
+                name_prefix: str = "") -> str:
+    """Deploy an Application (and its dependencies); returns the
+    ingress deployment name."""
+    controller = _controller
+    if isinstance(app_or_dep, Deployment):
+        app = app_or_dep.bind()
+    else:
+        app = app_or_dep
+
+    # deploy dependencies first, bottom-up; replace bound children with
+    # handles in the parent's init args
+    def resolve(node: Application) -> str:
+        args = []
+        for a in node.args:
+            if isinstance(a, Application):
+                child = resolve(a)
+                args.append(DeploymentHandle(controller, child))
+            else:
+                args.append(a)
+        kwargs = {}
+        for k, v in node.kwargs.items():
+            if isinstance(v, Application):
+                child = resolve(v)
+                kwargs[k] = DeploymentHandle(controller, child)
+            else:
+                kwargs[k] = v
+        dep = node.deployment
+        cfg = dep.config_dict()
+        if node is app:
+            cfg["route_prefix"] = (route_prefix
+                                   if route_prefix is not None
+                                   else cfg.get("route_prefix") or "/")
+        else:
+            cfg["route_prefix"] = None
+        name = name_prefix + dep.name
+        ray_tpu.get(controller.deploy.remote(
+            name, cloudpickle.dumps(dep.func_or_class),
+            tuple(args), kwargs, cfg))
+        return name
+
+    return resolve(app)
+
+
+def run(target: Union[Application, Deployment], *,
+        name: str = "default", route_prefix: Optional[str] = "/",
+        blocking: bool = False,
+        _wait_timeout: float = 30.0) -> DeploymentHandle:
+    """Deploy an application and return a handle to its ingress."""
+    start()
+    ingress = _deploy_one(target, route_prefix)
+    deadline = time.time() + _wait_timeout
+    while time.time() < deadline:
+        if ray_tpu.get(_controller.deployment_ready.remote(ingress)):
+            break
+        time.sleep(0.05)
+    handle = DeploymentHandle(_controller, ingress)
+    if blocking:  # pragma: no cover - interactive use
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    if _controller is None:
+        raise RuntimeError("serve is not running")
+    return DeploymentHandle(_controller, deployment_name)
+
+
+def status() -> Dict[str, Any]:
+    if _controller is None:
+        return {}
+    return ray_tpu.get(_controller.list_deployments.remote())
+
+
+def delete(name: str) -> None:
+    if _controller is not None:
+        ray_tpu.get(_controller.delete_deployment.remote(name))
+
+
+def shutdown() -> None:
+    global _controller, _proxy
+    if _proxy is not None:
+        _proxy.shutdown()
+        _proxy = None
+    if _controller is not None:
+        try:
+            ray_tpu.get(_controller.shutdown.remote(), timeout=10)
+            ray_tpu.kill(_controller)
+        except Exception:
+            pass
+        _controller = None

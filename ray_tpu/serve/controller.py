@@ -1,0 +1,212 @@
+"""ServeController — reconciles deployment state to target replica sets.
+
+Reference: python/ray/serve/_private/controller.py:86 (singleton actor),
+deployment_state.py (replica FSM, rolling updates, health checks),
+autoscaling_state.py (queue-depth scaling).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ServeController:
+    """One detached actor per Serve instance. Runs a reconciliation thread:
+    scale replica sets to target counts, replace unhealthy replicas,
+    apply autoscaling decisions from replica queue stats."""
+
+    def __init__(self):
+        self._deployments: Dict[str, dict] = {}  # name -> record
+        self._routes: Dict[str, str] = {}        # route_prefix -> name
+        self._lock = threading.RLock()
+        self._version = 0  # bumped on any change; routers poll this
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._reconcile_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- deploy
+    def deploy(self, name: str, serialized_callable, init_args, init_kwargs,
+               config: dict) -> None:
+        with self._lock:
+            old = self._deployments.get(name)
+            rec = {
+                "name": name,
+                "callable": serialized_callable,
+                "init_args": init_args,
+                "init_kwargs": init_kwargs,
+                "config": config,
+                "replicas": old["replicas"] if old else [],
+                "target": config.get("num_replicas", 1),
+                "version": config.get("version", "1"),
+                "last_scale_up": 0.0,
+                "last_scale_down": 0.0,
+            }
+            code_changed = old is not None and (
+                old["callable"] != serialized_callable
+                or old["version"] != rec["version"])
+            self._deployments[name] = rec
+            if code_changed:
+                # rolling update: drop old replicas; reconciler refills
+                for r in rec["replicas"]:
+                    self._kill_replica(r)
+                rec["replicas"] = []
+            route = config.get("route_prefix")
+            if route:
+                self._routes[route] = name
+            auto = config.get("autoscaling")
+            if auto:
+                rec["target"] = max(auto["min_replicas"], 1)
+            self._version += 1
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            rec = self._deployments.pop(name, None)
+            if rec:
+                for r in rec["replicas"]:
+                    self._kill_replica(r)
+            self._routes = {k: v for k, v in self._routes.items()
+                            if v != name}
+            self._version += 1
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            for rec in self._deployments.values():
+                for r in rec["replicas"]:
+                    self._kill_replica(r)
+            self._deployments.clear()
+            self._routes.clear()
+            self._version += 1
+
+    # ------------------------------------------------------------ queries
+    def get_replicas(self, name: str) -> List[Any]:
+        with self._lock:
+            rec = self._deployments.get(name)
+            return [r["actor"] for r in rec["replicas"]] if rec else []
+
+    def get_version(self) -> int:
+        return self._version
+
+    def get_route_table(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._routes)
+
+    def list_deployments(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                name: {
+                    "target": rec["target"],
+                    "num_replicas": len(rec["replicas"]),
+                    "version": rec["version"],
+                    "route_prefix": rec["config"].get("route_prefix"),
+                }
+                for name, rec in self._deployments.items()
+            }
+
+    def deployment_ready(self, name: str) -> bool:
+        with self._lock:
+            rec = self._deployments.get(name)
+            if rec is None:
+                return False
+            return len(rec["replicas"]) >= rec["target"] > 0
+
+    # ------------------------------------------------------- reconciler
+    def _kill_replica(self, r: dict) -> None:
+        try:
+            ray_tpu.kill(r["actor"])
+        except Exception:
+            pass
+
+    def _spawn_replica(self, rec: dict) -> dict:
+        from .replica import ServeReplica
+
+        opts = dict(rec["config"].get("ray_actor_options") or {})
+        opts.setdefault("max_concurrency",
+                        rec["config"].get("max_ongoing_requests", 100))
+        actor = ServeReplica.options(**opts).remote(
+            rec["callable"], rec["init_args"], rec["init_kwargs"],
+            rec["config"].get("user_config"))
+        return {"actor": actor, "created": time.time(), "healthy": True}
+
+    def _autoscale(self, rec: dict) -> None:
+        auto = rec["config"].get("autoscaling")
+        if not auto or not rec["replicas"]:
+            return
+        try:
+            stats = ray_tpu.get(
+                [r["actor"].get_num_ongoing_requests.remote()
+                 for r in rec["replicas"]], timeout=2)
+        except Exception:
+            return
+        avg = sum(stats) / max(len(stats), 1)
+        target = rec["target"]
+        now = time.time()
+        if avg > auto["target_ongoing_requests"] \
+                and target < auto["max_replicas"] \
+                and now - rec["last_scale_up"] > auto["upscale_delay_s"]:
+            rec["target"] = target + 1
+            rec["last_scale_up"] = now
+        elif avg < auto["target_ongoing_requests"] / 2 \
+                and target > auto["min_replicas"] \
+                and now - rec["last_scale_down"] > auto["downscale_delay_s"]:
+            rec["target"] = target - 1
+            rec["last_scale_down"] = now
+
+    def _reconcile_once(self) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            for rec in self._deployments.values():
+                self._autoscale(rec)
+                diff = rec["target"] - len(rec["replicas"])
+                if diff > 0:
+                    for _ in range(diff):
+                        rec["replicas"].append(self._spawn_replica(rec))
+                    self._version += 1
+                elif diff < 0:
+                    for _ in range(-diff):
+                        dead = rec["replicas"].pop()
+                        self._kill_replica(dead)
+                    self._version += 1
+
+    def _health_check(self) -> None:
+        with self._lock:
+            recs = list(self._deployments.values())
+        for rec in recs:
+            bad = []
+            for r in list(rec["replicas"]):
+                try:
+                    ok = ray_tpu.get(r["actor"].check_health.remote(),
+                                     timeout=5)
+                except Exception:
+                    ok = False
+                if not ok:
+                    bad.append(r)
+            if bad:
+                with self._lock:
+                    for r in bad:
+                        if r in rec["replicas"]:
+                            rec["replicas"].remove(r)
+                            self._kill_replica(r)
+                    self._version += 1
+
+    def _reconcile_loop(self) -> None:
+        last_health = 0.0
+        while not self._shutdown:
+            try:
+                self._reconcile_once()
+                if time.time() - last_health > 2.0:
+                    self._health_check()
+                    last_health = time.time()
+            except Exception:
+                pass
+            time.sleep(0.1)
+
+    def ping(self) -> str:
+        return "pong"

@@ -1,0 +1,173 @@
+"""DeploymentHandle + Router with power-of-two-choices replica scheduling.
+
+Reference: python/ray/serve/handle.py (DeploymentHandle,
+DeploymentResponse) and _private/replica_scheduler/pow_2_scheduler.py:51.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the replica call's ObjectRef."""
+
+    def __init__(self, ref, router: "Router", replica_key: str):
+        self._ref = ref
+        self._router = router
+        self._replica_key = replica_key
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._finish()
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._router._dec(self._replica_key)
+
+    @property
+    def ref(self):
+        return self._ref
+
+    def __await__(self):
+        # allow `await handle.remote(...)` inside async deployments
+        def gen():
+            while True:
+                ready, _ = ray_tpu.wait([self._ref], num_returns=1,
+                                        timeout=0)
+                if ready:
+                    break
+                yield
+            return self.result()
+
+        return gen()
+
+
+class Router:
+    """Client-side replica chooser: picks 2 random replicas, routes to the
+    one with fewer locally-tracked in-flight requests (pow-2 choices)."""
+
+    def __init__(self, controller, deployment_name: str):
+        self._controller = controller
+        self._name = deployment_name
+        self._replicas: List[Any] = []
+        self._inflight: Dict[str, int] = {}
+        self._version = -1
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+
+    def _refresh(self, force: bool = False) -> None:
+        now = time.time()
+        if not force and now - self._last_refresh < 0.5 and self._replicas:
+            return
+        try:
+            version = ray_tpu.get(self._controller.get_version.remote(),
+                                  timeout=5)
+        except Exception:
+            return
+        if version != self._version or not self._replicas:
+            replicas = ray_tpu.get(
+                self._controller.get_replicas.remote(self._name), timeout=5)
+            with self._lock:
+                self._replicas = replicas
+                self._version = version
+                keys = {self._key(r) for r in replicas}
+                self._inflight = {k: v for k, v in self._inflight.items()
+                                  if k in keys}
+        self._last_refresh = now
+
+    @staticmethod
+    def _key(replica) -> str:
+        return str(getattr(replica, "_actor_id", id(replica)))
+
+    def _dec(self, key: str) -> None:
+        with self._lock:
+            if key in self._inflight:
+                self._inflight[key] = max(0, self._inflight[key] - 1)
+
+    def choose(self):
+        deadline = time.time() + 30
+        while True:
+            self._refresh()
+            with self._lock:
+                replicas = list(self._replicas)
+            if replicas:
+                break
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"no replicas available for deployment {self._name!r}")
+            time.sleep(0.05)
+            self._refresh(force=True)
+        if len(replicas) == 1:
+            chosen = replicas[0]
+        else:
+            a, b = random.sample(replicas, 2)
+            with self._lock:
+                la = self._inflight.get(self._key(a), 0)
+                lb = self._inflight.get(self._key(b), 0)
+            chosen = a if la <= lb else b
+        key = self._key(chosen)
+        with self._lock:
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+        return chosen, key
+
+
+class DeploymentHandle:
+    def __init__(self, controller, deployment_name: str,
+                 method_name: str = "__call__"):
+        self._controller = controller
+        self._name = deployment_name
+        self._method = method_name
+        self._router = Router(controller, deployment_name)
+
+    def options(self, method_name: Optional[str] = None
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(self._controller, self._name,
+                             method_name or self._method)
+        h._router = self._router  # share in-flight accounting
+        return h
+
+    @property
+    def method(self):
+        return _MethodAccessor(self)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        replica, key = self._router.choose()
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        return DeploymentResponse(ref, self._router, key)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _BoundMethod(self, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self._controller, self._name, self._method))
+
+
+class _BoundMethod:
+    def __init__(self, handle: DeploymentHandle, method_name: str):
+        self._handle = handle
+        self._method_name = method_name
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle.options(
+            method_name=self._method_name).remote(*args, **kwargs)
+
+
+class _MethodAccessor:
+    def __init__(self, handle: DeploymentHandle):
+        self._handle = handle
+
+    def __getattr__(self, name):
+        return _BoundMethod(self._handle, name)

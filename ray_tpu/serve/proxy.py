@@ -1,0 +1,121 @@
+"""HTTP proxy: aiohttp server routing requests to deployment handles.
+
+Reference: python/ray/serve/_private/proxy.py (HTTPProxy :766 on
+uvicorn/starlette — here aiohttp, which is what this environment ships).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+class Request:
+    """Minimal request object passed to deployments (starlette-ish)."""
+
+    def __init__(self, method: str, path: str, query: Dict[str, str],
+                 headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.query_params = query
+        self.headers = headers
+        self._body = body
+
+    def body(self) -> bytes:
+        return self._body
+
+    def json(self) -> Any:
+        return json.loads(self._body) if self._body else None
+
+    @property
+    def text(self) -> str:
+        return self._body.decode()
+
+
+class HTTPProxy:
+    """Runs an aiohttp server on a daemon thread in the driver process."""
+
+    def __init__(self, controller, host: str = "127.0.0.1",
+                 port: int = 8000):
+        self._controller = controller
+        self.host = host
+        self.port = port
+        self._handles: Dict[str, Any] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._runner = None
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def _get_handle(self, name: str):
+        from .handle import DeploymentHandle
+
+        if name not in self._handles:
+            self._handles[name] = DeploymentHandle(self._controller, name)
+        return self._handles[name]
+
+    async def _handler(self, request):
+        from aiohttp import web
+
+        routes = ray_tpu.get(self._controller.get_route_table.remote())
+        path = request.path
+        match = None
+        for prefix in sorted(routes, key=len, reverse=True):
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(
+                    norm + "/") or norm == "/":
+                match = routes[prefix]
+                break
+        if match is None:
+            return web.Response(status=404, text="no route")
+        body = await request.read()
+        req = Request(request.method, path,
+                      dict(request.query),
+                      {k: v for k, v in request.headers.items()}, body)
+        handle = self._get_handle(match)
+        loop = asyncio.get_event_loop()
+        try:
+            response = handle.remote(req)
+            result = await loop.run_in_executor(
+                None, lambda: response.result(timeout=60))
+        except Exception as e:  # noqa: BLE001
+            return web.Response(status=500, text=str(e))
+        if isinstance(result, (dict, list)):
+            return web.json_response(result)
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        return web.Response(text=str(result))
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handler)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._runner = runner
+        self._started.set()
+        loop.run_forever()
+
+    def shutdown(self) -> None:
+        if self._loop is not None:
+            loop = self._loop
+
+            async def stop():
+                if self._runner is not None:
+                    await self._runner.cleanup()
+                loop.stop()
+
+            asyncio.run_coroutine_threadsafe(stop(), loop)
+            self._thread.join(timeout=5)
+            self._loop = None

@@ -1,0 +1,83 @@
+"""ServeReplica — the actor hosting one copy of a deployment's callable.
+
+Reference: python/ray/serve/_private/replica.py (user callable wrapper,
+max_ongoing_requests accounting, health checks).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ServeReplica:
+    """Runs the user class/function; tracks ongoing-request count used by
+    the router's power-of-two-choices and the autoscaler."""
+
+    def __init__(self, serialized_callable, init_args, init_kwargs,
+                 user_config=None):
+        import cloudpickle
+
+        target = cloudpickle.loads(serialized_callable)
+        if inspect.isclass(target):
+            self._callable = target(*init_args, **init_kwargs)
+        else:
+            self._callable = target
+        self._ongoing = 0
+        self._total = 0
+        self._is_class = inspect.isclass(target)
+        if user_config is not None and hasattr(
+                self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    async def handle_request(self, method_name: str, args, kwargs):
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_class:
+                if method_name == "__call__":
+                    fn = self._callable
+                else:
+                    fn = getattr(self._callable, method_name)
+            else:
+                fn = self._callable
+            if inspect.iscoroutinefunction(fn) or (
+                    not inspect.isfunction(fn) and not inspect.ismethod(fn)
+                    and inspect.iscoroutinefunction(
+                        getattr(fn, "__call__", None))):
+                result = await fn(*args, **kwargs)
+            else:
+                # sync callables run in a thread pool so concurrent
+                # requests overlap (reference: replica.py run_sync_in_
+                # threadpool) — keeps the ongoing-count signal honest for
+                # pow-2 routing and autoscaling
+                loop = asyncio.get_event_loop()
+                result = await loop.run_in_executor(
+                    None, lambda: fn(*args, **kwargs))
+            if inspect.iscoroutine(result):
+                result = await result
+            return result
+        finally:
+            self._ongoing -= 1
+
+    def reconfigure(self, user_config) -> None:
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+
+    def get_num_ongoing_requests(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "total": self._total,
+                "ts": time.time()}
+
+    def check_health(self) -> bool:
+        if hasattr(self._callable, "check_health"):
+            res = self._callable.check_health()
+            return bool(res) if res is not None else True
+        return True

@@ -1,0 +1,204 @@
+"""ray_tpu.serve tests (reference model: python/ray/serve/tests/)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance():
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    serve.start(serve.HTTPOptions(port=18231))
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _http_get(path, port=18231):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+        return r.status, r.read().decode()
+
+
+def _http_post(path, data, port=18231):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(data).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read().decode()
+
+
+def test_function_deployment_handle(serve_instance):
+    @serve.deployment
+    def echo(x):
+        return f"echo:{x}"
+
+    handle = serve.run(echo.bind(), route_prefix=None)
+    assert handle.remote("hi").result() == "echo:hi"
+
+
+def test_class_deployment(serve_instance):
+    @serve.deployment
+    class Counter:
+        def __init__(self, start):
+            self.count = start
+
+        def incr(self, n):
+            self.count += n
+            return self.count
+
+        def __call__(self, req):
+            return self.count
+
+    handle = serve.run(Counter.bind(10), route_prefix=None)
+    assert handle.incr.remote(5).result() == 15
+    assert handle.incr.remote(5).result() == 20
+
+
+def test_http_roundtrip(serve_instance):
+    @serve.deployment
+    class Greeter:
+        def __call__(self, request):
+            name = request.query_params.get("name", "world")
+            return {"hello": name}
+
+    serve.run(Greeter.bind(), route_prefix="/greet")
+    status, body = _http_get("/greet?name=tpu")
+    assert status == 200
+    assert json.loads(body) == {"hello": "tpu"}
+
+
+def test_http_json_body(serve_instance):
+    @serve.deployment
+    class Adder:
+        def __call__(self, request):
+            data = request.json()
+            return {"sum": data["a"] + data["b"]}
+
+    serve.run(Adder.bind(), route_prefix="/add")
+    status, body = _http_post("/add", {"a": 2, "b": 3})
+    assert json.loads(body) == {"sum": 5}
+
+
+def test_multiple_replicas(serve_instance):
+    @serve.deployment(num_replicas=3)
+    class WhoAmI:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, req):
+            return self.pid
+
+    handle = serve.run(WhoAmI.bind(), route_prefix=None)
+    pids = {handle.remote(None).result() for _ in range(20)}
+    assert len(pids) >= 2  # pow-2 routing spreads load
+
+
+def test_composition(serve_instance):
+    @serve.deployment
+    class Preprocessor:
+        def process(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            doubled = self.pre.process.remote(x).result()
+            return doubled + 1
+
+    handle = serve.run(Model.bind(Preprocessor.bind()), route_prefix=None)
+    assert handle.remote(10).result() == 21
+
+
+def test_status_and_delete(serve_instance):
+    @serve.deployment
+    def f(x):
+        return x
+
+    serve.run(f.bind(), route_prefix=None)
+    st = serve.status()
+    assert "f" in st
+    serve.delete("f")
+    assert "f" not in serve.status()
+
+
+def test_rolling_update_reconfigure(serve_instance):
+    @serve.deployment(version="1")
+    def v(x):
+        return "v1"
+
+    handle = serve.run(v.bind(), route_prefix=None)
+    assert handle.remote(0).result() == "v1"
+
+    @serve.deployment(name="v", version="2")
+    def v2(x):
+        return "v2"
+
+    handle = serve.run(v2.bind(), route_prefix=None)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if handle.remote(0).result() == "v2":
+            break
+        time.sleep(0.2)
+    assert handle.remote(0).result() == "v2"
+
+
+def test_batching(serve_instance):
+    @serve.deployment
+    class BatchModel:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        async def handle_batch(self, xs):
+            self.batch_sizes.append(len(xs))
+            return [x * 10 for x in xs]
+
+        async def __call__(self, x):
+            return await self.handle_batch(x)
+
+        def get_batch_sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(BatchModel.bind(), route_prefix=None)
+    responses = [handle.remote(i) for i in range(8)]
+    results = sorted(r.result() for r in responses)
+    assert results == [i * 10 for i in range(8)]
+    sizes = handle.get_batch_sizes.remote().result()
+    assert max(sizes) > 1  # some batching happened
+
+
+def test_autoscaling_scales_up(serve_instance):
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0, "upscale_delay_s": 0.1})
+    class Slow:
+        def __call__(self, req):
+            time.sleep(0.4)
+            return "ok"
+
+    handle = serve.run(Slow.bind(), route_prefix=None)
+    # flood with concurrent requests to build queue depth
+    responses = [handle.remote(None) for _ in range(12)]
+    deadline = time.time() + 15
+    scaled = False
+    while time.time() < deadline:
+        st = serve.status()
+        if st.get("Slow", {}).get("num_replicas", 0) >= 2:
+            scaled = True
+            break
+        time.sleep(0.2)
+    for r in responses:
+        r.result()
+    assert scaled
