@@ -47,6 +47,7 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16  # compute dtype (params stored fp32)
     remat: bool = True
+    loss_chunk: int = 256  # seq-chunk for the xent head; 0 = unchunked
 
     @property
     def head_dim(self) -> int:
@@ -73,6 +74,14 @@ class LlamaConfig:
         """~500M params — fills a single v5e chip's MXU better."""
         return LlamaConfig(vocab_size=vocab_size, dim=1280, n_layers=20,
                            n_heads=16, n_kv_heads=8, mlp_dim=5120,
+                           max_seq_len=2048)
+
+    @staticmethod
+    def bench(vocab_size: int = 32000) -> "LlamaConfig":
+        """~660M params with head_dim=128 — MXU-native lane width, no
+        padding in the flash kernel."""
+        return LlamaConfig(vocab_size=vocab_size, dim=1536, n_layers=16,
+                           n_heads=12, n_kv_heads=6, mlp_dim=6144,
                            max_seq_len=2048)
 
     @staticmethod
@@ -152,27 +161,42 @@ def init_params(cfg: LlamaConfig, key) -> Dict[str, Any]:
 # --------------------------------------------------------------------------- #
 
 
-def _attention(cfg: LlamaConfig, q, k, v, mesh):
-    """Dispatch: ring attention when the mesh shards sequence, else plain."""
-    B, T, H, D = q.shape
-    # GQA: repeat kv heads up to q heads
-    rep = cfg.n_heads // cfg.n_kv_heads
-    if rep > 1:
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    if mesh is not None and "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
-        from jax.sharding import PartitionSpec as P
+def _shard_mapped(fn, mesh, seq_axis):
+    """Wrap an attention body in shard_map: batch over data/fsdp, heads
+    over tensor, seq over ``seq_axis`` (None = unsharded)."""
+    from jax.sharding import PartitionSpec as P
 
-        batch_axes = tuple(a for a in ("slice", "data", "fsdp")
-                           if a in mesh.axis_names)
-        ha = "tensor" if "tensor" in mesh.axis_names else None
-        spec = P(batch_axes if batch_axes else None, "seq", ha, None)
-        fn = jax.shard_map(
+    batch_axes = tuple(a for a in ("slice", "data", "fsdp")
+                       if a in mesh.axis_names)
+    ha = "tensor" if "tensor" in mesh.axis_names else None
+    spec = P(batch_axes if batch_axes else None, seq_axis, ha, None)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)
+
+
+def _attention(cfg: LlamaConfig, q, k, v, mesh):
+    """Dispatch: ring attention when the mesh shards sequence, else the
+    Pallas flash kernel (GQA-aware, no [B,H,T,T] materialization) on TPU,
+    else plain XLA attention."""
+    B, T, H, D = q.shape
+    if mesh is not None and "seq" in mesh.axis_names and mesh.shape["seq"] > 1:
+        # ring path takes pre-repeated kv heads
+        rep = cfg.n_heads // cfg.n_kv_heads
+        if rep > 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        fn = _shard_mapped(
             partial(ring_attention_local, axis_name="seq", causal=True),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+            mesh, "seq")
         return fn(q, k, v)
-    return plain_attention(q, k, v, causal=True)
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    if mesh is not None and mesh.size > 1:
+        # pallas_call does not auto-partition under GSPMD: run the kernel
+        # per-shard via shard_map (seq unsharded on this path)
+        fn = _shard_mapped(partial(flash_attention, causal=True), mesh, None)
+        return fn(q, k, v)
+    return flash_attention(q, k, v, causal=True)
 
 
 def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
@@ -195,8 +219,8 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
     return x
 
 
-def forward(cfg: LlamaConfig, params, tokens, mesh=None):
-    """tokens [B, T] int32 -> logits [B, T, vocab] (cfg.dtype)."""
+def _backbone(cfg: LlamaConfig, params, tokens, mesh=None):
+    """tokens [B, T] int32 -> final-normed hidden states [B, T, dim]."""
     B, T = tokens.shape
     x = params["embedding"].astype(cfg.dtype)[tokens]
     if mesh is not None:
@@ -213,19 +237,56 @@ def forward(cfg: LlamaConfig, params, tokens, mesh=None):
         return layer_fn(carry, layer_params, positions), None
 
     x, _ = jax.lax.scan(scan_body, x, params["layers"])
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    head = (params["embedding"].T if cfg.tie_embeddings
+    return rms_norm(x, params["final_norm"], cfg.norm_eps)
+
+
+def _head(cfg: LlamaConfig, params):
+    return (params["embedding"].T if cfg.tie_embeddings
             else params["lm_head"])
-    return (x.astype(cfg.dtype) @ head.astype(cfg.dtype))
+
+
+def forward(cfg: LlamaConfig, params, tokens, mesh=None):
+    """tokens [B, T] int32 -> logits [B, T, vocab] (cfg.dtype)."""
+    x = _backbone(cfg, params, tokens, mesh)
+    return (x.astype(cfg.dtype) @ _head(cfg, params).astype(cfg.dtype))
 
 
 def loss_fn(cfg: LlamaConfig, params, tokens, mesh=None):
-    """Next-token cross-entropy; fp32 log-softmax. tokens [B, T+1]."""
+    """Next-token cross-entropy; fp32 log-softmax. tokens [B, T+1].
+
+    The lm_head matmul + log-softmax run CHUNKED over the sequence under
+    ``jax.checkpoint``: fp32 logits exist only per-chunk ([B, C, vocab]
+    instead of [B, T, vocab] — the round-1 OOM at batch 32), recomputed in
+    the backward pass. Costs one extra head matmul per chunk; frees GBs.
+    """
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(cfg, params, inputs, mesh).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    x = _backbone(cfg, params, inputs, mesh)
+    head = _head(cfg, params)
+    B, T, d = x.shape
+    C = cfg.loss_chunk
+
+    def chunk_nll(x_c, t_c):
+        logits = (x_c.astype(cfg.dtype)
+                  @ head.astype(cfg.dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, t_c[..., None], axis=-1)[..., 0]
+
+    if not C or T <= C:
+        return chunk_nll(x, targets).mean()
+
+    n, rem = divmod(T, C)
+    xs = jnp.swapaxes(x[:, :n * C].reshape(B, n, C, d), 0, 1)     # [n,B,C,d]
+    ts = jnp.swapaxes(targets[:, :n * C].reshape(B, n, C), 0, 1)  # [n,B,C]
+
+    def body(total, chunk):
+        x_c, t_c = chunk
+        return total + chunk_nll(x_c, t_c).sum(), None
+
+    total, _ = jax.lax.scan(jax.checkpoint(body), jnp.zeros((), jnp.float32),
+                            (xs, ts))
+    if rem:
+        total = total + chunk_nll(x[:, n * C:], targets[:, n * C:]).sum()
+    return total / (B * T)
 
 
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,342 @@
+"""Pallas TPU flash attention: blockwise causal attention, GQA-aware.
+
+The MXU-friendly replacement for ``plain_attention``'s [B, H, T, T] fp32
+score materialization (the round-1 MFU bottleneck). Design:
+
+- forward: grid over (batch, q_head, q_block); K/V for the head group live
+  in VMEM once (Pallas skips the re-DMA when the block index is unchanged
+  across consecutive grid steps); inner ``fori_loop`` over K/V blocks with
+  online-softmax (max/sum) carries, so HBM traffic is O(T) not O(T^2).
+  Causal skips future blocks entirely via a dynamic loop bound.
+- backward: two kernels — dQ (grid over q blocks, loop over past K/V
+  blocks) and dK/dV (grid over kv blocks, loop over future Q blocks),
+  recomputing probabilities from the saved logsumexp, flash-attention-2
+  style. GQA head-group reduction for dK/dV happens outside the kernel
+  (one reshape-sum).
+- GQA: q heads map to kv head ``h // (Hq // Hkv)`` in the BlockSpec index
+  map — no ``jnp.repeat`` of K/V through HBM.
+- head_dim is zero-padded to a lane multiple (128) when needed; padding
+  contributes nothing to scores and is sliced off outputs/grads.
+
+Reference behavior being replaced: ray.util's delegation of attention math
+to torch (reference has no in-repo attention kernel; SURVEY.md §5
+long-context row names Pallas flash/splash attention as the TPU design).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+_LANE = 128
+
+
+def _pick_block(t: int) -> Optional[int]:
+    for blk in (512, 256, 128, 64):
+        if t % blk == 0:
+            return blk
+    return None
+
+
+def _supported(q, k, block: Optional[int]) -> bool:
+    B, T, Hq, D = q.shape
+    Tk, Hkv = k.shape[1], k.shape[2]
+    if T != Tk or block is None or T % block != 0:
+        return False
+    if Hq % Hkv != 0:
+        return False
+    return True
+
+
+# --------------------------------------------------------------------------- #
+# Forward kernel
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, blk, causal,
+                n_kv_blocks):
+    """q_ref (1,1,blk,D); k/v_ref (1,1,T,D); o_ref (1,1,blk,D); lse (1,1,blk)."""
+    qi = pl.program_id(2)
+    D = q_ref.shape[-1]
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [blk, D]
+
+    def body(j, carry):
+        acc, l, m = carry
+        kb = k_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [blk, blk]
+        if causal:
+            q_pos = qi * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 0)
+            k_pos = j * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        vb = v_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, l, m_new
+
+    acc0 = jnp.zeros((blk, D), jnp.float32)
+    l0 = jnp.zeros((blk,), jnp.float32)
+    m0 = jnp.full((blk,), NEG_INF, jnp.float32)
+    upper = qi + 1 if causal else n_kv_blocks
+    acc, l, m = jax.lax.fori_loop(0, upper, body, (acc0, l0, m0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, :, 0] = m + jnp.log(l)
+
+
+def _fwd(q, k, v, *, causal, blk, interpret):
+    """q [B,Hq,T,D], k/v [B,Hkv,T,D] -> (o [B,Hq,T,D], lse [B,Hq,T])."""
+    B, Hq, T, D = q.shape
+    Hkv = k.shape[1]
+    rep = Hq // Hkv
+    scale = 1.0 / math.sqrt(D)
+    grid = (B, Hq, T // blk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, blk=blk, causal=causal,
+        n_kv_blocks=T // blk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, blk, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h // rep, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, blk, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, blk, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, Hq, T, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------------- #
+# Backward kernels
+# --------------------------------------------------------------------------- #
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, blk, causal, n_kv_blocks):
+    qi = pl.program_id(2)
+    D = q_ref.shape[-1]
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+
+    def body(j, dq):
+        kb = k_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * blk, blk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 0)
+            k_pos = j * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    upper = qi + 1 if causal else n_kv_blocks
+    dq = jax.lax.fori_loop(0, upper, body,
+                           jnp.zeros((blk, D), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, blk, causal, n_q_blocks):
+    kj = pl.program_id(2)
+    D = q_ref.shape[-1]
+    kb = k_ref[0, 0].astype(jnp.float32)  # [blk, D]
+    vb = v_ref[0, 0].astype(jnp.float32)
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, 0, pl.ds(i * blk, blk), :].astype(jnp.float32)
+        dob = do_ref[0, 0, pl.ds(i * blk, blk), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * blk, blk), 0]
+        delta = delta_ref[0, 0, pl.ds(i * blk, blk), 0]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [q_blk, k_blk]
+        if causal:
+            q_pos = i * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 0)
+            k_pos = kj * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [q, k]
+        dv_new = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # p^T @ do -> [k, D]
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [q, k]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # ds^T @ q -> [k, D]
+        return dk_new, dv_new
+
+    lower = kj if causal else 0
+    dk, dv = jax.lax.fori_loop(
+        lower, n_q_blocks, body,
+        (jnp.zeros((blk, D), jnp.float32), jnp.zeros((blk, D), jnp.float32)))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, *, causal, blk, interpret):
+    B, Hq, T, D = q.shape
+    Hkv = k.shape[1]
+    rep = Hq // Hkv
+    scale = 1.0 / math.sqrt(D)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B,Hq,T,1]
+    n_blocks = T // blk
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, blk=blk, causal=causal,
+                          n_kv_blocks=n_blocks),
+        grid=(B, Hq, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, blk, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, i: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, 1, blk, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, blk, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, blk, 1), lambda b, h, i: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, blk, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hq, T, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk_exp, dv_exp = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, blk=blk, causal=causal,
+                          n_q_blocks=n_blocks),
+        grid=(B, Hq, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, T, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, blk, D), lambda b, h, j: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, blk, D), lambda b, h, j: (b, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, T, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, T, 1), lambda b, h, j: (b, h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, blk, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, blk, D), lambda b, h, j: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hq, T, D), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hq, T, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # GQA group-sum: q heads [g*rep, (g+1)*rep) all attend kv head g
+    dk = dk_exp.reshape(B, Hkv, rep, T, D).sum(axis=2).astype(k.dtype)
+    dv = dv_exp.reshape(B, Hkv, rep, T, D).sum(axis=2).astype(v.dtype)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------- #
+# custom_vjp wrapper ([B,H,T,D] layout)
+# --------------------------------------------------------------------------- #
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhtd(q, k, v, causal, blk, interpret):
+    o, _ = _fwd(q, k, v, causal=causal, blk=blk, interpret=interpret)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, causal, blk, interpret):
+    o, lse = _fwd(q, k, v, causal=causal, blk=blk, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(causal, blk, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd(q, k, v, o, lse, do, causal=causal, blk=blk,
+                interpret=interpret)
+
+
+_flash_bhtd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# --------------------------------------------------------------------------- #
+# Public API ([B,T,H,D] layout, matching the model)
+# --------------------------------------------------------------------------- #
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block: Optional[int] = None,
+                    interpret: bool = False):
+    """Blockwise (flash) causal attention. GQA-aware — pass k/v unrepeated.
+
+    q: [B, T, Hq, D]; k, v: [B, T, Hkv, D] with Hq % Hkv == 0.
+    Returns [B, T, Hq, D] in q.dtype. Differentiable (custom VJP with
+    Pallas backward kernels). Falls back to the exact jnp implementation
+    when shapes don't block cleanly or no TPU backend is present.
+    """
+    B, T, Hq, D = q.shape
+    blk = block or _pick_block(T)
+    use_pallas = interpret or _on_tpu()
+    if not use_pallas or not _supported(q, k, blk):
+        return _fallback(q, k, v, causal)
+    # pad head_dim to the 128-lane boundary (zeros don't affect scores)
+    Dp = ((D + _LANE - 1) // _LANE) * _LANE
+    qt = jnp.swapaxes(q, 1, 2)  # [B,Hq,T,D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    if Dp != D:
+        pad = [(0, 0), (0, 0), (0, 0), (0, Dp - D)]
+        qt, kt, vt = jnp.pad(qt, pad), jnp.pad(kt, pad), jnp.pad(vt, pad)
+        # keep softmax scale of the true head_dim
+        qt = qt * (math.sqrt(Dp) / math.sqrt(D))
+    o = _flash_bhtd(qt, kt, vt, causal, blk, interpret)
+    if Dp != D:
+        o = o[..., :D]
+    return jnp.swapaxes(o, 1, 2)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _fallback(q, k, v, causal):
+    """Exact reference path (materializes scores) for small/odd shapes."""
+    Hq, Hkv = q.shape[2], k.shape[2]
+    if Hq != Hkv:
+        rep = Hq // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    from ray_tpu.parallel.ring_attention import plain_attention
+
+    return plain_attention(q, k, v, causal=causal)
