@@ -1,0 +1,29 @@
+"""CLI entry point: ``python -m ray_tpu <command>``.
+
+Analog of the reference's ``ray`` CLI (python/ray/scripts/scripts.py:571
+``ray start``): joins this machine to a running head as a node daemon.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m ray_tpu start --address <head_host:port> "
+              "--key <hex> [--num-cpus N] [--num-tpus N] "
+              "[--resources JSON] [--labels JSON]")
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "start":
+        from ray_tpu.core.node_daemon import main as daemon_main
+
+        return daemon_main(rest)
+    print(f"unknown command {cmd!r}; try --help", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

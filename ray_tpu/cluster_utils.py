@@ -10,7 +10,12 @@ simulates node death, driving the same failover paths real node loss would
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
 
 from ray_tpu.core import api, object_ref as object_ref_mod, runtime as runtime_mod
 from ray_tpu.core.node import Node
@@ -22,6 +27,7 @@ class Cluster:
                  head_node_args: Optional[Dict] = None, connect: bool = True):
         self.head: Optional[Head] = None
         self._connected = False
+        self._procs: List[subprocess.Popen] = []
         if initialize_head:
             args = dict(head_node_args or {})
             resources = args.pop("resources", {})
@@ -42,14 +48,53 @@ class Cluster:
 
     def add_node(self, num_cpus: int = 4, num_tpus: int = 0,
                  resources: Optional[Dict[str, float]] = None,
-                 labels: Optional[Dict[str, str]] = None) -> Node:
+                 labels: Optional[Dict[str, str]] = None,
+                 separate_process: bool = False,
+                 register_timeout: float = 30.0):
+        """Add a node: in-process by default (several raylets, one OS
+        process — the reference Cluster fixture), or as a REAL separate OS
+        process joining over TCP (``separate_process=True``), exercising the
+        full multi-host path: daemon registration, remote dispatch, direct
+        chunked node-to-node object transfer."""
         total = dict(resources or {})
         total.setdefault("CPU", num_cpus)
         if num_tpus:
             total["TPU"] = num_tpus
-        return self.head.add_node(total, labels=labels)
+        if not separate_process:
+            return self.head.add_node(total, labels=labels)
+        host, port = self.head.start_node_server()
+        before = set(self.head.nodes)
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        if total.get("TPU", 0) == 0:
+            env.pop("PALLAS_AXON_POOL_IPS", None)  # don't claim the TPU chip
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.core.node_daemon",
+             "--address", f"{host}:{port}",
+             "--key", self.head.cluster_key_hex,
+             # explicit counts: never let the daemon auto-detect the TPU
+             # chips a co-located node already advertises
+             "--num-cpus", str(total.get("CPU", num_cpus)),
+             "--num-tpus", str(total.get("TPU", 0)),
+             "--resources", json.dumps(
+                 {k: v for k, v in total.items() if k not in ("CPU", "TPU")}),
+             "--labels", json.dumps(labels or {})],
+            env=env,
+        )
+        self._procs.append(proc)
+        deadline = time.monotonic() + register_timeout
+        while time.monotonic() < deadline:
+            new = set(self.head.nodes) - before
+            if new:
+                return self.head.nodes[new.pop()]
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"node daemon exited rc={proc.returncode} before joining")
+            time.sleep(0.05)
+        raise TimeoutError("node daemon did not register in time")
 
-    def remove_node(self, node: Node) -> None:
+    def remove_node(self, node) -> None:
         self.head.remove_node(node.hex)
 
     def shutdown(self):
@@ -59,4 +104,14 @@ class Cluster:
         if self.head is not None:
             self.head.shutdown()
             self.head = None
+        for p in self._procs:
+            try:
+                p.terminate()
+                p.wait(timeout=5)
+            except Exception:
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+        self._procs.clear()
         api._head = None

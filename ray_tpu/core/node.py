@@ -294,7 +294,8 @@ class Node:
                 # actor task done (worker stays "actor") or stale
                 spec = None
         # The head decides whether to seal results (it may retry instead).
-        self.head.on_task_finished(self, task_id, err_name, spec, binding, results)
+        self.head.on_task_finished(self, task_id, err_name, spec, binding,
+                                   results, worker_id=w.worker_id)
         self._pump()
 
     def _on_worker_exit(self, w: WorkerHandle) -> None:
@@ -315,6 +316,37 @@ class Node:
         w.channel.close()
         self.head.on_worker_crashed(self, w, spec, binding, prev_state)
         self._pump()
+
+    def cancel_task(self, task_id, worker_id: Optional[WorkerID],
+                    force: bool) -> None:
+        """Forward a cancel to the worker running ``task_id`` (or the given
+        actor worker). Reference: CoreWorker::CancelTask -> executor interrupt."""
+        with self._lock:
+            target = None
+            if worker_id is not None:
+                target = self._workers.get(worker_id)
+            else:
+                for w in self._workers.values():
+                    if w.current_task is not None and \
+                            w.current_task.task_id == task_id:
+                        target = w
+                        break
+        if target is None:
+            return
+        try:
+            target.channel.send("cancel", task_id)
+        except OSError:
+            pass
+        if force:
+            self.kill_worker(target.worker_id)
+
+    def start_object_server(self, authkey: bytes, host: str = "127.0.0.1"):
+        """Start the node-to-node chunk server (multi-host mode)."""
+        from .object_transfer import ObjectServer
+
+        if getattr(self, "object_server", None) is None:
+            self.object_server = ObjectServer(self.store, authkey, host)
+        return self.object_server
 
     def kill_worker(self, worker_id: WorkerID) -> None:
         with self._lock:
@@ -351,5 +383,7 @@ class Node:
             self._listener.close()
         except OSError:
             pass
+        if getattr(self, "object_server", None) is not None:
+            self.object_server.close()
         self.store.close()
         self._handler_pool.shutdown(wait=False)

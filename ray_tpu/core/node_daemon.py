@@ -1,0 +1,268 @@
+"""Standalone node daemon: joins a head over TCP from another OS process/host.
+
+The multi-host split the reference gets from separate raylet processes
+(src/ray/raylet/main.cc:123): ``python -m ray_tpu.core.node_daemon
+--address <head_host:port> --key <hex>`` runs a full Node (worker pool +
+shm arena + object server) in its own process. The Node's upcalls into the
+"head" go through ``RemoteHead``, which forwards them over the registration
+channel; object payloads never traverse it — they move via direct chunked
+node-to-node pulls (object_transfer.py).
+
+Registration handshake (head side: runtime.py Head._register_daemon):
+    daemon -> ("hello", {})
+    head   -> ("welcome", {node_hex, job_id, config})   # head config adopted
+    daemon -> ("node_ready", {resources, labels, object_addr, pid})
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from .config import Config, global_config, set_global_config
+from .exceptions import ObjectLostError
+from .ids import JobID, NodeID, ObjectID
+from .protocol import Channel, RpcClient, connect, parse_address
+
+
+class _PinShim:
+    """dict-like ref_counts view backed by a head RPC (pin_check path).
+
+    Only consulted by the store's reclaim loop under memory pressure, so a
+    sync round-trip is acceptable; fails open to "pinned" so eviction never
+    drops an object the head still references just because the link blipped.
+    """
+
+    def __init__(self, rh: "RemoteHead"):
+        self._rh = rh
+
+    def get(self, oid, default=0):
+        try:
+            return 1 if self._rh.rpc.call("req", "is_pinned", (oid,),
+                                          timeout=5.0) else 0
+        except Exception:
+            return 1
+
+
+class RemoteHead:
+    """Daemon-side proxy implementing the Head interface a Node calls."""
+
+    def __init__(self, channel: Channel, welcome: dict, cluster_key: bytes):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.channel = channel
+        self.rpc = RpcClient(channel)
+        self.job_id = JobID(welcome["job_id"])
+        self.node_hex: str = welcome["node_hex"]
+        self.cluster_key = cluster_key
+        self.ref_counts = _PinShim(self)
+        self.node = None  # set after Node construction
+        self.stopped = threading.Event()
+        # handlers can block on node/store locks (e.g. store_delete vs a
+        # reclaim holding the store lock mid pin-check RPC): run them off
+        # the read loop so "rep" delivery is never queued behind them.
+        # dispatch-family messages keep a dedicated single thread so actor
+        # task ordering (send order to the worker channel) is preserved.
+        self._ordered_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="head-dispatch")
+        self._handler_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="head-msg")
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="head-link")
+        self._reader.start()
+
+    # ------------------------------------------------------------ channel
+
+    def _send(self, tag: str, *payload) -> None:
+        try:
+            self.channel.send(tag, *payload)
+        except (OSError, EOFError, ValueError):
+            self.stopped.set()
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                tag, payload = self.channel.recv()
+            except (EOFError, OSError):
+                self.rpc.fail_all(ConnectionError("head link lost"))
+                self.stopped.set()
+                return
+            if tag == "rep":
+                self.rpc.handle_reply(*payload)
+            elif tag == "shutdown":
+                self.stopped.set()
+                return
+            elif tag in ("dispatch", "dispatch_worker", "cancel",
+                         "kill_worker"):
+                self._ordered_pool.submit(self._handle, tag, payload)
+            else:
+                self._handler_pool.submit(self._handle, tag, payload)
+
+    def _handle(self, tag: str, payload) -> None:
+        try:
+            if tag == "dispatch":
+                self.node.dispatch(pickle.loads(payload[0]), payload[1])
+            elif tag == "dispatch_worker":
+                wid, spec_b = payload
+                spec = pickle.loads(spec_b)
+                if not self.node.dispatch_to_worker(wid, spec):
+                    self._send("dispatch_worker_failed", spec.task_id,
+                               spec.actor_id)
+            elif tag == "kill_worker":
+                self.node.kill_worker(payload[0])
+            elif tag == "cancel":
+                self.node.cancel_task(*payload)
+            elif tag == "store_delete":
+                self.node.store.delete(payload[0])
+        except Exception:
+            pass  # node dying; the head recovers via channel EOF
+
+    # ------------------------------------------- Head API consumed by Node
+
+    def on_task_finished(self, node, task_id, err_name, spec, binding,
+                         results, worker_id=None) -> None:
+        self._send("task_finished", task_id, err_name,
+                   pickle.dumps(spec) if spec is not None else None,
+                   binding, results, worker_id)
+
+    def on_object_sealed(self, oid: ObjectID, node_hex: str) -> None:
+        self._send("sealed", oid)
+
+    def on_worker_exit(self, node, w) -> None:
+        self._send("worker_exit", w.worker_id, w.actor_id, w.pid)
+
+    def on_worker_crashed(self, node, w, spec, binding, prev_state) -> None:
+        self._send("worker_crashed", w.worker_id, w.actor_id, w.pid,
+                   pickle.dumps(spec) if spec is not None else None,
+                   binding, prev_state)
+
+    def handle_worker_rpc(self, node, w, op: str, args):
+        return self.rpc.call("req", "worker_rpc", (op, list(args)))
+
+    def wait_objects(self, oids, num_returns, timeout):
+        # bounded rounds: an unbounded wait would pin one of the head's
+        # daemon-request threads forever (pool starvation/deadlock)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = None if deadline is None else deadline - time.monotonic()
+            round_t = 2.0 if remaining is None else max(0.0, min(remaining, 2.0))
+            ready = self.rpc.call("req", "wait_objects",
+                                  (oids, num_returns, round_t),
+                                  timeout=round_t + 30.0)
+            if len(ready) >= num_returns or (remaining is not None
+                                             and remaining <= 0):
+                return ready
+
+    def get_object_for_node(self, node, oid: ObjectID, timeout):
+        """Local-store check, then head locate + direct pull from the source
+        node's object server (reference: pull_manager.h chunked pull)."""
+        from .object_transfer import pull_object
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if node.store.contains(oid):
+                info = node.store.entry_info(oid)
+                if info is None:
+                    payload, is_err = node.store.get_payload(oid)
+                    return ("inline", bytes(payload), is_err)
+                off, size, is_err = info
+                return ("arena", off, size, is_err)
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                return ("timeout",)
+            round_t = 2.0 if remaining is None else max(0.05, min(remaining, 2.0))
+            try:
+                rep = self.rpc.call("req", "locate", (oid, round_t),
+                                    timeout=round_t + 30.0)
+            except Exception:
+                if self.stopped.is_set():
+                    raise ObjectLostError(oid, "head link lost")
+                continue
+            if rep[0] == "inline":
+                return ("inline", rep[1], rep[2])
+            if rep[0] == "locs":
+                all_stale = True
+                for src_hex, addr in rep[1]:
+                    res = pull_object(addr, self.cluster_key, oid,
+                                      dest_store=node.store)
+                    if res is None:
+                        # evicted/source died: invalidate so locate doesn't
+                        # return the same stale address forever
+                        try:
+                            self.rpc.call("req", "drop_location",
+                                          (oid, src_hex), timeout=10.0)
+                        except Exception:
+                            pass
+                        continue
+                    all_stale = False
+                    body, is_err = res
+                    if isinstance(body, tuple):
+                        _, off, size = body
+                        self.on_object_sealed(oid, node.hex)
+                        return ("arena", off, size, is_err)
+                    return ("inline", body, is_err)
+                if all_stale:
+                    time.sleep(0.05)  # let reconstruction/retry make progress
+            # timeout / stale locations: loop
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ray_tpu node daemon",
+        description="Join a ray_tpu head as a separate-process node")
+    ap.add_argument("--address", required=True, help="head host:port")
+    ap.add_argument("--key", required=True, help="cluster auth key (hex)")
+    ap.add_argument("--num-cpus", type=float, default=None)
+    ap.add_argument("--num-tpus", type=float, default=None)
+    ap.add_argument("--resources", default="{}", help="JSON resource dict")
+    ap.add_argument("--labels", default="{}", help="JSON label dict")
+    ap.add_argument("--session-dir", default=None)
+    args = ap.parse_args(argv)
+
+    from .accelerators import detect_resources
+
+    key = bytes.fromhex(args.key)
+    resources = detect_resources(
+        num_cpus=int(args.num_cpus) if args.num_cpus is not None else None,
+        num_tpus=int(args.num_tpus) if args.num_tpus is not None else None,
+        extra=json.loads(args.resources))
+    labels = json.loads(args.labels)
+
+    channel = connect(parse_address(args.address), key)
+    channel.send("hello", {})
+    tag, (welcome,) = channel.recv()
+    assert tag == "welcome", tag
+    # adopt the head's config so scheduler/store thresholds agree cluster-wide
+    set_global_config(Config.from_json(welcome["config"]))
+
+    head = RemoteHead(channel, welcome, key)
+    session_dir = args.session_dir or tempfile.mkdtemp(prefix="raytpu_node_")
+
+    from .node import Node
+
+    node = Node(head, NodeID(bytes.fromhex(welcome["node_hex"])), resources,
+                session_dir, labels)
+    head.node = node
+    server = node.start_object_server(key)
+    channel.send("node_ready", {
+        "resources": resources,
+        "labels": labels,
+        "object_addr": list(server.address),
+        "pid": os.getpid(),
+    })
+    try:
+        head.stopped.wait()
+    except KeyboardInterrupt:
+        pass
+    node.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -246,6 +246,37 @@ class LocalObjectStore:
             e.mapped = True
             return self.arena.view(e.offset, e.size), e.is_error
 
+    def read_meta(self, oid: ObjectID) -> Optional[Tuple[int, bool]]:
+        """(size, is_error) for a sealed object, else None. Does not pin."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None or not e.sealed:
+                return None
+            return e.size, e.is_error
+
+    def read_chunk(self, oid: ObjectID, start: int, n: int) -> Optional[bytes]:
+        """Copy out payload[start:start+n] for node-to-node transfer.
+
+        Re-looks-up the entry per call so a transfer never pins the object:
+        returns None if it was deleted/evicted mid-stream (puller retries
+        with a fresh location). Serves spilled objects straight from disk.
+        """
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None or not e.sealed:
+                return None
+            e.last_access = time.monotonic()
+            if e.inline is not None:
+                return e.inline[start:start + n]
+            if e.spilled_path is not None:
+                try:
+                    with open(e.spilled_path, "rb") as f:
+                        f.seek(start)
+                        return f.read(n)
+                except OSError:
+                    return None
+            return bytes(self.arena.view(e.offset, e.size)[start:start + n])
+
     def entry_info(self, oid: ObjectID) -> Optional[Tuple[int, int, bool]]:
         """(offset, size, is_error) for sealed arena objects, for direct worker
         mmap reads; None if inline/absent/spilled."""

@@ -1,0 +1,163 @@
+"""Direct node-to-node chunked object transfer.
+
+Analog of the reference's ObjectManager push/pull over gRPC
+(src/ray/object_manager/object_manager.h:117, chunked per
+object_manager_default_chunk_size ray_config_def.h:345): every node runs an
+``ObjectServer``; a node needing an object asks the head only for *locations*
+(addr + key), then pulls chunks straight from the source node's store into
+its own arena — the driver's memory is never in the data path (the round-1
+weakness: whole-object copies mediated by driver memory).
+
+Wire protocol (multiprocessing.connection over TCP, HMAC-authenticated):
+    puller -> ("pull", oid_binary)
+    server -> ("meta", size, is_error) | ("missing",)
+    server -> chunk bytes x ceil(size / chunk)      (send_bytes frames)
+Connections are per-pull; the OS socket buffer provides backpressure.
+"""
+
+from __future__ import annotations
+
+import threading
+from multiprocessing import connection as mpc
+from typing import Optional, Tuple
+
+from .config import global_config
+from .exceptions import ObjectLostError
+from .ids import ObjectID
+
+
+class ObjectServer:
+    """Per-node chunk server reading from the node's LocalObjectStore."""
+
+    def __init__(self, store, authkey: bytes, host: str = "127.0.0.1"):
+        self.store = store
+        self.authkey = authkey
+        self._listener = mpc.Listener(address=(host, 0), family="AF_INET",
+                                      authkey=authkey)
+        self.address: Tuple[str, int] = self._listener.address
+        self._alive = True
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                        name="object-server")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while self._alive:
+            try:
+                conn = self._listener.accept()
+            except Exception:
+                if not self._alive:
+                    return
+                continue
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn) -> None:
+        chunk = global_config().object_transfer_chunk_size
+        try:
+            while True:
+                msg = conn.recv()
+                if msg[0] != "pull":
+                    break
+                oid = ObjectID(msg[1])
+                meta = self.store.read_meta(oid)
+                if meta is None:
+                    conn.send(("missing",))
+                    continue
+                size, is_err = meta
+                conn.send(("meta", size, is_err))
+                sent, aborted = 0, False
+                while sent < size:
+                    n = min(chunk, size - sent)
+                    data = self.store.read_chunk(oid, sent, n)
+                    if data is None or len(data) != n:
+                        # deleted mid-stream: pad out the frame count so the
+                        # puller's framing stays aligned, then it re-locates
+                        conn.send_bytes(b"")
+                        aborted = True
+                        break
+                    conn.send_bytes(data)
+                    sent += n
+                if aborted:
+                    break
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._alive = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def pull_object(address, authkey: bytes, oid: ObjectID,
+                dest_store=None) -> Optional[Tuple[object, bool]]:
+    """Pull one object from a remote ObjectServer.
+
+    Small objects return (bytes, is_error). Large ones stream chunk-by-chunk
+    into ``dest_store``'s arena (never materializing the whole payload in
+    this process beyond one chunk) and return (("arena", offset, size),
+    is_error); with no dest_store large pulls assemble bytes. Returns None
+    if the remote no longer has the object (caller re-locates).
+    """
+    cfg = global_config()
+    conn = None
+    created = False
+    try:
+        conn = mpc.Client(address=tuple(address), family="AF_INET",
+                          authkey=authkey)
+        conn.send(("pull", oid.binary()))
+        msg = conn.recv()
+        if msg[0] != "meta":
+            return None
+        size, is_err = msg[1], msg[2]
+        inline = size <= cfg.max_direct_call_object_size or dest_store is None
+        if inline:
+            buf = bytearray()
+            while len(buf) < size:
+                data = conn.recv_bytes()
+                if not data:
+                    return None
+                buf += data
+            return bytes(buf), is_err
+        offset, view = dest_store.create(oid, size)
+        created = True
+        got = 0
+        while got < size:
+            data = conn.recv_bytes()
+            if not data:
+                dest_store.delete(oid)
+                return None
+            view[got:got + len(data)] = data
+            got += len(data)
+        dest_store.seal(oid, is_err)
+        return ("arena", offset, size), is_err
+    except (EOFError, OSError, ValueError):
+        # connect refused / source died mid-stream: drop any partial,
+        # unsealed arena entry so the space is reclaimable, and report
+        # "unavailable" so the caller re-locates
+        if created:
+            try:
+                dest_store.delete(oid)
+            except Exception:
+                pass
+        return None
+    finally:
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def pull_payload(address, authkey: bytes, oid: ObjectID):
+    """Pull as bytes regardless of size (driver-side get)."""
+    res = pull_object(address, authkey, oid, dest_store=None)
+    if res is None:
+        raise ObjectLostError(oid, "remote node no longer has the object")
+    return res

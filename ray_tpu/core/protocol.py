@@ -111,9 +111,30 @@ class Future:
         return self._value
 
 
-def make_listener(path: str, authkey: bytes) -> mpc.Listener:
-    return mpc.Listener(address=path, family="AF_UNIX", authkey=authkey)
+def make_listener(address, authkey: bytes) -> mpc.Listener:
+    """Listener over a unix socket (str path) or TCP ((host, port) tuple).
+
+    TCP is the multi-host transport — the analog of the reference's gRPC
+    server sockets (src/ray/rpc/grpc_server.h); auth uses the
+    multiprocessing HMAC challenge with the cluster key.
+    """
+    if isinstance(address, str):
+        return mpc.Listener(address=address, family="AF_UNIX", authkey=authkey)
+    return mpc.Listener(address=tuple(address), family="AF_INET",
+                        authkey=authkey)
 
 
-def connect(path: str, authkey: bytes) -> Channel:
-    return Channel(mpc.Client(address=path, family="AF_UNIX", authkey=authkey))
+def connect(address, authkey: bytes) -> Channel:
+    if isinstance(address, str):
+        return Channel(mpc.Client(address=address, family="AF_UNIX",
+                                  authkey=authkey))
+    return Channel(mpc.Client(address=tuple(address), family="AF_INET",
+                              authkey=authkey))
+
+
+def parse_address(addr: str):
+    """"host:port" -> (host, port); anything else is a unix-socket path."""
+    if ":" in addr and not addr.startswith("/"):
+        host, _, port = addr.rpartition(":")
+        return (host, int(port))
+    return addr

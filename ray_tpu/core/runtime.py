@@ -70,6 +70,64 @@ class ActorRecord:
     death_cause: Optional[str] = None
 
 
+class NodeProxy:
+    """Head-side handle to a node daemon running in another OS process/host.
+
+    Implements the slice of the Node interface the Head drives (dispatch,
+    actor-worker dispatch, kill/cancel, store delete) by forwarding over the
+    daemon's TCP channel; object payloads move separately via direct
+    node-to-node pulls (object_transfer.py). Analog of the reference's
+    per-raylet gRPC clients (node_manager.proto lease/cancel RPCs)."""
+
+    def __init__(self, head, node_id: NodeID, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]], channel,
+                 object_addr, pid: Optional[int]):
+        from .resources import NodeResources
+
+        self.head = head
+        self.node_id = node_id
+        self.hex = node_id.hex()
+        unit = set(global_config().unit_instance_resources.split(","))
+        self.resources = NodeResources(resources, unit_instance_names=unit)
+        self.resources.labels = labels or {}
+        self.resources_total = dict(resources)
+        self.labels = labels or {}
+        self.channel = channel
+        self.object_addr = tuple(object_addr)
+        self.pid = pid
+        self.alive = True
+
+    def _send(self, tag: str, *payload) -> bool:
+        try:
+            self.channel.send(tag, *payload)
+            return True
+        except (OSError, EOFError, ValueError):
+            return False
+
+    def dispatch(self, spec: TaskSpec, binding: dict) -> None:
+        # a failed send is handled like node death: the channel reader's EOF
+        # fires remove_node, which retries RUNNING tasks recorded on this node
+        self._send("dispatch", pickle.dumps(spec), binding)
+
+    def dispatch_to_worker(self, worker_id: WorkerID, spec: TaskSpec) -> bool:
+        # optimistic: a dead worker is reported back by the daemon
+        return self._send("dispatch_worker", worker_id, pickle.dumps(spec))
+
+    def kill_worker(self, worker_id: WorkerID) -> None:
+        self._send("kill_worker", worker_id)
+
+    def cancel_task(self, task_id, worker_id, force: bool) -> None:
+        self._send("cancel", task_id, worker_id, force)
+
+    def store_delete(self, oid: ObjectID) -> None:
+        self._send("store_delete", oid)
+
+    def shutdown(self) -> None:
+        self.alive = False
+        self._send("shutdown")
+        self.channel.close()
+
+
 class Head:
     """Cluster brain living in the driver process."""
 
@@ -89,6 +147,10 @@ class Head:
         self._waiting_on: Dict[ObjectID, Set[TaskID]] = defaultdict(set)
         self.ref_counts: Dict[ObjectID, int] = defaultdict(int)
         self._stopped = False
+        self._node_listener = None
+        self.node_server_address = None
+        self._cluster_key: Optional[bytes] = None
+        self._daemon_pool = None
         # head node (the driver's node)
         self.head_node = self.add_node(resources, labels=labels)
 
@@ -97,6 +159,8 @@ class Head:
     def add_node(self, resources: Dict[str, float],
                  labels: Optional[Dict[str, str]] = None) -> Node:
         node = Node(self, NodeID.from_random(), resources, self.session_dir, labels)
+        if self._cluster_key is not None:
+            node.start_object_server(self._cluster_key)
         with self._lock:
             self.nodes[node.hex] = node
         self.gcs.register_node(NodeInfo(node.node_id, node.hex,
@@ -104,6 +168,207 @@ class Head:
                                         labels=labels or {}))
         self.scheduler.add_node(node.hex, node.resources)
         return node
+
+    # --------------------------------------------------------- multi-host
+    @staticmethod
+    def _is_local(node) -> bool:
+        return hasattr(node, "store")
+
+    def start_node_server(self, host: str = "127.0.0.1", port: int = 0):
+        """Open the TCP join endpoint for remote node daemons and start
+        object servers on local nodes so daemons can pull from them.
+
+        Analog of the GCS server socket + per-node ObjectManager listeners
+        (gcs_server_main.cc / object_manager.proto:61). Returns (host, port).
+        """
+        from concurrent.futures import ThreadPoolExecutor
+
+        from .protocol import make_listener
+
+        if self._node_listener is not None:
+            return self.node_server_address
+        self._cluster_key = os.urandom(16)
+        self._node_listener = make_listener((host, port), self._cluster_key)
+        self.node_server_address = self._node_listener.address
+        self._daemon_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="daemon-req")
+        with self._lock:
+            nodes = [n for n in self.nodes.values() if self._is_local(n)]
+        for n in nodes:
+            n.start_object_server(self._cluster_key)
+        threading.Thread(target=self._node_accept_loop, daemon=True,
+                         name="node-server").start()
+        return self.node_server_address
+
+    @property
+    def cluster_key_hex(self) -> Optional[str]:
+        return self._cluster_key.hex() if self._cluster_key else None
+
+    def _node_accept_loop(self) -> None:
+        import multiprocessing.context as _mpctx
+
+        from .protocol import Channel
+
+        while not self._stopped:
+            try:
+                conn = self._node_listener.accept()
+            except (OSError, EOFError, _mpctx.AuthenticationError):
+                # a client dropping mid-handshake raises here too; only a
+                # closed listener (shutdown) ends the loop
+                if self._stopped or self._node_listener is None:
+                    return
+                continue
+            threading.Thread(target=self._register_daemon,
+                             args=(Channel(conn),), daemon=True).start()
+
+    def _register_daemon(self, channel) -> None:
+        if self._stopped:
+            channel.close()
+            return
+        try:
+            tag, _ = channel.recv()
+            assert tag == "hello"
+            node_id = NodeID.from_random()
+            channel.send("welcome", {
+                "node_hex": node_id.hex(),
+                "job_id": self.job_id.binary(),
+                "config": global_config().to_json(),
+            })
+            tag, (ready,) = channel.recv()
+            assert tag == "node_ready"
+        except Exception:
+            channel.close()
+            return
+        proxy = NodeProxy(self, node_id, ready["resources"],
+                          ready.get("labels"), channel,
+                          ready["object_addr"], ready.get("pid"))
+        if self._stopped:
+            proxy.shutdown()
+            return
+        with self._lock:
+            self.nodes[proxy.hex] = proxy
+        self.gcs.register_node(NodeInfo(node_id, proxy.hex,
+                                        resources_total=dict(ready["resources"]),
+                                        labels=proxy.labels))
+        self.scheduler.add_node(proxy.hex, proxy.resources)
+        threading.Thread(target=self._daemon_reader, args=(proxy,),
+                         daemon=True, name=f"daemon-{proxy.hex[:6]}").start()
+
+    def _daemon_reader(self, proxy: "NodeProxy") -> None:
+        import types
+
+        while True:
+            try:
+                tag, payload = proxy.channel.recv()
+            except (EOFError, OSError):
+                if not self._stopped and proxy.alive:
+                    proxy.alive = False
+                    self.remove_node(proxy.hex)
+                return
+            if tag == "task_finished":
+                task_id, err_name, spec_b, binding, results, worker_id = payload
+                spec = pickle.loads(spec_b) if spec_b else None
+                self.on_task_finished(proxy, task_id, err_name, spec, binding,
+                                      results, worker_id=worker_id)
+            elif tag == "sealed":
+                self.on_object_sealed(payload[0], proxy.hex)
+            elif tag == "worker_exit":
+                w = types.SimpleNamespace(worker_id=payload[0],
+                                          actor_id=payload[1], pid=payload[2])
+                self.on_worker_exit(proxy, w)
+            elif tag == "worker_crashed":
+                wid, actor_id, pid, spec_b, binding, prev_state = payload
+                w = types.SimpleNamespace(worker_id=wid, actor_id=actor_id,
+                                          pid=pid)
+                spec = pickle.loads(spec_b) if spec_b else None
+                self.on_worker_crashed(proxy, w, spec, binding, prev_state)
+            elif tag == "dispatch_worker_failed":
+                task_id, actor_id = payload
+                rec = self.tasks.get(task_id)
+                if rec is not None:
+                    self._handle_task_failure(
+                        rec, ActorDiedError(actor_id, "actor node/worker gone"),
+                        None)
+            elif tag == "req":
+                req_id, op, args = payload
+                self._daemon_pool.submit(self._handle_daemon_req, proxy,
+                                         req_id, op, args)
+
+    def _handle_daemon_req(self, proxy, req_id: int, op: str, args) -> None:
+        try:
+            if op == "locate":
+                result = self._locate_for_daemon(*args)
+            elif op == "wait_objects":
+                result = self.wait_objects(*args)
+            elif op == "worker_rpc":
+                result = self.handle_worker_rpc(None, None, args[0], args[1])
+            elif op == "is_pinned":
+                result = self.ref_counts.get(args[0], 0) > 0
+            elif op == "drop_location":
+                oid, node_hex = args
+                self.gcs.remove_object_location(oid, node_hex)
+                result = None
+            else:
+                raise ValueError(f"unknown daemon req {op!r}")
+            proxy._send("rep", req_id, True, result)
+        except Exception as e:  # noqa: BLE001
+            proxy._send("rep", req_id, False, e)
+
+    def _locate_for_daemon(self, oid: ObjectID, timeout: float):
+        """One bounded wait round of the daemon's object-location loop.
+
+        Small objects on local nodes are returned inline (saves a pull
+        round-trip — the analog of inline returns <100KB); otherwise the
+        daemon gets object-server addresses to pull from directly.
+        """
+        cfg = global_config()
+        deadline = time.monotonic() + timeout
+        attempted_reconstruction = False
+        while True:
+            with self._lock:
+                locs = [h for h in self.gcs.get_object_locations(oid)
+                        if h in self.nodes]
+                nodes = [self.nodes[h] for h in locs]
+            addrs = []
+            for h, n in zip(locs, nodes):
+                if self._is_local(n):
+                    meta = n.store.read_meta(oid)
+                    if meta and meta[0] <= cfg.max_direct_call_object_size:
+                        try:
+                            data, is_err = n.store.get_payload(oid)
+                            return ("inline", bytes(data), is_err)
+                        except ObjectLostError:
+                            continue
+                    srv = getattr(n, "object_server", None)
+                    if srv is not None:
+                        addrs.append((h, srv.address))
+                else:
+                    addrs.append((h, n.object_addr))
+            if addrs:
+                return ("locs", addrs)
+            if not attempted_reconstruction and not locs:
+                attempted_reconstruction = self._maybe_reconstruct(oid)
+            with self._object_cv:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return ("timeout",)
+                self._object_cv.wait(min(remaining, 0.2))
+
+    def _pull_from_proxy(self, proxy: "NodeProxy", oid: ObjectID, dest_store):
+        """Pull an object from a remote node directly into ``dest_store``
+        (chunked; driver memory holds at most one chunk). Returns
+        ("inline", bytes, is_err) or ("arena", off, size, is_err)."""
+        from .object_transfer import pull_object
+
+        res = pull_object(proxy.object_addr, self._cluster_key, oid,
+                          dest_store=dest_store)
+        if res is None:
+            raise ObjectLostError(oid, "remote node no longer has the object")
+        body, is_err = res
+        if isinstance(body, tuple):
+            _, off, size = body
+            return ("arena", off, size, is_err)
+        return ("inline", body, is_err)
 
     def remove_node(self, node_hex: str) -> None:
         """Simulate/handle node death (reference: gcs_node_manager node death
@@ -218,9 +483,10 @@ class Head:
 
     # ------------------------------------------------------------ completion
 
-    def on_task_finished(self, node: Node, task_id: TaskID, err_name: Optional[str],
+    def on_task_finished(self, node, task_id: TaskID, err_name: Optional[str],
                          node_spec: Optional[TaskSpec], node_binding: Optional[dict],
-                         results: List[Tuple[ObjectID, Optional[bytes], bool]]) -> None:
+                         results: List[Tuple[ObjectID, Optional[bytes], bool]],
+                         worker_id: Optional[WorkerID] = None) -> None:
         with self._lock:
             rec = self.tasks.get(task_id)
         if rec is None:
@@ -255,7 +521,7 @@ class Head:
         self._record_event(spec, "FINISHED", node.hex)
         self._seal_results(node, results)
         if spec.is_actor_creation:
-            self._on_actor_alive(spec, node)
+            self._on_actor_alive(spec, node, worker_id)
         if spec.actor_id is not None and not spec.is_actor_creation:
             with self._lock:
                 arec = self.actors.get(spec.actor_id)
@@ -263,10 +529,16 @@ class Head:
                     arec.inflight.discard(task_id)
         self._after_seal(spec)
 
-    def _seal_results(self, node: Node, results) -> None:
+    def _seal_results(self, node, results) -> None:
+        # Remote (proxy) nodes have no in-process store: inline results ride
+        # the control channel and land in the head node's store (the analog
+        # of the owner's in-process memory store).
+        store_node = node if hasattr(node, "store") else self.head_node
         for oid, payload, is_error in results:
             if payload is not None:
-                node.store.put_inline(oid, payload, is_error)
+                store_node.store.put_inline(oid, payload, is_error)
+                if store_node is not node:
+                    self.gcs.add_object_location(oid, store_node.hex)
             self.on_object_sealed(oid, node.hex)
 
     def _after_seal(self, spec: TaskSpec) -> None:
@@ -340,7 +612,8 @@ class Head:
 
     # ------------------------------------------------------------ actors
 
-    def _on_actor_alive(self, spec: TaskSpec, node: Node) -> None:
+    def _on_actor_alive(self, spec: TaskSpec, node,
+                        worker_id: Optional[WorkerID] = None) -> None:
         flush = []
         with self._lock:
             arec = self.actors.get(spec.actor_id)
@@ -348,11 +621,14 @@ class Head:
                 return
             arec.state = "ALIVE"
             arec.node_hex = node.hex
-            with node._lock:
-                for w in node._workers.values():
-                    if w.actor_id == spec.actor_id:
-                        arec.worker_id = w.worker_id
-                        break
+            if worker_id is not None:
+                arec.worker_id = worker_id
+            elif hasattr(node, "_workers"):
+                with node._lock:
+                    for w in node._workers.values():
+                        if w.actor_id == spec.actor_id:
+                            arec.worker_id = w.worker_id
+                            break
             while arec.pending:
                 flush.append(arec.pending.popleft())
         self.gcs.update_actor(spec.actor_id, state="ALIVE", node_hex=node.hex)
@@ -514,15 +790,30 @@ class Head:
                 locs = self.gcs.get_object_locations(oid)
                 node = None
                 for h in locs:
-                    if h in self.nodes:
-                        node = self.nodes[h]
-                        break
-            if node is not None:
+                    cand = self.nodes.get(h)
+                    if cand is None:
+                        continue
+                    if node is None or (self._is_local(cand)
+                                        and not self._is_local(node)):
+                        node = cand  # prefer a local (zero-copy) location
+            if node is not None and self._is_local(node):
                 try:
                     return node.store.get_payload(oid)
                 except ObjectLostError:
                     self.gcs.remove_object_location(oid, node.hex)
                     continue
+            if node is not None:
+                # remote daemon: chunked pull; large payloads land in the
+                # head node's store (cached location for future reads)
+                try:
+                    rep = self._pull_from_proxy(node, oid, self.head_node.store)
+                except ObjectLostError:
+                    self.gcs.remove_object_location(oid, node.hex)
+                    continue
+                if rep[0] == "inline":
+                    return rep[1], rep[2]
+                self.on_object_sealed(oid, self.head_node.hex)
+                return self.head_node.store.get_payload(oid)
             # no live location: try lineage reconstruction once
             if not attempted_reconstruction and locs == set():
                 if self._maybe_reconstruct(oid):
@@ -569,6 +860,15 @@ class Head:
                 locs = [h for h in self.gcs.get_object_locations(oid) if h in self.nodes]
             if locs:
                 src = self.nodes[locs[0]]
+                if not self._is_local(src):
+                    try:
+                        rep = self._pull_from_proxy(src, oid, node.store)
+                    except ObjectLostError:
+                        self.gcs.remove_object_location(oid, src.hex)
+                        continue
+                    if rep[0] == "arena":
+                        self.on_object_sealed(oid, node.hex)
+                    return rep
                 try:
                     payload, is_err = src.store.get_payload(oid)
                 except ObjectLostError:
@@ -611,8 +911,11 @@ class Head:
             locs = self.gcs.get_object_locations(oid)
             for h in locs:
                 node = self.nodes.get(h)
-                if node:
-                    node.store.delete(oid)
+                if node is not None:
+                    if self._is_local(node):
+                        node.store.delete(oid)
+                    else:
+                        node.store_delete(oid)
                 self.gcs.remove_object_location(oid, h)
 
     # ------------------------------------------------------------ worker RPC
@@ -695,22 +998,7 @@ class Head:
             node = self.nodes.get(rec.node_hex) if rec.node_hex else None
             worker_id = rec.worker_id  # set for actor tasks at dispatch
         if rec.state == "RUNNING" and node is not None:
-            with node._lock:
-                target = None
-                if worker_id is not None:
-                    target = node._workers.get(worker_id)
-                else:
-                    for w in node._workers.values():
-                        if w.current_task is not None and w.current_task.task_id == tid:
-                            target = w
-                            break
-            if target is not None:
-                try:
-                    target.channel.send("cancel", tid)
-                except OSError:
-                    pass
-                if force:
-                    node.kill_worker(target.worker_id)
+            node.cancel_task(tid, worker_id, force)
 
     def _record_event(self, spec: TaskSpec, state: str, node_hex=None, error=None):
         self.gcs.record_task_event(TaskEvent(
@@ -721,6 +1009,14 @@ class Head:
     def shutdown(self) -> None:
         self._stopped = True
         self.scheduler.stop()
+        if self._node_listener is not None:
+            try:
+                self._node_listener.close()
+            except OSError:
+                pass
+            self._node_listener = None
+        if self._daemon_pool is not None:
+            self._daemon_pool.shutdown(wait=False)
         with self._lock:
             nodes = list(self.nodes.values())
             self.nodes.clear()

@@ -1,0 +1,141 @@
+"""Multi-host control plane: separate-OS-process nodes joining over TCP.
+
+The round-2 milestone the round-1 review demanded: a real process boundary
+between head and node (reference: raylet main.cc as its own process, gRPC
+lease protocol node_manager.cc:1794), with direct chunked node-to-node
+object transfer (object_manager.h:117) instead of driver-mediated copies.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def two_host_cluster():
+    c = Cluster(head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2, resources={"remote": 4}, separate_process=True)
+    yield c
+    c.shutdown()
+
+
+def test_remote_node_tasks_actors_objects(two_host_cluster):
+    @ray_tpu.remote(resources={"remote": 1})
+    def double(x):
+        import os
+
+        return os.getpid(), x * 2
+
+    pid, v = ray_tpu.get(double.remote(21))
+    assert v == 42
+
+    # large result produced on the remote node, chunk-pulled by the driver
+    @ray_tpu.remote(resources={"remote": 1})
+    def big():
+        return np.arange(2_000_000, dtype=np.int64)
+
+    arr = ray_tpu.get(big.remote())
+    assert arr.shape == (2_000_000,) and int(arr[-1]) == 1_999_999
+
+    # large driver put consumed on the remote node (pull from head's server)
+    ref = ray_tpu.put(np.ones(1_500_000, dtype=np.float64))
+
+    @ray_tpu.remote(resources={"remote": 1})
+    def consume(a):
+        return float(a.sum())
+
+    assert ray_tpu.get(consume.remote(ref)) == 1_500_000.0
+
+    # actor on the remote node, ordered state
+    @ray_tpu.remote(resources={"remote": 1})
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def inc(self, n):
+            self.v += n
+            return self.v
+
+    a = Counter.remote()
+    assert ray_tpu.get([a.inc.remote(5), a.inc.remote(7)]) == [5, 12]
+
+
+def test_nested_submission_and_named_actor(two_host_cluster):
+    @ray_tpu.remote(resources={"remote": 1})
+    class Registry:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return len(self.items)
+
+    Registry.options(name="reg").remote()
+
+    @ray_tpu.remote(resources={"remote": 1})
+    def nested():
+        # worker-side get_actor + actor call + nested task, all over the
+        # daemon's RPC passthrough to the head
+        reg = ray_tpu.get_actor("reg")
+
+        @ray_tpu.remote
+        def inner(y):
+            return y + 1
+
+        v = ray_tpu.get(inner.remote(10))
+        return ray_tpu.get(reg.add.remote(v))
+
+    assert ray_tpu.get(nested.remote()) == 1
+
+
+def test_node_death_retries_on_survivor():
+    c = Cluster(head_node_args={"num_cpus": 2})
+    n2 = c.add_node(num_cpus=2, separate_process=True)
+    try:
+        @ray_tpu.remote(max_retries=2, num_cpus=1)
+        def slow(i):
+            import os
+            import time as _t
+
+            _t.sleep(2)
+            return os.getpid()
+
+        futs = [slow.remote(i) for i in range(4)]
+        time.sleep(0.8)
+        c._procs[0].kill()  # daemon dies with tasks in flight
+        pids = ray_tpu.get(futs, timeout=90)
+        assert len(pids) == 4
+        alive = {n["NodeID"]: n["Alive"] for n in ray_tpu.nodes()}
+        assert alive[n2.hex] is False
+    finally:
+        c.shutdown()
+
+
+def test_train_gang_across_hosts():
+    """JaxTrainer-style gang: one worker on each OS process (CPU jax)."""
+    c = Cluster(head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=2, resources={"remote": 4}, separate_process=True)
+    try:
+        from ray_tpu.train import JaxTrainer, ScalingConfig
+
+        def train_loop(config):
+            import ray_tpu.train as train
+
+            ctx = train.get_context()
+            # both ranks report; world assembled across two OS processes
+            train.report({"rank": ctx.get_world_rank(),
+                          "world": ctx.get_world_size()})
+
+        trainer = JaxTrainer(
+            train_loop_per_worker=train_loop,
+            scaling_config=ScalingConfig(num_workers=2,
+                                         resources_per_worker={"CPU": 1}),
+        )
+        result = trainer.fit()
+        assert result.metrics["world"] == 2
+    finally:
+        c.shutdown()
