@@ -1,0 +1,159 @@
+"""Core runtime microbenchmarks.
+
+Port of the reference's microbenchmark op set
+(/root/reference/python/ray/_private/ray_perf.py:120-315): put/get rates,
+task submit/round-trip rates, actor call rates, wait. Run:
+
+    python bench_core.py [--ops op1,op2] [--json]
+
+Prints one line per op; with --json, a JSON object of all results. These
+are the regression gates for the control/object planes (the tensor plane is
+bench.py's job).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def timeit(name, fn, multiplier=1, warmup=1, min_time=1.0):
+    for _ in range(warmup):
+        fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    print(f"{name:<42s} {rate:>12.1f} /s")
+    return rate
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="", help="comma-separated subset")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--num-cpus", type=int, default=4)
+    args = ap.parse_args()
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=args.num_cpus)
+    results = {}
+    selected = set(args.ops.split(",")) if args.ops else None
+
+    def run(name, fn, multiplier=1):
+        if selected and name not in selected:
+            return
+        results[name] = timeit(name, fn, multiplier)
+
+    # ---- objects ----------------------------------------------------------
+    small = b"x" * 1024
+
+    def put_small():
+        for _ in range(100):
+            ray_tpu.put(small)
+
+    run("put_small_1kb", put_small, 100)
+
+    ref = ray_tpu.put(small)
+
+    def get_small():
+        for _ in range(100):
+            ray_tpu.get(ref)
+
+    run("get_small_1kb", get_small, 100)
+
+    big = b"x" * (100 * 1024 * 1024)
+
+    def put_100mb():
+        r = ray_tpu.put(big)
+        del r
+
+    run("put_100mb", put_100mb, 1)
+
+    bref = ray_tpu.put(big)
+
+    def get_100mb():
+        ray_tpu.get(bref)
+
+    run("get_100mb", get_100mb, 1)
+
+    # ---- tasks ------------------------------------------------------------
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    ray_tpu.get(nop.remote())
+
+    def task_sync():
+        ray_tpu.get(nop.remote())
+
+    run("task_round_trip_sync", task_sync, 1)
+
+    def tasks_async_batch():
+        ray_tpu.get([nop.remote() for _ in range(1000)])
+
+    run("tasks_async_batch_1k", tasks_async_batch, 1000)
+
+    @ray_tpu.remote
+    def nop_arg(x):
+        return x
+
+    sref = ray_tpu.put(small)
+
+    def tasks_with_arg():
+        ray_tpu.get([nop_arg.remote(sref) for _ in range(100)])
+
+    run("tasks_with_object_arg", tasks_with_arg, 100)
+
+    # ---- actors -----------------------------------------------------------
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return b"ok"
+
+        async def am(self):
+            return b"ok"
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+
+    def actor_sync():
+        ray_tpu.get(a.m.remote())
+
+    run("actor_call_sync", actor_sync, 1)
+
+    def actor_async_batch():
+        ray_tpu.get([a.m.remote() for _ in range(1000)])
+
+    run("actor_calls_batch_1k", actor_async_batch, 1000)
+
+    aa = A.options(max_concurrency=8).remote()
+    ray_tpu.get(aa.am.remote())
+
+    def async_actor_batch():
+        ray_tpu.get([aa.am.remote() for _ in range(1000)])
+
+    run("async_actor_calls_batch_1k", async_actor_batch, 1000)
+
+    # ---- wait -------------------------------------------------------------
+    def wait_one():
+        refs = [nop.remote() for _ in range(10)]
+        ray_tpu.wait(refs, num_returns=1)
+        ray_tpu.get(refs)
+
+    run("wait_first_of_10", wait_one, 10)
+
+    ray_tpu.shutdown()
+    if args.json:
+        print(json.dumps(results))
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,10 @@ class Config:
     # worker_pool.h:163 num_prestarted_python_workers)
     worker_register_timeout_s: float = 60.0
     worker_lease_idle_timeout_s: float = 5.0
+    # plain CPU tasks staged per worker beyond the running one (lease
+    # pipelining, reference: normal_task_submitter.h worker_to_lease_entry_
+    # + max_tasks_in_flight; hides the done->dispatch round trip)
+    worker_pipeline_depth: int = 2
 
     # ---- tasks / fault tolerance (reference: ray_config_def.h:138,414,835) ----
     task_retry_delay_ms: int = 0

@@ -38,8 +38,10 @@ class WorkerHandle:
     pid: int
     proc: Optional[subprocess.Popen] = None
     state: str = "starting"  # starting | idle | busy | actor | dead
-    current_task: Optional[TaskSpec] = None
-    current_binding: Optional[dict] = None
+    # in-flight plain tasks staged on this worker (lease pipelining:
+    # > 1 entry means the next task is already in the worker's memory
+    # when the current one finishes)
+    assigned: Dict[object, Tuple[TaskSpec, dict]] = field(default_factory=dict)
     actor_id: Optional[object] = None
     reader: Optional[threading.Thread] = None
 
@@ -103,16 +105,32 @@ class Node:
             return False
 
     def _pump(self) -> None:
-        """Match queued tasks with idle workers; start workers as needed."""
+        """Match queued tasks with idle workers; start workers as needed.
+
+        When no worker is idle, plain unbound tasks are staged onto a busy
+        plain-task worker up to ``worker_pipeline_depth`` deep (reference:
+        normal_task_submitter lease pipelining) so the worker starts the
+        next task without waiting out the done->dispatch round trip.
+        """
+        depth = max(1, global_config().worker_pipeline_depth)
         to_send: List[Tuple[WorkerHandle, TaskSpec, dict]] = []
         with self._lock:
             while self._local_queue:
+                spec, binding = self._local_queue[0]
                 w = None
                 while self._idle:
                     cand = self._idle.popleft()
                     if cand.state == "idle":
                         w = cand
                         break
+                if (w is None and not spec.is_actor_creation and not binding):
+                    for cand in self._workers.values():
+                        if (cand.state == "busy"
+                                and len(cand.assigned) < depth
+                                and all(not s.is_actor_creation and not b
+                                        for s, b in cand.assigned.values())):
+                            w = cand
+                            break
                 if w is None:
                     # Start a new worker if under limit. Queued actor
                     # creations each get a dedicated worker beyond the pool.
@@ -123,14 +141,27 @@ class Node:
                     if active < limit:
                         self._start_worker_locked()
                     break
-                spec, binding = self._local_queue.popleft()
+                self._local_queue.popleft()
                 w.state = "busy"
-                w.current_task = spec
-                w.current_binding = binding
+                w.assigned[spec.task_id] = (spec, binding)
                 to_send.append((w, spec, binding))
+            # rescue: a worker is idle (or starting) with nothing queued
+            # while another worker has staged-unstarted tasks — ask for one
+            # back so it isn't stuck behind a long/blocked task
+            unstage: List[Tuple[WorkerHandle, object]] = []
+            if not self._local_queue and (self._idle or self._num_starting):
+                for cand in self._workers.values():
+                    if cand.state == "busy" and len(cand.assigned) > 1:
+                        last_tid = next(reversed(cand.assigned))
+                        unstage.append((cand, last_tid))
         for w, spec, binding in to_send:
             try:
                 w.channel.send("exec", pickle.dumps(spec), binding)
+            except OSError:
+                self._on_worker_dead(w)
+        for w, tid in unstage:
+            try:
+                w.channel.send("unstage", tid)
             except OSError:
                 self._on_worker_dead(w)
 
@@ -227,6 +258,18 @@ class Node:
             elif tag == "release":
                 for oid in payload[0]:
                     self.store.remove_ref(oid)
+            elif tag == "unstaged":
+                # worker handed back a staged-unstarted task: requeue it
+                tid = payload[0]
+                with self._lock:
+                    entry = w.assigned.pop(tid, None)
+                    if entry is not None:
+                        self._local_queue.appendleft(entry)
+                        if w.state == "busy" and not w.assigned:
+                            w.state = "idle"
+                            self._idle.append(w)
+                if entry is not None:
+                    self._pump()
             elif tag == "exit":
                 # graceful actor exit
                 self._on_worker_exit(w)
@@ -277,22 +320,19 @@ class Node:
     # ------------------------------------------------------------ lifecycle
 
     def _on_task_done(self, w: WorkerHandle, task_id, results, err_name) -> None:
-        spec = w.current_task
         with self._lock:
-            if spec is not None and spec.task_id == task_id:
-                w.current_task = None
-                binding = w.current_binding
-                w.current_binding = None
+            entry = w.assigned.pop(task_id, None)
+            if entry is not None:
+                spec, binding = entry
                 if spec.is_actor_creation and err_name is None:
                     w.state = "actor"
                     w.actor_id = spec.actor_id
-                elif w.state == "busy":
+                elif w.state == "busy" and not w.assigned:
                     w.state = "idle"
                     self._idle.append(w)
             else:
-                binding = None
                 # actor task done (worker stays "actor") or stale
-                spec = None
+                spec, binding = None, None
         # The head decides whether to seal results (it may retry instead).
         self.head.on_task_finished(self, task_id, err_name, spec, binding,
                                    results, worker_id=w.worker_id)
@@ -311,10 +351,14 @@ class Node:
             prev_state = w.state
             w.state = "dead"
             self._workers.pop(w.worker_id, None)
-            spec = w.current_task
-            binding = w.current_binding
+            assigned = list(w.assigned.values())
+            w.assigned.clear()
         w.channel.close()
-        self.head.on_worker_crashed(self, w, spec, binding, prev_state)
+        if assigned:
+            for spec, binding in assigned:
+                self.head.on_worker_crashed(self, w, spec, binding, prev_state)
+        else:
+            self.head.on_worker_crashed(self, w, None, None, prev_state)
         self._pump()
 
     def cancel_task(self, task_id, worker_id: Optional[WorkerID],
@@ -327,8 +371,7 @@ class Node:
                 target = self._workers.get(worker_id)
             else:
                 for w in self._workers.values():
-                    if w.current_task is not None and \
-                            w.current_task.task_id == task_id:
+                    if task_id in w.assigned:
                         target = w
                         break
         if target is None:

@@ -143,6 +143,21 @@ class RemoteHead:
                    binding, prev_state)
 
     def handle_worker_rpc(self, node, w, op: str, args):
+        if op == "pg_ready":
+            # bounded rounds: an hour-long blocking wait would pin one of
+            # the head's 16 daemon-request threads (pool starvation)
+            pg_id, timeout = args
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while True:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                round_t = (2.0 if remaining is None
+                           else max(0.0, min(remaining, 2.0)))
+                ready = self.rpc.call("req", "worker_rpc",
+                                      ("pg_ready", [pg_id, round_t]),
+                                      timeout=round_t + 30.0)
+                if ready or (remaining is not None and remaining <= round_t):
+                    return ready
         return self.rpc.call("req", "worker_rpc", (op, list(args)))
 
     def wait_objects(self, oids, num_returns, timeout):

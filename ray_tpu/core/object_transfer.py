@@ -17,6 +17,7 @@ Connections are per-pull; the OS socket buffer provides backpressure.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from multiprocessing import connection as mpc
 from typing import Optional, Tuple
@@ -24,6 +25,24 @@ from typing import Optional, Tuple
 from .config import global_config
 from .exceptions import ObjectLostError
 from .ids import ObjectID
+
+# Serialize concurrent pulls of the same object into the same store: two
+# racing create(oid) calls would free each other's in-flight arena offset
+# (object_store.py create() reclaims a stale entry's extent).
+_pull_locks: dict = {}
+_pull_locks_guard = threading.Lock()
+
+
+@contextlib.contextmanager
+def _pull_guard(dest_store, oid: ObjectID):
+    key = (id(dest_store), oid)
+    with _pull_locks_guard:
+        lock = _pull_locks.setdefault(key, threading.Lock())
+    with lock:
+        yield
+    with _pull_locks_guard:
+        if not lock.locked():
+            _pull_locks.pop(key, None)
 
 
 class ObjectServer:
@@ -106,6 +125,21 @@ def pull_object(address, authkey: bytes, oid: ObjectID,
     if the remote no longer has the object (caller re-locates).
     """
     cfg = global_config()
+    if dest_store is None:
+        return _pull_one(address, authkey, oid, None, cfg)
+    with _pull_guard(dest_store, oid):
+        # double-check: a racing pull may have landed it already
+        if dest_store.contains(oid):
+            info = dest_store.entry_info(oid)
+            if info is not None:
+                off, size, is_err = info
+                return ("arena", off, size), is_err
+            payload, is_err = dest_store.get_payload(oid)
+            return bytes(payload), is_err
+        return _pull_one(address, authkey, oid, dest_store, cfg)
+
+
+def _pull_one(address, authkey: bytes, oid: ObjectID, dest_store, cfg):
     conn = None
     created = False
     try:

@@ -495,11 +495,26 @@ class Head:
         spec = rec.spec
         # Release resources for non-actor-method tasks. A successful actor
         # creation keeps its resources for the actor's lifetime; a failed one
-        # must give them back.
+        # must give them back. The release runs through the lease-caching
+        # fast path: the next queued same-shape task comes back placed and is
+        # dispatched below on this same (node-reader) thread — no scheduler
+        # thread wakeup between tasks.
+        next_placed = None
         if spec.actor_id is None or spec.is_actor_creation:
             if not (spec.is_actor_creation and err_name is None):
-                self.scheduler.release(rec.node_hex or node.hex, spec,
-                                       rec.binding or node_binding or {})
+                next_placed = self.scheduler.complete_and_next(
+                    rec.node_hex or node.hex, spec,
+                    rec.binding or node_binding or {})
+        try:
+            self._settle_finished(rec, node, task_id, err_name, results,
+                                  worker_id)
+        finally:
+            if next_placed is not None:
+                self._dispatch_to_node(*next_placed)
+
+    def _settle_finished(self, rec: TaskRecord, node, task_id, err_name,
+                         results, worker_id) -> None:
+        spec = rec.spec
         if rec.cancelled:
             # already sealed TaskCancelledError; drop the late results
             return
@@ -533,13 +548,16 @@ class Head:
         # Remote (proxy) nodes have no in-process store: inline results ride
         # the control channel and land in the head node's store (the analog
         # of the owner's in-process memory store).
-        store_node = node if hasattr(node, "store") else self.head_node
+        is_proxy = not hasattr(node, "store")
+        store_node = self.head_node if is_proxy else node
         for oid, payload, is_error in results:
             if payload is not None:
                 store_node.store.put_inline(oid, payload, is_error)
-                if store_node is not node:
-                    self.gcs.add_object_location(oid, store_node.hex)
-            self.on_object_sealed(oid, node.hex)
+                # location = where the bytes actually are: inline results
+                # from a proxy node live only in the head store
+                self.on_object_sealed(oid, store_node.hex)
+            else:
+                self.on_object_sealed(oid, node.hex)
 
     def _after_seal(self, spec: TaskSpec) -> None:
         self.scheduler.kick()

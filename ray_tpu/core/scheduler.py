@@ -153,22 +153,45 @@ class ClusterScheduler:
     def release(self, node_hex: str, spec: TaskSpec, binding: dict) -> None:
         """Return a finished task's resources; wakes the dispatch loop."""
         with self._lock:
-            st = spec.scheduling_strategy
-            if st.kind == "PLACEMENT_GROUP" and st.placement_group_id in self._pgs:
-                pg = self._pgs[st.placement_group_id]
-                if pg.state == "REMOVED":
-                    # bundle reservation already returned its unused part;
-                    # the in-use part comes back directly to the node here
-                    nr = self._nodes.get(node_hex)
-                    if nr is not None:
-                        nr.release(spec.resources, binding)
-                elif 0 <= st.bundle_index < len(pg.bundles):
-                    pg.bundles[st.bundle_index].release(spec.resources, binding)
-            else:
+            self._release_locked(node_hex, spec, binding)
+            self._wake.notify_all()
+
+    def _release_locked(self, node_hex: str, spec: TaskSpec, binding: dict) -> None:
+        st = spec.scheduling_strategy
+        if st.kind == "PLACEMENT_GROUP" and st.placement_group_id in self._pgs:
+            pg = self._pgs[st.placement_group_id]
+            if pg.state == "REMOVED":
+                # bundle reservation already returned its unused part;
+                # the in-use part comes back directly to the node here
                 nr = self._nodes.get(node_hex)
                 if nr is not None:
                     nr.release(spec.resources, binding)
+            elif 0 <= st.bundle_index < len(pg.bundles):
+                pg.bundles[st.bundle_index].release(spec.resources, binding)
+        else:
+            nr = self._nodes.get(node_hex)
+            if nr is not None:
+                nr.release(spec.resources, binding)
+
+    def complete_and_next(self, node_hex: str, spec: TaskSpec, binding: dict):
+        """Release a finished task's resources and, in the same lock hold,
+        try to place the head-of-queue pending task — returning it for the
+        caller (the node reader thread) to dispatch directly.
+
+        This is the lease-caching fast path (reference:
+        normal_task_submitter.h:145 worker_to_lease_entry_): for streams of
+        same-shape tasks, completion -> next dispatch never touches the
+        scheduler thread, so no cv wakeup latency sits between tasks.
+        """
+        with self._lock:
+            self._release_locked(node_hex, spec, binding)
+            if self._pending and not self._stopped:
+                placed = self._try_place_locked(self._pending[0])
+                if placed is not None:
+                    self._pending.popleft()
+                    return placed
             self._wake.notify_all()
+        return None
 
     def kick(self) -> None:
         with self._lock:
@@ -187,10 +210,21 @@ class ClusterScheduler:
                 progress = self._try_schedule_pgs_locked()
                 ready: List[Tuple[str, TaskSpec, dict]] = []
                 still_pending = deque()
+                # Placements within a round only consume resources, so once a
+                # request signature fails to place, every later spec with the
+                # same signature fails too — skip them. Turns the O(queue)
+                # rescan per completion into O(1) for homogeneous batches
+                # (the 1M-calls-for-2k-tasks hot spot in bench_core.py).
+                failed_sigs = set()
                 while self._pending:
                     spec = self._pending.popleft()
+                    sig = self._request_sig(spec)
+                    if sig in failed_sigs:
+                        still_pending.append(spec)
+                        continue
                     placed = self._try_place_locked(spec)
                     if placed is None:
+                        failed_sigs.add(sig)
                         still_pending.append(spec)
                     else:
                         ready.append(placed)
@@ -205,6 +239,21 @@ class ClusterScheduler:
                         nr = self._nodes.get(node_hex)
                         if nr is not None:
                             nr.release(spec.resources, binding)
+
+    @staticmethod
+    def _request_sig(spec: TaskSpec):
+        """Hashable placement-equivalence key: same sig => same placeability
+        given fixed cluster resources. Cached on the spec."""
+        sig = getattr(spec, "_sched_sig", None)
+        if sig is None:
+            st = spec.scheduling_strategy
+            sig = (tuple(sorted(spec.resources.to_dict().items())), st.kind,
+                   getattr(st, "placement_group_id", None),
+                   getattr(st, "bundle_index", -1),
+                   str(getattr(st, "node_id", None)),
+                   getattr(st, "soft", False))
+            spec._sched_sig = sig
+        return sig
 
     def _try_place_locked(self, spec: TaskSpec) -> Optional[Tuple[str, TaskSpec, dict]]:
         st = spec.scheduling_strategy
@@ -225,6 +274,7 @@ class ClusterScheduler:
                     binding = b.acquire(spec.resources)
                     if st.bundle_index < 0:
                         st.bundle_index = i
+                        spec._sched_sig = None  # sig keyed on bundle_index
                     return b.node_hex, spec, binding
             return None
 

@@ -65,7 +65,12 @@ class WorkerRuntime:
         self.arena = ArenaClient(init_info["arena_path"], init_info["arena_capacity"])
         self._fn_cache: Dict[str, Any] = {}
         self._actors: Dict[ActorID, _ActorState] = {}
-        self._task_pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="exec")
+        # ONE thread: plain tasks execute strictly one-at-a-time per worker
+        # process (the ray semantic user code relies on for process-global
+        # state, e.g. jax). Staged (pipelined) tasks queue behind the
+        # running one and can be handed back via "unstage".
+        self._task_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="exec")
+        self._staged: Dict[object, Any] = {}  # task_id -> pending Future
         self._put_counter = 0
         self._put_lock = threading.Lock()
         self._current_task = threading.local()
@@ -232,10 +237,22 @@ class WorkerRuntime:
                     self._dispatch_exec(spec, binding)
                 elif tag == "cancel":
                     self._cancelled.add(payload[0])
+                elif tag == "unstage":
+                    # node reclaims a staged-but-unstarted task (another
+                    # worker went idle); only possible pre-execution, so
+                    # requeueing it elsewhere never duplicates side effects
+                    tid = payload[0]
+                    fut = self._staged.get(tid)
+                    if fut is not None and fut.cancel():
+                        self._staged.pop(tid, None)
+                        self.channel.send("unstaged", tid)
                 elif tag == "shutdown":
                     break
         finally:
             self._shutdown.set()
+            dump = getattr(self, "_profile_dump", None)
+            if dump is not None:
+                dump()  # os._exit skips atexit
             os._exit(0)
 
     def _dispatch_exec(self, spec: TaskSpec, binding: Dict[str, List[int]]) -> None:
@@ -254,7 +271,10 @@ class WorkerRuntime:
             else:
                 st.pool.submit(self._execute, spec, binding)
         else:
-            self._task_pool.submit(self._execute, spec, binding)
+            fut = self._task_pool.submit(self._execute, spec, binding)
+            self._staged[spec.task_id] = fut
+            fut.add_done_callback(
+                lambda _f, tid=spec.task_id: self._staged.pop(tid, None))
 
     async def _execute_async(self, spec: TaskSpec, st: _ActorState) -> None:
         try:
@@ -408,6 +428,11 @@ def worker_main(argv=None) -> None:
     from . import runtime as runtime_mod
 
     runtime_mod.set_current_runtime(runtime)
+    from ray_tpu.util.sampling_profiler import start_from_env
+
+    _dump_profile = start_from_env()  # RAY_TPU_SAMPLER=<prefix> to enable
+    if _dump_profile is not None:
+        runtime._profile_dump = _dump_profile
     runtime.serve_forever()
 
 

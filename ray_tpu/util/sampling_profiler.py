@@ -1,0 +1,59 @@
+"""In-process sampling profiler (all threads), env-var activated.
+
+The analog of attaching py-spy to a worker (reference debugging flow); used
+to find hot spots in worker/daemon processes where cProfile's single-thread
+view is useless. Activate with ``RAY_TPU_SAMPLER=/path/prefix`` — each
+process dumps ``<prefix>.<pid>`` at exit with stack-sample counts.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import os
+import sys
+import threading
+import time
+
+
+def start_from_env(env_var: str = "RAY_TPU_SAMPLER",
+                   interval_s: float = 0.002, depth: int = 8):
+    prefix = os.environ.get(env_var)
+    if not prefix:
+        return None
+    return start(f"{prefix}.{os.getpid()}", interval_s, depth)
+
+
+def start(path: str, interval_s: float = 0.002, depth: int = 8):
+    samples: collections.Counter = collections.Counter()
+    stop = threading.Event()
+    me = threading.get_ident()
+
+    def loop():
+        while not stop.is_set():
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                stack = []
+                f = frame
+                while f is not None and len(stack) < depth:
+                    stack.append(f"{f.f_code.co_name}:"
+                                 f"{os.path.basename(f.f_code.co_filename)}")
+                    f = f.f_back
+                samples["<".join(stack)] += 1
+            time.sleep(interval_s)
+
+    t = threading.Thread(target=loop, daemon=True, name="sampler")
+    t.start()
+
+    def dump():
+        stop.set()
+        try:
+            with open(path, "w") as f:
+                for k, v in samples.most_common(100):
+                    f.write(f"{v}\t{k}\n")
+        except OSError:
+            pass
+
+    atexit.register(dump)
+    return dump
