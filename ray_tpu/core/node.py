@@ -78,6 +78,7 @@ class Node:
         )
         self._accept_thread.start()
         self._num_starting = 0
+        self._registered_pids: set = set()
         with self._lock:
             for _ in range(min(cfg.worker_prestart_count, self.max_workers)):
                 self._start_worker_locked()
@@ -123,37 +124,46 @@ class Node:
                     if cand.state == "idle":
                         w = cand
                         break
-                if (w is None and not spec.is_actor_creation and not binding):
-                    for cand in self._workers.values():
-                        if (cand.state == "busy"
-                                and len(cand.assigned) < depth
-                                and all(not s.is_actor_creation and not b
-                                        for s, b in cand.assigned.values())):
-                            w = cand
-                            break
                 if w is None:
-                    # Start a new worker if under limit. Queued actor
-                    # creations each get a dedicated worker beyond the pool.
+                    # Prefer starting a new worker while under the limit —
+                    # staging must never strand a task behind a long task
+                    # when free capacity exists. Queued actor creations
+                    # each get a dedicated worker beyond the pool.
                     active = sum(1 for x in self._workers.values()
                                  if x.state in ("idle", "busy")) + self._num_starting
                     limit = self.max_workers + sum(
                         1 for s, _ in self._local_queue if s.is_actor_creation)
                     if active < limit:
                         self._start_worker_locked()
-                    break
+                        break
+                    # at capacity: stage onto a busy plain-task worker
+                    if not spec.is_actor_creation and not binding:
+                        for cand in self._workers.values():
+                            if (cand.state == "busy"
+                                    and len(cand.assigned) < depth
+                                    and all(not s.is_actor_creation and not b
+                                            for s, b in cand.assigned.values())):
+                                w = cand
+                                break
+                    if w is None:
+                        break
                 self._local_queue.popleft()
                 w.state = "busy"
                 w.assigned[spec.task_id] = (spec, binding)
                 to_send.append((w, spec, binding))
-            # rescue: a worker is idle (or starting) with nothing queued
-            # while another worker has staged-unstarted tasks — ask for one
-            # back so it isn't stuck behind a long/blocked task
+            # rescue: a worker sits idle with nothing queued while another
+            # has staged-unstarted tasks — ask for one back so it isn't
+            # stuck behind a long/blocked task. (Not triggered by workers
+            # merely starting, and never for tasks staged in this call —
+            # both would ping-pong stage/unstage.)
             unstage: List[Tuple[WorkerHandle, object]] = []
-            if not self._local_queue and (self._idle or self._num_starting):
+            just_staged = {spec.task_id for _, spec, _ in to_send}
+            if not self._local_queue and self._idle:
                 for cand in self._workers.values():
                     if cand.state == "busy" and len(cand.assigned) > 1:
                         last_tid = next(reversed(cand.assigned))
-                        unstage.append((cand, last_tid))
+                        if last_tid not in just_staged:
+                            unstage.append((cand, last_tid))
         for w, spec, binding in to_send:
             try:
                 w.channel.send("exec", pickle.dumps(spec), binding)
@@ -195,6 +205,13 @@ class Node:
 
     def _reap(self, proc: subprocess.Popen) -> None:
         proc.wait()
+        # a worker that died before registering would leak _num_starting
+        # (and with it a phantom slot in _pump's active count) forever
+        with self._lock:
+            if proc.pid not in self._registered_pids:
+                self._num_starting = max(0, self._num_starting - 1)
+            else:
+                self._registered_pids.discard(proc.pid)
 
     def _accept_loop(self) -> None:
         import multiprocessing.context as _mpctx
@@ -218,6 +235,7 @@ class Node:
             w = WorkerHandle(worker_id=wid, channel=channel, pid=pid, state="idle")
             with self._lock:
                 self._num_starting = max(0, self._num_starting - 1)
+                self._registered_pids.add(pid)
                 self._workers[wid] = w
                 self._idle.append(w)
             init_info = {

@@ -142,37 +142,34 @@ class RemoteHead:
                    pickle.dumps(spec) if spec is not None else None,
                    binding, prev_state)
 
+    def _bounded_rounds(self, make_req, done, timeout):
+        """Re-issue a head request in <=2s rounds until ``done(result)`` or
+        the deadline passes. An unbounded blocking request would pin one of
+        the head's 16 daemon-request threads (pool starvation/deadlock)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            round_t = (2.0 if remaining is None
+                       else max(0.0, min(remaining, 2.0)))
+            result = self.rpc.call("req", *make_req(round_t),
+                                   timeout=round_t + 30.0)
+            if done(result) or (remaining is not None
+                                and remaining <= round_t):
+                return result
+
     def handle_worker_rpc(self, node, w, op: str, args):
         if op == "pg_ready":
-            # bounded rounds: an hour-long blocking wait would pin one of
-            # the head's 16 daemon-request threads (pool starvation)
             pg_id, timeout = args
-            deadline = None if timeout is None else time.monotonic() + timeout
-            while True:
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                round_t = (2.0 if remaining is None
-                           else max(0.0, min(remaining, 2.0)))
-                ready = self.rpc.call("req", "worker_rpc",
-                                      ("pg_ready", [pg_id, round_t]),
-                                      timeout=round_t + 30.0)
-                if ready or (remaining is not None and remaining <= round_t):
-                    return ready
+            return self._bounded_rounds(
+                lambda t: ("worker_rpc", ("pg_ready", [pg_id, t])),
+                bool, timeout)
         return self.rpc.call("req", "worker_rpc", (op, list(args)))
 
     def wait_objects(self, oids, num_returns, timeout):
-        # bounded rounds: an unbounded wait would pin one of the head's
-        # daemon-request threads forever (pool starvation/deadlock)
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            remaining = None if deadline is None else deadline - time.monotonic()
-            round_t = 2.0 if remaining is None else max(0.0, min(remaining, 2.0))
-            ready = self.rpc.call("req", "wait_objects",
-                                  (oids, num_returns, round_t),
-                                  timeout=round_t + 30.0)
-            if len(ready) >= num_returns or (remaining is not None
-                                             and remaining <= 0):
-                return ready
+        return self._bounded_rounds(
+            lambda t: ("wait_objects", (oids, num_returns, t)),
+            lambda ready: len(ready) >= num_returns, timeout)
 
     def get_object_for_node(self, node, oid: ObjectID, timeout):
         """Local-store check, then head locate + direct pull from the source

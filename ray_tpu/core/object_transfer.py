@@ -28,7 +28,8 @@ from .ids import ObjectID
 
 # Serialize concurrent pulls of the same object into the same store: two
 # racing create(oid) calls would free each other's in-flight arena offset
-# (object_store.py create() reclaims a stale entry's extent).
+# (object_store.py create() reclaims a stale entry's extent). Entries are
+# refcounted — a lock is only removed when no thread holds or awaits it.
 _pull_locks: dict = {}
 _pull_locks_guard = threading.Lock()
 
@@ -37,12 +38,18 @@ _pull_locks_guard = threading.Lock()
 def _pull_guard(dest_store, oid: ObjectID):
     key = (id(dest_store), oid)
     with _pull_locks_guard:
-        lock = _pull_locks.setdefault(key, threading.Lock())
-    with lock:
-        yield
-    with _pull_locks_guard:
-        if not lock.locked():
-            _pull_locks.pop(key, None)
+        entry = _pull_locks.get(key)
+        if entry is None:
+            entry = _pull_locks[key] = [threading.Lock(), 0]
+        entry[1] += 1
+    try:
+        with entry[0]:
+            yield
+    finally:
+        with _pull_locks_guard:
+            entry[1] -= 1
+            if entry[1] <= 0:
+                _pull_locks.pop(key, None)
 
 
 class ObjectServer:

@@ -63,7 +63,30 @@ class SerializedObject:
             out += b
 
 
+class _ByValuePickler(pickle.Pickler):
+    """Plain pickle, except functions/classes from ``__main__`` or local
+    scopes are captured by value (cloudpickle). Plain pickle serializes
+    them BY REFERENCE — which "succeeds" in the driver and then fails (or
+    resolves to the wrong object) in workers whose ``__main__`` is
+    worker_runtime. Reference: ray vendors cloudpickle wholesale; this
+    keeps the fast path for ordinary data."""
+
+    def reducer_override(self, obj):
+        import types
+
+        if isinstance(obj, (types.FunctionType, type)):
+            mod = getattr(obj, "__module__", None)
+            qual = getattr(obj, "__qualname__", "")
+            if mod in ("__main__", None) or "<locals>" in qual:
+                import cloudpickle
+
+                return (cloudpickle.loads, (cloudpickle.dumps(obj),))
+        return NotImplemented
+
+
 def serialize(value: Any) -> SerializedObject:
+    import io
+
     import cloudpickle
 
     buffers: List[pickle.PickleBuffer] = []
@@ -71,11 +94,24 @@ def serialize(value: Any) -> SerializedObject:
     _contained_refs_ctx.append(contained)
     try:
         try:
-            # fast path: plain pickle (no bytecode scanning)
+            # fast path: plain C pickle. If the stream references __main__
+            # (driver-defined function/class pickled BY REFERENCE — which
+            # would resolve against worker_runtime in workers), re-pickle
+            # with the by-value override. The byte scan keeps ordinary
+            # data on the C path; a false positive just takes the slower
+            # correct path.
             meta = pickle.dumps(value, protocol=5,
                                 buffer_callback=buffers.append)
+            if b"__main__" in meta:
+                buffers.clear()
+                contained.clear()
+                bio = io.BytesIO()
+                _ByValuePickler(bio, protocol=5,
+                                buffer_callback=buffers.append).dump(value)
+                meta = bio.getvalue()
         except (pickle.PicklingError, AttributeError, TypeError):
             buffers.clear()
+            contained.clear()
             # local classes / closures / lambdas (reference: ray cloudpickle)
             meta = cloudpickle.dumps(value, protocol=5,
                                      buffer_callback=buffers.append)

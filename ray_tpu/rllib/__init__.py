@@ -1,0 +1,20 @@
+"""ray_tpu.rllib — RL training (reference: rllib/, new API stack subset).
+
+Core pieces: AlgorithmConfig builder, Algorithm (a Tune Trainable),
+EnvRunnerGroup (fault-tolerant sampling actors), JaxRLModule (functional
+policy/value nets), Learner/LearnerGroup (jitted updates, optional
+multi-learner gradient sync), PPO.
+"""
+
+from .algorithm import Algorithm, EnvRunnerGroup
+from .config import AlgorithmConfig
+from .env_runner import SingleAgentEnvRunner, compute_gae
+from .learner import Learner, LearnerGroup
+from .ppo import PPO, PPOConfig
+from .rl_module import JaxRLModule, RLModuleSpec
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "EnvRunnerGroup",
+    "SingleAgentEnvRunner", "compute_gae", "Learner", "LearnerGroup",
+    "PPO", "PPOConfig", "JaxRLModule", "RLModuleSpec",
+]

@@ -1,0 +1,169 @@
+"""SingleAgentEnvRunner: vectorized env stepping + policy inference.
+
+Analog of the reference's SingleAgentEnvRunner
+(rllib/env/single_agent_env_runner.py:61, sample :131): owns a gymnasium
+SyncVectorEnv, holds the current module weights, and produces fixed-length
+rollout batches. Runs as a CPU actor; inference is a jitted CPU forward.
+
+Gymnasium >=1.0 vector autoreset is NEXT_STEP mode: the step after a
+terminal is a reset transition whose action is ignored — those rows are
+marked invalid in the batch (``valid`` mask) and filtered before training.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class SingleAgentEnvRunner:
+    def __init__(self, env_creator: Callable, module_spec, num_envs: int,
+                 rollout_len: int, seed: int = 0, worker_idx: int = 0):
+        import gymnasium as gym
+
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.env = gym.vector.SyncVectorEnv(
+            [env_creator for _ in range(num_envs)])
+        self.module = module_spec.build(self.env.single_observation_space,
+                                        self.env.single_action_space)
+        self._rng = np.random.default_rng(seed * 10007 + worker_idx)
+        self._params = None
+        self._jit_forward = None
+        obs, _ = self.env.reset(seed=seed * 10007 + worker_idx)
+        self._obs = np.asarray(obs, np.float32)
+        self._prev_done = np.zeros(num_envs, bool)
+        self._ep_returns = np.zeros(num_envs, np.float64)
+        self._ep_lens = np.zeros(num_envs, np.int64)
+        self._completed_returns: deque = deque(maxlen=100)
+        self._completed_lens: deque = deque(maxlen=100)
+
+    # ---- weights ----
+
+    def set_weights(self, weights: Dict[str, np.ndarray]) -> None:
+        self._params = weights
+
+    def get_weights(self):
+        return self._params
+
+    def ping(self) -> str:
+        return "ok"
+
+    def _forward(self, obs: np.ndarray):
+        import jax
+        import jax.numpy as jnp
+
+        if self._jit_forward is None:
+            fwd = self.module.forward
+
+            @jax.jit
+            def step_fn(params, obs, key):
+                logits, value = fwd(params, obs)
+                logp_all = jax.nn.log_softmax(logits)
+                action = jax.random.categorical(key, logits)
+                logp = jnp.take_along_axis(
+                    logp_all, action[:, None], axis=1)[:, 0]
+                return action, logp, value
+
+            self._jit_forward = step_fn
+            self._jax = jax
+            self._key = jax.random.PRNGKey(
+                int(self._rng.integers(0, 2**31)))
+        self._key, sub = self._jax.random.split(self._key)
+        a, lp, v = self._jit_forward(self._params, obs, sub)
+        return (np.asarray(a), np.asarray(lp, np.float32),
+                np.asarray(v, np.float32))
+
+    # ---- sampling ----
+
+    def sample(self, weights: Optional[Dict] = None) -> Tuple[Dict, Dict]:
+        """One rollout of [rollout_len, num_envs] steps.
+
+        Returns (batch, stats). Batch arrays are [T, N]; ``valid`` masks
+        out autoreset rows; ``vf_last`` is V(s_T) per env for GAE
+        bootstrap.
+        """
+        if weights is not None:
+            self.set_weights(weights)
+        assert self._params is not None, "no weights set"
+        T, N = self.rollout_len, self.num_envs
+        obs_buf = np.empty((T, N) + self._obs.shape[1:], np.float32)
+        act_buf = np.empty((T, N), np.int64)
+        rew_buf = np.empty((T, N), np.float32)
+        term_buf = np.empty((T, N), bool)
+        done_buf = np.empty((T, N), bool)
+        logp_buf = np.empty((T, N), np.float32)
+        vf_buf = np.empty((T, N), np.float32)
+        valid_buf = np.empty((T, N), bool)
+
+        t0 = time.perf_counter()
+        for t in range(T):
+            action, logp, value = self._forward(self._obs)
+            next_obs, reward, term, trunc, _ = self.env.step(action)
+            obs_buf[t] = self._obs
+            act_buf[t] = action
+            rew_buf[t] = reward
+            term_buf[t] = term
+            done_buf[t] = term | trunc
+            logp_buf[t] = logp
+            vf_buf[t] = value
+            valid_buf[t] = ~self._prev_done  # autoreset rows are invalid
+
+            live = valid_buf[t]
+            self._ep_returns[live] += reward[live]
+            self._ep_lens[live] += 1
+            for i in np.nonzero(done_buf[t] & live)[0]:
+                self._completed_returns.append(float(self._ep_returns[i]))
+                self._completed_lens.append(int(self._ep_lens[i]))
+                self._ep_returns[i] = 0.0
+                self._ep_lens[i] = 0
+            self._prev_done = done_buf[t]
+            self._obs = np.asarray(next_obs, np.float32)
+
+        _, _, vf_last = self._forward(self._obs)
+        batch = {
+            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "terminateds": term_buf, "dones": done_buf, "logp": logp_buf,
+            "vf_preds": vf_buf, "valid": valid_buf, "vf_last": vf_last,
+        }
+        stats = {
+            "episode_returns": list(self._completed_returns),
+            "episode_lens": list(self._completed_lens),
+            "env_steps": int(valid_buf.sum()),
+            "sample_time_s": time.perf_counter() - t0,
+        }
+        return batch, stats
+
+
+def compute_gae(batch: Dict[str, np.ndarray], gamma: float, lam: float):
+    """Generalized advantage estimation over [T, N] arrays.
+
+    Truncated episodes are treated as terminated (no final-obs bootstrap) —
+    a small bias near time limits, standard in compact PPO implementations.
+    Returns flat, valid-row-filtered training arrays.
+    """
+    rew, vf = batch["rewards"], batch["vf_preds"]
+    term, done, valid = batch["terminateds"], batch["dones"], batch["valid"]
+    T, N = rew.shape
+    next_vf = np.vstack([vf[1:], batch["vf_last"][None, :]])
+    adv = np.zeros((T, N), np.float32)
+    last = np.zeros(N, np.float32)
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - done[t].astype(np.float32)
+        delta = rew[t] + gamma * next_vf[t] * nonterminal - vf[t]
+        last = delta + gamma * lam * nonterminal * last
+        adv[t] = last
+    ret = adv + vf
+    m = valid.reshape(-1)
+    flat = {
+        "obs": batch["obs"].reshape(T * N, -1)[m],
+        "actions": batch["actions"].reshape(-1)[m],
+        "logp": batch["logp"].reshape(-1)[m],
+        "advantages": adv.reshape(-1)[m],
+        "value_targets": ret.reshape(-1)[m],
+        "vf_preds": vf.reshape(-1)[m],
+    }
+    return flat

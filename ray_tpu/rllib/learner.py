@@ -1,0 +1,257 @@
+"""Learner / LearnerGroup: jitted policy updates, optionally distributed.
+
+Analog of the reference's Learner (rllib/core/learner/learner.py:116 —
+compute_gradients :446 / apply_gradients :568) and LearnerGroup
+(learner_group.py:83), TPU-first: the update is ONE jitted function
+(loss+grad+optimizer) compiled over an optional jax Mesh (data-parallel
+sharding of the minibatch); multi-learner mode shards the batch across
+learner actors whose gradients sync via ray_tpu.collective allreduce —
+the XLA/StoreGroup replacement for the reference's torch-DDP learners.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Learner:
+    """Owns module params + optimizer state; runs jitted minibatch updates."""
+
+    def __init__(self, module, config, loss_fn, collective_group: Optional[str] = None):
+        import jax
+        import optax
+
+        self.module = module
+        self.config = config
+        self.loss_fn = loss_fn  # (module, params, minibatch) -> (loss, stats)
+        self._collective_group = collective_group
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(getattr(config, "grad_clip", 0.5)),
+            optax.adam(config.lr),
+        )
+        params = module.init(jax.random.PRNGKey(config.seed))
+        self.state = {"params": params,
+                      "opt_state": self.optimizer.init(params)}
+        self._update_fn = self._build_update(config.mesh)
+
+    def _build_update(self, mesh):
+        import jax
+
+        module, loss_fn, optimizer = self.module, self.loss_fn, self.optimizer
+        allreduce_group = self._collective_group
+
+        def update(state, minibatch):
+            (loss, stats), grads = jax.value_and_grad(
+                lambda p: loss_fn(module, p, minibatch), has_aux=True
+            )(state["params"])
+            if allreduce_group is None:
+                updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                                    state["params"])
+                import optax
+
+                new_params = optax.apply_updates(state["params"], updates)
+                return ({"params": new_params, "opt_state": new_opt},
+                        loss, stats, None)
+            # distributed: return grads for host-side allreduce, apply later
+            return state, loss, stats, grads
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            batch_axis = mesh.axis_names[0]
+            data_sharding = {
+                k: NamedSharding(mesh, P(batch_axis))
+                for k in ("obs", "actions", "logp", "advantages",
+                          "value_targets", "vf_preds")
+            }
+            repl = NamedSharding(mesh, P())
+            return jax.jit(
+                update,
+                in_shardings=(jax.tree.map(lambda _: repl, self.state),
+                              data_sharding),
+                out_shardings=None,
+            )
+        return jax.jit(update)
+
+    def _apply_grads(self, grads):
+        import optax
+
+        updates, new_opt = self.optimizer.update(
+            grads, self.state["opt_state"], self.state["params"])
+        self.state = {
+            "params": optax.apply_updates(self.state["params"], updates),
+            "opt_state": new_opt,
+        }
+
+    def update(self, flat_batch: Dict[str, np.ndarray], *, num_epochs: int,
+               minibatch_size: int, rng: Optional[np.random.Generator] = None,
+               shard_pad_to: Optional[int] = None) -> Dict[str, float]:
+        """SGD epochs over shuffled minibatches; returns mean stats."""
+        rng = rng or np.random.default_rng(0)
+        n = len(flat_batch["actions"])
+        mbs = min(minibatch_size, n)
+        all_stats: List[Dict[str, float]] = []
+        for _ in range(num_epochs):
+            perm = rng.permutation(n)
+            for start in range(0, n - mbs + 1, mbs):
+                idx = perm[start:start + mbs]
+                mb = {k: v[idx] for k, v in flat_batch.items()}
+                self.state, loss, stats, grads = self._update_fn(
+                    self.state, mb)
+                if grads is not None:
+                    grads = self._allreduce(grads)
+                    self._apply_grads(grads)
+                all_stats.append(
+                    {k: float(v) for k, v in stats.items()})
+        keys = all_stats[0].keys() if all_stats else ()
+        return {k: float(np.mean([s[k] for s in all_stats])) for k in keys}
+
+    def _allreduce(self, grads):
+        import jax
+
+        from ray_tpu import collective
+        from ray_tpu.collective.types import ReduceOp
+
+        leaves, treedef = jax.tree.flatten(grads)
+        reduced = [
+            collective.allreduce(np.asarray(leaf),
+                                 group_name=self._collective_group,
+                                 op=ReduceOp.MEAN)
+            for leaf in leaves
+        ]
+        return jax.tree.unflatten(treedef, reduced)
+
+    # ---- weights ----
+
+    def get_weights(self) -> Dict[str, np.ndarray]:
+        import jax
+
+        return {k: np.asarray(v) for k, v in
+                jax.tree.map(lambda x: x, self.state["params"]).items()}
+
+    def set_weights(self, weights) -> None:
+        import jax.numpy as jnp
+
+        self.state["params"] = {k: jnp.asarray(v) for k, v in weights.items()}
+
+    def get_state(self):
+        import pickle
+
+        import jax
+
+        return pickle.dumps(jax.tree.map(np.asarray, self.state))
+
+    def set_state(self, blob) -> None:
+        import pickle
+
+        self.state = pickle.loads(blob)
+
+
+class LearnerGroup:
+    """One local learner (num_learners=0) or N learner actors with
+    collective gradient sync (num_learners>=1)."""
+
+    def __init__(self, config, module_spec, obs_space, act_space, loss_fn):
+        self.config = config
+        self._local: Optional[Learner] = None
+        self._actors: List[Any] = []
+        if config.num_learners <= 0:
+            module = module_spec.build(obs_space, act_space)
+            self._local = Learner(module, config, loss_fn)
+            return
+        import ray_tpu
+        from ray_tpu import collective
+
+        group = f"learners_{id(self)}"
+
+        @ray_tpu.remote(num_cpus=config.num_cpus_per_learner)
+        class _LearnerActor:
+            def __init__(self, spec, cfg, loss, rank, world, group_name):
+                collective.init_collective_group(
+                    world, rank, backend="store", group_name=group_name)
+                module = spec.build(obs_space, act_space)
+                self.learner = Learner(module, cfg, loss,
+                                       collective_group=group_name)
+
+            def update(self, shard, num_epochs, minibatch_size, seed):
+                return self.learner.update(
+                    shard, num_epochs=num_epochs,
+                    minibatch_size=minibatch_size,
+                    rng=np.random.default_rng(seed))
+
+            def get_weights(self):
+                return self.learner.get_weights()
+
+            def set_weights(self, w):
+                self.learner.set_weights(w)
+
+            def get_state(self):
+                return self.learner.get_state()
+
+            def set_state(self, blob):
+                self.learner.set_state(blob)
+
+        world = config.num_learners
+        cfg = config.copy()
+        self._actors = [
+            _LearnerActor.remote(module_spec, cfg, loss_fn, rank, world, group)
+            for rank in range(world)
+        ]
+        ray_tpu.get([a.get_weights.remote() for a in self._actors])
+        # start from identical weights
+        w0 = ray_tpu.get(self._actors[0].get_weights.remote())
+        ray_tpu.get([a.set_weights.remote(w0) for a in self._actors[1:]])
+
+    def update(self, flat_batch, *, num_epochs, minibatch_size, seed=0):
+        if self._local is not None:
+            return self._local.update(flat_batch, num_epochs=num_epochs,
+                                      minibatch_size=minibatch_size,
+                                      rng=np.random.default_rng(seed))
+        import ray_tpu
+
+        n = len(flat_batch["actions"])
+        world = len(self._actors)
+        per = n // world
+        refs = []
+        for rank, a in enumerate(self._actors):
+            shard = {k: v[rank * per:(rank + 1) * per]
+                     for k, v in flat_batch.items()}
+            # same seed everywhere: ranks must take identical minibatch
+            # counts/order for the allreduce schedule to line up
+            refs.append(a.update.remote(shard, num_epochs,
+                                        minibatch_size // world, seed))
+        stats = ray_tpu.get(refs)
+        keys = stats[0].keys() if stats else ()
+        return {k: float(np.mean([s[k] for s in stats])) for k in keys}
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        import ray_tpu
+
+        return ray_tpu.get(self._actors[0].get_weights.remote())
+
+    def set_weights(self, w):
+        if self._local is not None:
+            self._local.set_weights(w)
+            return
+        import ray_tpu
+
+        ray_tpu.get([a.set_weights.remote(w) for a in self._actors])
+
+    def get_state(self):
+        if self._local is not None:
+            return self._local.get_state()
+        import ray_tpu
+
+        return ray_tpu.get(self._actors[0].get_state.remote())
+
+    def set_state(self, blob):
+        if self._local is not None:
+            self._local.set_state(blob)
+            return
+        import ray_tpu
+
+        ray_tpu.get([a.set_state.remote(blob) for a in self._actors])

@@ -1,0 +1,114 @@
+"""PPO: clipped-surrogate policy optimization.
+
+Analog of the reference's new-stack PPO (rllib/algorithms/ppo/ppo.py:427
+training_step; loss per ppo_torch_learner): sample via EnvRunnerGroup ->
+GAE -> minibatch SGD epochs on the LearnerGroup -> weight sync. Loss and
+update are one jitted function (see learner.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .algorithm import Algorithm, summarize_episode_stats
+from .config import AlgorithmConfig
+from .env_runner import compute_gae
+from .learner import LearnerGroup
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = PPO
+        self.lambda_: float = 0.95
+        self.clip_param: float = 0.2
+        self.vf_clip_param: float = 10.0
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.0
+        self.num_epochs: int = 10
+        self.minibatch_size: int = 128
+        self.grad_clip: float = 0.5
+        self.kl_target: float = 0.02  # reported; no adaptive coeff (clip-only)
+
+
+def ppo_loss(config: PPOConfig):
+    """Returns (module, params, minibatch) -> (loss, stats), jit-safe."""
+    clip, vf_clip = config.clip_param, config.vf_clip_param
+    vf_coeff, ent_coeff = config.vf_loss_coeff, config.entropy_coeff
+
+    def loss_fn(module, params, mb):
+        import jax
+        import jax.numpy as jnp
+
+        logits, values = module.forward(params, mb["obs"])
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, mb["actions"][:, None], axis=1)[:, 0]
+        ratio = jnp.exp(logp - mb["logp"])
+        adv = mb["advantages"]
+        adv = (adv - adv.mean()) / jnp.maximum(adv.std(), 1e-6)
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+        policy_loss = -surrogate.mean()
+        # clipped value loss (reference ppo learner)
+        vf_err = (values - mb["value_targets"]) ** 2
+        vf_clipped = mb["vf_preds"] + jnp.clip(
+            values - mb["vf_preds"], -vf_clip, vf_clip)
+        vf_err2 = (vf_clipped - mb["value_targets"]) ** 2
+        vf_loss = jnp.maximum(vf_err, vf_err2).mean()
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1).mean()
+        total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        stats = {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_kl": (mb["logp"] - logp).mean(),
+            "clip_frac": (jnp.abs(ratio - 1.0) > clip).mean(),
+        }
+        return total, stats
+
+    return loss_fn
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig
+
+    def _build_learner_group(self) -> LearnerGroup:
+        return LearnerGroup(self.algo_config, self.algo_config.rl_module_spec,
+                            self.obs_space, self.act_space,
+                            ppo_loss(self.algo_config))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        weights = self.learner_group.get_weights()
+        batches, stats = [], []
+        target = cfg.train_batch_size
+        got = 0
+        while got < target:
+            if self.env_runner_group.num_healthy == 0:
+                if cfg.restart_failed_env_runners:
+                    self.env_runner_group.restore_workers()
+                else:
+                    raise RuntimeError("all env runners are dead")
+            bs, ss = self.env_runner_group.sample(weights)
+            for b, s in zip(bs, ss):
+                batches.append(b)
+                stats.append(s)
+                got += s["env_steps"]
+            if not bs:  # every healthy runner failed this round
+                self.env_runner_group.restore_workers()
+        flat_parts = [compute_gae(b, cfg.gamma, cfg.lambda_)
+                      for b in batches]
+        flat = {k: np.concatenate([p[k] for p in flat_parts])
+                for k in flat_parts[0]}
+        learner_stats = self.learner_group.update(
+            flat, num_epochs=cfg.num_epochs,
+            minibatch_size=cfg.minibatch_size, seed=self._iteration)
+        if cfg.restart_failed_env_runners:
+            self.env_runner_group.restore_workers()
+        result = summarize_episode_stats(stats)
+        result["learner"] = learner_stats
+        return result

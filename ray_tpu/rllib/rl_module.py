@@ -1,0 +1,84 @@
+"""RLModule: the neural-net policy/value container, functional JAX style.
+
+Analog of the reference's new-API-stack RLModule
+(rllib/core/rl_module/rl_module.py:271 + spec :48), redesigned TPU-first:
+a module is a pair of pure functions (init, forward) over a params pytree —
+no framework classes — so the same definition runs eagerly on CPU env
+runners and jitted/pjitted on TPU learners.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class JaxRLModule:
+    """Discrete-action policy + value function as pure functions.
+
+    forward(params, obs) -> (logits [B, num_actions], value [B]).
+    """
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Sequence[int] = (64, 64), activation: str = "tanh"):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hiddens = tuple(hiddens)
+        self.act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[activation]
+
+    def init(self, rng) -> Dict[str, Any]:
+        keys = jax.random.split(rng, 2 * len(self.hiddens) + 2)
+        params: Dict[str, Any] = {}
+        in_dim = self.obs_dim
+        # separate policy / value towers (reference PPO catalog default)
+        for tower in ("pi", "vf"):
+            d = in_dim
+            for i, h in enumerate(self.hiddens):
+                k = keys[len(params) % len(keys)]
+                params[f"{tower}_w{i}"] = (
+                    jax.random.normal(k, (d, h), jnp.float32)
+                    * np.sqrt(2.0 / d))
+                params[f"{tower}_b{i}"] = jnp.zeros((h,), jnp.float32)
+                d = h
+        params["pi_out_w"] = (
+            jax.random.normal(keys[-2], (d, self.num_actions), jnp.float32)
+            * 0.01)
+        params["pi_out_b"] = jnp.zeros((self.num_actions,), jnp.float32)
+        params["vf_out_w"] = (
+            jax.random.normal(keys[-1], (d, 1), jnp.float32) * 1.0)
+        params["vf_out_b"] = jnp.zeros((1,), jnp.float32)
+        return params
+
+    def forward(self, params, obs):
+        def tower(prefix, x):
+            for i in range(len(self.hiddens)):
+                x = self.act(x @ params[f"{prefix}_w{i}"]
+                             + params[f"{prefix}_b{i}"])
+            return x
+
+        x = obs.astype(jnp.float32)
+        logits = (tower("pi", x) @ params["pi_out_w"] + params["pi_out_b"])
+        value = (tower("vf", x) @ params["vf_out_w"] + params["vf_out_b"])
+        return logits, value[..., 0]
+
+
+@dataclass
+class RLModuleSpec:
+    """Builds a module from env spaces (reference: RLModuleSpec :48)."""
+
+    module_class: type = JaxRLModule
+    hiddens: Sequence[int] = (64, 64)
+    activation: str = "tanh"
+    module_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def build(self, obs_space, act_space) -> JaxRLModule:
+        obs_dim = int(np.prod(obs_space.shape))
+        num_actions = int(act_space.n)
+        return self.module_class(obs_dim, num_actions,
+                                 hiddens=self.hiddens,
+                                 activation=self.activation,
+                                 **self.module_kwargs)

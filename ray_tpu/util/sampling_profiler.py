@@ -48,9 +48,11 @@ def start(path: str, interval_s: float = 0.002, depth: int = 8):
 
     def dump():
         stop.set()
+        t.join(timeout=1.0)  # sampler may be mid-insert; snapshot after
+        snapshot = collections.Counter(dict(samples))
         try:
             with open(path, "w") as f:
-                for k, v in samples.most_common(100):
+                for k, v in snapshot.most_common(100):
                     f.write(f"{v}\t{k}\n")
         except OSError:
             pass
