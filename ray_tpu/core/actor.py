@@ -64,12 +64,15 @@ class ActorHandle:
             raise AttributeError(name)
         return ActorMethod(self, name, self._method_num_returns.get(name, 1))
 
-    def _submit_method(self, method_name: str, args, kwargs, num_returns: int):
+    def _submit_method(self, method_name: str, args, kwargs, num_returns):
         from .runtime import get_current_runtime
 
         runtime = get_current_runtime()
         if runtime is None:
             raise RuntimeError("ray_tpu.init() has not been called")
+        streaming = num_returns in ("streaming", "dynamic")
+        if streaming:
+            num_returns = 1
         out_args, out_kwargs, keepalive = prepare_args(runtime, args, kwargs)
         spec = TaskSpec(
             task_id=runtime.next_task_id(),
@@ -79,12 +82,17 @@ class ActorHandle:
             args=out_args,
             kwargs=out_kwargs,
             num_returns=num_returns,
+            streaming=streaming,
             resources=parse_task_resources(num_cpus=0, default_num_cpus=0.0),
             max_retries=0,
             actor_id=self._actor_id,
             pinned_args=[r.id for r in keepalive],
         )
         refs = runtime.actor_method_call(spec)
+        if streaming:
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, refs[0])
         if num_returns == 0:
             return None
         if num_returns == 1:

@@ -120,6 +120,12 @@ class ObjectID(BaseID):
         return cls(task_id.binary() + index.to_bytes(4, "little"))
 
     @classmethod
+    def for_stream(cls, task_id: TaskID, index: int):
+        # Streamed (generator) yields: own index namespace so they never
+        # clash with declared returns (reference: dynamic return ids).
+        return cls(task_id.binary() + (index | 0x40000000).to_bytes(4, "little"))
+
+    @classmethod
     def for_put(cls, task_id: TaskID, put_index: int):
         # High bit of the index distinguishes puts from returns.
         return cls(task_id.binary() + (put_index | 0x80000000).to_bytes(4, "little"))

@@ -78,7 +78,10 @@ class Node:
         )
         self._accept_thread.start()
         self._num_starting = 0
-        self._registered_pids: set = set()
+        # pids spawned but not yet counted down — the countdown happens
+        # exactly once, on whichever of (registration, process exit)
+        # happens first
+        self._starting_pids: set = set()
         with self._lock:
             for _ in range(min(cfg.worker_prestart_count, self.max_workers)):
                 self._start_worker_locked()
@@ -198,6 +201,7 @@ class Node:
             env=env, stdout=out, stderr=subprocess.STDOUT,
             cwd=os.getcwd(),
         )
+        self._starting_pids.add(proc.pid)
         # handle registered on accept
         threading.Thread(
             target=self._reap, args=(proc,), daemon=True
@@ -208,10 +212,9 @@ class Node:
         # a worker that died before registering would leak _num_starting
         # (and with it a phantom slot in _pump's active count) forever
         with self._lock:
-            if proc.pid not in self._registered_pids:
+            if proc.pid in self._starting_pids:
+                self._starting_pids.discard(proc.pid)
                 self._num_starting = max(0, self._num_starting - 1)
-            else:
-                self._registered_pids.discard(proc.pid)
 
     def _accept_loop(self) -> None:
         import multiprocessing.context as _mpctx
@@ -234,8 +237,9 @@ class Node:
             wid = WorkerID.from_random()
             w = WorkerHandle(worker_id=wid, channel=channel, pid=pid, state="idle")
             with self._lock:
-                self._num_starting = max(0, self._num_starting - 1)
-                self._registered_pids.add(pid)
+                if pid in self._starting_pids:
+                    self._starting_pids.discard(pid)
+                    self._num_starting = max(0, self._num_starting - 1)
                 self._workers[wid] = w
                 self._idle.append(w)
             init_info = {
@@ -276,6 +280,8 @@ class Node:
             elif tag == "release":
                 for oid in payload[0]:
                     self.store.remove_ref(oid)
+            elif tag == "stream":
+                self.head.on_stream_item(*payload)
             elif tag == "unstaged":
                 # worker handed back a staged-unstarted task: requeue it
                 tid = payload[0]

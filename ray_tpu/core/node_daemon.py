@@ -134,6 +134,9 @@ class RemoteHead:
     def on_object_sealed(self, oid: ObjectID, node_hex: str) -> None:
         self._send("sealed", oid)
 
+    def on_stream_item(self, task_id, index: int) -> None:
+        self._send("stream_item", task_id, index)
+
     def on_worker_exit(self, node, w) -> None:
         self._send("worker_exit", w.worker_id, w.actor_id, w.pid)
 
@@ -159,6 +162,11 @@ class RemoteHead:
                 return result
 
     def handle_worker_rpc(self, node, w, op: str, args):
+        if op == "stream_next":
+            task_id, index, timeout = args
+            return self._bounded_rounds(
+                lambda t: ("worker_rpc", ("stream_next", [task_id, index, t])),
+                lambda rep: rep[0] != "wait", timeout)
         if op == "pg_ready":
             pg_id, timeout = args
             return self._bounded_rounds(

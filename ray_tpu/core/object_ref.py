@@ -92,3 +92,49 @@ class ObjectRef:
 
 def _deserialize_ref(oid: ObjectID, owner_node):
     return ObjectRef(oid, owner_node)
+
+
+class ObjectRefGenerator:
+    """Iterator over a streaming task's yielded objects.
+
+    Analog of the reference's ObjectRefGenerator
+    (python/ray/_raylet.pyx:1074-1317 streaming generators): ``__next__``
+    returns the next yielded item's ObjectRef, blocking until the producer
+    seals it; StopIteration once the producer finished and all items were
+    consumed; a failed producer raises its error (stored on the primary
+    return) at the point of failure.
+    """
+
+    def __init__(self, task_id, primary_ref: ObjectRef):
+        self._task_id = task_id
+        self._primary = primary_ref
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        rt = get_runtime()
+        while True:
+            rep = rt.stream_next(self._task_id, self._i, timeout=2.0)
+            kind = rep[0]
+            if kind == "item":
+                self._i += 1
+                return ObjectRef(rep[1])
+            if kind == "end":
+                raise StopIteration
+            if kind == "error":
+                # the error payload is sealed on the primary return
+                rt.get([self._primary], timeout=30)
+                raise RuntimeError("streaming task failed")  # unreachable
+            # "wait": producer still running
+
+    def __len__(self):
+        raise TypeError("streaming generator has no static length")
+
+    def completed(self) -> ObjectRef:
+        """Ref that resolves to the total item count when the task ends."""
+        return self._primary
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._task_id, self._primary))

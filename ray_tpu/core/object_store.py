@@ -309,7 +309,13 @@ class LocalObjectStore:
             e = self._entries.pop(oid, None)
             if e is None:
                 return
-            if e.offset >= 0 and e.spilled_path is None:
+            # plasma lifetime contract: an extent whose zero-copy view was
+            # handed out (mapped) is NEVER returned to the allocator — a
+            # reader's array may still alias it, and reuse would silently
+            # corrupt what it sees. The extent leaks until store close
+            # (the reference frees plasma buffers only when all client
+            # references release; we track at entry granularity).
+            if e.offset >= 0 and e.spilled_path is None and not e.mapped:
                 self.arena.allocator.free(e.offset)
             if e.spilled_path:
                 try:

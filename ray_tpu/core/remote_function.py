@@ -131,6 +131,9 @@ class RemoteFunction:
         opt = self._options
         out_args, out_kwargs, keepalive = prepare_args(runtime, args, kwargs)
         num_returns = opt.get("num_returns", 1)
+        streaming = num_returns in ("streaming", "dynamic")
+        if streaming:
+            num_returns = 1  # primary return carries the final item count
         spec = TaskSpec(
             task_id=runtime.next_task_id(),
             job_id=runtime.runtime_context()["job_id"],
@@ -139,6 +142,7 @@ class RemoteFunction:
             args=out_args,
             kwargs=out_kwargs,
             num_returns=num_returns,
+            streaming=streaming,
             resources=parse_task_resources(
                 num_cpus=opt.get("num_cpus"),
                 num_tpus=opt.get("num_tpus"),
@@ -155,6 +159,10 @@ class RemoteFunction:
             pinned_args=[r.id for r in keepalive],
         )
         refs = runtime.submit_task(spec)
+        if streaming:
+            from .object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(spec.task_id, refs[0])
         if num_returns == 0:
             return None
         if num_returns == 1:

@@ -146,6 +146,7 @@ class Head:
         self.actors: Dict[ActorID, ActorRecord] = {}
         self._waiting_on: Dict[ObjectID, Set[TaskID]] = defaultdict(set)
         self.ref_counts: Dict[ObjectID, int] = defaultdict(int)
+        self.streams: Dict[TaskID, int] = {}  # task_id -> items streamed
         self._stopped = False
         self._node_listener = None
         self.node_server_address = None
@@ -272,6 +273,8 @@ class Head:
                                       results, worker_id=worker_id)
             elif tag == "sealed":
                 self.on_object_sealed(payload[0], proxy.hex)
+            elif tag == "stream_item":
+                self.on_stream_item(payload[0], payload[1])
             elif tag == "worker_exit":
                 w = types.SimpleNamespace(worker_id=payload[0],
                                           actor_id=payload[1], pid=payload[2])
@@ -799,6 +802,41 @@ class Head:
             rec.state = "QUEUED"
             self.scheduler.submit(rec.spec)
 
+    def on_stream_item(self, task_id: TaskID, index: int) -> None:
+        """A streaming task sealed item ``index`` (reference: streaming
+        generator item report). The item gets an owner pin (same semantics
+        as worker register_owned_object) so the reclaim loop can't evict
+        it before the consumer reads it; stream/task records are retained
+        until shutdown (task GC is future work, as for task records)."""
+        with self._object_cv:
+            cur = self.streams.get(task_id, 0)
+            if index + 1 > cur:
+                self.streams[task_id] = index + 1
+                self.ref_counts[ObjectID.for_stream(task_id, index)] += 1
+            self._object_cv.notify_all()
+
+    def stream_next(self, task_id: TaskID, index: int,
+                    timeout: Optional[float]):
+        """Next-item protocol for ObjectRefGenerator: ("item", oid) |
+        ("end", total) | ("error",) | ("wait",) after ``timeout``."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._object_cv:
+                count = self.streams.get(task_id, 0)
+                rec = self.tasks.get(task_id)
+                if index < count:
+                    return ("item", ObjectID.for_stream(task_id, index))
+                if rec is None or rec.state == "FAILED" or rec.cancelled:
+                    return ("error",)
+                if rec.state == "FINISHED":
+                    return ("end", count)
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return ("wait",)
+                self._object_cv.wait(min(remaining, 0.2)
+                                     if remaining is not None else 0.2)
+
     def get_object_payload(self, oid: ObjectID, timeout: Optional[float]):
         """Driver-side read: returns (buffer, is_error). Blocks until sealed."""
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -967,6 +1005,8 @@ class Head:
         if op == "kv":
             sub, rest = args[0], args[1:]
             return getattr(self.gcs, "kv_" + sub)(*rest)
+        if op == "stream_next":
+            return self.stream_next(args[0], args[1], args[2])
         if op == "register_owned_object":
             with self._lock:
                 self.ref_counts[args[0]] += 1
@@ -1139,6 +1179,9 @@ class DriverRuntime:
 
     def kv(self, op: str, *args):
         return getattr(self.head.gcs, "kv_" + op)(*args)
+
+    def stream_next(self, task_id, index: int, timeout=None):
+        return self.head.stream_next(task_id, index, timeout)
 
     # ---- refs ----
     def add_local_ref(self, oid: ObjectID) -> None:

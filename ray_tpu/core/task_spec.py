@@ -40,6 +40,7 @@ class TaskSpec:
     args: List[Tuple[str, Any]] = field(default_factory=list)
     kwargs: Dict[str, Tuple[str, Any]] = field(default_factory=dict)
     num_returns: int = 1
+    streaming: bool = False  # generator task: yields stream via for_stream ids
     resources: ResourceSet = field(default_factory=ResourceSet)
     max_retries: int = 3
     retry_exceptions: bool = False

@@ -366,6 +366,9 @@ class WorkerRuntime:
             )
 
     def _finish(self, spec: TaskSpec, result: Any) -> None:
+        if spec.streaming:
+            self._finish_streaming(spec, result)
+            return
         rids = spec.return_ids()
         if spec.num_returns == 1:
             values = [result]
@@ -396,6 +399,32 @@ class WorkerRuntime:
                 self.rpc.call("store", "seal", oid, False)
                 results.append((oid, None, False))
         self.channel.send("done", spec.task_id, results, None)
+
+    def _finish_streaming(self, spec: TaskSpec, result: Any) -> None:
+        """Iterate a generator task: each yield becomes its own sealed
+        object (ObjectID.for_stream) announced to the head; the primary
+        return carries the final item count (reference: streaming
+        generators, _raylet.pyx:1074-1317)."""
+        from .ids import ObjectID as _OID
+
+        count = 0
+        try:
+            if result is not None and hasattr(result, "__iter__"):
+                for item in result:
+                    oid = _OID.for_stream(spec.task_id, count)
+                    self._store_object(oid, serialization.serialize(item),
+                                       is_error=False)
+                    # one-way after the seal rpc returns: order guaranteed
+                    self.channel.send("stream", spec.task_id, count)
+                    count += 1
+        except Exception as e:  # mid-stream user error
+            self._send_error(spec, e)
+            return
+        spec.streaming = False  # primary return is a normal value now
+        self._finish(spec, count)
+
+    def stream_next(self, task_id, index: int, timeout=None):
+        return self.rpc.call("rpc", "stream_next", task_id, index, timeout)
 
     def _send_error(self, spec: TaskSpec, exc: Exception) -> None:
         if isinstance(exc, TaskError):

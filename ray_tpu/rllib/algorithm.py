@@ -85,6 +85,12 @@ class EnvRunnerGroup:
         restored = 0
         for i, ok in enumerate(self._healthy):
             if not ok:
+                try:
+                    # a HUNG (not dead) runner would otherwise keep its
+                    # worker process + CPU reservation forever
+                    ray_tpu.kill(self._runners[i])
+                except Exception:  # noqa: BLE001
+                    pass
                 self.num_restarts += 1
                 self._runners[i] = self._make_runner(i)
                 self._healthy[i] = True

@@ -13,7 +13,6 @@ marked invalid in the batch (``valid`` mask) and filtered before training.
 from __future__ import annotations
 
 import time
-from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -38,8 +37,9 @@ class SingleAgentEnvRunner:
         self._prev_done = np.zeros(num_envs, bool)
         self._ep_returns = np.zeros(num_envs, np.float64)
         self._ep_lens = np.zeros(num_envs, np.int64)
-        self._completed_returns: deque = deque(maxlen=100)
-        self._completed_lens: deque = deque(maxlen=100)
+        # drained on each sample() so an episode is reported exactly once
+        self._completed_returns: list = []
+        self._completed_lens: list = []
 
     # ---- weights ----
 
@@ -130,11 +130,13 @@ class SingleAgentEnvRunner:
             "vf_preds": vf_buf, "valid": valid_buf, "vf_last": vf_last,
         }
         stats = {
-            "episode_returns": list(self._completed_returns),
-            "episode_lens": list(self._completed_lens),
+            "episode_returns": self._completed_returns,
+            "episode_lens": self._completed_lens,
             "env_steps": int(valid_buf.sum()),
             "sample_time_s": time.perf_counter() - t0,
         }
+        self._completed_returns = []
+        self._completed_lens = []
         return batch, stats
 
 

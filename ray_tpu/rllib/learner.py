@@ -125,16 +125,19 @@ class Learner:
 
     # ---- weights ----
 
-    def get_weights(self) -> Dict[str, np.ndarray]:
+    def get_weights(self):
         import jax
 
-        return {k: np.asarray(v) for k, v in
-                jax.tree.map(lambda x: x, self.state["params"]).items()}
+        # pytree map, not dict comprehension: module_class is pluggable and
+        # a custom module's params may be arbitrarily nested
+        return jax.tree.map(np.asarray, self.state["params"])
 
     def set_weights(self, weights) -> None:
+        import jax
+
         import jax.numpy as jnp
 
-        self.state["params"] = {k: jnp.asarray(v) for k, v in weights.items()}
+        self.state["params"] = jax.tree.map(jnp.asarray, weights)
 
     def get_state(self):
         import pickle
@@ -213,15 +216,15 @@ class LearnerGroup:
 
         n = len(flat_batch["actions"])
         world = len(self._actors)
-        per = n // world
+        per = max(1, n // world)
+        mbs = max(1, minibatch_size // world)
         refs = []
         for rank, a in enumerate(self._actors):
             shard = {k: v[rank * per:(rank + 1) * per]
                      for k, v in flat_batch.items()}
             # same seed everywhere: ranks must take identical minibatch
             # counts/order for the allreduce schedule to line up
-            refs.append(a.update.remote(shard, num_epochs,
-                                        minibatch_size // world, seed))
+            refs.append(a.update.remote(shard, num_epochs, mbs, seed))
         stats = ray_tpu.get(refs)
         keys = stats[0].keys() if stats else ()
         return {k: float(np.mean([s[k] for s in stats])) for k in keys}

@@ -31,25 +31,25 @@ class JaxRLModule:
         self.act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[activation]
 
     def init(self, rng) -> Dict[str, Any]:
-        keys = jax.random.split(rng, 2 * len(self.hiddens) + 2)
+        keys = iter(jax.random.split(rng, 2 * len(self.hiddens) + 2))
         params: Dict[str, Any] = {}
-        in_dim = self.obs_dim
-        # separate policy / value towers (reference PPO catalog default)
+        d = self.obs_dim
+        # separate policy / value towers (reference PPO catalog default);
+        # one distinct key per weight matrix
         for tower in ("pi", "vf"):
-            d = in_dim
+            d = self.obs_dim
             for i, h in enumerate(self.hiddens):
-                k = keys[len(params) % len(keys)]
                 params[f"{tower}_w{i}"] = (
-                    jax.random.normal(k, (d, h), jnp.float32)
+                    jax.random.normal(next(keys), (d, h), jnp.float32)
                     * np.sqrt(2.0 / d))
                 params[f"{tower}_b{i}"] = jnp.zeros((h,), jnp.float32)
                 d = h
         params["pi_out_w"] = (
-            jax.random.normal(keys[-2], (d, self.num_actions), jnp.float32)
+            jax.random.normal(next(keys), (d, self.num_actions), jnp.float32)
             * 0.01)
         params["pi_out_b"] = jnp.zeros((self.num_actions,), jnp.float32)
         params["vf_out_w"] = (
-            jax.random.normal(keys[-1], (d, 1), jnp.float32) * 1.0)
+            jax.random.normal(next(keys), (d, 1), jnp.float32) * 1.0)
         params["vf_out_b"] = jnp.zeros((1,), jnp.float32)
         return params
 
