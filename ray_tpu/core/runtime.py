@@ -1011,6 +1011,13 @@ class Head:
             with self._lock:
                 self.ref_counts[args[0]] += 1
             return None
+        if op == "unregister_owned_object":
+            with self._lock:
+                self.ref_counts[args[0]] -= 1
+                should_delete = self.ref_counts[args[0]] <= 0
+            if should_delete and not self._stopped:
+                self.delete_object(args[0])
+            return None
         if op == "available_resources":
             return self.scheduler.available_resources()
         if op == "cluster_resources":

@@ -172,12 +172,17 @@ class Dataset:
     def random_sample(self, fraction: float,
                       *, seed: Optional[int] = None) -> "Dataset":
         def sample(row, _frac=fraction, _seed=seed):
-            rng = np.random  # per-row hash sampling is deterministic w/ seed
             if _seed is not None:
-                h = hash((repr(sorted(row.items())
-                               if isinstance(row, dict) else row), _seed))
+                # process-stable hash: built-in hash() is salted per
+                # process (PYTHONHASHSEED), which breaks determinism when
+                # rows are filtered in remote workers
+                import zlib
+
+                key = repr((sorted(row.items())
+                            if isinstance(row, dict) else row, _seed))
+                h = zlib.crc32(key.encode())
                 return (h % 10_000_000) / 10_000_000 < _frac
-            return rng.random() < _frac
+            return np.random.random() < _frac
 
         return self.filter(sample)
 
@@ -323,10 +328,21 @@ class Dataset:
 
         Reference: dataset.py:1236 + _internal/execution/operators/
         output_splitter.py — here a coordinator actor executes the plan and
-        deals output blocks round-robin to per-split queues.
+        deals output blocks round-robin to per-split queues. With
+        ``equal=True`` every block is sliced into n equal shares (per-block
+        remainder rows dropped), so all splits yield IDENTICAL row counts
+        per epoch — unequal splits feeding gang-scheduled SPMD Train
+        workers produce different batch counts and hang collectives.
         """
+        if locality_hints is not None:
+            import warnings
+
+            warnings.warn(
+                "streaming_split(locality_hints=...) is not honored: "
+                "the single-coordinator dealer has no block-locality "
+                "tracking yet", stacklevel=2)
         coordinator = _SplitCoordinator.options(max_concurrency=n + 2) \
-            .remote(self, n)
+            .remote(self, n, equal)
 
         def make_source(idx: int):
             epoch_box = [0]
@@ -517,11 +533,12 @@ class _SplitCoordinator:
     n consumer queues. A new epoch starts once every split requests it
     (gang barrier — Train workers iterate epochs in lockstep)."""
 
-    def __init__(self, ds: Dataset, n: int):
+    def __init__(self, ds: Dataset, n: int, equal: bool = False):
         import collections
 
         self._ds = ds
         self._n = n
+        self._equal = equal
         self._queues = [collections.deque() for _ in _range(n)]
         self._done = False
         self._epoch = -1
@@ -530,11 +547,50 @@ class _SplitCoordinator:
 
     def _pump(self):
         def run():
+            from .executor import _slice_range_task
+
             try:
                 i = 0
                 for bundle in self._ds._execute():
-                    with self._lock:
-                        self._queues[i % self._n].append(bundle.ref)
+                    if self._equal:
+                        rows = bundle.num_rows
+                        if rows is None:
+                            import ray_tpu as _rt
+
+                            from .block import BlockAccessor as _BA
+
+                            rows = _BA.for_block(
+                                _rt.get(bundle.ref)).num_rows()
+                        per = rows // self._n
+                        if per == 0:
+                            continue  # tiny block: dropped entirely
+                        # Pin the block across the submission burst: the
+                        # first share task can finish (unpinning the block
+                        # to refcount 0 -> deleted) before the later
+                        # shares are even submitted, stranding them in
+                        # WAITING_DEPS forever. Worker-held ObjectRefs do
+                        # not count head-side (centralized ownership).
+                        from ray_tpu.core import runtime as _runtime_mod
+
+                        rt = _runtime_mod.get_current_runtime()
+                        pinned = hasattr(rt, "rpc")
+                        if pinned:
+                            rt.rpc.call("rpc", "register_owned_object",
+                                        bundle.ref.id)
+                        shares = [
+                            _slice_range_task.remote(
+                                k * per, (k + 1) * per, [rows], bundle.ref)
+                            for k in _range(self._n)
+                        ]
+                        if pinned:
+                            rt.rpc.call("rpc", "unregister_owned_object",
+                                        bundle.ref.id)
+                        with self._lock:
+                            for k, ref in enumerate(shares):
+                                self._queues[k].append(ref)
+                    else:
+                        with self._lock:
+                            self._queues[i % self._n].append(bundle.ref)
                     i += 1
             finally:
                 self._done = True

@@ -943,10 +943,11 @@ class StreamingExecutor:
                     progress |= op.poll()
                 progress |= self._move_outputs()
                 for op in self.ops:
-                    out_bp = False
                     consumers = self.edges.get(id(op), [])
-                    if consumers and consumers[0].input_backpressure():
-                        out_bp = True
+                    # fan-out (union/zip reuse): EVERY consumer edge must
+                    # have room, matching _move_outputs' condition —
+                    # otherwise one saturated consumer defeats backpressure
+                    out_bp = any(c.input_backpressure() for c in consumers)
                     progress |= op.dispatch(out_bp)
                 while self.final_op.has_next():
                     yield self.final_op.get_next()

@@ -216,7 +216,11 @@ class LearnerGroup:
 
         n = len(flat_batch["actions"])
         world = len(self._actors)
-        per = max(1, n // world)
+        if n < world:
+            raise ValueError(
+                f"train batch of {n} rows cannot shard over {world} "
+                f"learners; raise train_batch_size or lower num_learners")
+        per = n // world
         mbs = max(1, minibatch_size // world)
         refs = []
         for rank, a in enumerate(self._actors):

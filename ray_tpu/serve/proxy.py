@@ -45,6 +45,8 @@ class HTTPProxy:
         self.host = host
         self.port = port
         self._handles: Dict[str, Any] = {}
+        self._routes_cache: Dict[str, str] = {}
+        self._routes_expiry = 0.0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
         self._runner = None
@@ -60,9 +62,22 @@ class HTTPProxy:
         return self._handles[name]
 
     async def _handler(self, request):
+        import time as _time
+
         from aiohttp import web
 
-        routes = ray_tpu.get(self._controller.get_route_table.remote())
+        loop = asyncio.get_event_loop()
+        # never block the event loop: route table fetched off-loop and
+        # cached briefly (long-poll push is the reference design; this is
+        # the polling analog with a bounded staleness window)
+        now = _time.monotonic()
+        if now >= self._routes_expiry:
+            self._routes_cache = await loop.run_in_executor(
+                None,
+                lambda: ray_tpu.get(
+                    self._controller.get_route_table.remote()))
+            self._routes_expiry = now + 1.0
+        routes = self._routes_cache
         path = request.path
         match = None
         for prefix in sorted(routes, key=len, reverse=True):
@@ -78,11 +93,13 @@ class HTTPProxy:
                       dict(request.query),
                       {k: v for k, v in request.headers.items()}, body)
         handle = self._get_handle(match)
-        loop = asyncio.get_event_loop()
         try:
-            response = handle.remote(req)
-            result = await loop.run_in_executor(
-                None, lambda: response.result(timeout=60))
+            # handle.remote() can spin in Router.choose() waiting for
+            # replicas — run it off the event loop too
+            def _call():
+                return handle.remote(req).result(timeout=60)
+
+            result = await loop.run_in_executor(None, _call)
         except Exception as e:  # noqa: BLE001
             return web.Response(status=500, text=str(e))
         if isinstance(result, (dict, list)):

@@ -166,8 +166,10 @@ class TuneController:
                  name: Optional[str] = None,
                  max_failures: int = 0,
                  trial_resources: Optional[Dict[str, float]] = None,
-                 checkpoint_freq: int = 0):
+                 checkpoint_freq: int = 0,
+                 restore_state: Optional[Dict[str, Any]] = None):
         self.trainable = trainable
+        self._restore_state = restore_state
         self.is_function = not (isinstance(trainable, type)
                                 and issubclass(trainable, Trainable))
         self.metric = metric
@@ -192,6 +194,46 @@ class TuneController:
         self._failures: Dict[str, int] = {}
 
     # -- trial lifecycle
+    def _prefill_from_restore(self) -> None:
+        """Recreate trials from a saved experiment_state (Tuner.restore):
+        TERMINATED trials keep their results and are not re-run; others
+        restart as PENDING, resuming from their last checkpoint. The
+        searcher is advanced past the restored trials so deterministic
+        searchers (grid/seeded random) don't regenerate them."""
+        import base64
+
+        import cloudpickle
+
+        saved = (self._restore_state or {}).get("trials", [])
+        for rec in saved:
+            if "config_pkl" in rec:
+                cfg = cloudpickle.loads(base64.b64decode(rec["config_pkl"]))
+            else:
+                continue  # legacy repr-only state: cannot reconstruct
+            trial = Trial(
+                trial_id=rec["trial_id"], config=cfg,
+                trial_dir=os.path.join(self.exp_dir, rec["trial_id"]))
+            if rec["status"] == "TERMINATED":
+                trial.status = "TERMINATED"
+                trial.last_result = rec.get("last_result") or {}
+                trial.iteration = rec.get("iteration", 0)
+                trial.checkpoint_path = rec.get("checkpoint_path")
+            else:
+                trial.status = "PENDING"
+                trial.restore_from = rec.get("checkpoint_path")
+            self.trials.append(trial)
+            self.searcher.suggest(trial.trial_id)  # consume one suggestion
+            if trial.status == "TERMINATED":
+                # free ConcurrencyLimiter-style live slots immediately:
+                # restored-complete trials never reach the normal
+                # completion path
+                try:
+                    self.searcher.on_trial_complete(
+                        trial.trial_id,
+                        result=trial.last_result or None)
+                except Exception:
+                    pass
+
     def _new_trial(self) -> Optional[Trial]:
         trial_id = uuid.uuid4().hex[:8]
         cfg = self.searcher.suggest(trial_id)
@@ -294,13 +336,31 @@ class TuneController:
             self.searcher.on_trial_complete(trial.trial_id, error=True)
 
     # -- checkpointing of experiment state
+    @staticmethod
+    def _config_pkl(t: Trial) -> str:
+        """Lossless config for Tuner.restore, cached per config object —
+        save_experiment_state runs every loop iteration and configs only
+        change on PBT exploit."""
+        import base64
+
+        import cloudpickle
+
+        cached = getattr(t, "_config_pkl_cache", None)
+        if cached is None or cached[0] is not t.config:
+            cached = (t.config, base64.b64encode(
+                cloudpickle.dumps(t.config)).decode())
+            t._config_pkl_cache = cached
+        return cached[1]
+
     def save_experiment_state(self) -> None:
         state = {
             "exp_name": self.exp_name,
             "trials": [{
                 "trial_id": t.trial_id, "config_repr": repr(t.config),
+                "config_pkl": self._config_pkl(t),
                 "status": t.status, "last_result": _json_safe(t.last_result),
                 "checkpoint_path": t.checkpoint_path, "error": t.error,
+                "iteration": t.iteration,
             } for t in self.trials],
         }
         with open(os.path.join(self.exp_dir, "experiment_state.json"),
@@ -309,6 +369,8 @@ class TuneController:
 
     # -- the run loop (reference: tune_controller.py step :666)
     def run(self) -> List[Trial]:
+        if self._restore_state:
+            self._prefill_from_restore()
         searcher_exhausted = False
         while True:
             # launch new trials
@@ -323,10 +385,11 @@ class TuneController:
                     break
                 self._start_trial(t)
                 running.append(t)
-            # restart pending (exploited / retried) trials
+            # restart pending (exploited / retried / restored) trials
             for t in self.trials:
                 if t.status == "PENDING" and t.actor is None \
-                        and t.restore_from is not None:
+                        and len([x for x in self.trials
+                                 if x.status == "RUNNING"]) < self.max_concurrent:
                     self._start_trial(t)
 
             running = [t for t in self.trials if t.status == "RUNNING"]

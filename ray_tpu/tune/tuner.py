@@ -127,6 +127,12 @@ class Tuner:
         searcher = tc.search_alg
         if searcher is not None and hasattr(searcher, "set_space"):
             searcher.set_space(param_space)
+        restore_path = getattr(self, "_restore_path", None)
+        if restore_path:
+            # continue in the SAME experiment dir so trial dirs/checkpoints
+            # of restored trials resolve
+            rc.storage_path = os.path.dirname(os.path.abspath(restore_path))
+            rc.name = os.path.basename(os.path.abspath(restore_path))
         controller = TuneController(
             trainable,
             param_space=param_space,
@@ -141,17 +147,26 @@ class Tuner:
             max_failures=rc.failure_config.max_failures,
             trial_resources=tc.trial_resources,
             checkpoint_freq=tc.checkpoint_freq,
+            restore_state=getattr(self, "_restore_state", None),
         )
         trials = controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)
 
     @classmethod
-    def restore(cls, path: str, trainable) -> "Tuner":
-        """Resume an interrupted experiment from its state file."""
+    def restore(cls, path: str, trainable, *, param_space=None,
+                tune_config=None, run_config=None) -> "Tuner":
+        """Resume an interrupted experiment from its state file.
+
+        Pass the ORIGINAL ``param_space``/``tune_config`` so trials not yet
+        generated before the interruption are still produced; restored
+        trials consume the first suggestions (deterministic searchers —
+        grid, seeded random — realign; finished trials are not re-run).
+        """
         state_file = os.path.join(path, "experiment_state.json")
         with open(state_file) as f:
             state = json.load(f)
-        t = cls(trainable)
+        t = cls(trainable, param_space=param_space, tune_config=tune_config,
+                run_config=run_config)
         t._restore_path = path
         t._restore_state = state
         return t
