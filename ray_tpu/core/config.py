@@ -61,6 +61,7 @@ class Config:
     health_check_failure_threshold: int = 5
 
     # ---- observability ----
+    log_to_driver: bool = True  # tail worker stdout/stderr to the driver
     task_events_enabled: bool = True
     task_events_max_buffered: int = 100_000
     metrics_report_interval_ms: int = 10_000

@@ -78,6 +78,8 @@ class Node:
         )
         self._accept_thread.start()
         self._num_starting = 0
+        self._tail_files: Dict[str, list] = {}  # path -> [offset, pid, dead_ts]
+        self._log_tailer_started = False
         # pids spawned but not yet counted down — the countdown happens
         # exactly once, on whichever of (registration, process exit)
         # happens first
@@ -194,7 +196,8 @@ class Node:
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         log_path = os.path.join(self.session_dir, "logs")
         os.makedirs(log_path, exist_ok=True)
-        out = open(os.path.join(log_path, f"worker-{time.time_ns()}.log"), "ab")
+        log_file = os.path.join(log_path, f"worker-{time.time_ns()}.log")
+        out = open(log_file, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu.core.worker_runtime",
              "--address", self._sock_path, "--authkey", self._authkey.hex()],
@@ -202,6 +205,8 @@ class Node:
             cwd=os.getcwd(),
         )
         self._starting_pids.add(proc.pid)
+        self._tail_files[log_file] = [0, proc.pid, None]
+        self._ensure_log_tailer()
         # handle registered on accept
         threading.Thread(
             target=self._reap, args=(proc,), daemon=True
@@ -215,6 +220,10 @@ class Node:
             if proc.pid in self._starting_pids:
                 self._starting_pids.discard(proc.pid)
                 self._num_starting = max(0, self._num_starting - 1)
+            for st in self._tail_files.values():
+                if st[1] == proc.pid and st[2] is None:
+                    st[2] = time.monotonic()  # tailer drops it after a
+                    # final read window
 
     def _accept_loop(self) -> None:
         import multiprocessing.context as _mpctx
@@ -282,6 +291,9 @@ class Node:
                     self.store.remove_ref(oid)
             elif tag == "stream":
                 self.head.on_stream_item(*payload)
+            elif tag == "metrics":
+                self.head.on_worker_metrics(
+                    f"{self.hex[:6]}:{w.pid}", payload[0])
             elif tag == "unstaged":
                 # worker handed back a staged-unstarted task: requeue it
                 tid = payload[0]
@@ -406,6 +418,39 @@ class Node:
             pass
         if force:
             self.kill_worker(target.worker_id)
+
+    def _ensure_log_tailer(self) -> None:
+        """Tail worker log files -> head -> driver stderr (reference:
+        log_monitor.py:581 tails per-proc files to the driver)."""
+        if self._log_tailer_started or not global_config().log_to_driver:
+            return
+        self._log_tailer_started = True
+
+        def tail():
+            while self.alive:
+                now = time.monotonic()
+                for path, st in list(self._tail_files.items()):
+                    try:
+                        with open(path, "rb") as f:
+                            f.seek(st[0])
+                            data = f.read()
+                    except OSError:
+                        self._tail_files.pop(path, None)
+                        continue
+                    if data:
+                        st[0] += len(data)
+                        try:
+                            self.head.on_worker_log(
+                                self.hex, st[1],
+                                data.decode("utf-8", "replace"))
+                        except Exception:
+                            pass
+                    if st[2] is not None and now - st[2] > 2.0:
+                        self._tail_files.pop(path, None)  # worker gone
+                time.sleep(0.5)
+
+        threading.Thread(target=tail, daemon=True,
+                         name=f"logtail-{self.hex[:6]}").start()
 
     def start_object_server(self, authkey: bytes, host: str = "127.0.0.1"):
         """Start the node-to-node chunk server (multi-host mode)."""

@@ -137,6 +137,12 @@ class RemoteHead:
     def on_stream_item(self, task_id, index: int) -> None:
         self._send("stream_item", task_id, index)
 
+    def on_worker_metrics(self, source_id: str, snapshot: dict) -> None:
+        self._send("worker_metrics", source_id, snapshot)
+
+    def on_worker_log(self, node_hex: str, pid: int, text: str) -> None:
+        self._send("worker_log", node_hex, pid, text)
+
     def on_worker_exit(self, node, w) -> None:
         self._send("worker_exit", w.worker_id, w.actor_id, w.pid)
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import sys
 import tempfile
 import threading
 import time
@@ -275,6 +276,10 @@ class Head:
                 self.on_object_sealed(payload[0], proxy.hex)
             elif tag == "stream_item":
                 self.on_stream_item(payload[0], payload[1])
+            elif tag == "worker_metrics":
+                self.on_worker_metrics(payload[0], payload[1])
+            elif tag == "worker_log":
+                self.on_worker_log(payload[0], payload[1], payload[2])
             elif tag == "worker_exit":
                 w = types.SimpleNamespace(worker_id=payload[0],
                                           actor_id=payload[1], pid=payload[2])
@@ -738,8 +743,14 @@ class Head:
 
     # ------------------------------------------------------------ worker events
 
+    def _retire_worker_metrics(self, node, w) -> None:
+        from ray_tpu.util.metrics import registry
+
+        registry().retire(f"{node.hex[:6]}:{w.pid}")
+
     def on_worker_exit(self, node: Node, w: WorkerHandle) -> None:
         """Graceful actor termination (__ray_terminate__)."""
+        self._retire_worker_metrics(node, w)
         if w.actor_id is not None:
             with self._lock:
                 arec = self.actors.get(w.actor_id)
@@ -768,6 +779,7 @@ class Head:
                           prev_state: str) -> None:
         if self._stopped or not node.alive:
             return
+        self._retire_worker_metrics(node, w)
         if w.actor_id is not None:
             with self._lock:
                 arec = self.actors.get(w.actor_id)
@@ -801,6 +813,85 @@ class Head:
             rec = self.tasks.get(tid)
             rec.state = "QUEUED"
             self.scheduler.submit(rec.spec)
+
+    def state_list(self, kind: str, limit: int = 1000):
+        """State API backend (reference: python/ray/util/state/api.py)."""
+        gcs = self.gcs
+        if kind == "tasks":
+            latest: Dict[bytes, dict] = {}
+            for ev in list(gcs.task_events):
+                latest[ev.task_id] = {
+                    "task_id": ev.task_id.hex(), "name": ev.name,
+                    "state": ev.state, "node_hex": ev.node_hex,
+                    "ts": ev.ts, "attempt": ev.attempt, "error": ev.error,
+                }
+            return list(latest.values())[-limit:]
+        if kind == "actors":
+            return [{
+                "actor_id": a.actor_id.hex(), "class_name": a.class_name,
+                "state": a.state, "name": a.name,
+                "node_hex": getattr(a, "node_hex", None),
+            } for a in list(gcs.actors.values())[:limit]]
+        if kind == "nodes":
+            return [{
+                "node_id": n.hex, "alive": n.Alive
+                if hasattr(n, "Alive") else n.alive,
+                "resources": n.resources_total, "labels": n.labels,
+            } for n in list(gcs.nodes.values())[:limit]]
+        if kind == "objects":
+            with self._lock:
+                items = list(gcs.object_dir.items())[:limit]
+            return [{"object_id": oid.hex(), "locations": sorted(locs),
+                     "ref_count": self.ref_counts.get(oid, 0)}
+                    for oid, locs in items]
+        if kind == "placement_groups":
+            return [{"pg_id": pid.hex(), "state": pg.state,
+                     "bundles": len(pg.bundles)}
+                    for pid, pg in
+                    list(self.scheduler._pgs.items())[:limit]]
+        raise ValueError(f"unknown state kind {kind!r}")
+
+    def on_worker_metrics(self, source_id: str, snapshot: dict) -> None:
+        from ray_tpu.util.metrics import registry
+
+        registry().merge(source_id, snapshot)
+
+    def on_worker_log(self, node_hex: str, pid: int, text: str) -> None:
+        """Tail-to-driver (reference: log_monitor.py -> driver stdout)."""
+        if not global_config().log_to_driver:
+            return
+        prefix = f"({node_hex[:6]} pid={pid}) "
+        for line in text.splitlines():
+            print(prefix + line, file=sys.stderr)
+
+    def start_metrics_server(self, host: str = "127.0.0.1", port: int = 0):
+        """Prometheus text endpoint (reference: metrics agent re-export)."""
+        import http.server
+
+        from ray_tpu.util.metrics import registry, render_prometheus
+
+        if getattr(self, "_metrics_server", None) is not None:
+            return self._metrics_address
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(handler):  # noqa: N805
+                body = render_prometheus(registry()).encode()
+                handler.send_response(200)
+                handler.send_header("Content-Type",
+                                    "text/plain; version=0.0.4")
+                handler.send_header("Content-Length", str(len(body)))
+                handler.end_headers()
+                handler.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        srv = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._metrics_server = srv
+        self._metrics_address = srv.server_address
+        threading.Thread(target=srv.serve_forever, daemon=True,
+                         name="metrics-http").start()
+        return self._metrics_address
 
     def on_stream_item(self, task_id: TaskID, index: int) -> None:
         """A streaming task sealed item ``index`` (reference: streaming
@@ -1007,6 +1098,8 @@ class Head:
             return getattr(self.gcs, "kv_" + sub)(*rest)
         if op == "stream_next":
             return self.stream_next(args[0], args[1], args[2])
+        if op == "state_list":
+            return self.state_list(args[0], args[1])
         if op == "register_owned_object":
             with self._lock:
                 self.ref_counts[args[0]] += 1
@@ -1066,6 +1159,11 @@ class Head:
             node.cancel_task(tid, worker_id, force)
 
     def _record_event(self, spec: TaskSpec, state: str, node_hex=None, error=None):
+        from ray_tpu.util.metrics import registry
+
+        registry().record("ray_tpu_tasks_total", "counter",
+                          "task state transitions",
+                          (("state", state),), 1.0, mode="add")
         self.gcs.record_task_event(TaskEvent(
             task_id=spec.task_id.binary(), name=spec.function_name, state=state,
             node_hex=node_hex, ts=time.time(), attempt=spec.attempt, error=error,
@@ -1082,6 +1180,12 @@ class Head:
             self._node_listener = None
         if self._daemon_pool is not None:
             self._daemon_pool.shutdown(wait=False)
+        if getattr(self, "_metrics_server", None) is not None:
+            try:
+                self._metrics_server.shutdown()
+                self._metrics_server.server_close()  # release the socket
+            except Exception:
+                pass
         with self._lock:
             nodes = list(self.nodes.values())
             self.nodes.clear()

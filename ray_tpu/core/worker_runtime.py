@@ -457,6 +457,11 @@ def worker_main(argv=None) -> None:
     from . import runtime as runtime_mod
 
     runtime_mod.set_current_runtime(runtime)
+    from ray_tpu.util.metrics import start_report_thread
+
+    start_report_thread(
+        lambda snap: channel.send("metrics", snap),
+        global_config().metrics_report_interval_ms / 1000.0)
     from ray_tpu.util.sampling_profiler import start_from_env
 
     _dump_profile = start_from_env()  # RAY_TPU_SAMPLER=<prefix> to enable

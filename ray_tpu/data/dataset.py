@@ -549,6 +549,42 @@ class _SplitCoordinator:
         def run():
             from .executor import _slice_range_task
 
+            buf_refs: list = []
+            buf_counts: list = []
+
+            def flush():
+                """Deal the buffered blocks as n equal row shares.
+
+                Pin the blocks across the submission burst: the first
+                share task can finish (unpinning a block to refcount 0 ->
+                deleted) before the later shares are even submitted,
+                stranding them in WAITING_DEPS. Worker-held ObjectRefs
+                do not count head-side (centralized ownership)."""
+                total = sum(buf_counts)
+                per = total // self._n
+                if per == 0:
+                    return  # under n rows even accumulated: drop
+                from ray_tpu.core import runtime as _runtime_mod
+
+                rt = _runtime_mod.get_current_runtime()
+                pinned = hasattr(rt, "rpc")
+                if pinned:
+                    for r in buf_refs:
+                        rt.rpc.call("rpc", "register_owned_object", r.id)
+                shares = [
+                    _slice_range_task.remote(
+                        k * per, (k + 1) * per, list(buf_counts), *buf_refs)
+                    for k in _range(self._n)
+                ]
+                if pinned:
+                    for r in buf_refs:
+                        rt.rpc.call("rpc", "unregister_owned_object", r.id)
+                with self._lock:
+                    for k, ref in enumerate(shares):
+                        self._queues[k].append(ref)
+                buf_refs.clear()
+                buf_counts.clear()
+
             try:
                 i = 0
                 for bundle in self._ds._execute():
@@ -561,37 +597,18 @@ class _SplitCoordinator:
 
                             rows = _BA.for_block(
                                 _rt.get(bundle.ref)).num_rows()
-                        per = rows // self._n
-                        if per == 0:
-                            continue  # tiny block: dropped entirely
-                        # Pin the block across the submission burst: the
-                        # first share task can finish (unpinning the block
-                        # to refcount 0 -> deleted) before the later
-                        # shares are even submitted, stranding them in
-                        # WAITING_DEPS forever. Worker-held ObjectRefs do
-                        # not count head-side (centralized ownership).
-                        from ray_tpu.core import runtime as _runtime_mod
-
-                        rt = _runtime_mod.get_current_runtime()
-                        pinned = hasattr(rt, "rpc")
-                        if pinned:
-                            rt.rpc.call("rpc", "register_owned_object",
-                                        bundle.ref.id)
-                        shares = [
-                            _slice_range_task.remote(
-                                k * per, (k + 1) * per, [rows], bundle.ref)
-                            for k in _range(self._n)
-                        ]
-                        if pinned:
-                            rt.rpc.call("rpc", "unregister_owned_object",
-                                        bundle.ref.id)
-                        with self._lock:
-                            for k, ref in enumerate(shares):
-                                self._queues[k].append(ref)
+                        # accumulate so blocks smaller than n rows are
+                        # never silently dropped whole
+                        buf_refs.append(bundle.ref)
+                        buf_counts.append(rows)
+                        if sum(buf_counts) >= self._n:
+                            flush()
                     else:
                         with self._lock:
                             self._queues[i % self._n].append(bundle.ref)
                     i += 1
+                if self._equal and buf_refs:
+                    flush()
             finally:
                 self._done = True
 

@@ -222,7 +222,15 @@ class TuneController:
                 trial.status = "PENDING"
                 trial.restore_from = rec.get("checkpoint_path")
             self.trials.append(trial)
-            self.searcher.suggest(trial.trial_id)  # consume one suggestion
+            sug = self.searcher.suggest(trial.trial_id)
+            if sug == "PENDING":
+                import warnings
+
+                warnings.warn(
+                    "restore: searcher (e.g. ConcurrencyLimiter at "
+                    "capacity) did not advance past a restored trial; "
+                    "deterministic searchers may regenerate its config",
+                    stacklevel=2)
             if trial.status == "TERMINATED":
                 # free ConcurrencyLimiter-style live slots immediately:
                 # restored-complete trials never reach the normal

@@ -6,3 +6,5 @@ from ray_tpu.core.placement_group import (  # noqa: F401
     placement_group_table,
     remove_placement_group,
 )
+
+from ray_tpu.util import metrics, state  # noqa: F401,E402

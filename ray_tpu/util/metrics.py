@@ -1,0 +1,228 @@
+"""Application + runtime metrics: Counter/Gauge/Histogram with a
+Prometheus text endpoint on the head.
+
+Analog of ``ray.util.metrics`` over the reference's stats pipeline
+(src/ray/stats/metric.h -> per-node metrics agent -> Prometheus,
+python/ray/_private/metrics_agent.py:51). Here each process keeps a local
+registry; worker registries flush to the head piggybacked on the worker
+channel ("metrics" one-way messages, metrics_report_interval_ms); the head
+aggregates and serves the Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_TagKey = Tuple[Tuple[str, str], ...]
+
+
+class _Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # name -> {"type", "help", "values": {tag_key: float}, "buckets"?}
+        self.metrics: Dict[str, dict] = {}
+        self._dirty = False
+
+    def record(self, name: str, mtype: str, help_: str, tags: _TagKey,
+               value: float, mode: str = "set",
+               buckets: Optional[List[float]] = None) -> None:
+        with self._lock:
+            m = self.metrics.setdefault(
+                name, {"type": mtype, "help": help_, "values": {},
+                       "buckets": buckets})
+            if mode == "add":
+                m["values"][tags] = m["values"].get(tags, 0.0) + value
+            elif mode == "observe":  # histogram: per-bucket counts + sum
+                counts = m["values"].setdefault(tags, _hist_zero(buckets))
+                counts["sum"] += value
+                counts["count"] += 1
+                for b in buckets or ():
+                    if value <= b:
+                        counts["le"][b] += 1
+            else:
+                m["values"][tags] = value
+            self._dirty = True
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            self._dirty = False
+            out = {}
+            for name, m in self.metrics.items():
+                out[name] = {"type": m["type"], "help": m["help"],
+                             "buckets": m["buckets"],
+                             "values": {k: (dict(v, le=dict(v["le"]))
+                                            if isinstance(v, dict) else v)
+                                        for k, v in m["values"].items()}}
+            return out
+
+    def retire(self, source_id: str) -> None:
+        """A source (worker) died: fold its cumulative metrics (counters,
+        histograms) into a retired accumulator so sums stay monotonic if
+        the node:pid source id is ever reused, and drop its gauges so
+        /metrics stops exporting stale liveness values."""
+        with self._lock:
+            for m in self.metrics.values():
+                sources = m.get("sources") or {}
+                values = sources.pop(source_id, None)
+                if values is None:
+                    continue
+                if m["type"] == "gauge":
+                    continue  # dropped
+                retired = sources.setdefault("_retired", {})
+                for tags, v in values.items():
+                    if m["type"] == "histogram":
+                        acc = retired.setdefault(tags,
+                                                 _hist_zero(m["buckets"]))
+                        acc["sum"] += v["sum"]
+                        acc["count"] += v["count"]
+                        for b, c in (v.get("le") or {}).items():
+                            acc["le"][b] = acc["le"].get(b, 0) + c
+                    else:
+                        retired[tags] = retired.get(tags, 0.0) + v
+
+    def merge(self, source_id: str, snap: Dict[str, dict]) -> None:
+        """Head-side: absorb a worker snapshot (keyed so re-reports
+        overwrite rather than double-count)."""
+        with self._lock:
+            for name, m in snap.items():
+                mine = self.metrics.setdefault(
+                    name, {"type": m["type"], "help": m["help"],
+                           "buckets": m.get("buckets"), "values": {},
+                           "sources": {}})
+                mine.setdefault("sources", {})[source_id] = m["values"]
+
+
+def _hist_zero(buckets):
+    return {"sum": 0.0, "count": 0, "le": {b: 0 for b in (buckets or ())}}
+
+
+_registry = _Registry()
+
+
+def registry() -> _Registry:
+    return _registry
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> _TagKey:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Counter:
+    """Monotonic counter (reference: ray.util.metrics.Counter)."""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        self._name = name
+        self._desc = description
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        _registry.record(self._name, "counter", self._desc,
+                         _tags_key(tags), value, mode="add")
+
+
+class Gauge:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Tuple[str, ...] = ()):
+        self._name = name
+        self._desc = description
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        _registry.record(self._name, "gauge", self._desc,
+                         _tags_key(tags), value, mode="set")
+
+
+class Histogram:
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Tuple[str, ...] = ()):
+        self._name = name
+        self._desc = description
+        self._buckets = sorted(boundaries or
+                               [0.001, 0.01, 0.1, 1, 10, 100])
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        _registry.record(self._name, "histogram", self._desc,
+                         _tags_key(tags), value, mode="observe",
+                         buckets=self._buckets)
+
+
+# --------------------------------------------------------------------------- #
+# Prometheus text rendering (head side)
+# --------------------------------------------------------------------------- #
+
+
+def _fmt_tags(tags: _TagKey, extra: Dict[str, str] = ()) -> str:
+    items = list(tags) + list(dict(extra).items() if extra else [])
+    if not items:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+def render_prometheus(reg: _Registry) -> str:
+    """All sources merged into Prometheus exposition text."""
+    lines: List[str] = []
+    with reg._lock:
+        for name, m in sorted(reg.metrics.items()):
+            lines.append(f"# HELP {name} {m['help']}")
+            lines.append(f"# TYPE {name} {m['type']}")
+            all_values: List[Tuple[str, _TagKey, object]] = []
+            for tags, v in m["values"].items():
+                all_values.append(("", tags, v))
+            for src, values in (m.get("sources") or {}).items():
+                for tags, v in values.items():
+                    all_values.append((src, tags, v))
+            if m["type"] == "histogram":
+                for src, tags, v in all_values:
+                    extra = {"source": src} if src else {}
+                    cum = 0
+                    for b in sorted((v.get("le") or {})):
+                        cum = v["le"][b]
+                        lines.append(
+                            f"{name}_bucket"
+                            f"{_fmt_tags(tags, dict(extra, le=str(b)))}"
+                            f" {cum}")
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_fmt_tags(tags, dict(extra, le='+Inf'))}"
+                        f" {v['count']}")
+                    lines.append(
+                        f"{name}_sum{_fmt_tags(tags, extra)} {v['sum']}")
+                    lines.append(
+                        f"{name}_count{_fmt_tags(tags, extra)} {v['count']}")
+            else:
+                # same metric from several sources: sum counters, keep
+                # per-source gauges
+                if m["type"] == "counter":
+                    agg: Dict[_TagKey, float] = {}
+                    for _, tags, v in all_values:
+                        agg[tags] = agg.get(tags, 0.0) + v
+                    for tags, v in agg.items():
+                        lines.append(f"{name}{_fmt_tags(tags)} {v}")
+                else:
+                    for src, tags, v in all_values:
+                        extra = {"source": src} if src else {}
+                        lines.append(f"{name}{_fmt_tags(tags, extra)} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def start_report_thread(send_fn, interval_s: float) -> threading.Event:
+    """Worker-side: periodically flush the local registry via send_fn."""
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval_s):
+            if _registry._dirty:
+                try:
+                    send_fn(_registry.snapshot())
+                except Exception:
+                    return
+
+    threading.Thread(target=loop, daemon=True,
+                     name="metrics-report").start()
+    return stop

@@ -1,0 +1,68 @@
+"""State API: list/summarize cluster entities.
+
+Analog of the reference's ``ray list tasks|actors|objects|nodes`` +
+summaries (python/ray/util/state/api.py, backed by the dashboard head and
+GlobalStateAccessor). Here the head's GCS tables are the single source of
+truth; workers reach them through the worker-RPC passthrough.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+def _state_query(kind: str, limit: int) -> List[Dict[str, Any]]:
+    from ray_tpu.core import runtime as runtime_mod
+
+    rt = runtime_mod.get_current_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    if hasattr(rt, "head"):  # driver
+        return rt.head.state_list(kind, limit)
+    return rt.rpc.call("rpc", "state_list", kind, limit)  # worker
+
+
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Latest-state row per task (from the GCS task-event table)."""
+    return _state_query("tasks", limit)
+
+
+def list_actors(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _state_query("actors", limit)
+
+
+def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _state_query("nodes", limit)
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _state_query("objects", limit)
+
+
+def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
+    return _state_query("placement_groups", limit)
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """{func_name: {state: count}} (reference: ray summary tasks)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for row in list_tasks(limit=100_000):
+        by_state = out.setdefault(row["name"], {})
+        by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+    return out
+
+
+def summarize_actors() -> Dict[str, Dict[str, int]]:
+    out: Dict[str, Dict[str, int]] = {}
+    for row in list_actors(limit=100_000):
+        by_state = out.setdefault(row["class_name"], {})
+        by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+    return out
+
+
+def summarize_objects() -> Dict[str, Any]:
+    rows = list_objects(limit=1_000_000)
+    return {
+        "total_objects": len(rows),
+        "total_locations": sum(len(r["locations"]) for r in rows),
+    }

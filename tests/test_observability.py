@@ -1,0 +1,94 @@
+"""State API, metrics pipeline, log tail-to-driver.
+
+Reference: python/ray/util/state/api.py, ray.util.metrics +
+metrics_agent.py Prometheus re-export, log_monitor.py:581.
+"""
+
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api
+from ray_tpu.util import state
+from ray_tpu.util.metrics import Counter, Gauge, Histogram, registry, \
+    render_prometheus
+
+
+def test_state_api_lists(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 2
+
+    a = A.options(name="obs_actor").remote()
+    ray_tpu.get([f.remote(), f.remote(), a.m.remote()])
+
+    tasks = state.list_tasks()
+    assert any(t["name"] == "f" and t["state"] == "FINISHED" for t in tasks)
+    actors = state.list_actors()
+    assert any(x["state"] == "ALIVE" and x["name"] == "obs_actor"
+               for x in actors)
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    summary = state.summarize_tasks()
+    assert summary["f"]["FINISHED"] == 2
+    # worker-side query goes through the RPC passthrough
+    @ray_tpu.remote
+    def from_worker():
+        from ray_tpu.util import state as s
+
+        return len(s.list_nodes())
+
+    assert ray_tpu.get(from_worker.remote()) == 1
+
+
+def test_metrics_prometheus_endpoint(ray_start_regular):
+    head = api._get_head()
+    host, port = head.start_metrics_server()
+    Counter("test_counter_total", "a counter").inc(2.0, tags={"k": "v"})
+    Gauge("test_gauge", "a gauge").set(7.5)
+    Histogram("test_hist", "a histogram", boundaries=[1, 10]).observe(3.0)
+    body = urllib.request.urlopen(
+        f"http://{host}:{port}/metrics").read().decode()
+    assert 'test_counter_total{k="v"} 2.0' in body
+    assert "test_gauge 7.5" in body
+    assert "test_hist_count 1" in body
+    assert 'test_hist_bucket' in body
+    # runtime task metrics recorded by the head
+    assert "ray_tpu_tasks_total" in body
+
+
+def test_worker_metrics_merge():
+    """Worker snapshots merge under a source key; counters sum."""
+    reg = registry()
+    reg.merge("w1", {"m_total": {"type": "counter", "help": "h",
+                                 "buckets": None,
+                                 "values": {(): 3.0}}})
+    reg.merge("w2", {"m_total": {"type": "counter", "help": "h",
+                                 "buckets": None,
+                                 "values": {(): 4.0}}})
+    text = render_prometheus(reg)
+    assert "m_total 7.0" in text
+
+
+def test_log_to_driver(ray_start_regular, capfd):
+    @ray_tpu.remote
+    def shout():
+        print("LOUD_MARKER_123")
+        return 1
+
+    ray_tpu.get(shout.remote())
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        err = capfd.readouterr().err
+        if "LOUD_MARKER_123" in err:
+            assert "pid=" in err
+            return
+        time.sleep(0.2)
+    pytest.fail("worker stdout was not tailed to the driver")
