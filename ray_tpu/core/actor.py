@@ -44,6 +44,12 @@ class ActorMethod:
         return self._handle._submit_method(self._name, args, kwargs,
                                            self._num_returns)
 
+    def bind(self, *args):
+        """Author a compiled-graph node (reference: dag_node.py bind)."""
+        from ray_tpu.dag import _bind
+
+        return _bind(self, *args)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor method {self._name}() cannot be called directly; "
@@ -60,7 +66,8 @@ class ActorHandle:
 
     def __getattr__(self, name: str):
         if (name.startswith("__") and name.endswith("__")
-                and name not in ("__ray_terminate__", "__collective_init__")):
+                and name not in ("__ray_terminate__", "__collective_init__",
+                                 "__compiled_exec__")):
             raise AttributeError(name)
         return ActorMethod(self, name, self._method_num_returns.get(name, 1))
 

@@ -293,6 +293,56 @@ class WorkerRuntime:
             self._current_task.task_id = None
             self._current_task.actor_id = None
 
+    def _start_compiled_exec(self, st: _ActorState, desc: dict) -> None:
+        from ray_tpu.experimental.channel import (
+            TAG_ERROR,
+            TAG_STOP,
+            ChannelClosed,
+            ShmChannel,
+        )
+
+        ch_in = ShmChannel(desc["in_path"], desc["capacity"])
+        ch_out = ShmChannel(desc["out_path"], desc["capacity"])
+        method = getattr(st.instance, desc["method"])
+        template = list(desc.get("args_template") or [("input",)])
+
+        def build_args(value):
+            return [value if t[0] == "input" else t[1] for t in template]
+
+        def loop():
+            while True:
+                try:
+                    tag, payload = ch_in.read(timeout=None)
+                except ChannelClosed:
+                    # propagate the stop sentinel downstream, then exit
+                    try:
+                        ch_out.write(b"", tag=TAG_STOP, timeout=10.0)
+                    except Exception:
+                        pass
+                    ch_in.close()
+                    ch_out.close()
+                    return
+                except Exception:
+                    return  # channel unlinked under us (teardown race)
+                if tag == TAG_ERROR:
+                    ch_out.write(payload, tag=TAG_ERROR)  # pass through
+                    continue
+                try:
+                    value = serialization.deserialize(payload)
+                    # run on the actor's executor so compiled executions
+                    # serialize with eager .remote() calls on the same
+                    # instance (the single-threaded actor contract)
+                    result = st.pool.submit(
+                        method, *build_args(value)).result()
+                    ch_out.write(serialization.serialize(result).to_bytes())
+                except Exception as e:  # noqa: BLE001 — ship to consumer
+                    err = TaskError.from_exception(desc["method"], e)
+                    ch_out.write(serialization.serialize(err).to_bytes(),
+                                 tag=TAG_ERROR)
+
+        threading.Thread(target=loop, daemon=True,
+                         name=f"compiled-exec-{desc['method']}").start()
+
     def _resolve_args(self, spec: TaskSpec):
         def resolve(v):
             kind, payload = v
@@ -328,6 +378,12 @@ class WorkerRuntime:
                     self.channel.send("exit")
                     time.sleep(0.2)
                     os._exit(0)
+                if fn_name == "__compiled_exec__":
+                    # install a resident compiled-graph executor thread
+                    # (reference: compiled_dag_node.py do_exec_tasks :92)
+                    self._start_compiled_exec(st, args[0])
+                    self._finish(spec, None)
+                    return
                 if fn_name == "__collective_init__":
                     # runtime-level hook so any actor can join a collective
                     # group without declaring a method (reference:

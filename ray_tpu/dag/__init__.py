@@ -1,0 +1,191 @@
+"""Compiled graphs (aDAG): bind actor methods into a DAG, compile once,
+execute repeatedly without per-call scheduling.
+
+Analog of the reference's ray.dag (dag_node.py bind API +
+compiled_dag_node.py:143 CompiledTask / do_exec_tasks resident loops):
+each actor in the compiled chain runs a resident executor thread fed by
+shared-memory channels (experimental/channel.py); the driver writes the
+input into the first channel and reads the result from the last — the
+head, scheduler, and per-task bookkeeping are out of the loop entirely.
+
+MVP scope: linear chains of single-node actors (the reference's common
+pipeline case); constant extra args are bound at compile time.
+
+    with InputNode() as inp:
+        d = worker_b.double.bind(worker_a.inc.bind(inp))
+    compiled = d.experimental_compile()
+    ref = compiled.execute(5)       # -> CompiledDAGRef
+    value = ref.get()
+    compiled.teardown()
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Any, List, Optional
+
+from ray_tpu.core import serialization
+from ray_tpu.experimental.channel import (
+    TAG_ERROR,
+    TAG_STOP,
+    ChannelClosed,
+    ChannelTimeout,
+    ShmChannel,
+    channel_path,
+)
+
+
+class DAGNode:
+    pass
+
+
+class InputNode(DAGNode):
+    """The driver-supplied per-execution input (reference: input_node.py)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor_handle, method_name: str, args: tuple):
+        self.actor = actor_handle
+        self.method_name = method_name
+        self.args = args
+        upstream = [a for a in args if isinstance(a, DAGNode)]
+        if len(upstream) != 1:
+            raise ValueError(
+                "compiled-graph MVP supports exactly one upstream node per "
+                f"bind; got {len(upstream)}")
+        self.upstream = upstream[0]
+        # positional template: the upstream value is substituted at its
+        # ORIGINAL argument position (scaled.bind(3, inp) != bind(inp, 3))
+        self.args_template = [
+            ("input",) if isinstance(a, DAGNode) else ("const", a)
+            for a in args
+        ]
+
+    def experimental_compile(self, buffer_size_bytes: int = 4 * 1024 * 1024):
+        return CompiledDAG(self, buffer_size_bytes)
+
+
+def _bind(actor_method, *args):
+    return ClassMethodNode(actor_method._handle, actor_method._name, args)
+
+
+class CompiledDAGRef:
+    """Result handle for one execute(); results must be consumed in
+    submission order (single output channel — reference semantics)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+
+    def get(self, timeout: Optional[float] = 30.0):
+        return self._dag._read_result(self._seq, timeout)
+
+
+class CompiledDAG:
+    def __init__(self, output_node: ClassMethodNode, buffer_size: int):
+        # topo order: walk upstream to the InputNode
+        chain: List[ClassMethodNode] = []
+        node = output_node
+        while isinstance(node, ClassMethodNode):
+            chain.append(node)
+            node = node.upstream
+        if not isinstance(node, InputNode):
+            raise ValueError("compiled DAG must terminate at an InputNode")
+        chain.reverse()
+        self._chain = chain
+        self._buffer_size = buffer_size
+        uid = uuid.uuid4().hex[:10]
+        n = len(chain)
+        paths = [channel_path(f"{uid}_{i}") for i in range(n + 1)]
+        self._channels = [ShmChannel(p, buffer_size, create=True)
+                          for p in paths]
+        self._in = self._channels[0]
+        self._out = self._channels[-1]
+        # split locks: a submitter blocked on a full pipeline must not
+        # prevent a reader from draining results (that would deadlock)
+        self._submit_lock = threading.Lock()
+        self._read_lock = threading.Lock()
+        self._next_seq = 0
+        self._next_read = 0
+        self._results: dict = {}
+        self._torn_down = False
+        # install resident executor loops (reference: do_exec_tasks)
+        import ray_tpu
+
+        acks = []
+        for i, task in enumerate(chain):
+            acks.append(task.actor.__compiled_exec__.remote({
+                "method": task.method_name,
+                "in_path": paths[i],
+                "out_path": paths[i + 1],
+                "capacity": buffer_size,
+                "args_template": task.args_template,
+            }))
+        ray_tpu.get(acks, timeout=60)
+
+    def execute(self, value: Any,
+                timeout: Optional[float] = 60.0) -> CompiledDAGRef:
+        with self._submit_lock:
+            if self._torn_down:
+                raise RuntimeError("compiled DAG was torn down")
+            # bounded write: a full pipeline (single-slot channels, nothing
+            # consuming results) raises ChannelTimeout instead of blocking
+            # the driver forever
+            self._in.write(serialization.serialize(value).to_bytes(),
+                           timeout=timeout)
+            seq = self._next_seq
+            self._next_seq += 1
+        return CompiledDAGRef(self, seq)
+
+    def _read_result(self, seq: int, timeout: Optional[float]):
+        with self._read_lock:
+            while self._next_read <= seq:
+                tag, payload = self._out.read(timeout)
+                self._results[self._next_read] = (tag, payload)
+                self._next_read += 1
+            tag, payload = self._results.pop(seq)
+        value = serialization.deserialize(payload)
+        if tag == TAG_ERROR:
+            raise value
+        return value
+
+    def teardown(self) -> None:
+        with self._submit_lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+        # drain unconsumed results first so the stop sentinel can flow
+        # through the (single-slot) pipeline, then keep draining until the
+        # sentinel comes out the far end; every step is bounded
+        stop_sent = False
+        for _ in range(self._next_seq + len(self._chain) + 2):
+            if not stop_sent:
+                try:
+                    self._in.write(b"", tag=TAG_STOP, timeout=0.5)
+                    stop_sent = True
+                except ChannelTimeout:
+                    pass  # input slot full: drain below, retry
+                except Exception:
+                    stop_sent = True
+            try:
+                self._out.read(timeout=2.0)
+            except ChannelClosed:
+                break  # sentinel arrived: all loops exited
+            except Exception:
+                if stop_sent:
+                    break
+        for ch in self._channels:
+            ch.close(unlink=True)
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

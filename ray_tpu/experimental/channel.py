@@ -1,0 +1,146 @@
+"""Shared-memory SPSC channels for compiled graphs.
+
+Analog of the reference's shared_memory_channel.py (601 LoC) + mutable
+plasma objects (experimental_mutable_object_manager.cc): a single-slot
+rendezvous buffer in /dev/shm mapped by both endpoint processes. The fast
+path is two mmap writes plus one doorbell syscall — no scheduler, no
+per-call task bookkeeping. Waiting uses named-FIFO doorbells rather than
+spinning: on an oversubscribed host, competing spinners starve the very
+producer they wait on (measured 0.6x vs eager on 1 core; doorbells win).
+
+Layout: [write_seq u64][read_seq u64][msg_len u64][tag u8][payload...].
+Writer waits until the reader drained the slot (read_seq == write_seq);
+reader waits until write_seq > read_seq.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import select
+import struct
+import time
+from typing import Optional
+
+_HDR = struct.Struct("<QQQB")  # write_seq, read_seq, msg_len, tag
+# each endpoint writes ONLY its own fields (a full-header pack from the
+# reader could land after the writer's next publish and clobber len/tag):
+# writer owns write_seq + len + tag; reader owns read_seq.
+_WSEQ = struct.Struct("<Q")     # at offset 0
+_RSEQ = struct.Struct("<Q")     # at offset 8
+_LENTAG = struct.Struct("<QB")  # at offset 16
+TAG_DATA = 0
+TAG_STOP = 1
+TAG_ERROR = 2
+
+
+class ChannelTimeout(Exception):
+    pass
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class ShmChannel:
+    """One-directional single-producer single-consumer channel."""
+
+    def __init__(self, path: str, capacity: int = 4 * 1024 * 1024,
+                 create: bool = False):
+        self.path = path
+        self.capacity = capacity
+        total = _HDR.size + capacity
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        self._fd = os.open(path, flags, 0o600)
+        if create:
+            os.ftruncate(self._fd, total)
+        self._mm = mmap.mmap(self._fd, total)
+        if create:
+            _HDR.pack_into(self._mm, 0, 0, 0, 0, TAG_DATA)
+        # doorbells: data_ready rings the reader, slot_free rings the writer.
+        # O_RDWR on a FIFO never blocks at open and works for both ends.
+        self._bells = []
+        for suffix in (".rdy", ".free"):
+            p = path + suffix
+            if create:
+                try:
+                    os.mkfifo(p, 0o600)
+                except FileExistsError:
+                    pass
+            self._bells.append(os.open(p, os.O_RDWR | os.O_NONBLOCK))
+        self._bell_rdy, self._bell_free = self._bells
+
+    # ---- internals ----
+
+    def _header(self):
+        return _HDR.unpack_from(self._mm, 0)
+
+    def _ring(self, fd: int) -> None:
+        try:
+            os.write(fd, b"\x00")
+        except (BlockingIOError, OSError):
+            pass  # full pipe still wakes the peer
+
+    def _wait(self, ready, bell_fd: int, timeout: Optional[float]) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not ready():
+            remaining = 0.2 if deadline is None else min(
+                0.2, deadline - time.monotonic())
+            if remaining <= 0:
+                raise ChannelTimeout(self.path)
+            select.select([bell_fd], [], [], remaining)
+            try:  # drain stale tokens; state re-checked by the loop
+                os.read(bell_fd, 4096)
+            except (BlockingIOError, OSError):
+                pass
+
+    # ---- API ----
+
+    def write(self, payload: bytes, tag: int = TAG_DATA,
+              timeout: Optional[float] = None) -> None:
+        if len(payload) > self.capacity:
+            raise ValueError(
+                f"message of {len(payload)}B exceeds channel capacity "
+                f"{self.capacity}B (raise buffer_size_bytes)")
+        self._wait(lambda: (lambda w, r, _l, _t: r == w)(*self._header()),
+                   self._bell_free, timeout)
+        w, r, _, _ = self._header()
+        self._mm[_HDR.size:_HDR.size + len(payload)] = payload
+        # payload + len/tag first, write_seq last: the reader checks the
+        # seq before trusting the rest
+        _LENTAG.pack_into(self._mm, 16, len(payload), tag)
+        _WSEQ.pack_into(self._mm, 0, w + 1)
+        self._ring(self._bell_rdy)
+
+    def read(self, timeout: Optional[float] = None):
+        self._wait(lambda: (lambda w, r, _l, _t: w > r)(*self._header()),
+                   self._bell_rdy, timeout)
+        w, r, length, tag = self._header()
+        payload = bytes(self._mm[_HDR.size:_HDR.size + length])
+        _RSEQ.pack_into(self._mm, 8, r + 1)  # only the reader's field
+        self._ring(self._bell_free)
+        if tag == TAG_STOP:
+            raise ChannelClosed(self.path)
+        return (tag, payload) if tag == TAG_ERROR else (TAG_DATA, payload)
+
+    def close(self, unlink: bool = False) -> None:
+        try:
+            self._mm.close()
+        except BufferError:
+            pass
+        for fd in (self._fd, *self._bells):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        if unlink:
+            for p in (self.path, self.path + ".rdy", self.path + ".free"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+
+def channel_path(name: str) -> str:
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+    return os.path.join(base, f"raytpu_chan_{name}")
