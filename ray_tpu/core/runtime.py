@@ -55,6 +55,11 @@ class TaskRecord:
     missing_deps: Set[ObjectID] = field(default_factory=set)
     cancelled: bool = False
     unpinned: bool = False
+    # settle/release guards: completion and crash handlers race (a failed
+    # dispatch_to_worker send vs the node reader's worker-death report);
+    # each attempt settles exactly once and releases resources exactly once
+    settling: bool = False
+    released: bool = False
 
 
 @dataclass
@@ -453,6 +458,7 @@ class Head:
         rec.state = "RUNNING"
         rec.node_hex = arec.node_hex
         rec.worker_id = worker_id
+        self._inject_delay("actor_dispatch")
         if node is None or not node.dispatch_to_worker(worker_id, spec):
             self._handle_task_failure(rec, ActorDiedError(spec.actor_id, "actor node/worker gone"),
                                       results=None)
@@ -491,6 +497,39 @@ class Head:
 
     # ------------------------------------------------------------ completion
 
+    def _inject_delay(self, handler: str) -> None:
+        """Fault-injection latency (reference: RAY_testing_asio_delay_us,
+        ray_config_def.h:821): RAY_TPU_TESTING_DELAY_MS="name=ms,..."."""
+        d = global_config().delay_for(handler)
+        if d:
+            time.sleep(d)
+
+    def _begin_settle(self, rec: TaskRecord) -> bool:
+        """Claim the right to settle this attempt; False if another path
+        (completion vs crash-report race) already did."""
+        with self._lock:
+            if rec.settling or rec.state in ("FAILED", "FINISHED"):
+                return False
+            rec.settling = True
+            return True
+
+    def _release_task_resources(self, rec: TaskRecord, fallback_hex: str,
+                                node_binding, err_name):
+        """Idempotent resource release; returns the lease-cached next task
+        (complete_and_next) when this call performed the release."""
+        spec = rec.spec
+        if not (spec.actor_id is None or spec.is_actor_creation):
+            return None
+        if spec.is_actor_creation and err_name is None:
+            return None  # successful creation keeps its resources
+        with self._lock:
+            if rec.released:
+                return None
+            rec.released = True
+        return self.scheduler.complete_and_next(
+            rec.node_hex or fallback_hex, spec,
+            rec.binding or node_binding or {})
+
     def on_task_finished(self, node, task_id: TaskID, err_name: Optional[str],
                          node_spec: Optional[TaskSpec], node_binding: Optional[dict],
                          results: List[Tuple[ObjectID, Optional[bytes], bool]],
@@ -500,22 +539,23 @@ class Head:
         if rec is None:
             self._seal_results(node, results)
             return
-        spec = rec.spec
-        # Release resources for non-actor-method tasks. A successful actor
-        # creation keeps its resources for the actor's lifetime; a failed one
-        # must give them back. The release runs through the lease-caching
-        # fast path: the next queued same-shape task comes back placed and is
-        # dispatched below on this same (node-reader) thread — no scheduler
-        # thread wakeup between tasks.
-        next_placed = None
-        if spec.actor_id is None or spec.is_actor_creation:
-            if not (spec.is_actor_creation and err_name is None):
-                next_placed = self.scheduler.complete_and_next(
-                    rec.node_hex or node.hex, spec,
-                    rec.binding or node_binding or {})
+        self._inject_delay("task_finished")
+        # Release resources for non-actor-method tasks (idempotent — the
+        # crash path may have released already). A successful actor
+        # creation keeps its resources for the actor's lifetime. The
+        # release runs through the lease-caching fast path: the next
+        # queued same-shape task comes back placed and is dispatched below
+        # on this same (node-reader) thread.
+        next_placed = self._release_task_resources(rec, node.hex,
+                                                   node_binding, err_name)
         try:
-            self._settle_finished(rec, node, task_id, err_name, results,
-                                  worker_id)
+            if self._begin_settle(rec):
+                self._settle_finished(rec, node, task_id, err_name, results,
+                                      worker_id)
+            else:
+                # crash handler settled this attempt first: results arriving
+                # late are dropped (it retried or failed the task)
+                pass
         finally:
             if next_placed is not None:
                 self._dispatch_to_node(*next_placed)
@@ -585,6 +625,8 @@ class Head:
         rec.state = "PENDING"
         rec.node_hex = None
         rec.binding = None
+        rec.settling = False
+        rec.released = False
         self._record_event(spec, "RETRY")
         delay = cfg.task_retry_delay_ms / 1000.0
 
@@ -613,7 +655,10 @@ class Head:
             for oid in to_delete:
                 self.delete_object(oid)
 
-    def _fail_task_now(self, rec: TaskRecord, exc: Exception) -> None:
+    def _fail_task_now(self, rec: TaskRecord, exc: Exception,
+                       _guard: bool = True) -> None:
+        if _guard and not self._begin_settle(rec):
+            return
         rec.state = "FAILED"
         self._unpin_args(rec)
         err = exc if isinstance(exc, (ActorDiedError, TaskCancelledError, ObjectLostError)) \
@@ -626,15 +671,22 @@ class Head:
 
     def _handle_task_failure(self, rec: TaskRecord, exc: Exception, results) -> None:
         spec = rec.spec
-        if spec.actor_id is None or spec.is_actor_creation:
-            self.scheduler.release(rec.node_hex or "", spec, rec.binding or {})
+        next_placed = self._release_task_resources(
+            rec, rec.node_hex or "", None, type(exc).__name__)
+        if not self._begin_settle(rec):
+            # the completion path settled this attempt first
+            if next_placed is not None:
+                self._dispatch_to_node(*next_placed)
+            return
         if self._is_retriable(spec, type(exc).__name__):
             self._retry_task(rec, results)
         else:
             self._record_event(spec, "FAILED", rec.node_hex, error=str(exc))
-            self._fail_task_now(rec, exc)
+            self._fail_task_now(rec, exc, _guard=False)
             if spec.is_actor_creation:
                 self._on_actor_creation_failed(spec, str(exc))
+        if next_placed is not None:
+            self._dispatch_to_node(*next_placed)
 
     # ------------------------------------------------------------ actors
 
@@ -700,10 +752,13 @@ class Head:
         for tid in inflight:
             rec = self.tasks.get(tid)
             if rec is not None and rec.state == "RUNNING":
+                if not self._begin_settle(rec):
+                    continue  # completion path settled this attempt first
                 if rec.spec.max_retries > rec.spec.attempt and rec.spec.retry_exceptions:
                     self._retry_task(rec, None)
                 else:
-                    self._fail_task_now(rec, ActorDiedError(arec.actor_id, cause))
+                    self._fail_task_now(rec, ActorDiedError(arec.actor_id, cause),
+                                        _guard=False)
         if restart:
             self.gcs.update_actor(arec.actor_id, state="RESTARTING")
             # release old incarnation's resources and resubmit creation
@@ -779,6 +834,7 @@ class Head:
                           prev_state: str) -> None:
         if self._stopped or not node.alive:
             return
+        self._inject_delay("worker_crashed")
         self._retire_worker_metrics(node, w)
         if w.actor_id is not None:
             with self._lock:
@@ -1150,7 +1206,9 @@ class Head:
                 return
             if rec.state in ("PENDING", "QUEUED", "WAITING_DEPS"):
                 rec.cancelled = True
-                rec.state = "FAILED"
+                # state transition happens inside the (settle-guarded)
+                # fail path — pre-setting FAILED would trip the guard and
+                # skip sealing the cancellation error
                 self._fail_task_now(rec, TaskCancelledError("task cancelled"))
                 return
             node = self.nodes.get(rec.node_hex) if rec.node_hex else None

@@ -331,9 +331,14 @@ class WorkerRuntime:
                     value = serialization.deserialize(payload)
                     # run on the actor's executor so compiled executions
                     # serialize with eager .remote() calls on the same
-                    # instance (the single-threaded actor contract)
-                    result = st.pool.submit(
-                        method, *build_args(value)).result()
+                    # instance (the single-threaded actor contract);
+                    # async methods go through the actor's event loop
+                    if st.is_async and asyncio.iscoroutinefunction(method):
+                        result = asyncio.run_coroutine_threadsafe(
+                            method(*build_args(value)), st.loop).result()
+                    else:
+                        result = st.pool.submit(
+                            method, *build_args(value)).result()
                     ch_out.write(serialization.serialize(result).to_bytes())
                 except Exception as e:  # noqa: BLE001 — ship to consumer
                     err = TaskError.from_exception(desc["method"], e)

@@ -47,15 +47,12 @@ class ServeController:
                 "last_scale_up": 0.0,
                 "last_scale_down": 0.0,
             }
-            code_changed = old is not None and (
-                old["callable"] != serialized_callable
-                or old["version"] != rec["version"])
             self._deployments[name] = rec
-            if code_changed:
-                # rolling update: drop old replicas; reconciler refills
-                for r in rec["replicas"]:
-                    self._kill_replica(r)
-                rec["replicas"] = []
+            # code/version changes roll gradually in the reconciler:
+            # replicas carry the version they were spawned with; stale
+            # ones are replaced one per cycle AFTER a surge replica of
+            # the new version exists (maxSurge=1, maxUnavailable=0 —
+            # reference: deployment_state.py rolling updates)
             route = config.get("route_prefix")
             if route:
                 self._routes[route] = name
@@ -97,6 +94,19 @@ class ServeController:
         with self._lock:
             return dict(self._routes)
 
+    def get_route_meta(self) -> Dict[str, dict]:
+        """Per-route metadata the proxy needs (stream flag, timeout)."""
+        with self._lock:
+            out = {}
+            for prefix, name in self._routes.items():
+                cfg = self._deployments.get(name, {}).get("config", {})
+                out[prefix] = {
+                    "name": name,
+                    "stream": bool(cfg.get("stream")),
+                    "timeout": float(cfg.get("request_timeout_s", 60.0)),
+                }
+            return out
+
     def list_deployments(self) -> Dict[str, dict]:
         with self._lock:
             return {
@@ -132,7 +142,8 @@ class ServeController:
         actor = ServeReplica.options(**opts).remote(
             rec["callable"], rec["init_args"], rec["init_kwargs"],
             rec["config"].get("user_config"))
-        return {"actor": actor, "created": time.time(), "healthy": True}
+        return {"actor": actor, "created": time.time(), "healthy": True,
+                "version": rec["version"], "callable": rec["callable"]}
 
     def _autoscale(self, rec: dict) -> None:
         auto = rec["config"].get("autoscaling")
@@ -158,20 +169,42 @@ class ServeController:
             rec["target"] = target - 1
             rec["last_scale_down"] = now
 
+    def _replica_stale(self, rec: dict, r: dict) -> bool:
+        return (r.get("version") != rec["version"]
+                or r.get("callable") != rec["callable"])
+
     def _reconcile_once(self) -> None:
         with self._lock:
             if self._shutdown:
                 return
             for rec in self._deployments.values():
                 self._autoscale(rec)
-                diff = rec["target"] - len(rec["replicas"])
+                replicas = rec["replicas"]
+                stale = [r for r in replicas if self._replica_stale(rec, r)]
+                fresh = [r for r in replicas if r not in stale]
+                target = rec["target"]
+                if stale:
+                    # rolling update (maxSurge=1): spawn a fresh replica
+                    # up to target+1 total, then retire one stale per
+                    # cycle while above target — alternating until the
+                    # whole set is on the new version
+                    if len(fresh) < target and len(replicas) <= target:
+                        replicas.append(self._spawn_replica(rec))
+                        self._version += 1
+                    elif len(replicas) > target or len(fresh) >= target:
+                        dead = stale[0]
+                        replicas.remove(dead)
+                        self._kill_replica(dead)
+                        self._version += 1
+                    continue
+                diff = target - len(replicas)
                 if diff > 0:
                     for _ in range(diff):
-                        rec["replicas"].append(self._spawn_replica(rec))
+                        replicas.append(self._spawn_replica(rec))
                     self._version += 1
                 elif diff < 0:
                     for _ in range(-diff):
-                        dead = rec["replicas"].pop()
+                        dead = replicas.pop()
                         self._kill_replica(dead)
                     self._version += 1
 
@@ -181,6 +214,11 @@ class ServeController:
         for rec in recs:
             bad = []
             for r in list(rec["replicas"]):
+                if time.time() - r["created"] < 10.0:
+                    # creation grace: a replica still cold-starting (worker
+                    # fork + deserialize) must not be killed for missing a
+                    # ping — that causes a perpetual kill/respawn loop
+                    continue
                 try:
                     ok = ray_tpu.get(r["actor"].check_health.remote(),
                                      timeout=5)

@@ -37,7 +37,9 @@ class Deployment:
                  user_config: Optional[dict] = None,
                  version: str = "1",
                  route_prefix: Optional[str] = "/",
-                 health_check_period_s: float = 2.0):
+                 health_check_period_s: float = 2.0,
+                 stream: bool = False,
+                 request_timeout_s: float = 60.0):
         self._target = target
         self.name = name
         if isinstance(autoscaling_config, dict):
@@ -51,6 +53,8 @@ class Deployment:
             version=version,
             route_prefix=route_prefix,
             health_check_period_s=health_check_period_s,
+            stream=stream,
+            request_timeout_s=request_timeout_s,
         )
 
     def options(self, **overrides) -> "Deployment":
@@ -84,6 +88,8 @@ class Deployment:
             "user_config": self._opts["user_config"],
             "version": self._opts["version"],
             "route_prefix": self._opts["route_prefix"],
+            "stream": self._opts.get("stream", False),
+            "request_timeout_s": self._opts.get("request_timeout_s", 60.0),
         }
 
     def __repr__(self):

@@ -12,20 +12,35 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.core.exceptions import ActorDiedError
 
 
 class DeploymentResponse:
     """Future-like wrapper over the replica call's ObjectRef."""
 
-    def __init__(self, ref, router: "Router", replica_key: str):
+    def __init__(self, ref, router: "Router", replica_key: str,
+                 redispatch=None):
         self._ref = ref
         self._router = router
         self._replica_key = replica_key
         self._done = False
+        self._redispatch = redispatch
 
     def result(self, timeout: Optional[float] = None) -> Any:
+        # a replica killed mid-flight (rolling update, health replacement)
+        # re-routes to a live one (reference: router retries on
+        # ActorDiedError for idempotent-by-convention requests)
+        attempts = 3 if self._redispatch is not None else 1
         try:
-            return ray_tpu.get(self._ref, timeout=timeout)
+            for attempt in range(attempts):
+                try:
+                    return ray_tpu.get(self._ref, timeout=timeout)
+                except ActorDiedError:
+                    if attempt == attempts - 1:
+                        raise
+                    self._router._dec(self._replica_key)
+                    self._router._refresh(force=True)
+                    self._ref, self._replica_key = self._redispatch()
         finally:
             self._finish()
 
@@ -123,16 +138,24 @@ class Router:
 
 class DeploymentHandle:
     def __init__(self, controller, deployment_name: str,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", stream: bool = False,
+                 stream_item_timeout_s: Optional[float] = None):
         self._controller = controller
         self._name = deployment_name
         self._method = method_name
+        self._stream = stream
+        self._stream_item_timeout_s = stream_item_timeout_s
         self._router = Router(controller, deployment_name)
 
-    def options(self, method_name: Optional[str] = None
+    def options(self, method_name: Optional[str] = None,
+                stream: Optional[bool] = None,
+                stream_item_timeout_s: Optional[float] = None
                 ) -> "DeploymentHandle":
         h = DeploymentHandle(self._controller, self._name,
-                             method_name or self._method)
+                             method_name or self._method,
+                             self._stream if stream is None else stream,
+                             stream_item_timeout_s
+                             or self._stream_item_timeout_s)
         h._router = self._router  # share in-flight accounting
         return h
 
@@ -140,10 +163,32 @@ class DeploymentHandle:
     def method(self):
         return _MethodAccessor(self)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         replica, key = self._router.choose()
+        if self._stream:
+            # items stream incrementally (streaming generators); the
+            # in-flight count drops when the generator is exhausted
+            gen = replica.handle_request_stream.options(
+                num_returns="streaming").remote(self._method, args, kwargs)
+            item_timeout = self._stream_item_timeout_s
+
+            def iterate():
+                try:
+                    for ref in gen:
+                        # bounded per-item wait: a hung replica must not
+                        # pin the consumer (and its executor thread) forever
+                        yield ray_tpu.get(ref, timeout=item_timeout)
+                finally:
+                    self._router._dec(key)
+
+            return iterate()
         ref = replica.handle_request.remote(self._method, args, kwargs)
-        return DeploymentResponse(ref, self._router, key)
+
+        def redispatch():
+            r2, k2 = self._router.choose()
+            return r2.handle_request.remote(self._method, args, kwargs), k2
+
+        return DeploymentResponse(ref, self._router, key, redispatch)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -152,7 +197,8 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._controller, self._name, self._method))
+                (self._controller, self._name, self._method, self._stream,
+                 self._stream_item_timeout_s))
 
 
 class _BoundMethod:

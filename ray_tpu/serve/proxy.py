@@ -13,6 +13,8 @@ from typing import Any, Dict, Optional
 
 import ray_tpu
 
+_STREAM_END = object()
+
 
 class Request:
     """Minimal request object passed to deployments (starlette-ish)."""
@@ -75,7 +77,7 @@ class HTTPProxy:
             self._routes_cache = await loop.run_in_executor(
                 None,
                 lambda: ray_tpu.get(
-                    self._controller.get_route_table.remote()))
+                    self._controller.get_route_meta.remote()))
             self._routes_expiry = now + 1.0
         routes = self._routes_cache
         path = request.path
@@ -92,12 +94,40 @@ class HTTPProxy:
         req = Request(request.method, path,
                       dict(request.query),
                       {k: v for k, v in request.headers.items()}, body)
-        handle = self._get_handle(match)
+        handle = self._get_handle(match["name"])
+        if match.get("stream"):
+            # dispatch BEFORE sending headers: a routing failure (e.g. no
+            # replicas) must surface as a 5xx, not a truncated 200
+            try:
+                it = await loop.run_in_executor(
+                    None, lambda: handle.options(
+                        stream=True,
+                        stream_item_timeout_s=match.get("timeout", 60.0),
+                    ).remote(req))
+            except Exception as e:  # noqa: BLE001
+                return web.Response(status=503, text=str(e))
+            # streaming response: chunks flow as the replica yields them
+            resp = web.StreamResponse()
+            await resp.prepare(request)
+            try:
+                while True:
+                    chunk = await loop.run_in_executor(
+                        None, lambda: next(it, _STREAM_END))
+                    if chunk is _STREAM_END:
+                        break
+                    if isinstance(chunk, str):
+                        chunk = chunk.encode()
+                    await resp.write(chunk)
+            except Exception:  # mid-stream replica failure: cut the stream
+                pass
+            await resp.write_eof()
+            return resp
+        timeout = match.get("timeout", 60.0)
         try:
             # handle.remote() can spin in Router.choose() waiting for
             # replicas — run it off the event loop too
             def _call():
-                return handle.remote(req).result(timeout=60)
+                return handle.remote(req).result(timeout=timeout)
 
             result = await loop.run_in_executor(None, _call)
         except Exception as e:  # noqa: BLE001

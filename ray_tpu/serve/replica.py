@@ -65,6 +65,23 @@ class ServeReplica:
         finally:
             self._ongoing -= 1
 
+    def handle_request_stream(self, method_name: str, args, kwargs):
+        """Streaming requests: the user callable returns a generator whose
+        items stream back via num_returns="streaming" actor-method calls
+        (reference: replica streaming responses over generators)."""
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_class:
+                fn = (self._callable if method_name == "__call__"
+                      else getattr(self._callable, method_name))
+            else:
+                fn = self._callable
+            for item in fn(*args, **kwargs):
+                yield item
+        finally:
+            self._ongoing -= 1
+
     def reconfigure(self, user_config) -> None:
         if hasattr(self._callable, "reconfigure"):
             self._callable.reconfigure(user_config)

@@ -1,0 +1,148 @@
+"""Serve hardening: streaming responses, rolling updates without outage,
+request timeouts; plus the actor crash-during-dispatch race regression
+(delay-injection driven, reference: RAY_testing_asio_delay_us analog).
+"""
+
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.exceptions import ActorDiedError
+
+
+PORT = 18233
+
+
+@pytest.fixture
+def serve_instance(ray_start_regular):
+    serve.start(serve.HTTPOptions(port=PORT))
+    yield
+    serve.shutdown()
+
+
+def test_streaming_response_http(serve_instance):
+    @serve.deployment(stream=True, route_prefix="/stream")
+    def chunks(req):
+        for i in range(4):
+            yield f"part{i};"
+
+    serve.run(chunks.bind())
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{PORT}/stream", timeout=30).read().decode()
+    assert body == "part0;part1;part2;part3;"
+
+
+def test_streaming_handle(serve_instance):
+    @serve.deployment(stream=True)
+    def gen(req):
+        for i in range(3):
+            yield i * 2
+
+    handle = serve.run(gen.bind(), route_prefix=None)
+    assert list(handle.options(stream=True).remote(None)) == [0, 2, 4]
+
+
+def test_rolling_update_no_outage(serve_instance):
+    """During a version rollout every request gets an answer (old or new
+    version) — the kill-all-then-refill outage window is gone."""
+    @serve.deployment(version="1", num_replicas=2)
+    def app(req):
+        return "v1"
+
+    handle = serve.run(app.bind(), route_prefix=None)
+    assert handle.remote(0).result() == "v1"
+
+    @serve.deployment(name="app", version="2", num_replicas=2)
+    def app2(req):
+        return "v2"
+
+    handle = serve.run(app2.bind(), route_prefix=None)
+    saw = set()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        # every call must succeed during the rollout
+        saw.add(handle.remote(0).result(timeout=15))
+        if "v2" in saw:
+            break
+        time.sleep(0.05)
+    assert "v2" in saw, f"rollout never completed: {saw}"
+
+
+def test_request_timeout(serve_instance):
+    @serve.deployment(route_prefix="/slow", request_timeout_s=1.0)
+    def slow(req):
+        time.sleep(10)
+        return "late"
+
+    serve.run(slow.bind())
+    t0 = time.time()
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(f"http://127.0.0.1:{PORT}/slow", timeout=30)
+    elapsed = time.time() - t0
+    assert exc_info.value.code == 500
+    assert elapsed < 8, f"timeout not enforced ({elapsed:.1f}s)"
+
+
+def test_crash_during_actor_dispatch_settles_once(ray_start_regular):
+    """Regression for the round-1 race audit: a worker dying WHILE an actor
+    task dispatch is in flight must settle the task exactly once — no
+    double retry, no resource double-release. Driven by delay injection
+    at the 'actor_dispatch' point."""
+    from ray_tpu.core import api
+    from ray_tpu.core.config import global_config
+
+    head = api._get_head()
+
+    @ray_tpu.remote(max_restarts=2)
+    class Victim:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def work(self, i):
+            return i
+
+    a = Victim.remote()
+    pid = ray_tpu.get(a.pid.remote())
+    # baseline WITH the live actor holding its CPU: a double release of
+    # the method task's (zero) or creation's resources would push
+    # available above this; a leak would leave it below
+    baseline = head.scheduler.available_resources()
+
+    cfg = global_config()
+    old_delay = cfg.testing_delay_ms
+    cfg.testing_delay_ms = "actor_dispatch=300"
+    try:
+        import os as _os
+
+        ref = a.work.remote(1)  # dispatch sleeps 300ms with rec RUNNING
+        time.sleep(0.05)
+        _os.kill(pid, 9)  # worker dies mid-dispatch: both race arms fire
+        try:
+            ray_tpu.get(ref, timeout=30)
+        except ActorDiedError:
+            pass  # default max_task_retries=0: death may fail the call
+    finally:
+        cfg.testing_delay_ms = old_delay
+
+    # actor restarts and serves again
+    deadline = time.time() + 30
+    while True:
+        try:
+            assert ray_tpu.get(a.work.remote(7), timeout=10) == 7
+            break
+        except ActorDiedError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+    # resources fully released exactly once: view returns to baseline
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if head.scheduler.available_resources() == baseline:
+            break
+        time.sleep(0.2)
+    assert head.scheduler.available_resources() == baseline
