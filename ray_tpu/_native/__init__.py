@@ -1,0 +1,3 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain (reference: the C++ core under src/ray/; here the pieces where
+native code pays — the plasma arena allocator)."""

@@ -1,0 +1,54 @@
+"""Loader for the native plasma arena allocator.
+
+Compiles ``plasma_alloc.cpp`` with the system g++ on first import (cached
+as a shared object beside the source; rebuilt when the source is newer).
+Concurrent builds from parallel worker starts serialize on a file lock.
+Falls back by raising ImportError — the store keeps its Python free-list
+allocator when no toolchain is available (object_store._make_allocator).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "plasma_alloc.cpp")
+_SO = os.path.join(
+    _DIR, "_plasma_native" + (sysconfig.get_config_var("EXT_SUFFIX") or ".so"))
+
+
+def _needs_build() -> bool:
+    try:
+        return os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+    except OSError:
+        return True
+
+
+def _build() -> None:
+    import fcntl
+
+    lock_path = _SO + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if not _needs_build():
+            return  # another process built it while we waited
+        include = sysconfig.get_paths()["include"]
+        tmp = _SO + f".tmp.{os.getpid()}"
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+             f"-I{include}", _SRC, "-o", tmp],
+            check=True, capture_output=True)
+        os.replace(tmp, _SO)  # atomic: importers never see a partial .so
+
+
+if _needs_build():
+    _build()
+
+_spec = importlib.util.spec_from_file_location("_plasma_native", _SO)
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+
+NativeAllocator = _mod.NativeAllocator

@@ -1,0 +1,186 @@
+/* Native arena allocator for the plasma object store.
+ *
+ * The C++ analog of the reference's dlmalloc-backed plasma arena
+ * (src/ray/object_manager/plasma/dlmalloc.cc over a vendored
+ * src/ray/thirdparty/dlmalloc.c): best-fit allocation with O(log n)
+ * free-block lookup and immediate neighbor coalescing on free, managing
+ * offsets into the mmap'd shared arena (the Python side owns the mapping;
+ * this class owns only the extent bookkeeping, exactly like the Python
+ * FreeListAllocator it replaces on hot paths).
+ *
+ * CPython C API binding (no pybind11 in this environment).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+
+namespace {
+
+constexpr size_t kAlign = 64;  // match the store's 64B alignment contract
+
+struct Arena {
+  size_t capacity = 0;
+  size_t allocated_bytes = 0;
+  // free extents indexed both ways: by offset (coalescing) and by size
+  // (best-fit in O(log n))
+  std::map<size_t, size_t> free_by_off;        // offset -> size
+  std::multimap<size_t, size_t> free_by_size;  // size -> offset
+  std::map<size_t, size_t> allocated;          // offset -> size
+  std::mutex mu;
+
+  void insert_free(size_t off, size_t size) {
+    free_by_off.emplace(off, size);
+    free_by_size.emplace(size, off);
+  }
+
+  void erase_free(size_t off, size_t size) {
+    free_by_off.erase(off);
+    auto range = free_by_size.equal_range(size);
+    for (auto it = range.first; it != range.second; ++it) {
+      if (it->second == off) {
+        free_by_size.erase(it);
+        return;
+      }
+    }
+  }
+};
+
+struct AllocatorObject {
+  PyObject_HEAD
+  Arena* arena;
+};
+
+int Allocator_init(AllocatorObject* self, PyObject* args, PyObject*) {
+  unsigned long long capacity = 0;
+  if (!PyArg_ParseTuple(args, "K", &capacity)) return -1;
+  self->arena = new Arena();
+  self->arena->capacity = static_cast<size_t>(capacity);
+  self->arena->insert_free(0, self->arena->capacity);
+  return 0;
+}
+
+void Allocator_dealloc(AllocatorObject* self) {
+  delete self->arena;
+  Py_TYPE(self)->tp_free(reinterpret_cast<PyObject*>(self));
+}
+
+PyObject* Allocator_allocate(AllocatorObject* self, PyObject* args) {
+  unsigned long long req = 0;
+  if (!PyArg_ParseTuple(args, "K", &req)) return nullptr;
+  size_t size = static_cast<size_t>(req);
+  if (size < 8) size = 8;
+  size = (size + kAlign - 1) & ~(kAlign - 1);
+
+  Arena* a = self->arena;
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->free_by_size.lower_bound(size);  // best fit
+  if (it == a->free_by_size.end()) Py_RETURN_NONE;
+  size_t block_size = it->first;
+  size_t off = it->second;
+  a->erase_free(off, block_size);
+  if (block_size > size) {
+    a->insert_free(off + size, block_size - size);
+  }
+  a->allocated.emplace(off, size);
+  a->allocated_bytes += size;
+  return PyLong_FromUnsignedLongLong(off);
+}
+
+PyObject* Allocator_free(AllocatorObject* self, PyObject* args) {
+  unsigned long long off_in = 0;
+  if (!PyArg_ParseTuple(args, "K", &off_in)) return nullptr;
+  size_t off = static_cast<size_t>(off_in);
+
+  Arena* a = self->arena;
+  std::lock_guard<std::mutex> lock(a->mu);
+  auto it = a->allocated.find(off);
+  if (it == a->allocated.end()) {
+    PyErr_SetString(PyExc_KeyError, "offset not allocated");
+    return nullptr;
+  }
+  size_t size = it->second;
+  a->allocated.erase(it);
+  a->allocated_bytes -= size;
+
+  // coalesce with the following free extent
+  auto next = a->free_by_off.find(off + size);
+  if (next != a->free_by_off.end()) {
+    size_t nsize = next->second;
+    a->erase_free(off + size, nsize);
+    size += nsize;
+  }
+  // coalesce with the preceding free extent
+  auto prev = a->free_by_off.lower_bound(off);
+  if (prev != a->free_by_off.begin()) {
+    --prev;
+    if (prev->first + prev->second == off) {
+      size_t poff = prev->first, psize = prev->second;
+      a->erase_free(poff, psize);
+      off = poff;
+      size += psize;
+    }
+  }
+  a->insert_free(off, size);
+  Py_RETURN_NONE;
+}
+
+PyObject* Allocator_bytes_allocated(AllocatorObject* self, PyObject*) {
+  Arena* a = self->arena;
+  std::lock_guard<std::mutex> lock(a->mu);
+  return PyLong_FromUnsignedLongLong(a->allocated_bytes);
+}
+
+PyObject* Allocator_num_free_blocks(AllocatorObject* self, PyObject*) {
+  Arena* a = self->arena;
+  std::lock_guard<std::mutex> lock(a->mu);
+  return PyLong_FromSize_t(a->free_by_off.size());
+}
+
+PyMethodDef Allocator_methods[] = {
+    {"allocate", reinterpret_cast<PyCFunction>(Allocator_allocate),
+     METH_VARARGS, "allocate(size) -> offset | None"},
+    {"free", reinterpret_cast<PyCFunction>(Allocator_free), METH_VARARGS,
+     "free(offset)"},
+    {"bytes_allocated",
+     reinterpret_cast<PyCFunction>(Allocator_bytes_allocated), METH_NOARGS,
+     "total bytes currently allocated"},
+    {"num_free_blocks",
+     reinterpret_cast<PyCFunction>(Allocator_num_free_blocks), METH_NOARGS,
+     "free-list length (fragmentation diagnostic)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyTypeObject AllocatorType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+PyModuleDef plasma_module = {
+    PyModuleDef_HEAD_INIT, "_plasma_native",
+    "Native best-fit arena allocator (dlmalloc analog)", -1,
+    nullptr, nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__plasma_native(void) {
+  AllocatorType.tp_name = "_plasma_native.NativeAllocator";
+  AllocatorType.tp_basicsize = sizeof(AllocatorObject);
+  AllocatorType.tp_flags = Py_TPFLAGS_DEFAULT;
+  AllocatorType.tp_new = PyType_GenericNew;
+  AllocatorType.tp_init = reinterpret_cast<initproc>(Allocator_init);
+  AllocatorType.tp_dealloc = reinterpret_cast<destructor>(Allocator_dealloc);
+  AllocatorType.tp_methods = Allocator_methods;
+  if (PyType_Ready(&AllocatorType) < 0) return nullptr;
+  PyObject* m = PyModule_Create(&plasma_module);
+  if (!m) return nullptr;
+  Py_INCREF(&AllocatorType);
+  if (PyModule_AddObject(m, "NativeAllocator",
+                         reinterpret_cast<PyObject*>(&AllocatorType)) < 0) {
+    Py_DECREF(&AllocatorType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  return m;
+}

@@ -540,6 +540,21 @@ class Head:
             self._seal_results(node, results)
             return
         self._inject_delay("task_finished")
+        # A finish arriving for a SUPERSEDED attempt (the crash handler
+        # already settled + released + re-queued this record — its retry
+        # reset the guards) must be dropped entirely: releasing again would
+        # inflate scheduler availability and settling would seal stale
+        # results over the retried attempt. Detect it by retry-in-progress
+        # states and, across a pickle boundary (remote nodes), the attempt
+        # number the node dispatched.
+        with self._lock:
+            retry_pending = rec.state in ("PENDING", "QUEUED",
+                                          "WAITING_DEPS")
+        if (node_spec is not None and node_spec is not rec.spec
+                and node_spec.attempt != rec.spec.attempt):
+            retry_pending = True
+        if retry_pending:
+            return
         # Release resources for non-actor-method tasks (idempotent — the
         # crash path may have released already). A successful actor
         # creation keeps its resources for the actor's lifetime. The

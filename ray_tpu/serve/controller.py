@@ -90,10 +90,6 @@ class ServeController:
     def get_version(self) -> int:
         return self._version
 
-    def get_route_table(self) -> Dict[str, str]:
-        with self._lock:
-            return dict(self._routes)
-
     def get_route_meta(self) -> Dict[str, dict]:
         """Per-route metadata the proxy needs (stream flag, timeout)."""
         with self._lock:
@@ -173,6 +169,28 @@ class ServeController:
         return (r.get("version") != rec["version"]
                 or r.get("callable") != rec["callable"])
 
+    def _probe_ready(self, replicas: List[dict]) -> None:
+        """Non-blocking readiness: a replica is ready once it answers one
+        health ping. Gates stale-replica retirement so a broken new
+        version never takes down the serving set (reference:
+        deployment_state.py waits for the surge replica to be healthy)."""
+        for r in replicas:
+            if r.get("ready"):
+                continue
+            ref = r.get("ping_ref")
+            if ref is None:
+                r["ping_ref"] = r["actor"].check_health.remote()
+                continue
+            done, _ = ray_tpu.wait([ref], num_returns=1, timeout=0)
+            if done:
+                try:
+                    r["ready"] = bool(ray_tpu.get(ref, timeout=1))
+                except Exception:
+                    r["ready"] = False
+                r["ping_ref"] = None
+                if not r["ready"]:
+                    r["ping_ref"] = r["actor"].check_health.remote()
+
     def _reconcile_once(self) -> None:
         with self._lock:
             if self._shutdown:
@@ -185,13 +203,18 @@ class ServeController:
                 target = rec["target"]
                 if stale:
                     # rolling update (maxSurge=1): spawn a fresh replica
-                    # up to target+1 total, then retire one stale per
-                    # cycle while above target — alternating until the
-                    # whole set is on the new version
+                    # up to target+1 total; retire one stale per cycle
+                    # only when enough fresh replicas are READY to keep
+                    # the serving set covered
+                    self._probe_ready(fresh)
+                    ready = [r for r in fresh if r.get("ready")]
                     if len(fresh) < target and len(replicas) <= target:
                         replicas.append(self._spawn_replica(rec))
                         self._version += 1
-                    elif len(replicas) > target or len(fresh) >= target:
+                    elif (len(ready) >= min(target, len(fresh))
+                          and len(ready) > 0
+                          and (len(replicas) > target
+                               or len(fresh) >= target)):
                         dead = stale[0]
                         replicas.remove(dead)
                         self._kill_replica(dead)

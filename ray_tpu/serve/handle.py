@@ -29,14 +29,21 @@ class DeploymentResponse:
     def result(self, timeout: Optional[float] = None) -> Any:
         # a replica killed mid-flight (rolling update, health replacement)
         # re-routes to a live one (reference: router retries on
-        # ActorDiedError for idempotent-by-convention requests)
+        # ActorDiedError for idempotent-by-convention requests). ONE
+        # deadline spans all attempts — the configured timeout must not
+        # triple under retries.
         attempts = 3 if self._redispatch is not None else 1
+        deadline = None if timeout is None else time.time() + timeout
         try:
             for attempt in range(attempts):
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.time()))
                 try:
-                    return ray_tpu.get(self._ref, timeout=timeout)
+                    return ray_tpu.get(self._ref, timeout=remaining)
                 except ActorDiedError:
-                    if attempt == attempts - 1:
+                    if attempt == attempts - 1 or (
+                            deadline is not None
+                            and time.time() >= deadline):
                         raise
                     self._router._dec(self._replica_key)
                     self._router._refresh(force=True)

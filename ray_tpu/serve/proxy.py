@@ -118,8 +118,13 @@ class HTTPProxy:
                     if isinstance(chunk, str):
                         chunk = chunk.encode()
                     await resp.write(chunk)
-            except Exception:  # mid-stream replica failure: cut the stream
-                pass
+            except Exception:
+                # mid-stream failure: ABORT the connection (no clean eof)
+                # so the client can tell truncation from completion
+                resp.force_close()
+                if request.transport is not None:
+                    request.transport.close()
+                return resp
             await resp.write_eof()
             return resp
         timeout = match.get("timeout", 60.0)

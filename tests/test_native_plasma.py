@@ -1,0 +1,97 @@
+"""Native C++ arena allocator (ray_tpu._native.plasma).
+
+Reference: plasma's dlmalloc arena (src/ray/object_manager/plasma/
+dlmalloc.cc). The Python FreeListAllocator remains the fallback when no
+toolchain is present.
+"""
+
+import random
+
+import pytest
+
+try:
+    from ray_tpu._native.plasma import NativeAllocator
+except Exception:  # no g++ / build failure: fallback path covers us
+    NativeAllocator = None
+
+needs_native = pytest.mark.skipif(NativeAllocator is None,
+                                  reason="native toolchain unavailable")
+
+
+@needs_native
+def test_basic_alloc_free_coalesce():
+    a = NativeAllocator(1 << 20)
+    o1, o2, o3 = a.allocate(100), a.allocate(1000), a.allocate(64)
+    assert {o1, o2, o3} == {0, 128, 128 + 1024}  # 64B-aligned best fit
+    assert a.bytes_allocated() == 128 + 1024 + 64
+    a.free(o2)
+    assert a.allocate(500) == o2  # freed extent reused
+    a.free(o1)
+    a.free(o3)
+    a.free(o2)
+    assert a.bytes_allocated() == 0
+    assert a.num_free_blocks() == 1  # fully coalesced back to one extent
+    assert a.allocate(1 << 20) == 0  # whole arena fits again
+    assert a.allocate(64) is None  # full -> None, matching the Python API
+
+
+@needs_native
+def test_free_unknown_offset_raises():
+    a = NativeAllocator(1 << 16)
+    with pytest.raises(KeyError):
+        a.free(4096)
+
+
+@needs_native
+def test_fuzz_self_consistency():
+    """Random alloc/free: extents never overlap, accounting exact,
+    full free coalesces to a single block."""
+    cap = 4 << 20
+    a = NativeAllocator(cap)
+    rng = random.Random(7)
+    live = {}
+    expected_bytes = 0
+    for i in range(30_000):
+        if live and rng.random() < 0.48:
+            key = rng.choice(list(live))
+            off, size = live.pop(key)
+            a.free(off)
+            expected_bytes -= size
+        else:
+            req = rng.randint(1, 48 * 1024)
+            aligned = max(8, (req + 63) & ~63)
+            off = a.allocate(req)
+            if off is None:
+                continue
+            assert off % 64 == 0
+            assert off + aligned <= cap
+            for o2, s2 in live.values():
+                assert off + aligned <= o2 or o2 + s2 <= off, \
+                    f"overlap at op {i}"
+            live[i] = (off, aligned)
+            expected_bytes += aligned
+        assert a.bytes_allocated() == expected_bytes
+    for off, _ in live.values():
+        a.free(off)
+    assert a.bytes_allocated() == 0
+    assert a.num_free_blocks() == 1
+
+
+@needs_native
+def test_object_store_uses_native_allocator(tmp_path):
+    """The guarded import in object_store resolves to the real native
+    module now (round-1 flagged it as a phantom)."""
+    from ray_tpu.core.object_store import LocalObjectStore
+    from ray_tpu.core.ids import ObjectID
+
+    store = LocalObjectStore(str(tmp_path), "ee" * 16, capacity=1 << 20)
+    try:
+        assert type(store.arena.allocator).__name__ == "NativeAllocator"
+        oid = ObjectID(b"x" * 20)
+        off, view = store.create(oid, 1000)
+        view[:4] = b"abcd"
+        store.seal(oid)
+        payload, is_err = store.get_payload(oid)
+        assert bytes(payload[:4]) == b"abcd" and not is_err
+    finally:
+        store.close()
