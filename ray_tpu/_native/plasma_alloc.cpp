@@ -72,6 +72,7 @@ PyObject* Allocator_allocate(AllocatorObject* self, PyObject* args) {
   unsigned long long req = 0;
   if (!PyArg_ParseTuple(args, "K", &req)) return nullptr;
   size_t size = static_cast<size_t>(req);
+  if (size > self->arena->capacity) Py_RETURN_NONE;  // also blocks align wrap
   if (size < 8) size = 8;
   size = (size + kAlign - 1) & ~(kAlign - 1);
 

@@ -20,6 +20,7 @@ import cloudpickle
 
 from .ids import ActorID
 from .remote_function import prepare_args, resolve_scheduling_strategy
+from .runtime_env import pack_runtime_env
 from .resources import parse_task_resources
 from .task_spec import TaskSpec
 
@@ -189,7 +190,7 @@ class ActorClass:
             max_retries=0,
             scheduling_strategy=resolve_scheduling_strategy(
                 opt.get("scheduling_strategy")),
-            runtime_env=opt.get("runtime_env"),
+            runtime_env=pack_runtime_env(opt.get("runtime_env"), runtime),
             actor_id=actor_id,
             is_actor_creation=True,
             actor_max_concurrency=opt.get("max_concurrency", 1),

@@ -147,14 +147,18 @@ class Node:
                             if (cand.state == "busy"
                                     and len(cand.assigned) < depth
                                     and all(not s.is_actor_creation and not b
-                                            for s, b in cand.assigned.values())):
+                                            for s, b, _ in
+                                            cand.assigned.values())):
                                 w = cand
                                 break
                     if w is None:
                         break
                 self._local_queue.popleft()
                 w.state = "busy"
-                w.assigned[spec.task_id] = (spec, binding)
+                # stamp the attempt at assignment: spec objects are shared
+                # with the head and mutate on retry, so a late finish must
+                # carry the attempt it actually ran
+                w.assigned[spec.task_id] = (spec, binding, spec.attempt)
                 to_send.append((w, spec, binding))
             # rescue: a worker sits idle with nothing queued while another
             # has staged-unstarted tasks — ask for one back so it isn't
@@ -300,7 +304,7 @@ class Node:
                 with self._lock:
                     entry = w.assigned.pop(tid, None)
                     if entry is not None:
-                        self._local_queue.appendleft(entry)
+                        self._local_queue.appendleft(entry[:2])
                         if w.state == "busy" and not w.assigned:
                             w.state = "idle"
                             self._idle.append(w)
@@ -359,7 +363,7 @@ class Node:
         with self._lock:
             entry = w.assigned.pop(task_id, None)
             if entry is not None:
-                spec, binding = entry
+                spec, binding, attempt = entry
                 if spec.is_actor_creation and err_name is None:
                     w.state = "actor"
                     w.actor_id = spec.actor_id
@@ -368,10 +372,11 @@ class Node:
                     self._idle.append(w)
             else:
                 # actor task done (worker stays "actor") or stale
-                spec, binding = None, None
+                spec, binding, attempt = None, None, None
         # The head decides whether to seal results (it may retry instead).
         self.head.on_task_finished(self, task_id, err_name, spec, binding,
-                                   results, worker_id=w.worker_id)
+                                   results, worker_id=w.worker_id,
+                                   attempt=attempt)
         self._pump()
 
     def _on_worker_exit(self, w: WorkerHandle) -> None:
@@ -391,7 +396,7 @@ class Node:
             w.assigned.clear()
         w.channel.close()
         if assigned:
-            for spec, binding in assigned:
+            for spec, binding, _attempt in assigned:
                 self.head.on_worker_crashed(self, w, spec, binding, prev_state)
         else:
             self.head.on_worker_crashed(self, w, None, None, prev_state)

@@ -126,10 +126,10 @@ class RemoteHead:
     # ------------------------------------------- Head API consumed by Node
 
     def on_task_finished(self, node, task_id, err_name, spec, binding,
-                         results, worker_id=None) -> None:
+                         results, worker_id=None, attempt=None) -> None:
         self._send("task_finished", task_id, err_name,
                    pickle.dumps(spec) if spec is not None else None,
-                   binding, results, worker_id)
+                   binding, results, worker_id, attempt)
 
     def on_object_sealed(self, oid: ObjectID, node_hex: str) -> None:
         self._send("sealed", oid)

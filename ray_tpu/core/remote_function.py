@@ -130,6 +130,9 @@ class RemoteFunction:
         self._ensure_registered(runtime)
         opt = self._options
         out_args, out_kwargs, keepalive = prepare_args(runtime, args, kwargs)
+        from .runtime_env import pack_runtime_env
+
+        runtime_env = pack_runtime_env(opt.get("runtime_env"), runtime)
         num_returns = opt.get("num_returns", 1)
         streaming = num_returns in ("streaming", "dynamic")
         if streaming:
@@ -155,7 +158,7 @@ class RemoteFunction:
             retry_exceptions=bool(opt.get("retry_exceptions", False)),
             scheduling_strategy=resolve_scheduling_strategy(
                 opt.get("scheduling_strategy")),
-            runtime_env=opt.get("runtime_env"),
+            runtime_env=runtime_env,
             pinned_args=[r.id for r in keepalive],
         )
         refs = runtime.submit_task(spec)

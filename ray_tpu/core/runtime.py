@@ -273,10 +273,12 @@ class Head:
                     self.remove_node(proxy.hex)
                 return
             if tag == "task_finished":
-                task_id, err_name, spec_b, binding, results, worker_id = payload
+                (task_id, err_name, spec_b, binding, results, worker_id,
+                 attempt) = payload
                 spec = pickle.loads(spec_b) if spec_b else None
                 self.on_task_finished(proxy, task_id, err_name, spec, binding,
-                                      results, worker_id=worker_id)
+                                      results, worker_id=worker_id,
+                                      attempt=attempt)
             elif tag == "sealed":
                 self.on_object_sealed(payload[0], proxy.hex)
             elif tag == "stream_item":
@@ -533,7 +535,8 @@ class Head:
     def on_task_finished(self, node, task_id: TaskID, err_name: Optional[str],
                          node_spec: Optional[TaskSpec], node_binding: Optional[dict],
                          results: List[Tuple[ObjectID, Optional[bytes], bool]],
-                         worker_id: Optional[WorkerID] = None) -> None:
+                         worker_id: Optional[WorkerID] = None,
+                         attempt: Optional[int] = None) -> None:
         with self._lock:
             rec = self.tasks.get(task_id)
         if rec is None:
@@ -550,9 +553,11 @@ class Head:
         with self._lock:
             retry_pending = rec.state in ("PENDING", "QUEUED",
                                           "WAITING_DEPS")
-        if (node_spec is not None and node_spec is not rec.spec
-                and node_spec.attempt != rec.spec.attempt):
-            retry_pending = True
+            # attempt stamped at dispatch (spec objects mutate on retry):
+            # a finish for a superseded attempt is dropped even if the
+            # retry already reached RUNNING
+            if attempt is not None and attempt != rec.spec.attempt:
+                retry_pending = True
         if retry_pending:
             return
         # Release resources for non-actor-method tasks (idempotent — the

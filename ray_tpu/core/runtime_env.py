@@ -1,0 +1,172 @@
+"""Runtime environments: per-task/actor env_vars, working_dir, py_modules.
+
+Analog of the reference's runtime-env subsystem
+(python/ray/_private/runtime_env/ + agent/runtime_env_agent.py:161):
+directories are zipped at submission, shipped through the GCS KV store,
+and materialized once per worker host into a content-addressed cache;
+env_vars apply around execution (set-and-restore for shared plain-task
+workers, permanent for actor-dedicated workers).
+
+Supported keys: ``env_vars`` (dict), ``working_dir`` (local dir path),
+``py_modules`` (list of local dir paths). conda/pip/container isolation
+is out of scope (workers share the interpreter).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+_KV_NS = "runtime_env"
+_MAX_ZIP = 100 * 1024 * 1024
+# abspath -> (fingerprint, uploaded-ref): skip re-zipping an unchanged dir
+# on every .remote() call (submission-throughput killer otherwise)
+_upload_cache: Dict[str, Tuple[tuple, dict]] = {}
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    base = os.path.abspath(path)
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, _dirs, files in os.walk(base):
+            for f in files:
+                if f.endswith(".pyc") or "__pycache__" in root:
+                    continue
+                full = os.path.join(root, f)
+                zf.write(full, os.path.relpath(full, base))
+    data = buf.getvalue()
+    if len(data) > _MAX_ZIP:
+        raise ValueError(
+            f"runtime_env dir {path!r} zips to {len(data)}B "
+            f"(limit {_MAX_ZIP}B)")
+    return data
+
+
+def _dir_fingerprint(base: str) -> tuple:
+    """Cheap change detector: (count, total size, max mtime) over files."""
+    n = total = 0
+    latest = 0.0
+    for root, _dirs, files in os.walk(base):
+        for f in files:
+            if f.endswith(".pyc") or "__pycache__" in root:
+                continue
+            try:
+                st = os.stat(os.path.join(root, f))
+            except OSError:
+                continue
+            n += 1
+            total += st.st_size
+            latest = max(latest, st.st_mtime)
+    return (n, total, latest)
+
+
+def pack_runtime_env(env: Optional[dict], runtime) -> Optional[dict]:
+    """Driver/submitter side: replace local paths with KV references."""
+    if not env:
+        return env
+    out = dict(env)
+
+    def upload(path: str) -> dict:
+        base = os.path.abspath(path)
+        fp = _dir_fingerprint(base)
+        cached = _upload_cache.get(base)
+        if cached is not None and cached[0] == fp:
+            return cached[1]
+        data = _zip_dir(path)
+        digest = hashlib.blake2b(data, digest_size=16).hexdigest()
+        key = f"pkg_{digest}".encode()
+        if not runtime.kv("exists", key, _KV_NS):
+            runtime.kv("put", key, data, _KV_NS, True)
+        ref = {"kv_key": key.decode(), "hash": digest,
+               "basename": os.path.basename(base)}
+        _upload_cache[base] = (fp, ref)
+        return ref
+
+    wd = out.get("working_dir")
+    if isinstance(wd, str):
+        out["working_dir"] = upload(wd)
+    mods = out.get("py_modules")
+    if mods:
+        out["py_modules"] = [upload(m) if isinstance(m, str) else m
+                             for m in mods]
+    return out
+
+
+def _materialize(ref: dict, runtime) -> str:
+    """Extract a KV-stored zip into the host-local content cache."""
+    import fcntl
+
+    cache_root = os.path.join("/tmp", "raytpu_runtime_env")
+    os.makedirs(cache_root, exist_ok=True)
+    dest = os.path.join(cache_root, ref["hash"])
+    marker = dest + ".ok"
+    if os.path.exists(marker):
+        return dest
+    with open(dest + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.exists(marker):
+            return dest
+        data = runtime.kv("get", ref["kv_key"].encode(), _KV_NS)
+        if data is None:
+            raise RuntimeError(
+                f"runtime_env package {ref['kv_key']} missing from KV")
+        os.makedirs(dest, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(data)) as zf:
+            zf.extractall(dest)
+        open(marker, "w").close()
+    return dest
+
+
+def apply_runtime_env(env: Optional[dict], runtime):
+    """Worker side: apply before execution; returns a restore() callable
+    (no-op when nothing was applied)."""
+    if not env:
+        return lambda: None
+    saved_env: Dict[str, Optional[str]] = {}
+    saved_cwd: Optional[str] = None
+    added_paths: List[str] = []
+
+    def restore():
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        if saved_cwd is not None:
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+        for p in added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+
+    try:
+        for k, v in (env.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+
+        wd = env.get("working_dir")
+        if isinstance(wd, dict):
+            path = _materialize(wd, runtime)
+            saved_cwd = os.getcwd()
+            os.chdir(path)
+            sys.path.insert(0, path)
+            added_paths.append(path)
+
+        for mod in env.get("py_modules") or ():
+            if isinstance(mod, dict):
+                path = _materialize(mod, runtime)
+                sys.path.insert(0, path)
+                added_paths.append(path)
+    except BaseException:
+        restore()  # partial application must not leak into later tasks
+        raise
+
+    return restore

@@ -360,11 +360,21 @@ class WorkerRuntime:
         return args, kwargs
 
     def _execute(self, spec: TaskSpec, binding: Dict[str, List[int]]) -> None:
+        restore_env = lambda: None  # noqa: E731
         try:
             if spec.task_id in self._cancelled:
                 raise TaskCancelledError(f"task {spec.task_id.hex()} cancelled")
             if binding:
                 self._apply_accelerator_binding(binding)
+            if spec.runtime_env:
+                from .runtime_env import apply_runtime_env
+
+                restore = apply_runtime_env(spec.runtime_env, self)
+                # actor-creation envs persist for the actor's lifetime
+                # (the worker is dedicated); plain-task envs restore so
+                # the shared worker doesn't leak env state across tasks
+                if not spec.is_actor_creation:
+                    restore_env = restore
             args, kwargs = self._resolve_args(spec)
             self._current_task.task_id = spec.task_id
             self._current_task.actor_id = spec.actor_id
@@ -408,6 +418,7 @@ class WorkerRuntime:
         except Exception as e:  # noqa: BLE001
             self._send_error(spec, e)
         finally:
+            restore_env()
             self._current_task.task_id = None
             self._current_task.actor_id = None
 

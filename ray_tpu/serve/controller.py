@@ -208,6 +208,14 @@ class ServeController:
                     # the serving set covered
                     self._probe_ready(fresh)
                     ready = [r for r in fresh if r.get("ready")]
+                    if target == 0:
+                        # scaled to zero mid-roll: nothing to cover, just
+                        # retire the stale set
+                        dead = stale[0]
+                        replicas.remove(dead)
+                        self._kill_replica(dead)
+                        self._version += 1
+                        continue
                     if len(fresh) < target and len(replicas) <= target:
                         replicas.append(self._spawn_replica(rec))
                         self._version += 1

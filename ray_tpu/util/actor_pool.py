@@ -1,0 +1,99 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Analog of the reference's ray.util.ActorPool
+(python/ray/util/actor_pool.py): submit/map/map_unordered over idle
+actors, with get_next / get_next_unordered consumption.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; queues if no actor is idle."""
+        if not self._idle:
+            # block until some in-flight call finishes, freeing an actor
+            self._wait_for_one()
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._index_to_future[self._next_task_index] = ref
+        self._next_task_index += 1
+
+    def _wait_for_one(self) -> None:
+        refs = list(self._future_to_actor)
+        ready, _ = ray_tpu.wait(refs, num_returns=1)
+        for ref in ready:
+            self._idle.append(self._future_to_actor.pop(ref))
+            break
+
+    def has_next(self) -> bool:
+        return self._next_return_index < self._next_task_index
+
+    def get_next(self, timeout=None) -> Any:
+        """Next result in SUBMISSION order. A timeout leaves the pool
+        state untouched so the call can be retried."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ref = self._index_to_future[self._next_return_index]
+        value = ray_tpu.get(ref, timeout=timeout)  # raises -> no pops
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+        return value
+
+    def get_next_unordered(self, timeout=None) -> Any:
+        """Next result to COMPLETE, regardless of submission order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        refs = [r for r in self._index_to_future.values()]
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        for idx, r in list(self._index_to_future.items()):
+            if r == ref:
+                del self._index_to_future[idx]
+                break
+        # note: return indices no longer align after unordered pops; the
+        # ordered API must not be mixed with unordered (reference caveat)
+        self._next_return_index += 1
+        value = ray_tpu.get(ref)
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+        return value
+
+    def map(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def has_free(self) -> bool:
+        return len(self._idle) > 0
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor) -> None:
+        self._idle.append(actor)

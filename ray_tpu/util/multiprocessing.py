@@ -1,0 +1,148 @@
+"""multiprocessing.Pool clone over actors.
+
+Analog of the reference's ray.util.multiprocessing.Pool
+(python/ray/util/multiprocessing/pool.py): the stdlib Pool API (map /
+imap / imap_unordered / apply / apply_async / starmap) running each chunk
+on a pool of actor processes, so existing multiprocessing code scales to
+the cluster unchanged.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+from .actor_pool import ActorPool
+
+
+@ray_tpu.remote
+class _PoolWorker:
+    def run_chunk(self, fn, chunk, star: bool):
+        if star:
+            return [fn(*args) for args in chunk]
+        return [fn(x) for x in chunk]
+
+    def run_one(self, fn, args, kwargs):
+        return fn(*args, **kwargs)
+
+
+class AsyncResult:
+    def __init__(self, ref):
+        self._ref = ref
+
+    def get(self, timeout: Optional[float] = None):
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait([self._ref], num_returns=1, timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait([self._ref], num_returns=1, timeout=0)
+        return bool(ready)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None, *,
+                 actor_options: Optional[dict] = None):
+        if processes is None:
+            try:
+                processes = max(1, int(
+                    ray_tpu.available_resources().get("CPU", os.cpu_count())))
+            except Exception:  # noqa: BLE001
+                processes = os.cpu_count() or 1
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 1)
+        self._workers = [_PoolWorker.options(**opts).remote()
+                         for _ in range(processes)]
+        self._processes = processes
+        self._closed = False
+        self._rr = itertools.count()
+
+    # ---- helpers ----
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        for i in range(0, len(items), chunksize):
+            yield items[i:i + chunksize]
+
+    def _map_refs(self, fn, iterable, chunksize, star):
+        refs = []
+        for worker, chunk in zip(itertools.cycle(self._workers),
+                                 self._chunks(iterable, chunksize)):
+            refs.append(worker.run_chunk.remote(fn, chunk, star))
+        return refs
+
+    # ---- stdlib Pool API ----
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        refs = self._map_refs(fn, iterable, chunksize, star=False)
+        out: List[Any] = []
+        for chunk in ray_tpu.get(refs):
+            out.extend(chunk)
+        return out
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> List[Any]:
+        refs = self._map_refs(fn, iterable, chunksize, star=True)
+        out: List[Any] = []
+        for chunk in ray_tpu.get(refs):
+            out.extend(chunk)
+        return out
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        refs = self._map_refs(fn, iterable, chunksize, star=False)
+        for ref in refs:
+            yield from ray_tpu.get(ref)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        refs = self._map_refs(fn, iterable, chunksize, star=False)
+        pending = list(refs)
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            for ref in ready:
+                yield from ray_tpu.get(ref)
+
+    def apply(self, fn: Callable, args: tuple = (), kwargs: dict = None):
+        return self.apply_async(fn, args, kwargs).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwargs: dict = None) -> AsyncResult:
+        worker = self._workers[next(self._rr) % self._processes]
+        return AsyncResult(worker.run_one.remote(fn, args, kwargs or {}))
+
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+        for w in self._workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still open")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()
+        return False

@@ -1,0 +1,130 @@
+"""Distributed Queue backed by an actor.
+
+Analog of the reference's ray.util.queue.Queue
+(python/ray/util/queue.py): a named/shared FIFO usable from any driver or
+worker, with blocking put/get, timeouts, and batch operations.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+@ray_tpu.remote
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        from collections import deque
+
+        self._maxsize = maxsize
+        self._items: deque = deque()
+
+    def put(self, item) -> bool:
+        if self._maxsize > 0 and len(self._items) >= self._maxsize:
+            return False
+        self._items.append(item)
+        return True
+
+    def put_batch(self, items) -> int:
+        n = 0
+        for item in items:
+            if self._maxsize > 0 and len(self._items) >= self._maxsize:
+                break
+            self._items.append(item)
+            n += 1
+        return n
+
+    def get(self):
+        if not self._items:
+            return False, None
+        return True, self._items.popleft()
+
+    def get_batch(self, n: int):
+        out = []
+        while self._items and len(out) < n:
+            out.append(self._items.popleft())
+        return out
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        self._actor = _QueueActor.options(**opts).remote(maxsize)
+        self._maxsize = maxsize
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self._actor.put.remote(item)):
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full()
+            time.sleep(0.01)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty()
+            time.sleep(0.01)
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        n = ray_tpu.get(self._actor.put_batch.remote(list(items)))
+        if n < len(items):
+            raise Full(f"only {n}/{len(items)} items fit")
+
+    def get_nowait_batch(self, n: int) -> List[Any]:
+        out = ray_tpu.get(self._actor.get_batch.remote(n))
+        if len(out) < n:
+            raise Empty(f"only {len(out)}/{n} items available")
+        return out
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self._maxsize > 0 and self.qsize() >= self._maxsize
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self._actor)
+
+    def __reduce__(self):
+        return (_rebuild_queue, (self._actor, self._maxsize))
+
+
+def _rebuild_queue(actor, maxsize):
+    q = object.__new__(Queue)
+    q._actor = actor
+    q._maxsize = maxsize
+    return q

@@ -1,0 +1,79 @@
+"""ray.util extras: ActorPool, distributed Queue, multiprocessing.Pool.
+
+Reference: python/ray/util/actor_pool.py, queue.py,
+multiprocessing/pool.py.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Queue
+from ray_tpu.util.queue import Empty, Full
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, x):
+        return x * 2
+
+
+def test_actor_pool_map_ordered(ray_start_regular):
+    pool = ActorPool([_Doubler.remote(), _Doubler.remote()])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert out == [x * 2 for x in range(8)]
+
+
+def test_actor_pool_unordered(ray_start_regular):
+    pool = ActorPool([_Doubler.remote(), _Doubler.remote()])
+    out = sorted(pool.map_unordered(
+        lambda a, v: a.double.remote(v), range(6)))
+    assert out == [x * 2 for x in range(6)]
+
+
+def test_actor_pool_submit_get_next(ray_start_regular):
+    pool = ActorPool([_Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)
+    assert pool.get_next() == 20
+    assert pool.get_next() == 40
+    assert not pool.has_next()
+
+
+def test_queue_basic(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(block=False)
+    q.shutdown()
+
+
+def test_queue_shared_across_workers(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i * 10)
+        return n
+
+    assert ray_tpu.get(producer.remote(q, 3)) == 3
+    assert [q.get(timeout=10) for _ in range(3)] == [0, 10, 20]
+    q.shutdown()
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(2) as p:
+        assert p.map(lambda x: x * x, range(10)) == [x * x for x in range(10)]
+        assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        assert sorted(p.imap_unordered(lambda x: -x, range(5))) == \
+            [-4, -3, -2, -1, 0]
+        r = p.apply_async(lambda a: a + 1, (41,))
+        assert r.get(timeout=30) == 42
