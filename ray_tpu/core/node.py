@@ -40,8 +40,10 @@ class WorkerHandle:
     state: str = "starting"  # starting | idle | busy | actor | dead
     # in-flight plain tasks staged on this worker (lease pipelining:
     # > 1 entry means the next task is already in the worker's memory
-    # when the current one finishes)
-    assigned: Dict[object, Tuple[TaskSpec, dict]] = field(default_factory=dict)
+    # when the current one finishes); values are
+    # (spec, binding, attempt-at-dispatch)
+    assigned: Dict[object, Tuple[TaskSpec, dict, int]] = field(
+        default_factory=dict)
     actor_id: Optional[object] = None
     reader: Optional[threading.Thread] = None
 

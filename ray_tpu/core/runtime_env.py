@@ -75,7 +75,10 @@ def pack_runtime_env(env: Optional[dict], runtime) -> Optional[dict]:
         fp = _dir_fingerprint(base)
         cached = _upload_cache.get(base)
         if cached is not None and cached[0] == fp:
-            return cached[1]
+            # shutdown()+init() recreates the KV store: confirm the
+            # package still exists before trusting the cached ref
+            if runtime.kv("exists", cached[1]["kv_key"].encode(), _KV_NS):
+                return cached[1]
         data = _zip_dir(path)
         digest = hashlib.blake2b(data, digest_size=16).hexdigest()
         key = f"pkg_{digest}".encode()

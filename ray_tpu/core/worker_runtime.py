@@ -371,10 +371,11 @@ class WorkerRuntime:
 
                 restore = apply_runtime_env(spec.runtime_env, self)
                 # actor-creation envs persist for the actor's lifetime
-                # (the worker is dedicated); plain-task envs restore so
-                # the shared worker doesn't leak env state across tasks
-                if not spec.is_actor_creation:
-                    restore_env = restore
+                # (the worker is dedicated) — but only once the creation
+                # SUCCEEDS; a failed creation returns this worker to the
+                # shared pool, so its env must roll back. Plain-task envs
+                # always restore.
+                restore_env = restore
             args, kwargs = self._resolve_args(spec)
             self._current_task.task_id = spec.task_id
             self._current_task.actor_id = spec.actor_id
@@ -384,6 +385,8 @@ class WorkerRuntime:
                 self._actors[spec.actor_id] = _ActorState(
                     instance, spec.actor_max_concurrency, spec.actor_is_async
                 )
+                restore_env = lambda: None  # noqa: E731 — creation OK:
+                # the env persists for the actor's lifetime
                 self._finish(spec, None)
             elif spec.actor_id is not None:
                 st = self._actors[spec.actor_id]

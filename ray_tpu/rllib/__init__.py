@@ -10,11 +10,13 @@ from .algorithm import Algorithm, EnvRunnerGroup
 from .config import AlgorithmConfig
 from .env_runner import SingleAgentEnvRunner, compute_gae
 from .learner import Learner, LearnerGroup
+from .impala import IMPALA, IMPALAConfig
 from .ppo import PPO, PPOConfig
 from .rl_module import JaxRLModule, RLModuleSpec
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "EnvRunnerGroup",
     "SingleAgentEnvRunner", "compute_gae", "Learner", "LearnerGroup",
-    "PPO", "PPOConfig", "JaxRLModule", "RLModuleSpec",
+    "PPO", "PPOConfig", "IMPALA", "IMPALAConfig",
+    "JaxRLModule", "RLModuleSpec",
 ]

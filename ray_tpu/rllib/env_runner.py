@@ -128,6 +128,7 @@ class SingleAgentEnvRunner:
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
             "terminateds": term_buf, "dones": done_buf, "logp": logp_buf,
             "vf_preds": vf_buf, "valid": valid_buf, "vf_last": vf_last,
+            "last_obs": self._obs.copy(),
         }
         stats = {
             "episode_returns": self._completed_returns,

@@ -1,0 +1,191 @@
+"""IMPALA: async actor-learner RL with V-trace off-policy correction.
+
+Analog of the reference's IMPALA (rllib/algorithms/impala/impala.py +
+vtrace implementation): env runners sample continuously and the learner
+consumes batches as they arrive (no synchronization barrier, unlike PPO);
+the policy lag between the behavior policy that sampled and the target
+policy that learns is corrected with V-trace (Espeholt et al. 2018,
+arXiv:1802.01561) computed inside the jitted loss via a backward
+``lax.scan``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+import ray_tpu
+
+from .algorithm import Algorithm, summarize_episode_stats
+from .config import AlgorithmConfig
+from .learner import LearnerGroup
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = IMPALA
+        self.lr = 5e-4
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.clip_rho_threshold: float = 1.0
+        self.clip_pg_rho_threshold: float = 1.0
+        self.grad_clip: float = 40.0
+        self.num_epochs: int = 1  # IMPALA consumes each batch once
+        self.minibatch_size: int = 0  # 0 = whole batch per update
+
+
+def impala_loss(config: IMPALAConfig):
+    """(module, params, batch) -> (loss, stats) with inline V-trace.
+
+    Batch arrays are [T, N] time-major sequences plus a validity mask;
+    the learner recomputes values under the CURRENT params and corrects
+    the behavior-policy returns with clipped importance weights.
+    """
+    gamma = config.gamma
+    rho_bar = config.clip_rho_threshold
+    pg_rho_bar = config.clip_pg_rho_threshold
+    vf_coeff = config.vf_loss_coeff
+    ent_coeff = config.entropy_coeff
+
+    def loss_fn(module, params, mb):
+        import jax
+        import jax.numpy as jnp
+
+        obs = mb["obs"]            # [T, N, obs_dim]
+        actions = mb["actions"]    # [T, N]
+        rewards = mb["rewards"]
+        dones = mb["dones"].astype(jnp.float32)
+        valid = mb["valid"].astype(jnp.float32)
+        behavior_logp = mb["logp"]
+
+        T, N = actions.shape
+        flat_obs = obs.reshape(T * N, -1)
+        logits, values = module.forward(params, flat_obs)
+        logits = logits.reshape(T, N, -1)
+        values = values.reshape(T, N)
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, actions[..., None], axis=-1)[..., 0]
+
+        # bootstrap with V(s_T) under current params
+        _, boot = module.forward(params, mb["last_obs"])  # [N]
+        boot = jax.lax.stop_gradient(boot)
+
+        # IMPORTANT: rho feeds the V-trace TARGETS; without the stop-grad
+        # the value loss backprops through rho into the policy with an
+        # inverted sign (it lowers vs by lowering the probability of
+        # positive-delta actions) and training diverges
+        rho = jax.lax.stop_gradient(
+            jnp.exp(target_logp - behavior_logp))
+        clipped_rho = jnp.minimum(rho_bar, rho)
+        cs = jnp.minimum(1.0, rho)
+        discounts = gamma * (1.0 - dones)
+        values_sg = jax.lax.stop_gradient(values)
+        next_values = jnp.concatenate(
+            [values_sg[1:], boot[None, :]], axis=0)
+        deltas = clipped_rho * (
+            rewards + discounts * next_values - values_sg)
+
+        def backward(acc, xs):
+            delta_t, disc_t, c_t = xs
+            acc = delta_t + disc_t * c_t * acc
+            return acc, acc
+
+        _, vs_minus_v = jax.lax.scan(
+            backward, jnp.zeros((N,), jnp.float32),
+            (deltas, discounts, cs), reverse=True)
+        vs = jax.lax.stop_gradient(vs_minus_v + values_sg)
+        vs_next = jnp.concatenate([vs[1:], boot[None, :]], axis=0)
+        pg_adv = jnp.minimum(pg_rho_bar, rho) * (
+            rewards + discounts * vs_next - values_sg)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+
+        w = valid / jnp.maximum(valid.sum(), 1.0)
+        policy_loss = -(target_logp * pg_adv * w).sum()
+        vf_loss = 0.5 * (((vs - values) ** 2) * w).sum()
+        entropy = (-(jnp.exp(logp_all) * logp_all).sum(-1) * w).sum()
+        total = policy_loss + vf_coeff * vf_loss - ent_coeff * entropy
+        stats = {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_rho": (rho * w).sum(),
+        }
+        return total, stats
+
+    return loss_fn
+
+
+class IMPALA(Algorithm):
+    config_class = IMPALAConfig
+
+    def _build_learner_group(self) -> LearnerGroup:
+        return LearnerGroup(self.algo_config, self.algo_config.rl_module_spec,
+                            self.obs_space, self.act_space,
+                            impala_loss(self.algo_config))
+
+    def setup(self, config) -> None:
+        super().setup(config)
+        self._inflight: Dict[Any, int] = {}  # sample ref -> runner idx
+
+    def _kick(self, idx: int, weights_ref) -> None:
+        group = self.env_runner_group
+        if group._local is not None:
+            return
+        ref = group._runners[idx].sample.remote(weights_ref)
+        self._inflight[ref] = idx
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        group = self.env_runner_group
+        weights = self.learner_group.get_weights()
+
+        if group._local is not None:
+            batches_stats = [group._local.sample(weights)]
+        else:
+            wref = ray_tpu.put(weights)
+            for i in range(len(group._runners)):
+                if group._healthy[i] and i not in self._inflight.values():
+                    self._kick(i, wref)
+            # async harvest: take whatever finished first; stragglers keep
+            # sampling (the IMPALA architecture: no gang barrier)
+            batches_stats = []
+            deadline_refs = list(self._inflight)
+            ready, _ = ray_tpu.wait(deadline_refs, num_returns=1,
+                                    timeout=120)
+            for ref in ready:
+                idx = self._inflight.pop(ref)
+                try:
+                    b, s = ray_tpu.get(ref, timeout=60)
+                    batches_stats.append((b, s))
+                    self._kick(idx, wref)  # resample with fresh weights
+                except Exception:  # noqa: BLE001 — runner died
+                    group._healthy[idx] = False
+            if not batches_stats:
+                group.restore_workers()
+                return {"num_env_steps_sampled": 0}
+
+        all_stats: List[dict] = []
+        learner_stats: Dict[str, float] = {}
+        for batch, stats in batches_stats:
+            all_stats.append(stats)
+            seq = {
+                "obs": batch["obs"].astype(np.float32),
+                "actions": batch["actions"],
+                "rewards": batch["rewards"],
+                "dones": batch["dones"],
+                "valid": batch["valid"],
+                "logp": batch["logp"],
+                "last_obs": batch["last_obs"],
+            }
+            learner_stats = self.learner_group.update(
+                seq, num_epochs=cfg.num_epochs,
+                minibatch_size=0, seed=self._iteration,
+                sequence_batch=True)
+        if cfg.restart_failed_env_runners:
+            group.restore_workers()
+        result = summarize_episode_stats(all_stats)
+        result["learner"] = learner_stats
+        return result

@@ -87,8 +87,24 @@ class Learner:
 
     def update(self, flat_batch: Dict[str, np.ndarray], *, num_epochs: int,
                minibatch_size: int, rng: Optional[np.random.Generator] = None,
-               shard_pad_to: Optional[int] = None) -> Dict[str, float]:
-        """SGD epochs over shuffled minibatches; returns mean stats."""
+               sequence_batch: bool = False) -> Dict[str, float]:
+        """SGD epochs over shuffled minibatches; returns mean stats.
+
+        ``sequence_batch``: the batch is time-major [T, N] sequences (e.g.
+        IMPALA/V-trace) consumed whole — no row shuffling or minibatching.
+        """
+        if sequence_batch:
+            all_stats = []
+            for _ in range(num_epochs):
+                self.state, loss, stats, grads = self._update_fn(
+                    self.state, flat_batch)
+                if grads is not None:
+                    grads = self._allreduce(grads)
+                    self._apply_grads(grads)
+                all_stats.append({k: float(v) for k, v in stats.items()})
+            keys = all_stats[0].keys() if all_stats else ()
+            return {k: float(np.mean([s[k] for s in all_stats]))
+                    for k in keys}
         rng = rng or np.random.default_rng(0)
         n = len(flat_batch["actions"])
         mbs = min(minibatch_size, n)
@@ -207,11 +223,17 @@ class LearnerGroup:
         w0 = ray_tpu.get(self._actors[0].get_weights.remote())
         ray_tpu.get([a.set_weights.remote(w0) for a in self._actors[1:]])
 
-    def update(self, flat_batch, *, num_epochs, minibatch_size, seed=0):
+    def update(self, flat_batch, *, num_epochs, minibatch_size, seed=0,
+               sequence_batch: bool = False):
         if self._local is not None:
             return self._local.update(flat_batch, num_epochs=num_epochs,
                                       minibatch_size=minibatch_size,
-                                      rng=np.random.default_rng(seed))
+                                      rng=np.random.default_rng(seed),
+                                      sequence_batch=sequence_batch)
+        if sequence_batch:
+            raise NotImplementedError(
+                "sequence (time-major) batches are not sharded across "
+                "remote learners yet; use num_learners=0")
         import ray_tpu
 
         n = len(flat_batch["actions"])

@@ -44,6 +44,8 @@ class AsyncResult:
         return bool(ready)
 
     def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")  # stdlib contract
         try:
             self.get(timeout=0)
             return True
@@ -67,6 +69,7 @@ class Pool:
         self._processes = processes
         self._closed = False
         self._rr = itertools.count()
+        self._outstanding: List[Any] = []
 
     # ---- helpers ----
 
@@ -82,6 +85,7 @@ class Pool:
         for worker, chunk in zip(itertools.cycle(self._workers),
                                  self._chunks(iterable, chunksize)):
             refs.append(worker.run_chunk.remote(fn, chunk, star))
+        self._outstanding.extend(refs)
         return refs
 
     # ---- stdlib Pool API ----
@@ -123,7 +127,9 @@ class Pool:
     def apply_async(self, fn: Callable, args: tuple = (),
                     kwargs: dict = None) -> AsyncResult:
         worker = self._workers[next(self._rr) % self._processes]
-        return AsyncResult(worker.run_one.remote(fn, args, kwargs or {}))
+        ref = worker.run_one.remote(fn, args, kwargs or {})
+        self._outstanding.append(ref)
+        return AsyncResult(ref)
 
     def close(self) -> None:
         self._closed = True
@@ -139,6 +145,12 @@ class Pool:
     def join(self) -> None:
         if not self._closed:
             raise ValueError("Pool is still open")
+        # stdlib contract: join() is the completion barrier for all
+        # submitted work
+        if self._outstanding:
+            ray_tpu.wait(self._outstanding,
+                         num_returns=len(self._outstanding))
+            self._outstanding.clear()
 
     def __enter__(self):
         return self

@@ -35,14 +35,12 @@ class _QueueActor:
         self._items.append(item)
         return True
 
-    def put_batch(self, items) -> int:
-        n = 0
-        for item in items:
-            if self._maxsize > 0 and len(self._items) >= self._maxsize:
-                break
-            self._items.append(item)
-            n += 1
-        return n
+    def put_batch(self, items) -> bool:
+        # atomic: all or nothing (reference ray.util.queue batch contract)
+        if self._maxsize > 0 and                 len(self._items) + len(items) > self._maxsize:
+            return False
+        self._items.extend(items)
+        return True
 
     def get(self):
         if not self._items:
@@ -50,10 +48,10 @@ class _QueueActor:
         return True, self._items.popleft()
 
     def get_batch(self, n: int):
-        out = []
-        while self._items and len(out) < n:
-            out.append(self._items.popleft())
-        return out
+        # atomic: nothing is popped unless n items are available
+        if len(self._items) < n:
+            return None
+        return [self._items.popleft() for _ in range(n)]
 
     def qsize(self) -> int:
         return len(self._items)
@@ -97,14 +95,13 @@ class Queue:
         return self.get(block=False)
 
     def put_nowait_batch(self, items: List[Any]) -> None:
-        n = ray_tpu.get(self._actor.put_batch.remote(list(items)))
-        if n < len(items):
-            raise Full(f"only {n}/{len(items)} items fit")
+        if not ray_tpu.get(self._actor.put_batch.remote(list(items))):
+            raise Full(f"batch of {len(items)} items does not fit")
 
     def get_nowait_batch(self, n: int) -> List[Any]:
         out = ray_tpu.get(self._actor.get_batch.remote(n))
-        if len(out) < n:
-            raise Empty(f"only {len(out)}/{n} items available")
+        if out is None:
+            raise Empty(f"fewer than {n} items available")
         return out
 
     def qsize(self) -> int:

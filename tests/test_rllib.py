@@ -207,3 +207,86 @@ def test_ppo_under_tune(ray_start_regular, tmp_path):
     assert len(results) == 2
     assert not results.errors
     assert results.get_best_result().metrics["episode_return_mean"] >= 0
+
+
+def test_impala_vtrace_gradient_direction():
+    """Regression: V-trace targets must be stop-gradiented — without it
+    the value loss backprops through rho and pushes GOOD actions' logp
+    down (observed full inversion: the bandit below converged to the
+    zero-reward arm)."""
+    import jax
+    import numpy as np
+    import optax
+
+    from ray_tpu.rllib.impala import IMPALAConfig, impala_loss
+    from ray_tpu.rllib.rl_module import JaxRLModule
+
+    cfg = IMPALAConfig()
+    module = JaxRLModule(4, 2)
+    params = module.init(jax.random.PRNGKey(0))
+    loss_fn = impala_loss(cfg)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+    T, N = 32, 4
+    rng = np.random.RandomState(0)
+    obs = np.ones((T, N, 4), np.float32)
+
+    @jax.jit
+    def step(params, opt_state, mb):
+        (_, _), g = jax.value_and_grad(
+            lambda p: loss_fn(module, p, mb), has_aux=True)(params)
+        up, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(params, up), opt_state
+
+    p0 = 0.5
+    for _ in range(150):
+        logits, _ = module.forward(params, np.ones((1, 4), np.float32))
+        p0 = float(jax.nn.softmax(logits)[0, 0])
+        actions = (rng.rand(T, N) > p0).astype(np.int64)
+        logp = np.where(actions == 0, np.log(p0 + 1e-9),
+                        np.log(1 - p0 + 1e-9)).astype(np.float32)
+        mb = {"obs": obs, "actions": actions,
+              "rewards": (actions == 0).astype(np.float32),
+              "dones": np.zeros((T, N), bool),
+              "valid": np.ones((T, N), bool), "logp": logp,
+              "last_obs": np.ones((N, 4), np.float32)}
+        params, opt_state = step(params, opt_state, mb)
+    assert p0 > 0.9, f"policy failed to prefer the paying arm: P(a0)={p0}"
+
+
+def test_impala_learns_cartpole():
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                           rollout_fragment_length=64)
+              .training(lr=5e-4, entropy_coeff=0.01)
+              .debugging(seed=0))
+    algo = config.build()
+    best = 0.0
+    for _ in range(350):
+        r = algo.train()
+        best = max(best, r.get("episode_return_mean", 0.0))
+        if best >= 400:
+            break
+    assert best >= 400, f"IMPALA failed to learn CartPole: best={best}"
+
+
+def test_impala_async_remote_runners(ray_start_regular):
+    """Async harvest: learner consumes whichever runner finishes first and
+    immediately resamples it (no gang barrier)."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                           rollout_fragment_length=32,
+                           num_cpus_per_env_runner=1)
+              .debugging(seed=0))
+    algo = config.build()
+    r1 = algo.train()
+    assert r1["num_env_steps_sampled"] > 0
+    r2 = algo.train()
+    assert r2["training_iteration"] == 2
+    algo.cleanup()
