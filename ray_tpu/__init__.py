@@ -21,6 +21,7 @@ from ray_tpu.core.api import (  # noqa: F401
     put,
     remote,
     shutdown,
+    start_client_server,
     wait,
 )
 from ray_tpu.core.exceptions import (  # noqa: F401
@@ -42,6 +43,7 @@ from ray_tpu.core.runtime_context import get_runtime_context  # noqa: F401
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "start_client_server",
     "kill", "cancel", "get_actor", "method", "available_resources",
     "cluster_resources", "nodes", "ObjectRef", "get_runtime_context",
     "RayTpuError", "TaskError", "ActorError", "ActorDiedError",

@@ -1,28 +1,164 @@
 """CLI entry point: ``python -m ray_tpu <command>``.
 
-Analog of the reference's ``ray`` CLI (python/ray/scripts/scripts.py:571
-``ray start``): joins this machine to a running head as a node daemon.
+Analog of the reference's ``ray`` CLI (python/ray/scripts/scripts.py:
+``ray start`` :571, ``ray stop`` :1047, ``ray job submit/status/logs/
+stop/list``, ``ray list tasks|actors|nodes``). Commands:
+
+    head    start a head process (client server + dashboard), park
+    start   join this machine to a running head as a node daemon
+    submit  submit a job entrypoint to a head's dashboard
+    job     status|logs|stop|list against a dashboard address
+    list    tasks|actors|nodes|objects|placement_groups via dashboard
 """
 
 from __future__ import annotations
 
+import argparse
+import json
 import sys
+import time
+
+
+def _cmd_head(args) -> int:
+    import ray_tpu
+    from ray_tpu.dashboard import start_dashboard
+
+    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    addr, key = ray_tpu.start_client_server(host=args.host, port=args.port)
+    dash = start_dashboard(host=args.host, port=args.dashboard_port)
+    from ray_tpu.core import api as _api
+
+    head = _api._get_head()
+    print("head started.")
+    print(f"  client address : ray_tpu://{addr[0]}:{addr[1]}")
+    print(f"  cluster key    : {key}")
+    print(f"  dashboard      : http://{dash.address[0]}:{dash.address[1]}")
+    if getattr(head, "node_server_address", None):
+        ns = head.node_server_address
+        print(f"  node server    : {ns[0]}:{ns[1]} (for `start --address`)")
+    print("Ctrl-C to stop.")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        ray_tpu.shutdown()
+    return 0
+
+
+def _cmd_submit(args, rest) -> int:
+    from ray_tpu.jobs import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+    entrypoint = " ".join(rest) if rest else args.entrypoint
+    if not entrypoint:
+        print("no entrypoint given (use: submit -- <cmd ...>)",
+              file=sys.stderr)
+        return 2
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    sid = client.submit_job(entrypoint=entrypoint,
+                            runtime_env=runtime_env or None,
+                            submission_id=args.submission_id)
+    print(sid)
+    if args.no_wait:
+        return 0
+    for chunk in client.tail_job_logs(sid):
+        sys.stdout.write(chunk)
+        sys.stdout.flush()
+    status = client.get_job_status(sid)
+    print(f"\njob {sid}: {status}", file=sys.stderr)
+    return 0 if status == "SUCCEEDED" else 1
+
+
+def _cmd_job(args) -> int:
+    from ray_tpu.jobs import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address)
+    if args.op == "list":
+        print(json.dumps(client.list_jobs(), indent=2))
+    elif args.op == "status":
+        print(client.get_job_status(args.job_id))
+    elif args.op == "logs":
+        sys.stdout.write(client.get_job_logs(args.job_id))
+    elif args.op == "stop":
+        print(json.dumps({"stopped": client.stop_job(args.job_id)}))
+    return 0
+
+
+def _cmd_list(args) -> int:
+    import urllib.request
+
+    base = args.address
+    if not base.startswith("http"):
+        base = "http://" + base
+    url = f"{base}/api/{args.kind}?limit={args.limit}"
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        print(json.dumps(json.loads(resp.read().decode()), indent=2))
+    return 0
 
 
 def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m ray_tpu")
+    sub = p.add_subparsers(dest="cmd")
+
+    h = sub.add_parser("head", help="start a head (client server + dashboard)")
+    h.add_argument("--host", default="0.0.0.0",
+                   help="bind interface (default all; use 127.0.0.1 for "
+                        "local-only)")
+    h.add_argument("--port", type=int, default=0)
+    h.add_argument("--dashboard-port", type=int, default=8265)
+    h.add_argument("--num-cpus", type=int, default=None)
+    h.add_argument("--num-tpus", type=int, default=None)
+
+    s = sub.add_parser("start", help="join a head as a node daemon")
+    s.add_argument("daemon_args", nargs=argparse.REMAINDER)
+
+    sb = sub.add_parser("submit", help="submit a job")
+    sb.add_argument("--address", default="http://127.0.0.1:8265")
+    sb.add_argument("--working-dir", default=None)
+    sb.add_argument("--submission-id", default=None)
+    sb.add_argument("--no-wait", action="store_true")
+    sb.add_argument("--entrypoint", default=None)
+
+    j = sub.add_parser("job", help="job status|logs|stop|list")
+    j.add_argument("op", choices=["status", "logs", "stop", "list"])
+    j.add_argument("job_id", nargs="?")
+    j.add_argument("--address", default="http://127.0.0.1:8265")
+
+    ls = sub.add_parser("list", help="list cluster state")
+    ls.add_argument("kind", choices=["tasks", "actors", "nodes", "objects",
+                                     "placement_groups", "jobs"])
+    ls.add_argument("--address", default="http://127.0.0.1:8265")
+    ls.add_argument("--limit", type=int, default=100)
+
     argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m ray_tpu start --address <head_host:port> "
-              "--key <hex> [--num-cpus N] [--num-tpus N] "
-              "[--resources JSON] [--labels JSON]")
-        return 0
-    cmd, rest = argv[0], argv[1:]
-    if cmd == "start":
+    # split off trailing "-- entrypoint..." for submit
+    rest = []
+    if "--" in argv:
+        i = argv.index("--")
+        argv, rest = argv[:i], argv[i + 1:]
+    args = p.parse_args(argv)
+
+    if args.cmd == "head":
+        return _cmd_head(args)
+    if args.cmd == "start":
         from ray_tpu.core.node_daemon import main as daemon_main
 
-        return daemon_main(rest)
-    print(f"unknown command {cmd!r}; try --help", file=sys.stderr)
-    return 2
+        return daemon_main(args.daemon_args)
+    if args.cmd == "submit":
+        return _cmd_submit(args, rest)
+    if args.cmd == "job":
+        if args.op != "list" and not args.job_id:
+            print("job_id required", file=sys.stderr)
+            return 2
+        return _cmd_job(args)
+    if args.cmd == "list":
+        if args.kind == "jobs":
+            args.kind = "jobs/"
+        return _cmd_list(args)
+    p.print_help()
+    return 0
 
 
 if __name__ == "__main__":

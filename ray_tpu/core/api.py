@@ -39,14 +39,36 @@ def init(
     namespace: str = "default",
     labels: Optional[Dict[str, str]] = None,
     ignore_reinit_error: bool = False,
+    address: Optional[str] = None,
+    cluster_key: Optional[str] = None,
     **_kwargs,
 ):
-    """Start a single-node cluster in-process and connect the driver."""
+    """Start a single-node cluster in-process and connect the driver —
+    or, with ``address="ray_tpu://host:port"``, connect this process as a
+    *remote* driver to a running head (Ray Client analog; reference:
+    ``ray.init(address="ray://...")``). ``cluster_key`` (hex; or env
+    ``RAY_TPU_CLUSTER_KEY``) authenticates the channel."""
     global _head, _namespace
     if is_initialized():
         if ignore_reinit_error:
             return runtime_mod.get_current_runtime()
         raise RuntimeError("ray_tpu.init() called twice")
+    address = address or os.environ.get("RAY_TPU_ADDRESS")
+    if address and address not in ("local", "auto"):
+        from .client_runtime import ClientRuntime
+
+        if address.startswith("ray_tpu://"):
+            address = address[len("ray_tpu://"):]
+        key_hex = cluster_key or os.environ.get("RAY_TPU_CLUSTER_KEY", "")
+        if not key_hex:
+            raise ValueError(
+                "connecting to a remote head requires cluster_key= or "
+                "RAY_TPU_CLUSTER_KEY")
+        _namespace = namespace
+        rt = ClientRuntime(address, bytes.fromhex(key_hex))
+        runtime_mod.set_current_runtime(rt)
+        object_ref_mod.set_runtime(rt)
+        return rt
     from .config import global_config
     from .accelerators import detect_resources
 
@@ -69,9 +91,31 @@ def shutdown():
         return
     runtime_mod.set_current_runtime(None)
     object_ref_mod.set_runtime(None)
+    if getattr(rt, "mode", None) == "CLIENT":
+        rt.disconnect()
+        return
     if _head is not None:
+        cs = getattr(_head, "_client_server", None)
+        if cs is not None:
+            cs.stop()
+            _head._client_server = None
         _head.shutdown()
         _head = None
+
+
+def start_client_server(host: str = "127.0.0.1", port: int = 0):
+    """Start the head-side remote-driver server (Ray Client analog).
+
+    Returns ((host, port), cluster_key_hex) — hand these to remote
+    drivers: ``ray_tpu.init(address=f"ray_tpu://{host}:{port}",
+    cluster_key=key)``.
+    """
+    head = _get_head()
+    from .client_server import ClientServer
+
+    if getattr(head, "_client_server", None) is None:
+        head._client_server = ClientServer(head, host, port)
+    return head._client_server.address, head.cluster_key_hex
 
 
 def _get_head() -> Head:

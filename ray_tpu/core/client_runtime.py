@@ -1,0 +1,201 @@
+"""Client-mode runtime: a remote driver proxying the API over TCP.
+
+Reference: ``ray.init(address="ray://host:port")`` client mode
+(python/ray/util/client/worker.py ``Worker`` — the client-side stub that
+converts every public API call into an RPC). Same role here: this object
+satisfies the runtime interface that ``ray_tpu.remote/get/put/wait`` and
+the actor machinery call, but every operation crosses one authenticated
+TCP channel to the head's ClientServer (core/client_server.py).
+
+Serialization happens client-side (core/serialization.py), so values round
+-trip exactly as in-process drivers'; TaskSpecs travel whole — the head
+re-stamps the session's job id.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional
+
+from . import serialization
+from .ids import ObjectID, TaskID
+from .protocol import Channel, RpcClient, connect, parse_address
+
+
+class ClientRuntime:
+    def __init__(self, address, cluster_key: bytes):
+        if isinstance(address, str):
+            address = parse_address(address)
+        self._channel = connect(address, cluster_key)
+        tag, payload = self._channel.recv()
+        if tag != "welcome":
+            raise ConnectionError(f"bad handshake from client server: {tag}")
+        welcome = payload[0]
+        self.job_id = welcome["job_id"]
+        self._node_id = welcome["node_id"]
+        self._driver_task_id = welcome["driver_task_id"]
+        self._rpc = RpcClient(self._channel)
+        self._closed = False
+        self._fn_cache = {}
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="client-rpc-reader", daemon=True)
+        self._reader.start()
+
+    # ---- plumbing ---------------------------------------------------------
+    def _read_loop(self) -> None:
+        try:
+            while not self._closed:
+                tag, payload = self._channel.recv()
+                if tag == "reply":
+                    self._rpc.handle_reply(*payload)
+        except (EOFError, OSError, ConnectionError) as e:
+            if not self._closed:
+                self._rpc.fail_all(
+                    ConnectionError(f"lost connection to head: {e!r}"))
+
+    def _call(self, op: str, *args, timeout: Optional[float] = None):
+        if self._closed:
+            raise RuntimeError("client runtime is disconnected")
+        return self._rpc.call("rpc", op, *args, timeout=timeout)
+
+    def _notify(self, tag: str, *payload) -> None:
+        if self._closed:
+            return
+        try:
+            self._channel.send(tag, *payload)
+        except Exception:
+            pass
+
+    def disconnect(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._notify("bye")
+        try:
+            self._channel.close()
+        except Exception:
+            pass
+
+    # ---- runtime interface ------------------------------------------------
+    @property
+    def mode(self) -> str:
+        return "CLIENT"
+
+    def is_initialized(self) -> bool:
+        return not self._closed
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.from_random()
+
+    def put(self, value: Any, _owner=None):
+        from .object_ref import ObjectRef
+
+        sobj = serialization.serialize(value)
+        buf = bytearray()
+        sobj.write_into(buf)
+        oid = self._call("put", bytes(buf))
+        return ObjectRef(oid)
+
+    def get(self, refs, timeout: Optional[float] = None) -> List[Any]:
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        out = []
+        for r in refs:
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            payload, is_error = self._call("get", r.id, remaining)
+            value = serialization.deserialize(payload)
+            if is_error:
+                raise value
+            out.append(value)
+        return out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        ready_ids = set(self._call(
+            "wait", [r.id for r in refs], num_returns, timeout))
+        ready = [r for r in refs if r.id in ready_ids]
+        not_ready = [r for r in refs if r.id not in ready_ids]
+        return ready, not_ready
+
+    def submit_task(self, spec) -> list:
+        from .object_ref import ObjectRef
+
+        self._call("submit", spec)
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def actor_method_call(self, spec) -> list:
+        return self.submit_task(spec)
+
+    def register_function(self, function_id: str, payload: bytes) -> None:
+        self._call("register_function", function_id, payload)
+
+    def get_function(self, function_id: str):
+        import pickle
+
+        if function_id not in self._fn_cache:
+            payload = self._call("get_function", function_id)
+            if payload is None:
+                raise RuntimeError(f"function {function_id} not registered")
+            self._fn_cache[function_id] = pickle.loads(payload)
+        return self._fn_cache[function_id]
+
+    def create_actor_record(self, spec, name, namespace, max_restarts,
+                            detached):
+        self._call("create_actor", spec, name, namespace, max_restarts,
+                   detached)
+
+    def get_actor_info(self, name: str, namespace: str):
+        return self._call("get_actor_info", name, namespace)
+
+    def kill_actor(self, actor_id, no_restart: bool = True):
+        self._call("kill_actor", actor_id, no_restart)
+
+    def cancel_task(self, oid, force: bool = False):
+        self._call("cancel", oid, force)
+
+    def kv(self, op: str, *args):
+        return self._call("kv", op, args)
+
+    def stream_next(self, task_id, index: int, timeout=None):
+        return self._call("stream_next", task_id, index, timeout)
+
+    def state_list(self, kind: str, limit: int = 1000):
+        return self._call("state_list", kind, limit)
+
+    # ---- refs (fire-and-forget over the ordered channel) ------------------
+    def add_local_ref(self, oid: ObjectID) -> None:
+        self._notify("refop", "add", oid)
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        self._notify("refop", "del", oid)
+
+    def add_borrow_ref(self, oid: ObjectID) -> None:
+        self._notify("refop", "add", oid)
+
+    # ---- cluster info -----------------------------------------------------
+    def runtime_context(self) -> dict:
+        return {
+            "job_id": self.job_id,
+            "node_id": self._node_id,
+            "worker_id": b"client-driver",
+            "task_id": self._driver_task_id,
+            "actor_id": None,
+            "accelerator_ids": {},
+            "mode": "CLIENT",
+        }
+
+    def available_resources(self):
+        return self._call("avail")
+
+    def cluster_resources(self):
+        return self._call("total")
+
+    def nodes(self):
+        return self._call("nodes")
+
+    def create_placement_group(self, bundles, strategy, name=""):
+        return self._call("create_pg", bundles, strategy, name)
+
+    def placement_group_op(self, op: str, *args):
+        return self._call("pg_op", op, args)

@@ -1,0 +1,266 @@
+"""Head-side client server: remote drivers over TCP (Ray Client analog).
+
+Reference: ``ray://`` client mode — a gRPC proxy/server pair
+(python/ray/util/client/server/server.py, proxier.py) through which a
+remote ``ray.init(address="ray://...")`` driver submits tasks, puts/gets
+objects, and manages actors on a running cluster. Here the transport is
+the same authenticated TCP channel protocol the node daemons use
+(core/protocol.py); each connected client gets a session with its own
+job id and a pin ledger, so a dying client releases its object pins.
+
+Job submission (job_manager.py) rides on this: a submitted job's driver
+subprocess connects back as a client.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .ids import ActorID, JobID, ObjectID, TaskID
+from .protocol import Channel, make_listener
+
+
+class _ClientSession:
+    """One connected remote driver."""
+
+    def __init__(self, server: "ClientServer", channel: Channel):
+        self.server = server
+        self.head = server.head
+        self.channel = channel
+        self.job_id = JobID.from_random()
+        self.driver_task_id = TaskID.for_driver_task(self.job_id)
+        self.put_counter = 0
+        self.pins: Dict[ObjectID, int] = {}
+        self.lock = threading.Lock()
+        self.closed = False
+
+    # ---- ref ledger -------------------------------------------------------
+    def pin(self, oid: ObjectID) -> None:
+        with self.lock:
+            self.pins[oid] = self.pins.get(oid, 0) + 1
+        with self.head._lock:
+            self.head.ref_counts[oid] += 1
+
+    def unpin(self, oid: ObjectID) -> None:
+        with self.lock:
+            cur = self.pins.get(oid, 0)
+            if cur <= 1:
+                self.pins.pop(oid, None)
+            else:
+                self.pins[oid] = cur - 1
+        with self.head._lock:
+            self.head.ref_counts[oid] -= 1
+            dead = self.head.ref_counts[oid] <= 0
+        if dead and not self.head._stopped:
+            self.head.delete_object(oid)
+
+    def release_all(self) -> None:
+        with self.lock:
+            pins, self.pins = self.pins, {}
+        for oid, n in pins.items():
+            with self.head._lock:
+                self.head.ref_counts[oid] -= n
+                dead = self.head.ref_counts[oid] <= 0
+            if dead and not self.head._stopped:
+                try:
+                    self.head.delete_object(oid)
+                except Exception:
+                    pass
+
+    # ---- ops --------------------------------------------------------------
+    def op_put(self, data: bytes):
+        from .config import global_config
+
+        with self.lock:
+            self.put_counter += 1
+            idx = self.put_counter
+        oid = ObjectID.for_put(self.driver_task_id, idx)
+        node = self.head.head_node
+        if len(data) <= global_config().max_direct_call_object_size:
+            node.store.put_inline(oid, bytes(data), False)
+        else:
+            _, view = node.store.create(oid, len(data))
+            view[: len(data)] = data
+            node.store.seal(oid, False)
+        self.head.on_object_sealed(oid, node.hex)
+        return oid
+
+    def op_get(self, oid: ObjectID, timeout: Optional[float]):
+        payload, is_error = self.head.get_object_payload(oid, timeout)
+        return bytes(payload), is_error
+
+    def dispatch(self, op: str, args: tuple) -> Any:
+        head = self.head
+        if op == "put":
+            return self.op_put(args[0])
+        if op == "get":
+            return self.op_get(args[0], args[1])
+        if op == "wait":
+            return head.wait_objects(args[0], args[1], args[2])
+        if op == "submit":
+            spec = args[0]
+            spec.job_id = self.job_id
+            head.submit_spec(spec)
+            return None
+        if op == "register_function":
+            head.gcs.register_function(args[0], args[1])
+            return None
+        if op == "get_function":
+            return head.gcs.get_function(args[0])
+        if op == "create_actor":
+            return head.create_actor(*args)
+        if op == "get_actor_info":
+            info = head.gcs.get_named_actor(args[0], args[1])
+            if info is None or info.state == "DEAD":
+                return None
+            return {"actor_id": info.actor_id,
+                    "class_name": info.class_name,
+                    "max_task_retries": info.max_task_retries}
+        if op == "kill_actor":
+            return head.kill_actor(args[0], args[1])
+        if op == "cancel":
+            return head.cancel_task(args[0], args[1])
+        if op == "kv":
+            return getattr(head.gcs, "kv_" + args[0])(*args[1])
+        if op == "stream_next":
+            return head.stream_next(args[0], args[1], args[2])
+        if op == "avail":
+            return head.scheduler.available_resources()
+        if op == "total":
+            return head.scheduler.total_resources()
+        if op == "nodes":
+            return [{"NodeID": n.hex, "Alive": n.alive,
+                     "Resources": n.resources_total, "Labels": n.labels}
+                    for n in head.gcs.nodes.values()]
+        if op == "create_pg":
+            pg = head.scheduler.create_placement_group(*args)
+            return pg.pg_id
+        if op == "pg_op":
+            return head.handle_worker_rpc(None, None, "pg_" + args[0],
+                                          args[1])
+        if op == "state_list":
+            return head.state_list(args[0], args[1])
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown client op {op!r}")
+
+    # ---- serve loop -------------------------------------------------------
+    def _dispatch_and_reply(self, req_id: int, op: str, args: tuple) -> None:
+        try:
+            value, ok = self.dispatch(op, args), True
+        except BaseException as e:  # noqa: BLE001
+            value, ok = e, False
+        try:
+            self.channel.send("reply", req_id, ok, value)
+        except (OSError, ConnectionError):
+            pass  # client went away
+        except Exception:
+            # result unpicklable: send the repr as an error
+            try:
+                self.channel.send(
+                    "reply", req_id, False,
+                    RuntimeError(f"unserializable reply for {op}: "
+                                 f"{type(value).__name__}"))
+            except Exception:
+                pass
+
+    def serve(self) -> None:
+        """Reader loop. Blocking ops (get/wait/stream_next with no timeout)
+        run on a per-session pool so they can't stall other RPCs or refops
+        from the same client — the deadlock would be: thread A's get blocks
+        the reader while thread B's submit (which produces A's object) sits
+        unread on the channel."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix=f"client-{self.job_id.hex()[:6]}")
+        try:
+            while not self.server._stopped:
+                tag, payload = self.channel.recv()
+                if tag == "rpc":
+                    req_id, op, *args = payload
+                    pool.submit(self._dispatch_and_reply, req_id, op,
+                                tuple(args))
+                elif tag == "refop":
+                    kind, oid = payload
+                    (self.pin if kind == "add" else self.unpin)(oid)
+                elif tag == "bye":
+                    break
+        except (EOFError, OSError, ConnectionError):
+            pass
+        finally:
+            self.closed = True
+            pool.shutdown(wait=False)
+            self.release_all()
+            try:
+                self.channel.close()
+            except Exception:
+                pass
+            self.server._forget(self)
+
+
+class ClientServer:
+    """Accept loop for remote-driver sessions."""
+
+    def __init__(self, head, host: str = "0.0.0.0", port: int = 0):
+        self.head = head
+        self._stopped = False
+        if head._cluster_key is None:
+            # client server implies a TCP cluster: bring the node server up
+            # (on the same interface, so remote nodes can reach it too)
+            head.start_node_server(host="0.0.0.0" if host != "127.0.0.1"
+                                   else "127.0.0.1")
+        self._listener = make_listener((host, port), head._cluster_key)
+        self.address = self._listener.address
+        self.sessions = []
+        self._sessions_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        name="client-server", daemon=True)
+        self._thread.start()
+
+    def _forget(self, sess: "_ClientSession") -> None:
+        with self._sessions_lock:
+            try:
+                self.sessions.remove(sess)
+            except ValueError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError, Exception):
+                if self._stopped:
+                    return
+                time.sleep(0.05)
+                continue
+            ch = Channel(conn)
+            sess = _ClientSession(self, ch)
+            try:
+                ch.send("welcome", {
+                    "job_id": sess.job_id,
+                    "node_id": self.head.head_node.hex,
+                    "driver_task_id": sess.driver_task_id,
+                })
+            except Exception:
+                continue
+            with self._sessions_lock:
+                self.sessions.append(sess)
+            threading.Thread(target=sess.serve, daemon=True,
+                             name=f"client-{sess.job_id.hex()[:6]}").start()
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        with self._sessions_lock:
+            sessions = list(self.sessions)
+        for sess in sessions:
+            try:
+                sess.channel.close()  # unblocks the reader -> clean teardown
+            except Exception:
+                pass

@@ -115,6 +115,20 @@ class RemoteFunction:
             f"use {self.__name__}.remote()."
         )
 
+    def __getstate__(self):
+        # picklable across processes/storage: drop the runtime binding
+        # (re-registers lazily on the other side)
+        d = self.__dict__.copy()
+        d["_registered_with"] = None
+        return d
+
+    def bind(self, *args, **kwargs):
+        """Author a task-DAG node for workflows (reference: dag_node.py
+        bind / workflow DAG authoring)."""
+        from ray_tpu.workflow.api import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def _ensure_registered(self, runtime) -> None:
         self._materialize_payload()
         if self._registered_with is not runtime:

@@ -1,0 +1,246 @@
+"""Dashboard: HTTP head serving cluster state, metrics, and the jobs API.
+
+Reference: python/ray/dashboard/head.py (aiohttp app aggregating per-module
+routes) + modules/job/job_head.py (jobs REST) + the Prometheus re-export.
+Single stdlib ThreadingHTTPServer here — no aiohttp dependency in the
+control plane — with:
+
+    GET  /                      HTML overview (nodes/actors/jobs/resources)
+    GET  /metrics               Prometheus text format
+    GET  /api/cluster           resource totals/availability
+    GET  /api/nodes|actors|tasks|objects|placement_groups
+    GET  /api/jobs/             list jobs
+    POST /api/jobs/             submit {entrypoint, runtime_env, ...}
+    GET  /api/jobs/<id>         job info
+    GET  /api/jobs/<id>/logs    driver log text
+    POST /api/jobs/<id>/stop    stop the driver
+    DELETE /api/jobs/<id>       delete a terminal job
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+body{font-family:system-ui,sans-serif;margin:24px;background:#fafafa;color:#222}
+h1{font-size:20px} h2{font-size:15px;margin:18px 0 6px}
+table{border-collapse:collapse;width:100%;background:#fff;font-size:13px}
+th,td{border:1px solid #ddd;padding:4px 8px;text-align:left}
+th{background:#f0f0f0} code{background:#eee;padding:1px 4px;border-radius:3px}
+.ok{color:#0a0} .bad{color:#c00}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="cluster"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Recent tasks</h2><table id="tasks"></table>
+<script>
+function esc(v){return String(v).replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
+function row(cells, tag){return '<tr>'+cells.map(c=>'<'+tag+'>'+c+'</'+tag+'>').join('')+'</tr>'}
+async function refresh(){
+ try{
+  const c = await (await fetch('/api/cluster')).json();
+  document.getElementById('cluster').innerHTML =
+    '<p>total: <code>'+esc(JSON.stringify(c.total))+'</code> available: <code>'+
+    esc(JSON.stringify(c.available))+'</code></p>';
+  const n = await (await fetch('/api/nodes')).json();
+  document.getElementById('nodes').innerHTML = row(['id','alive','resources'],'th')+
+    n.map(x=>row([esc(x.node_id||x.NodeID),(x.alive??x.Alive)?'<span class=ok>alive</span>':'<span class=bad>dead</span>',
+    esc(JSON.stringify(x.resources||x.Resources))],'td')).join('');
+  const a = await (await fetch('/api/actors')).json();
+  document.getElementById('actors').innerHTML = row(['id','class','state','restarts'],'th')+
+    a.map(x=>row([esc(x.actor_id),esc(x.class_name),esc(x.state),esc(x.num_restarts||0)],'td')).join('');
+  const j = await (await fetch('/api/jobs/')).json();
+  document.getElementById('jobs').innerHTML = row(['id','status','entrypoint','message'],'th')+
+    j.map(x=>row([esc(x.submission_id),esc(x.status),'<code>'+esc(x.entrypoint)+'</code>',esc(x.message)],'td')).join('');
+  const t = await (await fetch('/api/tasks?limit=25')).json();
+  document.getElementById('tasks').innerHTML = row(['task','name','state','node'],'th')+
+    t.slice(-25).map(x=>row([esc(x.task_id),esc(x.name||''),esc(x.state),esc(x.node_hex||'')],'td')).join('');
+ }catch(e){console.log(e)}
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class DashboardServer:
+    """Stdlib HTTP server bound to a Head (+ optional JobManager)."""
+
+    def __init__(self, head, host: str = "127.0.0.1", port: int = 0,
+                 job_manager=None):
+        import http.server
+
+        self.head = head
+        self.job_manager = job_manager
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, obj, code: int = 200):
+                self._send(code, json.dumps(obj).encode())
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                if not n:
+                    return {}
+                try:
+                    return json.loads(self.rfile.read(n).decode())
+                except ValueError:
+                    return {}
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    self._json({"error": repr(e)}, 500)
+
+            def do_POST(self):
+                try:
+                    outer._post(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    self._json({"error": repr(e)}, 500)
+
+            def do_DELETE(self):
+                try:
+                    outer._delete(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    self._json({"error": repr(e)}, 500)
+
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.address = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="dashboard-http",
+            daemon=True)
+        self._thread.start()
+
+    # ---- routing ----------------------------------------------------------
+    _JOB_RE = re.compile(r"^/api/jobs/([^/]+)(/logs|/stop)?$")
+
+    def _get(self, h) -> None:
+        path, _, query = h.path.partition("?")
+        params = dict(p.split("=", 1) for p in query.split("&") if "=" in p)
+        limit = int(params.get("limit", 1000))
+        if path in ("/", "/index.html"):
+            h._send(200, _PAGE.encode(), "text/html; charset=utf-8")
+        elif path == "/metrics":
+            from ray_tpu.util.metrics import registry, render_prometheus
+
+            h._send(200, render_prometheus(registry()).encode(),
+                    "text/plain; version=0.0.4")
+        elif path == "/api/cluster":
+            h._json({
+                "total": self.head.scheduler.total_resources(),
+                "available": self.head.scheduler.available_resources(),
+            })
+        elif path in ("/api/nodes", "/api/actors", "/api/tasks",
+                      "/api/objects", "/api/placement_groups"):
+            h._json(self.head.state_list(path.rsplit("/", 1)[1], limit))
+        elif path == "/api/jobs" or path == "/api/jobs/":
+            h._json([j.to_dict() for j in self._jm().list_jobs()])
+        else:
+            m = self._JOB_RE.match(path)
+            if m and (m.group(2) or "") == "/logs":
+                try:
+                    offset = int(params.get("offset", 0))
+                    h._send(200, self._jm().get_job_logs(
+                        m.group(1), offset=offset).encode(),
+                        "text/plain; charset=utf-8")
+                except KeyError:
+                    h._json({"error": "not found"}, 404)
+            elif m and not m.group(2):
+                try:
+                    h._json(self._jm().get_job_info(m.group(1)).to_dict())
+                except KeyError:
+                    h._json({"error": "not found"}, 404)
+            else:
+                h._json({"error": "not found"}, 404)
+
+    def _post(self, h) -> None:
+        path = h.path.split("?", 1)[0]
+        if path in ("/api/jobs", "/api/jobs/"):
+            body = h._body()
+            if not body.get("entrypoint"):
+                h._json({"error": "entrypoint required"}, 400)
+                return
+            sid = self._jm().submit_job(
+                entrypoint=body["entrypoint"],
+                runtime_env=body.get("runtime_env"),
+                metadata=body.get("metadata"),
+                submission_id=body.get("submission_id"))
+            h._json({"submission_id": sid})
+            return
+        m = self._JOB_RE.match(path)
+        if m and m.group(2) == "/stop":
+            try:
+                h._json({"stopped": self._jm().stop_job(m.group(1))})
+            except KeyError:
+                h._json({"error": "not found"}, 404)
+        else:
+            h._json({"error": "not found"}, 404)
+
+    def _delete(self, h) -> None:
+        m = self._JOB_RE.match(h.path.split("?", 1)[0])
+        if m and not m.group(2):
+            try:
+                h._json({"deleted": self._jm().delete_job(m.group(1))})
+            except KeyError:
+                h._json({"error": "not found"}, 404)
+            except RuntimeError as e:
+                h._json({"error": str(e)}, 400)
+        else:
+            h._json({"error": "not found"}, 404)
+
+    def _jm(self):
+        if self.job_manager is None:
+            raise RuntimeError("no JobManager attached to this dashboard")
+        return self.job_manager
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265,
+                    with_jobs: bool = True) -> DashboardServer:
+    """Start the dashboard on the current in-process head.
+
+    With ``with_jobs`` the head's client server is started too, so
+    submitted jobs' drivers join this cluster.
+    """
+    import ray_tpu
+    from ray_tpu.core import api as _api
+
+    head = _api._get_head()
+    jm = None
+    if with_jobs:
+        from ray_tpu.jobs import JobManager
+
+        addr, key_hex = ray_tpu.start_client_server()
+        jm = JobManager(client_address=addr, cluster_key_hex=key_hex)
+    srv = DashboardServer(head, host, port, job_manager=jm)
+    head._dashboard = srv
+    return srv

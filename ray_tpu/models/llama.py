@@ -319,29 +319,7 @@ def make_train_step(cfg: LlamaConfig, mesh, optimizer=None, rules=None):
     # necessarily divisible by the seq axis
     data_sharding = NamedSharding(mesh, P(batch_axes if batch_axes else None))
 
-    def opt_shardings(params_shardings, sample_params):
-        """Match optimizer-state leaves to param shardings *structurally*:
-        optax moment pytrees mirror the params pytree, so a state leaf whose
-        path suffix equals a param path gets that param's sharding. (Shape
-        matching is wrong: wq/wo share a shape but have transposed specs.)"""
-        from jax.tree_util import tree_flatten_with_path, tree_map_with_path
-
-        opt_state = jax.eval_shape(optimizer.init, sample_params)
-        flat_params, _ = tree_flatten_with_path(sample_params)
-        by_path = {}
-        for (path, leaf), ps in zip(
-                flat_params, jax.tree.leaves(params_shardings)):
-            by_path[tuple(str(k) for k in path)] = ps
-
-        def match(path, leaf):
-            p = tuple(str(k) for k in path)
-            for start in range(len(p)):
-                ps = by_path.get(p[start:])
-                if ps is not None:
-                    return ps
-            return repl
-
-        return tree_map_with_path(match, opt_state)
+    from ray_tpu.parallel.sharding import opt_state_shardings
 
     def init_state(key):
         params = init_params(cfg, key)
@@ -352,7 +330,8 @@ def make_train_step(cfg: LlamaConfig, mesh, optimizer=None, rules=None):
     sample = jax.eval_shape(init_state, jax.random.PRNGKey(0))
     state_shardings = {
         "params": param_shardings,
-        "opt_state": opt_shardings(param_shardings, sample["params"]),
+        "opt_state": opt_state_shardings(
+            optimizer, sample["params"], param_shardings, repl),
         "step": repl,
     }
 
@@ -366,6 +345,180 @@ def make_train_step(cfg: LlamaConfig, mesh, optimizer=None, rules=None):
         new_params = optax.apply_updates(state["params"], updates)
         return ({"params": new_params, "opt_state": new_opt,
                  "step": state["step"] + 1}, loss)
+
+    train_step = jax.jit(
+        step_fn,
+        in_shardings=(state_shardings, data_sharding),
+        out_shardings=(state_shardings, repl),
+        donate_argnums=(0,),
+    )
+    return init_jit, train_step, data_sharding, state_shardings
+
+
+# --------------------------------------------------------------------------- #
+# Pipeline-parallel train step (pipe [+ tensor/data] mesh axes)
+# --------------------------------------------------------------------------- #
+
+
+def _pp_layer(cfg: LlamaConfig, x, p, positions, tensor_axis=None):
+    """One decoder layer on *local* shards inside the pipeline shard_map.
+
+    Head/mlp counts come from the shard shapes (Megatron-style manual TP:
+    q/k/v/gate/up column-parallel — no comm; wo/down row-parallel — psum
+    over ``tensor_axis``). Norm weights are full-width (replicated)."""
+    from ray_tpu.ops.flash_attention import flash_attention
+
+    cd = cfg.dtype
+    B, T, d = x.shape
+    hd = cfg.head_dim
+    nq = p["wq"].shape[-1] // hd
+    nkv = p["wk"].shape[-1] // hd
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps).astype(cd)
+    q = (h @ p["wq"].astype(cd)).reshape(B, T, nq, hd)
+    kk = (h @ p["wk"].astype(cd)).reshape(B, T, nkv, hd)
+    vv = (h @ p["wv"].astype(cd)).reshape(B, T, nkv, hd)
+    q, kk = rotary_embedding(q, kk, positions, cfg.rope_theta)
+    attn = flash_attention(q, kk, vv, causal=True)
+    o = attn.reshape(B, T, nq * hd) @ p["wo"].astype(cd)
+    if tensor_axis:
+        o = jax.lax.psum(o, tensor_axis)
+    x = x + o.astype(x.dtype)
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps).astype(cd)
+    g = jax.nn.silu(h @ p["w_gate"].astype(cd))
+    u = h @ p["w_up"].astype(cd)
+    y = (g * u) @ p["w_down"].astype(cd)
+    if tensor_axis:
+        y = jax.lax.psum(y, tensor_axis)
+    return x + y.astype(x.dtype)
+
+
+def make_pipeline_train_step(cfg: LlamaConfig, mesh, num_microbatches: int,
+                             optimizer=None):
+    """GPipe pipeline-parallel train step over a mesh with a ``pipe`` axis.
+
+    Layers are split into ``mesh.shape['pipe']`` contiguous stages (params
+    reshaped [L] -> [P, L/P], stage dim sharded over ``pipe``); the
+    microbatch schedule is :func:`ray_tpu.parallel.pipeline.pipelined_apply`
+    inside one shard_map over the full mesh. ``tensor`` (if present) shards
+    heads/mlp within each stage with explicit psums; ``data``/``fsdp`` axes
+    act as pure data parallelism here (shard_map's autodiff inserts the
+    gradient psums). Embedding/lm_head run outside the pipelined region
+    under GSPMD, replicated over ``pipe``.
+
+    Returns (init_jit, train_step, data_sharding, state_shardings) — the
+    same contract as :func:`make_train_step`.
+    """
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.pipeline import (merge_microbatches,
+                                           pipelined_apply,
+                                           split_microbatches)
+
+    if "pipe" not in mesh.axis_names:
+        raise ValueError("mesh has no 'pipe' axis")
+    n_stages = mesh.shape["pipe"]
+    if cfg.n_layers % n_stages:
+        raise ValueError(f"{cfg.n_layers} layers not divisible by "
+                         f"{n_stages} pipeline stages")
+    optimizer = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95,
+                                         weight_decay=0.1)
+    ta = "tensor" if ("tensor" in mesh.axis_names
+                      and mesh.shape["tensor"] > 1) else None
+    batch_axes = tuple(a for a in ("slice", "data", "fsdp")
+                       if a in mesh.axis_names)
+    bspec = batch_axes if batch_axes else None
+
+    layer_specs = {
+        "wq": P("pipe", None, None, ta),
+        "wk": P("pipe", None, None, ta),
+        "wv": P("pipe", None, None, ta),
+        "wo": P("pipe", None, ta, None),
+        "w_gate": P("pipe", None, None, ta),
+        "w_up": P("pipe", None, None, ta),
+        "w_down": P("pipe", None, ta, None),
+        "attn_norm": P("pipe", None, None),
+        "mlp_norm": P("pipe", None, None),
+    }
+    vocab_axis = ta
+    param_specs = {
+        "embedding": P(vocab_axis, None),
+        "layers": layer_specs,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        param_specs["lm_head"] = P(None, vocab_axis)
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs,
+        is_leaf=lambda x: isinstance(x, P))
+    repl = NamedSharding(mesh, P())
+    data_sharding = NamedSharding(mesh, P(bspec))
+
+    def init_state(key):
+        params = init_params(cfg, key)
+        params["layers"] = jax.tree.map(
+            lambda a: a.reshape((n_stages, cfg.n_layers // n_stages)
+                                + a.shape[1:]), params["layers"])
+        return {"params": params, "opt_state": optimizer.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    from ray_tpu.parallel.sharding import opt_state_shardings
+
+    sample = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    state_shardings = {
+        "params": param_shardings,
+        "opt_state": opt_state_shardings(
+            optimizer, sample["params"], param_shardings, repl),
+        "step": repl,
+    }
+
+    init_jit = jax.jit(init_state, out_shardings=state_shardings)
+
+    act_spec = {"x": P(bspec, None, None), "pos": P(bspec, None)}
+
+    def pipe_region(stage_params, x, positions):
+        local = jax.tree.map(lambda a: a[0], stage_params)
+
+        def stage_fn(sp, act):
+            def one_layer(carry, lp):
+                return _pp_layer(cfg, carry, lp, act["pos"], ta), None
+
+            body = one_layer
+            if cfg.remat:
+                body = jax.checkpoint(one_layer)
+            h, _ = jax.lax.scan(body, act["x"], sp)
+            return {"x": h, "pos": act["pos"]}
+
+        mb = split_microbatches({"x": x, "pos": positions},
+                                num_microbatches)
+        out = pipelined_apply(stage_fn, local, mb, axis_name="pipe")
+        return merge_microbatches(out)["x"]
+
+    pipe_fn = jax.shard_map(
+        pipe_region, mesh=mesh,
+        in_specs=(layer_specs, act_spec["x"], act_spec["pos"]),
+        out_specs=act_spec["x"], check_vma=False)
+
+    def loss(params, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        B, T = inputs.shape
+        x = params["embedding"].astype(cfg.dtype)[inputs]
+        positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+        x = pipe_fn(params["layers"], x, positions)
+        x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+        logits = (x.astype(cfg.dtype)
+                  @ _head(cfg, params).astype(cfg.dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return nll.mean()
+
+    def step_fn(state, tokens):
+        l, grads = jax.value_and_grad(loss)(state["params"], tokens)
+        updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                            state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return ({"params": new_params, "opt_state": new_opt,
+                 "step": state["step"] + 1}, l)
 
     train_step = jax.jit(
         step_fn,

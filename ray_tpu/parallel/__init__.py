@@ -7,6 +7,11 @@ ICI/DCN collectives.
 """
 
 from ray_tpu.parallel.mesh import MeshConfig, make_mesh  # noqa: F401
+from ray_tpu.parallel.pipeline import (  # noqa: F401
+    merge_microbatches,
+    pipelined_apply,
+    split_microbatches,
+)
 from ray_tpu.parallel.sharding import (  # noqa: F401
     DEFAULT_RULES,
     fsdp_sharding,

@@ -99,6 +99,36 @@ def fsdp_sharding(tree, mesh, axis: str = "fsdp", min_size: int = 2 ** 16):
         lambda x: jax.device_put(x, NamedSharding(mesh, spec_for(x))), tree)
 
 
+def opt_state_shardings(optimizer, sample_params, param_shardings, default):
+    """Match optimizer-state leaves to param shardings *structurally*.
+
+    Optax moment pytrees mirror the params pytree, so a state leaf whose
+    path suffix equals a param path gets that param's sharding. (Shape
+    matching is wrong: e.g. wq/wo share a shape but have transposed
+    specs.) Leaves with no matching param path (step counters, scalars)
+    get ``default``.
+    """
+    import jax
+    from jax.tree_util import tree_flatten_with_path, tree_map_with_path
+
+    opt_state = jax.eval_shape(optimizer.init, sample_params)
+    flat_params, _ = tree_flatten_with_path(sample_params)
+    by_path = {}
+    for (path, leaf), ps in zip(flat_params,
+                                jax.tree.leaves(param_shardings)):
+        by_path[tuple(str(k) for k in path)] = ps
+
+    def match(path, leaf):
+        p = tuple(str(k) for k in path)
+        for start in range(len(p)):
+            ps = by_path.get(p[start:])
+            if ps is not None:
+                return ps
+        return default
+
+    return tree_map_with_path(match, opt_state)
+
+
 def constraint(x, logical_axes, mesh=None, rules=None):
     """with_sharding_constraint using logical names (inside jit)."""
     import jax

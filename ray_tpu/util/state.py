@@ -19,6 +19,8 @@ def _state_query(kind: str, limit: int) -> List[Dict[str, Any]]:
         raise RuntimeError("ray_tpu.init() has not been called")
     if hasattr(rt, "head"):  # driver
         return rt.head.state_list(kind, limit)
+    if hasattr(rt, "state_list"):  # remote client driver
+        return rt.state_list(kind, limit)
     return rt.rpc.call("rpc", "state_list", kind, limit)  # worker
 
 

@@ -1,0 +1,174 @@
+"""Ray Client analog (remote drivers over TCP) + job submission + dashboard.
+
+Mirrors the reference's client-mode tests (a separate OS process drives
+the cluster through ray://) and job manager tests (entrypoint subprocess
+joins the shared cluster, status/logs/stop lifecycle).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_client_script(body: str, address, key_hex: str) -> str:
+    """Run `body` in a fresh process connected as a remote driver."""
+    script = textwrap.dedent(body)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["RAY_TPU_ADDRESS"] = f"ray_tpu://{address[0]}:{address[1]}"
+    env["RAY_TPU_CLUSTER_KEY"] = key_hex
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, f"client failed:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+@pytest.fixture
+def client_cluster():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    address, key_hex = ray_tpu.start_client_server()
+    yield address, key_hex
+    ray_tpu.shutdown()
+
+
+class TestClientMode:
+    def test_remote_driver_tasks(self, client_cluster):
+        address, key = client_cluster
+        out = _run_client_script("""
+            import ray_tpu
+            ray_tpu.init()  # address/key from env
+
+            @ray_tpu.remote
+            def square(x):
+                return x * x
+
+            refs = [square.remote(i) for i in range(5)]
+            print("RESULT", sum(ray_tpu.get(refs)))
+            ray_tpu.shutdown()
+        """, address, key)
+        assert "RESULT 30" in out
+
+    def test_remote_driver_put_get_and_actor(self, client_cluster):
+        address, key = client_cluster
+        out = _run_client_script("""
+            import ray_tpu
+            ray_tpu.init()
+
+            big = list(range(20000))  # forces a store (non-inline) put
+            ref = ray_tpu.put(big)
+            assert ray_tpu.get(ref)[-1] == 19999
+
+            @ray_tpu.remote
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+                def add(self, k):
+                    self.n += k
+                    return self.n
+
+            c = Counter.remote()
+            assert ray_tpu.get(c.add.remote(3)) == 3
+            assert ray_tpu.get(c.add.remote(4)) == 7
+            nodes = ray_tpu.nodes()
+            assert len(nodes) >= 1
+            print("CLIENT_OK")
+            ray_tpu.shutdown()
+        """, address, key)
+        assert "CLIENT_OK" in out
+
+    def test_client_state_api(self, client_cluster):
+        address, key = client_cluster
+        out = _run_client_script("""
+            import ray_tpu
+            from ray_tpu.util import state
+            ray_tpu.init()
+
+            @ray_tpu.remote
+            def noop():
+                return 1
+
+            ray_tpu.get([noop.remote() for _ in range(3)])
+            print("NODES", len(state.list_nodes()))
+            ray_tpu.shutdown()
+        """, address, key)
+        assert "NODES 1" in out
+
+
+@pytest.fixture
+def dashboard_cluster(tmp_path):
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    from ray_tpu.dashboard import start_dashboard
+
+    dash = start_dashboard(port=0)
+    # jobs need the repo importable from the entrypoint subprocess
+    dash.job_manager._log_dir = str(tmp_path)
+    base = f"http://{dash.address[0]}:{dash.address[1]}"
+    yield base
+    dash.stop()
+    if dash.job_manager:
+        dash.job_manager.shutdown()
+    ray_tpu.shutdown()
+
+
+class TestJobsAndDashboard:
+    def test_dashboard_endpoints(self, dashboard_cluster):
+        base = dashboard_cluster
+        with urllib.request.urlopen(base + "/api/cluster", timeout=10) as r:
+            cluster = json.loads(r.read().decode())
+        assert "total" in cluster and "available" in cluster
+        with urllib.request.urlopen(base + "/api/nodes", timeout=10) as r:
+            nodes = json.loads(r.read().decode())
+        assert len(nodes) == 1 and nodes[0]["alive"]
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            assert b"ray_tpu dashboard" in r.read()
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            r.read()  # prometheus endpoint serves
+
+    def test_job_lifecycle(self, dashboard_cluster):
+        from ray_tpu.jobs import JobStatus, JobSubmissionClient
+
+        client = JobSubmissionClient(dashboard_cluster)
+        code = ("import ray_tpu; ray_tpu.init(); "
+                "f = ray_tpu.remote(lambda x: x + 1); "
+                "print('JOBVAL', ray_tpu.get(f.remote(41))); "
+                "ray_tpu.shutdown()")
+        env = {"env_vars": {"PYTHONPATH": REPO}}
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} -c \"{code}\"",
+            runtime_env=env, metadata={"who": "test"})
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if client.get_job_status(sid) in JobStatus.TERMINAL:
+                break
+            time.sleep(0.5)
+        assert client.get_job_status(sid) == JobStatus.SUCCEEDED, \
+            client.get_job_logs(sid)
+        assert "JOBVAL 42" in client.get_job_logs(sid)
+        jobs = client.list_jobs()
+        assert any(j["submission_id"] == sid for j in jobs)
+        assert client.delete_job(sid)
+
+    def test_job_stop(self, dashboard_cluster):
+        from ray_tpu.jobs import JobStatus, JobSubmissionClient
+
+        client = JobSubmissionClient(dashboard_cluster)
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+        time.sleep(0.5)
+        assert client.stop_job(sid)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if client.get_job_status(sid) == JobStatus.STOPPED:
+                break
+            time.sleep(0.2)
+        assert client.get_job_status(sid) == JobStatus.STOPPED

@@ -1,0 +1,115 @@
+"""Workflow library: durable DAG execution, resume, continuations.
+
+Mirrors the reference's workflow test strategy (basic run, failure +
+resume-from-checkpoint, dynamic continuation, management API).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture
+def wf_storage(tmp_path, ray_start_regular):
+    storage = str(tmp_path / "wf")
+    workflow.init(storage)
+    yield storage
+    workflow.api._default_storage = None
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def double(x):
+    return 2 * x
+
+
+class TestWorkflowBasics:
+    def test_linear_dag(self, wf_storage):
+        dag = double.bind(add.bind(1, 2))
+        assert workflow.run(dag, workflow_id="lin") == 6
+        assert workflow.get_status("lin") == workflow.WorkflowStatus.SUCCESSFUL
+        assert workflow.get_output("lin") == 6
+
+    def test_diamond_dag(self, wf_storage):
+        a = add.bind(1, 1)
+        left = double.bind(a)
+        right = add.bind(a, 10)
+        dag = add.bind(left, right)
+        # a=2, left=double(a)=4, right=a+10=12
+        assert workflow.run(dag, workflow_id="dia") == 16
+
+    def test_run_async(self, wf_storage):
+        fut = workflow.run_async(add.bind(2, 3), workflow_id="async1")
+        assert fut.result(timeout=60) == 5
+
+    def test_list_and_delete(self, wf_storage):
+        workflow.run(add.bind(1, 1), workflow_id="gone")
+        assert ("gone", workflow.WorkflowStatus.SUCCESSFUL) in \
+            workflow.list_all()
+        workflow.delete("gone")
+        assert "gone" not in [w for w, _ in workflow.list_all()]
+        with pytest.raises(workflow.api.WorkflowNotFoundError):
+            workflow.get_status("gone")
+
+    def test_metadata_counts_steps(self, wf_storage):
+        workflow.run(double.bind(add.bind(3, 4)), workflow_id="meta")
+        md = workflow.get_metadata("meta")
+        assert md["completed_steps"] == 2
+        assert md["status"] == workflow.WorkflowStatus.SUCCESSFUL
+
+
+class TestWorkflowResume:
+    def test_failure_then_resume_skips_done_steps(self, wf_storage,
+                                                  tmp_path):
+        marker = str(tmp_path / "fail_once")
+        count_file = str(tmp_path / "count")
+
+        @ray_tpu.remote(max_retries=0)
+        def counted(x):
+            with open(count_file, "a") as f:
+                f.write("x")
+            return x + 1
+
+        @ray_tpu.remote(max_retries=0)
+        def flaky(x):
+            if not os.path.exists(marker):
+                with open(marker, "w") as f:
+                    f.write("1")
+                raise RuntimeError("boom")
+            return x * 10
+
+        dag = flaky.bind(counted.bind(4))
+        with pytest.raises(Exception):
+            workflow.run(dag, workflow_id="res")
+        assert workflow.get_status("res") == \
+            workflow.WorkflowStatus.RESUMABLE
+        # resume: counted's checkpoint is loaded, not re-executed
+        assert workflow.resume("res") == 50
+        with open(count_file) as f:
+            assert f.read() == "x"
+        assert workflow.get_status("res") == \
+            workflow.WorkflowStatus.SUCCESSFUL
+
+    def test_resume_successful_returns_result(self, wf_storage):
+        workflow.run(add.bind(20, 22), workflow_id="done")
+        assert workflow.resume("done") == 42
+
+
+class TestWorkflowContinuation:
+    def test_dynamic_recursion(self, wf_storage):
+        @ray_tpu.remote
+        def factorial(n, acc=1):
+            if n <= 1:
+                return acc
+            return workflow.continuation(factorial.bind(n - 1, acc * n))
+
+        assert workflow.run(factorial.bind(5), workflow_id="fact") == 120
+        # continuation steps are checkpointed too
+        assert workflow.get_metadata("fact")["completed_steps"] >= 5
