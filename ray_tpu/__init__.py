@@ -40,10 +40,11 @@ from ray_tpu.core.exceptions import (  # noqa: F401
 )
 from ray_tpu.core.object_ref import ObjectRef  # noqa: F401
 from ray_tpu.core.runtime_context import get_runtime_context  # noqa: F401
+from ray_tpu.util.timeline import timeline  # noqa: F401
 
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
-    "start_client_server",
+    "start_client_server", "timeline",
     "kill", "cancel", "get_actor", "method", "available_resources",
     "cluster_resources", "nodes", "ObjectRef", "get_runtime_context",
     "RayTpuError", "TaskError", "ActorError", "ActorDiedError",

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import shlex
 import sys
 import time
 
@@ -33,6 +34,9 @@ def _cmd_head(args) -> int:
     print(f"  client address : ray_tpu://{addr[0]}:{addr[1]}")
     print(f"  cluster key    : {key}")
     print(f"  dashboard      : http://{dash.address[0]}:{dash.address[1]}")
+    if dash.auth_token:
+        print(f"  job auth token : {dash.auth_token} "
+              "(pass --token / RAY_TPU_JOB_TOKEN to submit)")
     if getattr(head, "node_server_address", None):
         ns = head.node_server_address
         print(f"  node server    : {ns[0]}:{ns[1]} (for `start --address`)")
@@ -48,8 +52,10 @@ def _cmd_head(args) -> int:
 def _cmd_submit(args, rest) -> int:
     from ray_tpu.jobs import JobSubmissionClient
 
-    client = JobSubmissionClient(args.address)
-    entrypoint = " ".join(rest) if rest else args.entrypoint
+    client = JobSubmissionClient(args.address, auth_token=args.token)
+    # shlex.join preserves the caller's quoting through the server-side
+    # shell re-execution
+    entrypoint = shlex.join(rest) if rest else args.entrypoint
     if not entrypoint:
         print("no entrypoint given (use: submit -- <cmd ...>)",
               file=sys.stderr)
@@ -74,7 +80,7 @@ def _cmd_submit(args, rest) -> int:
 def _cmd_job(args) -> int:
     from ray_tpu.jobs import JobSubmissionClient
 
-    client = JobSubmissionClient(args.address)
+    client = JobSubmissionClient(args.address, auth_token=args.token)
     if args.op == "list":
         print(json.dumps(client.list_jobs(), indent=2))
     elif args.op == "status":
@@ -120,11 +126,14 @@ def main(argv=None) -> int:
     sb.add_argument("--submission-id", default=None)
     sb.add_argument("--no-wait", action="store_true")
     sb.add_argument("--entrypoint", default=None)
+    sb.add_argument("--token", default=None,
+                    help="job auth token (or RAY_TPU_JOB_TOKEN)")
 
     j = sub.add_parser("job", help="job status|logs|stop|list")
     j.add_argument("op", choices=["status", "logs", "stop", "list"])
     j.add_argument("job_id", nargs="?")
     j.add_argument("--address", default="http://127.0.0.1:8265")
+    j.add_argument("--token", default=None)
 
     ls = sub.add_parser("list", help="list cluster state")
     ls.add_argument("kind", choices=["tasks", "actors", "nodes", "objects",
@@ -133,6 +142,12 @@ def main(argv=None) -> int:
     ls.add_argument("--limit", type=int, default=100)
 
     argv = list(sys.argv[1:] if argv is None else argv)
+    # `start` hands everything through to the daemon parser directly
+    # (argparse REMAINDER chokes on a leading --flag)
+    if argv and argv[0] == "start":
+        from ray_tpu.core.node_daemon import main as daemon_main
+
+        return daemon_main(argv[1:])
     # split off trailing "-- entrypoint..." for submit
     rest = []
     if "--" in argv:
@@ -142,10 +157,6 @@ def main(argv=None) -> int:
 
     if args.cmd == "head":
         return _cmd_head(args)
-    if args.cmd == "start":
-        from ray_tpu.core.node_daemon import main as daemon_main
-
-        return daemon_main(args.daemon_args)
     if args.cmd == "submit":
         return _cmd_submit(args, rest)
     if args.cmd == "job":

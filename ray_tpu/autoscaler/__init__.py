@@ -1,0 +1,22 @@
+"""Autoscaler: reconcile cluster size against pending resource demand.
+
+Reference: autoscaler v2 (python/ray/autoscaler/v2/autoscaler.py:42 — a
+periodic reconciler reading demand from GCS load reports and instance
+state from a cloud provider) and the v1 StandardAutoscaler
+(_private/autoscaler.py:172). Re-designed for TPU fleets: a node is a
+*host joining over TCP* (the ``python -m ray_tpu start`` daemon), and the
+cloud-provider seam is :class:`NodeProvider` — the local subprocess
+provider is fully functional (used in tests and single-machine
+elasticity); a TPU-slice provider maps node requests onto GKE/Queued
+Resources via an operator-supplied launcher.
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    LocalNodeProvider,
+    NodeProvider,
+    TPUSliceProvider,
+)
+
+__all__ = ["Autoscaler", "AutoscalerConfig", "NodeProvider",
+           "LocalNodeProvider", "TPUSliceProvider"]

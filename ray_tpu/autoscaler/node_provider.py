@@ -1,0 +1,130 @@
+"""Node providers: the cloud seam of the autoscaler.
+
+Reference: python/ray/autoscaler/node_provider.py (NodeProvider interface:
+create_node/terminate_node/non_terminated_nodes) and the per-cloud
+implementations under python/ray/autoscaler/_private/. Here the
+interface is minimal and synchronous; the reconciler serializes calls.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+
+class NodeProvider:
+    """Create/terminate worker nodes. Implementations must be idempotent
+    on terminate and report only their own (non-head) nodes."""
+
+    def create_node(self, node_config: dict) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def is_running(self, provider_id: str) -> bool:
+        return provider_id in self.non_terminated_nodes()
+
+    def shutdown(self) -> None:
+        for pid in list(self.non_terminated_nodes()):
+            self.terminate_node(pid)
+
+
+class LocalNodeProvider(NodeProvider):
+    """Worker nodes as local ``python -m ray_tpu start`` daemon processes
+    joining the head over TCP — the autoscaler analog of the reference's
+    'local' provider, and the test double for cloud providers (every
+    launched node is a REAL separate-process node daemon)."""
+
+    def __init__(self, head_address, cluster_key_hex: str):
+        self._address = f"{head_address[0]}:{head_address[1]}"
+        self._key = cluster_key_hex
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def create_node(self, node_config: dict) -> str:
+        import json
+
+        provider_id = f"local-{uuid.uuid4().hex[:8]}"
+        cmd = [sys.executable, "-m", "ray_tpu", "start",
+               "--address", self._address, "--key", self._key,
+               # the provider_id label is how the reconciler maps a
+               # cluster node back to this instance for termination
+               "--labels", json.dumps({"provider_id": provider_id}),
+               # explicit counts — never auto-detect (a co-located node
+               # already advertises the TPU chips)
+               "--num-cpus", str(node_config.get("num_cpus", 1)),
+               "--num-tpus", str(node_config.get("num_tpus", 0))]
+        if node_config.get("resources"):
+            cmd += ["--resources", json.dumps(node_config["resources"])]
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # CPU worker nodes
+        repo = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(cmd, env=env, start_new_session=True,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        with self._lock:
+            self._procs[provider_id] = proc
+        return provider_id
+
+    def terminate_node(self, provider_id: str) -> None:
+        with self._lock:
+            proc = self._procs.pop(provider_id, None)
+        if proc is None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.time() + 3.0
+        while time.time() < deadline and proc.poll() is None:
+            time.sleep(0.05)
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return [pid for pid, proc in self._procs.items()
+                    if proc.poll() is None]
+
+
+class TPUSliceProvider(NodeProvider):
+    """TPU-slice provisioning seam (GKE node pools / Queued Resources).
+
+    Zero-egress environments can't call cloud APIs, so actual provisioning
+    is delegated to operator-supplied callables — e.g. wrappers over
+    ``gcloud compute tpus queued-resources create`` or a KubeRay-style CRD
+    reconciler. The autoscaler treats slices as atomic nodes: one
+    create_node call = one slice request (the TPU analog of the
+    reference's per-VM cloud providers).
+    """
+
+    def __init__(self, launch_fn: Callable[[dict], str],
+                 terminate_fn: Callable[[str], None],
+                 list_fn: Callable[[], List[str]]):
+        self._launch = launch_fn
+        self._terminate = terminate_fn
+        self._list = list_fn
+
+    def create_node(self, node_config: dict) -> str:
+        return self._launch(node_config)
+
+    def terminate_node(self, provider_id: str) -> None:
+        self._terminate(provider_id)
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._list())

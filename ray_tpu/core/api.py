@@ -41,6 +41,7 @@ def init(
     ignore_reinit_error: bool = False,
     address: Optional[str] = None,
     cluster_key: Optional[str] = None,
+    storage: Optional[str] = None,
     **_kwargs,
 ):
     """Start a single-node cluster in-process and connect the driver —
@@ -77,7 +78,7 @@ def init(
     total = detect_resources(num_cpus=num_cpus, num_tpus=num_tpus,
                              num_gpus=num_gpus, extra=resources)
     _namespace = namespace
-    _head = Head(total, labels=labels)
+    _head = Head(total, labels=labels, storage=storage)
     rt = DriverRuntime(_head)
     runtime_mod.set_current_runtime(rt)
     object_ref_mod.set_runtime(rt)

@@ -166,12 +166,15 @@ class _ClientSession:
             except Exception:
                 pass
 
+    # ops that can block indefinitely (a full pool of them must never be
+    # able to queue the submit that would unblock them)
+    _BLOCKING_OPS = frozenset({"get", "wait", "stream_next"})
+
     def serve(self) -> None:
-        """Reader loop. Blocking ops (get/wait/stream_next with no timeout)
-        run on a per-session pool so they can't stall other RPCs or refops
-        from the same client — the deadlock would be: thread A's get blocks
-        the reader while thread B's submit (which produces A's object) sits
-        unread on the channel."""
+        """Reader loop. Quick ops share a per-session pool; potentially
+        unbounded blocking ops (get/wait/stream_next) each get their own
+        thread — N threads of a client all blocked in get() must leave the
+        path open for the submit that produces their objects."""
         from concurrent.futures import ThreadPoolExecutor
 
         pool = ThreadPoolExecutor(
@@ -181,8 +184,15 @@ class _ClientSession:
                 tag, payload = self.channel.recv()
                 if tag == "rpc":
                     req_id, op, *args = payload
-                    pool.submit(self._dispatch_and_reply, req_id, op,
-                                tuple(args))
+                    if op in self._BLOCKING_OPS:
+                        threading.Thread(
+                            target=self._dispatch_and_reply,
+                            args=(req_id, op, tuple(args)),
+                            daemon=True,
+                            name=f"client-blk-{op}").start()
+                    else:
+                        pool.submit(self._dispatch_and_reply, req_id, op,
+                                    tuple(args))
                 elif tag == "refop":
                     kind, oid = payload
                     (self.pin if kind == "add" else self.unpin)(oid)

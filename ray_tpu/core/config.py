@@ -57,8 +57,11 @@ class Config:
     task_retry_delay_ms: int = 0
     lineage_pinning_enabled: bool = True
     actor_restart_delay_ms: int = 0
-    health_check_period_ms: int = 1000
-    health_check_failure_threshold: int = 5
+    # node prober: period * threshold = grace before a silent daemon is
+    # declared dead (generous default — pongs share the daemon's handler
+    # pool, so a saturated 1-core host must not look dead)
+    health_check_period_ms: int = 2000
+    health_check_failure_threshold: int = 10
 
     # ---- observability ----
     log_to_driver: bool = True  # tail worker stdout/stderr to the driver

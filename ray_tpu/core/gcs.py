@@ -92,14 +92,33 @@ class PubSub:
 
 
 class GCS:
-    def __init__(self):
+    def __init__(self, store=None):
+        from .gcs_store import InMemoryStore
+
         self._lock = threading.RLock()
+        self._store = store or InMemoryStore()
         self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)  # namespace -> kv
         self.functions: Dict[str, bytes] = {}  # function_id -> pickled fn/class
+        # recover durable tables (reference: GCS restart w/ RedisStoreClient)
+        recovered = self._store.load()
+        for (ns, key), value in recovered.get("kv", {}).items():
+            self.kv[ns][key] = value
+        self.functions.update(recovered.get("functions", {}))
+        self._recovered_jobs = recovered.get("jobs", {})
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[tuple, ActorID] = {}  # (namespace, name) -> id
         self.nodes: Dict[str, NodeInfo] = {}
         self.jobs: Dict[JobID, JobInfo] = {}
+        # prior-session jobs from durable storage, shown DEAD (their
+        # drivers did not survive the head restart)
+        for job_hex, rec in self._recovered_jobs.items():
+            try:
+                jid = JobID(bytes.fromhex(job_hex))
+                self.jobs[jid] = JobInfo(
+                    jid, entrypoint=rec.get("entrypoint", "driver"),
+                    state="DEAD", start_time=rec.get("start_time", 0.0))
+            except Exception:
+                pass
         self.object_dir: Dict[ObjectID, Set[str]] = defaultdict(set)  # oid -> node hexes
         self.pubsub = PubSub()
         cfg = global_config()
@@ -113,6 +132,7 @@ class GCS:
             if not overwrite and key in ns:
                 return False
             ns[key] = value
+            self._store.put("kv", (namespace, key), value)
             return True
 
     def kv_get(self, key: bytes, namespace: str = "default") -> Optional[bytes]:
@@ -121,7 +141,10 @@ class GCS:
 
     def kv_del(self, key: bytes, namespace: str = "default") -> bool:
         with self._lock:
-            return self.kv[namespace].pop(key, None) is not None
+            existed = self.kv[namespace].pop(key, None) is not None
+            if existed:
+                self._store.delete("kv", (namespace, key))
+            return existed
 
     def kv_keys(self, prefix: bytes, namespace: str = "default") -> List[bytes]:
         with self._lock:
@@ -135,6 +158,7 @@ class GCS:
     def register_function(self, function_id: str, payload: bytes) -> None:
         with self._lock:
             self.functions[function_id] = payload
+            self._store.put("functions", function_id, payload)
 
     def get_function(self, function_id: str) -> Optional[bytes]:
         with self._lock:
@@ -202,6 +226,12 @@ class GCS:
     def add_job(self, info: JobInfo) -> None:
         with self._lock:
             self.jobs[info.job_id] = info
+            self._store.put("jobs", info.job_id.hex(), {
+                "entrypoint": info.entrypoint, "state": info.state,
+                "start_time": info.start_time})
+
+    def close(self) -> None:
+        self._store.close()
 
     # ---- object directory (reference: ownership_based_object_directory.cc) ----
     def add_object_location(self, oid: ObjectID, node_hex: str) -> None:

@@ -1,0 +1,132 @@
+"""Pluggable GCS table storage: the fault-tolerance seam.
+
+Reference: the GCS's StoreClient abstraction —
+``InMemoryStoreClient`` (src/ray/gcs/store_client/in_memory_store_client.h:31,
+default, state dies with the process) vs ``RedisStoreClient``
+(redis_store_client.h:33, enables GCS restart recovery). Same split here:
+:class:`InMemoryStore` is a no-op sink; :class:`FileStore` journals every
+durable-table write (KV, function registry, job history, workflow-style
+metadata) to an append-only log with periodic snapshot compaction, and a
+restarted head (``ray_tpu.init(storage=...)``) replays it.
+
+Redis isn't in this environment (and a TPU-pod head has a local disk /
+NFS mount), so the durable backend is a file journal — same recovery
+contract, zero extra services.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+
+class GcsStore:
+    """put/delete land synchronously; load() replays at construction."""
+
+    def put(self, table: str, key: Any, value: Any) -> None:
+        raise NotImplementedError
+
+    def delete(self, table: str, key: Any) -> None:
+        raise NotImplementedError
+
+    def load(self) -> Dict[str, Dict[Any, Any]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStore(GcsStore):
+    def put(self, table: str, key: Any, value: Any) -> None:
+        pass
+
+    def delete(self, table: str, key: Any) -> None:
+        pass
+
+    def load(self) -> Dict[str, Dict[Any, Any]]:
+        return {}
+
+
+class FileStore(GcsStore):
+    """Append-only journal + snapshot under a directory.
+
+    Layout: ``snapshot.pkl`` (full table dump) + ``journal.pkl`` (stream of
+    pickled ("put"|"del", table, key, value) records since the snapshot).
+    Writes append+flush; after ``compact_every`` journal records the state
+    is re-snapshotted and the journal truncated.
+    """
+
+    def __init__(self, path: str, compact_every: int = 1000):
+        self.dir = path
+        os.makedirs(path, exist_ok=True)
+        self._snap_path = os.path.join(path, "snapshot.pkl")
+        self._journal_path = os.path.join(path, "journal.pkl")
+        self._compact_every = compact_every
+        self._lock = threading.Lock()
+        self._tables = self._replay()
+        self._journal = open(self._journal_path, "ab")
+        self._since_compact = 0
+
+    def _replay(self) -> Dict[str, Dict[Any, Any]]:
+        tables: Dict[str, Dict[Any, Any]] = {}
+        if os.path.exists(self._snap_path):
+            try:
+                with open(self._snap_path, "rb") as f:
+                    tables = pickle.load(f)
+            except Exception:
+                tables = {}
+        if os.path.exists(self._journal_path):
+            try:
+                with open(self._journal_path, "rb") as f:
+                    while True:
+                        try:
+                            op, table, key, value = pickle.load(f)
+                        except EOFError:
+                            break
+                        t = tables.setdefault(table, {})
+                        if op == "put":
+                            t[key] = value
+                        else:
+                            t.pop(key, None)
+            except Exception:
+                pass  # torn tail record: keep what replayed cleanly
+        return tables
+
+    def _append(self, record: Tuple) -> None:
+        pickle.dump(record, self._journal)
+        self._journal.flush()
+        self._since_compact += 1
+        if self._since_compact >= self._compact_every:
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        tmp = self._snap_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(self._tables, f)
+        os.replace(tmp, self._snap_path)
+        self._journal.close()
+        self._journal = open(self._journal_path, "wb")
+        self._since_compact = 0
+
+    def put(self, table: str, key: Any, value: Any) -> None:
+        with self._lock:
+            self._tables.setdefault(table, {})[key] = value
+            self._append(("put", table, key, value))
+
+    def delete(self, table: str, key: Any) -> None:
+        with self._lock:
+            self._tables.setdefault(table, {}).pop(key, None)
+            self._append(("del", table, key, None))
+
+    def load(self) -> Dict[str, Dict[Any, Any]]:
+        with self._lock:
+            return {t: dict(kv) for t, kv in self._tables.items()}
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._journal.close()
+            except Exception:
+                pass

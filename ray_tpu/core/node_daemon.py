@@ -64,6 +64,8 @@ class RemoteHead:
         self.ref_counts = _PinShim(self)
         self.node = None  # set after Node construction
         self.stopped = threading.Event()
+        self.cluster_view: list = []          # syncer-broadcast membership
+        self.cluster_view_version: int = 0
         # handlers can block on node/store locks (e.g. store_delete vs a
         # reclaim holding the store lock mid pin-check RPC): run them off
         # the read loop so "rep" delivery is never queued behind them.
@@ -120,6 +122,18 @@ class RemoteHead:
                 self.node.cancel_task(*payload)
             elif tag == "store_delete":
                 self.node.store.delete(payload[0])
+            elif tag == "ping":
+                # health probe (reference: gcs_health_check_manager.h) —
+                # answered from the handler pool, so a wedged daemon
+                # genuinely misses probes
+                self._send("pong", payload[0])
+            elif tag == "cluster_view":
+                # syncer broadcast (reference: RaySyncer RESOURCE_VIEW
+                # fan-out); versioned — drop stale reorderings
+                version, view = payload
+                if version > self.cluster_view_version:
+                    self.cluster_view_version = version
+                    self.cluster_view = view
         except Exception:
             pass  # node dying; the head recovers via channel EOF
 
@@ -282,10 +296,14 @@ def main(argv=None) -> int:
         "object_addr": list(server.address),
         "pid": os.getpid(),
     })
+    from .syncer import NodeSyncer
+
+    syncer = NodeSyncer(head, node)
     try:
         head.stopped.wait()
     except KeyboardInterrupt:
         pass
+    syncer.stop()
     node.shutdown()
     return 0
 

@@ -102,6 +102,7 @@ class NodeProxy:
         self.object_addr = tuple(object_addr)
         self.pid = pid
         self.alive = True
+        self.last_pong = time.monotonic()
 
     def _send(self, tag: str, *payload) -> bool:
         try:
@@ -138,11 +139,18 @@ class Head:
     """Cluster brain living in the driver process."""
 
     def __init__(self, resources: Dict[str, float], session_dir: Optional[str] = None,
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 storage: Optional[str] = None):
         self.session_dir = session_dir or tempfile.mkdtemp(prefix="raytpu_session_")
         os.makedirs(self.session_dir, exist_ok=True)
         self.job_id = JobID.from_random()
-        self.gcs = GCS()
+        store = None
+        if storage:
+            # durable GCS tables (reference: RedisStoreClient GCS FT)
+            from .gcs_store import FileStore
+
+            store = FileStore(os.path.join(storage, "gcs"))
+        self.gcs = GCS(store=store)
         self.gcs.add_job(JobInfo(self.job_id))
         self.scheduler = ClusterScheduler(self._dispatch_to_node)
         self.nodes: Dict[str, Node] = {}
@@ -153,6 +161,8 @@ class Head:
         self._waiting_on: Dict[ObjectID, Set[TaskID]] = defaultdict(set)
         self.ref_counts: Dict[ObjectID, int] = defaultdict(int)
         self.streams: Dict[TaskID, int] = {}  # task_id -> items streamed
+        self.node_loads: Dict[str, dict] = {}  # node hex -> syncer snapshot
+        self._view_version = 0
         self._stopped = False
         self._node_listener = None
         self.node_server_address = None
@@ -174,6 +184,8 @@ class Head:
                                         resources_total=dict(resources),
                                         labels=labels or {}))
         self.scheduler.add_node(node.hex, node.resources)
+        if self._node_listener is not None:
+            self._broadcast_cluster_view()
         return node
 
     # --------------------------------------------------------- multi-host
@@ -205,7 +217,65 @@ class Head:
             n.start_object_server(self._cluster_key)
         threading.Thread(target=self._node_accept_loop, daemon=True,
                          name="node-server").start()
+        threading.Thread(target=self._health_check_loop, daemon=True,
+                         name="health-prober").start()
         return self.node_server_address
+
+    def on_node_sync(self, proxy, snap: dict) -> None:
+        """Merge a daemon's load report (reference: RaySyncer RESOURCE_VIEW
+        consumption in the GCS). A sync also counts as liveness."""
+        with self._lock:
+            cur = self.node_loads.get(proxy.hex)
+            if cur is not None and cur.get("version", 0) >= snap.get(
+                    "version", 0):
+                return  # stale out-of-order update
+            self.node_loads[proxy.hex] = snap
+        proxy.last_pong = time.monotonic()
+        info = self.gcs.nodes.get(proxy.hex)
+        if info is not None:
+            info.last_heartbeat = time.monotonic()
+
+    def _broadcast_cluster_view(self) -> None:
+        """Fan the merged membership view out to every daemon (reference:
+        RaySyncer broadcast of the aggregated resource view)."""
+        with self._lock:
+            self._view_version += 1
+            version = self._view_version
+            proxies = [n for n in self.nodes.values()
+                       if isinstance(n, NodeProxy) and n.alive]
+        with self.gcs._lock:  # snapshot: registrations mutate concurrently
+            infos = list(self.gcs.nodes.values())
+        view = [{"hex": info.hex, "alive": info.alive,
+                 "resources": info.resources_total}
+                for info in infos]
+        for p in proxies:
+            p._send("cluster_view", version, view)
+
+    def _health_check_loop(self) -> None:
+        """Active node probing (reference: gcs_health_check_manager.h:39 —
+        periodic gRPC health checks with a miss threshold). EOF detection
+        catches cleanly-dying daemons; this catches wedged ones."""
+        cfg = global_config()
+        period = max(0.1, cfg.health_check_period_ms / 1000.0)
+        threshold = max(1, cfg.health_check_failure_threshold)
+        seq = 0
+        while not self._stopped:
+            time.sleep(period)
+            seq += 1
+            with self._lock:
+                proxies = [n for n in self.nodes.values()
+                           if isinstance(n, NodeProxy) and n.alive]
+            now = time.monotonic()
+            for p in proxies:
+                if now - p.last_pong > period * threshold:
+                    p.alive = False
+                    try:
+                        p.channel.close()  # reader EOF completes cleanup
+                    except Exception:
+                        pass
+                    self.remove_node(p.hex)
+                    continue
+                p._send("ping", seq)
 
     @property
     def cluster_key_hex(self) -> Optional[str]:
@@ -258,6 +328,7 @@ class Head:
                                         resources_total=dict(ready["resources"]),
                                         labels=proxy.labels))
         self.scheduler.add_node(proxy.hex, proxy.resources)
+        self._broadcast_cluster_view()
         threading.Thread(target=self._daemon_reader, args=(proxy,),
                          daemon=True, name=f"daemon-{proxy.hex[:6]}").start()
 
@@ -267,7 +338,9 @@ class Head:
         while True:
             try:
                 tag, payload = proxy.channel.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, TypeError):
+                # TypeError: prober closed the connection mid-recv (the
+                # CPython Connection zeroes its handle)
                 if not self._stopped and proxy.alive:
                     proxy.alive = False
                     self.remove_node(proxy.hex)
@@ -304,6 +377,10 @@ class Head:
                     self._handle_task_failure(
                         rec, ActorDiedError(actor_id, "actor node/worker gone"),
                         None)
+            elif tag == "pong":
+                proxy.last_pong = time.monotonic()
+            elif tag == "sync":
+                self.on_node_sync(proxy, payload[0])
             elif tag == "req":
                 req_id, op, args = payload
                 self._daemon_pool.submit(self._handle_daemon_req, proxy,
@@ -394,6 +471,10 @@ class Head:
             return
         self.scheduler.remove_node(node_hex)
         self.gcs.mark_node_dead(node_hex)
+        with self._lock:
+            self.node_loads.pop(node_hex, None)
+        if self._node_listener is not None:
+            self._broadcast_cluster_view()
         node.shutdown()
         lost = self.gcs.drop_node_objects(node_hex)
         # fail/retry running tasks that were on the node
@@ -913,6 +994,7 @@ class Head:
                 "node_id": n.hex, "alive": n.Alive
                 if hasattr(n, "Alive") else n.alive,
                 "resources": n.resources_total, "labels": n.labels,
+                "load": self.node_loads.get(n.hex),
             } for n in list(gcs.nodes.values())[:limit]]
         if kind == "objects":
             with self._lock:
@@ -1269,6 +1351,7 @@ class Head:
             self.nodes.clear()
         for node in nodes:
             node.shutdown()
+        self.gcs.close()
 
 
 # --------------------------------------------------------------------------- #

@@ -143,6 +143,24 @@ class ClusterScheduler:
                     out[k] = out.get(k, 0) + v
             return out
 
+    def pending_demand(self) -> List[Dict[str, float]]:
+        """Resource asks of queued (unplaced) tasks + unreserved PG bundles
+        — the autoscaler's input (reference: resource_demand_scheduler.py
+        consuming GCS load reports)."""
+        with self._lock:
+            out = [dict(spec.resources.to_dict()) for spec in self._pending]
+            for pg in self._pending_pgs:
+                for b in pg.bundles:
+                    if b.node_hex is None:
+                        out.append(dict(b.resources.to_dict()))
+            return out
+
+    def idle_nodes(self) -> List[str]:
+        """Nodes with zero resource utilization (no tasks/actors/bundles)."""
+        with self._lock:
+            return [h for h, nr in self._nodes.items()
+                    if nr.utilization() <= 0.0]
+
     # ---- task scheduling -------------------------------------------------
 
     def submit(self, spec: TaskSpec) -> None:

@@ -1,0 +1,67 @@
+"""Resource/load syncer: periodic node->head reports + head->node views.
+
+Reference: RaySyncer (src/ray/common/ray_syncer/ray_syncer.h) — versioned
+RESOURCE_VIEW / COMMANDS streams between raylets and the GCS, which then
+re-broadcasts the merged cluster view. The topology here is the same
+hub-and-spoke (every daemon syncs with the head; the head fans the merged
+view back out); messages are versioned so stale updates are dropped.
+
+Daemon side: :class:`NodeSyncer` thread sends a load snapshot (object
+store occupancy, worker count, OS load) every period. Head side:
+``Head.on_node_sync`` merges into ``node_loads`` (surfaced by the state
+API), and membership changes broadcast a ``cluster_view`` message each
+daemon retains for peer selection.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict
+
+
+def collect_load(node) -> Dict[str, Any]:
+    """Snapshot one node's load (daemon side)."""
+    store = node.store
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:  # pragma: no cover
+        load1 = 0.0
+    return {
+        "ts": time.time(),
+        "store_capacity": store.capacity,
+        "store_used": int(getattr(store.arena.allocator, "bytes_allocated",
+                                  lambda: 0)())
+        if store.arena.allocator else 0,
+        "num_workers": len(getattr(node, "workers", []) or []),
+        "os_load_1m": load1,
+        "pid": os.getpid(),
+    }
+
+
+class NodeSyncer:
+    """Daemon-side reporter: ships load snapshots on a fixed period."""
+
+    def __init__(self, remote_head, node, period_s: float = 1.0):
+        self._head = remote_head
+        self._node = node
+        self._period = period_s
+        self._version = 0
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="node-syncer")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stopped.wait(self._period):
+            self._version += 1
+            try:
+                snap = collect_load(self._node)
+                snap["version"] = self._version
+                self._head._send("sync", snap)
+            except Exception:
+                return  # head link gone; daemon is shutting down
+
+    def stop(self) -> None:
+        self._stopped.set()

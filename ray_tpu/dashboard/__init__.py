@@ -72,11 +72,14 @@ class DashboardServer:
     """Stdlib HTTP server bound to a Head (+ optional JobManager)."""
 
     def __init__(self, head, host: str = "127.0.0.1", port: int = 0,
-                 job_manager=None):
+                 job_manager=None, auth_token: Optional[str] = None):
         import http.server
 
         self.head = head
         self.job_manager = job_manager
+        # bearer token gate for job mutations (submit/stop/delete execute
+        # shell commands — never expose them unauthenticated off-loopback)
+        self.auth_token = auth_token
         outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
@@ -148,6 +151,11 @@ class DashboardServer:
 
             h._send(200, render_prometheus(registry()).encode(),
                     "text/plain; version=0.0.4")
+        elif path == "/api/timeline":
+            from ray_tpu.util.timeline import (_build_chrome_trace,
+                                               raw_events_for_head)
+
+            h._json(_build_chrome_trace(raw_events_for_head(self.head)))
         elif path == "/api/cluster":
             h._json({
                 "total": self.head.scheduler.total_resources(),
@@ -163,9 +171,16 @@ class DashboardServer:
             if m and (m.group(2) or "") == "/logs":
                 try:
                     offset = int(params.get("offset", 0))
-                    h._send(200, self._jm().get_job_logs(
-                        m.group(1), offset=offset).encode(),
-                        "text/plain; charset=utf-8")
+                    text, nxt = self._jm().read_job_logs(
+                        m.group(1), offset=offset)
+                    body = text.encode()
+                    h.send_response(200)
+                    h.send_header("Content-Type",
+                                  "text/plain; charset=utf-8")
+                    h.send_header("Content-Length", str(len(body)))
+                    h.send_header("X-Next-Offset", str(nxt))
+                    h.end_headers()
+                    h.wfile.write(body)
                 except KeyError:
                     h._json({"error": "not found"}, 404)
             elif m and not m.group(2):
@@ -176,7 +191,17 @@ class DashboardServer:
             else:
                 h._json({"error": "not found"}, 404)
 
+    def _authorized(self, h) -> bool:
+        if not self.auth_token:
+            return True
+        got = h.headers.get("Authorization", "")
+        return got == f"Bearer {self.auth_token}"
+
     def _post(self, h) -> None:
+        if not self._authorized(h):
+            h._json({"error": "missing/invalid Authorization bearer token"},
+                    401)
+            return
         path = h.path.split("?", 1)[0]
         if path in ("/api/jobs", "/api/jobs/"):
             body = h._body()
@@ -200,6 +225,10 @@ class DashboardServer:
             h._json({"error": "not found"}, 404)
 
     def _delete(self, h) -> None:
+        if not self._authorized(h):
+            h._json({"error": "missing/invalid Authorization bearer token"},
+                    401)
+            return
         m = self._JOB_RE.match(h.path.split("?", 1)[0])
         if m and not m.group(2):
             try:
@@ -225,22 +254,30 @@ class DashboardServer:
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265,
-                    with_jobs: bool = True) -> DashboardServer:
+                    with_jobs: bool = True,
+                    auth_token: Optional[str] = None) -> DashboardServer:
     """Start the dashboard on the current in-process head.
 
     With ``with_jobs`` the head's client server is started too, so
-    submitted jobs' drivers join this cluster.
+    submitted jobs' drivers join this cluster. On a non-loopback bind a
+    bearer token is REQUIRED for job mutations: pass one, or one is
+    generated (read it from ``server.auth_token``).
     """
     import ray_tpu
     from ray_tpu.core import api as _api
 
     head = _api._get_head()
+    if auth_token is None and host not in ("127.0.0.1", "localhost"):
+        import secrets
+
+        auth_token = secrets.token_hex(16)
     jm = None
     if with_jobs:
         from ray_tpu.jobs import JobManager
 
         addr, key_hex = ray_tpu.start_client_server()
         jm = JobManager(client_address=addr, cluster_key_hex=key_hex)
-    srv = DashboardServer(head, host, port, job_manager=jm)
+    srv = DashboardServer(head, host, port, job_manager=jm,
+                          auth_token=auth_token)
     head._dashboard = srv
     return srv

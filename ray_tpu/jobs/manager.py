@@ -46,6 +46,7 @@ class JobInfo:
     metadata: Dict[str, str] = field(default_factory=dict)
     runtime_env: Dict[str, Any] = field(default_factory=dict)
     driver_exit_code: Optional[int] = None
+    stop_requested: bool = False
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -122,11 +123,15 @@ class JobManager:
         with self._lock:
             info = self._jobs[submission_id]
             self._procs.pop(submission_id, None)
-            if info.status == JobStatus.STOPPED:
-                pass
-            elif rc == 0:
+            # the monitor is the single writer of terminal status: a clean
+            # exit-0 that raced an (undelivered) stop is SUCCEEDED, not
+            # STOPPED
+            if rc == 0:
                 info.status = JobStatus.SUCCEEDED
                 info.message = "driver exited 0"
+            elif info.stop_requested:
+                info.status = JobStatus.STOPPED
+                info.message = "stopped by user"
             else:
                 info.status = JobStatus.FAILED
                 info.message = f"driver exited {rc}"
@@ -139,10 +144,9 @@ class JobManager:
             proc = self._procs.get(submission_id)
             if info is None:
                 raise KeyError(submission_id)
-            if proc is None:
-                return False
-            info.status = JobStatus.STOPPED
-            info.message = "stopped by user"
+            if proc is None or proc.poll() is not None:
+                return False  # already terminal; _monitor records the truth
+            info.stop_requested = True
         try:
             os.killpg(proc.pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
@@ -194,9 +198,11 @@ class JobManager:
     def log_path(self, submission_id: str) -> str:
         return os.path.join(self._log_dir, f"{submission_id}.log")
 
-    def get_job_logs(self, submission_id: str, offset: int = 0) -> str:
-        """Log text from byte ``offset`` — tailers pass their position so
-        each poll reads only the increment, not the whole file."""
+    def read_job_logs(self, submission_id: str, offset: int = 0):
+        """(text, next_byte_offset) from byte ``offset``. Tailers must
+        carry ``next_byte_offset`` (not len(text): decoding with
+        errors='replace' changes lengths for non-UTF-8 / torn multibyte
+        tails, which would desynchronize a re-encoded offset)."""
         with self._lock:
             if submission_id not in self._jobs:
                 raise KeyError(submission_id)
@@ -204,9 +210,13 @@ class JobManager:
             with open(self.log_path(submission_id), "rb") as f:
                 if offset:
                     f.seek(offset)
-                return f.read().decode(errors="replace")
+                data = f.read()
+                return data.decode(errors="replace"), offset + len(data)
         except OSError:
-            return ""
+            return "", offset
+
+    def get_job_logs(self, submission_id: str, offset: int = 0) -> str:
+        return self.read_job_logs(submission_id, offset)[0]
 
     def shutdown(self) -> None:
         with self._lock:
@@ -224,12 +234,16 @@ class JobManager:
 
 
 class JobSubmissionClient:
-    """HTTP client against the dashboard's /api/jobs endpoints."""
+    """HTTP client against the dashboard's /api/jobs endpoints.
 
-    def __init__(self, address: str):
+    ``auth_token`` (or env ``RAY_TPU_JOB_TOKEN``) is required when the
+    dashboard was started on a non-loopback interface."""
+
+    def __init__(self, address: str, auth_token: Optional[str] = None):
         self._base = address.rstrip("/")
         if not self._base.startswith("http"):
             self._base = "http://" + self._base
+        self._token = auth_token or os.environ.get("RAY_TPU_JOB_TOKEN", "")
 
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None) -> Any:
@@ -237,9 +251,11 @@ class JobSubmissionClient:
         import urllib.request
 
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
         req = urllib.request.Request(
-            self._base + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            self._base + path, data=data, method=method, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 raw = resp.read().decode()
@@ -270,10 +286,29 @@ class JobSubmissionClient:
         return self._request("GET", "/api/jobs/")
 
     def get_job_logs(self, submission_id: str, offset: int = 0) -> str:
+        return self._get_logs(submission_id, offset)[0]
+
+    def _get_logs(self, submission_id: str, offset: int = 0):
+        """(text, next_byte_offset) — offset from the X-Next-Offset header
+        so polling stays byte-accurate across encodings."""
+        import urllib.error
+        import urllib.request
+
         path = f"/api/jobs/{submission_id}/logs"
         if offset:
             path += f"?offset={offset}"
-        return self._request("GET", path)
+        req = urllib.request.Request(self._base + path)
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                text = resp.read().decode(errors="replace")
+                nxt = int(resp.headers.get("X-Next-Offset") or offset)
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(
+                f"GET {path} -> {e.code}: "
+                f"{e.read().decode(errors='replace')}") from None
+        return text, nxt
 
     def stop_job(self, submission_id: str) -> bool:
         return self._request(
@@ -288,12 +323,11 @@ class JobSubmissionClient:
         Polls with a byte offset so each request transfers only new text."""
         seen = 0
         while True:
-            chunk = self.get_job_logs(submission_id, offset=seen)
+            chunk, seen = self._get_logs(submission_id, offset=seen)
             if chunk:
                 yield chunk
-                seen += len(chunk.encode())
             if self.get_job_status(submission_id) in JobStatus.TERMINAL:
-                rest = self.get_job_logs(submission_id, offset=seen)
+                rest, seen = self._get_logs(submission_id, offset=seen)
                 if rest:
                     yield rest
                 return

@@ -1,0 +1,112 @@
+"""GCS durable storage + node health probing + resource syncer.
+
+Reference coverage modeled: GCS FT via RedisStoreClient (restart recovery
+of KV/function/job tables), gcs_health_check_manager (miss-threshold node
+death), RaySyncer (node load reports reaching the head's view).
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import global_config
+from ray_tpu.core.gcs_store import FileStore
+
+
+class TestFileStore:
+    def test_journal_replay(self, tmp_path):
+        s = FileStore(str(tmp_path / "gcs"))
+        s.put("kv", ("default", b"a"), b"1")
+        s.put("kv", ("default", b"b"), b"2")
+        s.delete("kv", ("default", b"a"))
+        s.close()
+        s2 = FileStore(str(tmp_path / "gcs"))
+        tables = s2.load()
+        assert tables["kv"] == {("default", b"b"): b"2"}
+        s2.close()
+
+    def test_snapshot_compaction(self, tmp_path):
+        s = FileStore(str(tmp_path / "gcs"), compact_every=10)
+        for i in range(25):
+            s.put("t", i, i * i)
+        s.close()
+        s2 = FileStore(str(tmp_path / "gcs"), compact_every=10)
+        assert s2.load()["t"] == {i: i * i for i in range(25)}
+        # journal was truncated at the last compaction
+        assert os.path.getsize(str(tmp_path / "gcs" / "journal.pkl")) < 4096
+        s2.close()
+
+
+class TestHeadRecovery:
+    def test_kv_functions_jobs_survive_restart(self, tmp_path):
+        storage = str(tmp_path / "cluster")
+        ray_tpu.init(num_cpus=2, num_tpus=0, storage=storage)
+        from ray_tpu.core import api as _api
+
+        head = _api._get_head()
+        head.gcs.kv_put(b"mykey", b"myvalue", namespace="app")
+        head.gcs.register_function("fn123", b"payload")
+        ray_tpu.shutdown()
+
+        ray_tpu.init(num_cpus=2, num_tpus=0, storage=storage)
+        head2 = _api._get_head()
+        assert head2.gcs.kv_get(b"mykey", namespace="app") == b"myvalue"
+        assert head2.gcs.get_function("fn123") == b"payload"
+        ray_tpu.shutdown()
+
+
+@pytest.fixture
+def probed_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cfg = global_config()
+    old = (cfg.health_check_period_ms, cfg.health_check_failure_threshold)
+    cfg.health_check_period_ms = 200
+    cfg.health_check_failure_threshold = 8
+    c = Cluster(head_node_args={"num_cpus": 1})
+    yield c
+    cfg.health_check_period_ms, cfg.health_check_failure_threshold = old
+    c.shutdown()
+
+
+class TestHealthProberAndSyncer:
+    def test_wedged_daemon_declared_dead(self, probed_cluster):
+        c = probed_cluster
+        c.add_node(num_cpus=1, resources={"spare": 1},
+                   separate_process=True)
+        head = c.head
+        proxy = next(n for n in head.nodes.values()
+                     if getattr(n, "pid", None) is not None
+                     and n.hex != head.head_node.hex)
+        daemon_pid = proxy.pid
+
+        # syncer: load report reaches the head's view
+        deadline = time.time() + 20
+        while time.time() < deadline and proxy.hex not in head.node_loads:
+            time.sleep(0.2)
+        assert proxy.hex in head.node_loads
+        assert head.node_loads[proxy.hex]["store_capacity"] > 0
+        rows = head.state_list("nodes")
+        assert any(r.get("load") for r in rows)
+
+        # SIGSTOP: process alive, channel open, but no pongs -> the prober
+        # (not EOF detection) must declare it dead
+        os.kill(daemon_pid, signal.SIGSTOP)
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                info = head.gcs.nodes.get(proxy.hex)
+                if info is not None and not info.alive:
+                    break
+                time.sleep(0.2)
+            info = head.gcs.nodes.get(proxy.hex)
+            assert info is not None and not info.alive, \
+                "wedged daemon was not declared dead by the prober"
+        finally:
+            try:
+                os.kill(daemon_pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass

@@ -1,0 +1,39 @@
+"""Timeline export (ray.timeline analog): chrome-trace JSON from task
+events."""
+
+import json
+
+import ray_tpu
+
+
+def test_timeline_events(tmp_path, ray_start_regular):
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    ray_tpu.get([work.remote(i) for i in range(3)])
+    out = str(tmp_path / "trace.json")
+    events = ray_tpu.timeline(out)
+    slices = [e for e in events if e.get("ph") == "X"
+              and e.get("name") == "work"]
+    assert len(slices) == 3
+    for s in slices:
+        assert s["dur"] >= 0 and s["cat"] == "task"
+        assert s["args"]["task_id"]
+    with open(out) as f:
+        assert json.load(f) == events
+
+
+def test_timeline_marks_failures(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("x")
+
+    try:
+        ray_tpu.get(boom.remote())
+    except Exception:
+        pass
+    events = ray_tpu.timeline()
+    failed = [e for e in events if e.get("name") == "boom"
+              and e.get("ph") == "X"]
+    assert failed and "error" in failed[-1]["args"]
