@@ -117,8 +117,10 @@ def main(argv=None) -> int:
     h.add_argument("--num-cpus", type=int, default=None)
     h.add_argument("--num-tpus", type=int, default=None)
 
-    s = sub.add_parser("start", help="join a head as a node daemon")
-    s.add_argument("daemon_args", nargs=argparse.REMAINDER)
+    # NOTE: `start` is dispatched before argparse (see main()); this stub
+    # exists only so it shows in --help
+    sub.add_parser("start", help="join a head as a node daemon "
+                                 "(--address <host:port> --key <hex> ...)")
 
     sb = sub.add_parser("submit", help="submit a job")
     sb.add_argument("--address", default="http://127.0.0.1:8265")

@@ -145,11 +145,19 @@ class Autoscaler:
         return None
 
     def _loop(self) -> None:
+        import logging
+
+        log = logging.getLogger("ray_tpu.autoscaler")
+        last_err = None
         while not self._stopped.wait(self.config.interval_s):
             try:
                 self.update()
-            except Exception:
-                pass  # transient head/provider hiccups; next tick retries
+                last_err = None
+            except Exception as e:  # next tick retries; log distinct errors
+                if repr(e) != last_err:
+                    last_err = repr(e)
+                    log.exception("autoscaler reconcile failed "
+                                  "(will keep retrying): %s", e)
 
     def stop(self, terminate_nodes: bool = True) -> None:
         self._stopped.set()

@@ -171,28 +171,27 @@ class _ClientSession:
     _BLOCKING_OPS = frozenset({"get", "wait", "stream_next"})
 
     def serve(self) -> None:
-        """Reader loop. Quick ops share a per-session pool; potentially
-        unbounded blocking ops (get/wait/stream_next) each get their own
-        thread — N threads of a client all blocked in get() must leave the
-        path open for the submit that produces their objects."""
+        """Reader loop. Quick ops share a small per-session pool;
+        potentially long-blocking ops (get/wait/stream_next) go to a much
+        larger dedicated pool — its capacity bounds how many of a client's
+        threads may block in get() simultaneously without starving the
+        submit that would unblock them, while reusing threads (stream_next
+        arrives once per streamed item)."""
         from concurrent.futures import ThreadPoolExecutor
 
-        pool = ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix=f"client-{self.job_id.hex()[:6]}")
+        prefix = f"client-{self.job_id.hex()[:6]}"
+        pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix=prefix)
+        blocking_pool = ThreadPoolExecutor(
+            max_workers=256, thread_name_prefix=prefix + "-blk")
         try:
             while not self.server._stopped:
                 tag, payload = self.channel.recv()
                 if tag == "rpc":
                     req_id, op, *args = payload
-                    if op in self._BLOCKING_OPS:
-                        threading.Thread(
-                            target=self._dispatch_and_reply,
-                            args=(req_id, op, tuple(args)),
-                            daemon=True,
-                            name=f"client-blk-{op}").start()
-                    else:
-                        pool.submit(self._dispatch_and_reply, req_id, op,
-                                    tuple(args))
+                    target = (blocking_pool if op in self._BLOCKING_OPS
+                              else pool)
+                    target.submit(self._dispatch_and_reply, req_id, op,
+                                  tuple(args))
                 elif tag == "refop":
                     kind, oid = payload
                     (self.pin if kind == "add" else self.unpin)(oid)
@@ -203,6 +202,7 @@ class _ClientSession:
         finally:
             self.closed = True
             pool.shutdown(wait=False)
+            blocking_pool.shutdown(wait=False)
             self.release_all()
             try:
                 self.channel.close()

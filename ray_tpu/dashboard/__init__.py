@@ -194,8 +194,10 @@ class DashboardServer:
     def _authorized(self, h) -> bool:
         if not self.auth_token:
             return True
+        import hmac
+
         got = h.headers.get("Authorization", "")
-        return got == f"Bearer {self.auth_token}"
+        return hmac.compare_digest(got, f"Bearer {self.auth_token}")
 
     def _post(self, h) -> None:
         if not self._authorized(h):

@@ -24,6 +24,7 @@ from ray_tpu.data.dataset import (  # noqa: F401
     from_items,
     from_numpy,
     from_pandas,
+    from_torch,
     range,
     range_tensor,
     read_binary_files,
@@ -32,6 +33,7 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
 )
 from ray_tpu.data.datasource import (  # noqa: F401
@@ -49,7 +51,8 @@ __all__ = [
     "ActorPoolStrategy", "range", "range_tensor", "from_items",
     "from_blocks", "from_pandas", "from_arrow", "from_numpy",
     "read_parquet", "read_csv", "read_json", "read_numpy", "read_text",
-    "read_binary_files", "read_datasource", "AggregateFn", "Count", "Sum",
+    "read_binary_files", "read_sql", "from_torch", "read_datasource",
+    "AggregateFn", "Count", "Sum",
     "Min", "Max", "Mean", "Std", "AbsMax", "Quantile", "Block",
     "BlockAccessor",
 ]

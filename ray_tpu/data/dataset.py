@@ -737,3 +737,25 @@ def read_binary_files(paths, *, include_paths: bool = False,
 
 def read_text(paths, *, parallelism: int = -1) -> Dataset:
     return read_datasource(TextDatasource(paths), parallelism=parallelism)
+
+
+def read_sql(sql: str, connection_factory, *, shard_rows=None,
+             parallelism: int = -1) -> Dataset:
+    """Rows of a DBAPI-2 query (reference: ray.data.read_sql).
+
+    ``connection_factory`` is called per read task (connections don't
+    pickle); pass ``shard_rows`` to window the query across tasks."""
+    from .datasource import SQLDatasource
+
+    return read_datasource(
+        SQLDatasource(sql, connection_factory, shard_rows=shard_rows),
+        parallelism=parallelism)
+
+
+def from_torch(torch_dataset, *, parallelism: int = -1) -> Dataset:
+    """A map-style torch Dataset's items, one row each (reference:
+    ray.data.from_torch)."""
+    from .datasource import TorchDatasource
+
+    return read_datasource(TorchDatasource(torch_dataset),
+                           parallelism=parallelism)

@@ -124,6 +124,112 @@ class ItemsDatasource(Datasource):
         return tasks
 
 
+class SQLDatasource(Datasource):
+    """DBAPI-2 query source (reference: read_sql / SQLDatasource).
+
+    ``connection_factory`` returns a fresh DBAPI connection per read task
+    (connections don't pickle); partitioning wraps the query in
+    LIMIT/OFFSET windows when ``parallelism > 1``.
+    """
+
+    def __init__(self, sql: str, connection_factory: Callable[[], Any],
+                 *, shard_rows: Optional[int] = None):
+        self._sql = sql
+        self._factory = connection_factory
+        self._shard_rows = shard_rows
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        sql, factory, shard = self._sql, self._factory, self._shard_rows
+
+        def fetch(cur):
+            cols = [d[0] for d in cur.description]
+            return [dict(zip(cols, r)) for r in cur.fetchall()]
+
+        def run_whole():
+            conn = factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                return [build_block(fetch(cur))]
+            finally:
+                conn.close()
+
+        if parallelism <= 1 or not shard:
+            return [ReadTask(run_whole)]
+
+        # strided windows: task i reads windows i, i+P, i+2P, ... until an
+        # empty window — full coverage for any table size (a fixed window
+        # per task would silently truncate). Include ORDER BY in the query
+        # for stable window membership.
+        def run_strided(task_idx, world):
+            conn = factory()
+            blocks = []
+            try:
+                cur = conn.cursor()
+                w = task_idx
+                while True:
+                    cur.execute(f"{sql} LIMIT {shard} OFFSET {w * shard}")
+                    rows = fetch(cur)
+                    if rows:
+                        blocks.append(build_block(rows))
+                    if len(rows) < shard:
+                        break
+                    w += world
+                return blocks or [build_block([])]
+            finally:
+                conn.close()
+
+        return [ReadTask(lambda i=i: run_strided(i, parallelism))
+                for i in range(parallelism)]
+
+
+class TorchDatasource(Datasource):
+    """Map-style ``torch.utils.data.Dataset`` source (reference:
+    from_torch / TorchDatasource): indices shard across read tasks; each
+    task materializes its slice through __getitem__."""
+
+    def __init__(self, torch_dataset):
+        self._ds = torch_dataset
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        ds = self._ds
+        n = len(ds)
+        parallelism = max(1, min(parallelism, n or 1))
+        chunk = (n + parallelism - 1) // parallelism if n else 1
+        tasks = []
+        for start in range(0, n, chunk):
+            end = min(start + chunk, n)
+
+            def fn(start=start, end=end):
+                rows = []
+                for i in range(start, end):
+                    item = ds[i]
+                    if isinstance(item, dict):
+                        rows.append({k: _to_numpy(v)
+                                     for k, v in item.items()})
+                    elif isinstance(item, (tuple, list)):
+                        rows.append({f"item_{j}": _to_numpy(v)
+                                     for j, v in enumerate(item)})
+                    else:
+                        rows.append({"item": _to_numpy(item)})
+                return [build_block(rows)]
+
+            tasks.append(ReadTask(fn, BlockMetadata(num_rows=end - start)))
+        if not tasks:
+            tasks.append(ReadTask(lambda: [build_block([])],
+                                  BlockMetadata(num_rows=0)))
+        return tasks
+
+
+def _to_numpy(v):
+    if hasattr(v, "numpy"):  # torch tensor
+        try:
+            return v.detach().cpu().numpy()
+        except Exception:
+            return v.numpy()
+    return v
+
+
 def _expand_paths(paths) -> List[str]:
     if isinstance(paths, str):
         paths = [paths]

@@ -8,6 +8,7 @@ multi-learner gradient sync), PPO.
 
 from .algorithm import Algorithm, EnvRunnerGroup
 from .config import AlgorithmConfig
+from .dqn import DQN, DQNConfig, ReplayBuffer
 from .env_runner import SingleAgentEnvRunner, compute_gae
 from .learner import Learner, LearnerGroup
 from .impala import IMPALA, IMPALAConfig
@@ -17,6 +18,6 @@ from .rl_module import JaxRLModule, RLModuleSpec
 __all__ = [
     "Algorithm", "AlgorithmConfig", "EnvRunnerGroup",
     "SingleAgentEnvRunner", "compute_gae", "Learner", "LearnerGroup",
-    "PPO", "PPOConfig", "IMPALA", "IMPALAConfig",
-    "JaxRLModule", "RLModuleSpec",
+    "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
+    "ReplayBuffer", "JaxRLModule", "RLModuleSpec",
 ]

@@ -56,17 +56,18 @@ class EnvRunnerGroup:
             return 1
         return sum(self._healthy)
 
-    def sample(self, weights) -> Tuple[List[Dict], List[Dict]]:
+    def sample(self, weights, **kw) -> Tuple[List[Dict], List[Dict]]:
         """Fan out sample() to healthy runners; mark failures dead instead
-        of raising (reference: foreach_worker fault-tolerant fanout)."""
+        of raising (reference: foreach_worker fault-tolerant fanout).
+        Extra kwargs (e.g. ``epsilon``) pass through to the runners."""
         if self._local is not None:
-            b, s = self._local.sample(weights)
+            b, s = self._local.sample(weights, **kw)
             return [b], [s]
         wref = ray_tpu.put(weights)
         refs = []
         for i, r in enumerate(self._runners):
             if self._healthy[i]:
-                refs.append((i, r.sample.remote(wref)))
+                refs.append((i, r.sample.remote(wref, **kw)))
         batches, stats = [], []
         for i, ref in refs:
             try:

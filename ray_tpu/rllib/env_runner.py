@@ -52,7 +52,10 @@ class SingleAgentEnvRunner:
     def ping(self) -> str:
         return "ok"
 
-    def _forward(self, obs: np.ndarray):
+    def _forward(self, obs: np.ndarray, epsilon: Optional[float] = None):
+        """Policy inference: categorical sampling (on-policy algorithms)
+        or, with ``epsilon``, epsilon-greedy over the logits/Q-values
+        (value-based algorithms — reference: EpsilonGreedy exploration)."""
         import jax
         import jax.numpy as jnp
 
@@ -68,23 +71,40 @@ class SingleAgentEnvRunner:
                     logp_all, action[:, None], axis=1)[:, 0]
                 return action, logp, value
 
+            @jax.jit
+            def eps_fn(params, obs, key, eps):
+                logits, value = fwd(params, obs)
+                ka, ku = jax.random.split(key)
+                greedy = jnp.argmax(logits, axis=-1)
+                rand = jax.random.randint(ka, greedy.shape, 0,
+                                          logits.shape[-1])
+                explore = jax.random.uniform(ku, greedy.shape) < eps
+                action = jnp.where(explore, rand, greedy)
+                return action, jnp.zeros_like(value), value
+
             self._jit_forward = step_fn
+            self._jit_eps = eps_fn
             self._jax = jax
             self._key = jax.random.PRNGKey(
                 int(self._rng.integers(0, 2**31)))
         self._key, sub = self._jax.random.split(self._key)
-        a, lp, v = self._jit_forward(self._params, obs, sub)
+        if epsilon is None:
+            a, lp, v = self._jit_forward(self._params, obs, sub)
+        else:
+            a, lp, v = self._jit_eps(self._params, obs, sub,
+                                     float(epsilon))
         return (np.asarray(a), np.asarray(lp, np.float32),
                 np.asarray(v, np.float32))
 
     # ---- sampling ----
 
-    def sample(self, weights: Optional[Dict] = None) -> Tuple[Dict, Dict]:
+    def sample(self, weights: Optional[Dict] = None,
+               epsilon: Optional[float] = None) -> Tuple[Dict, Dict]:
         """One rollout of [rollout_len, num_envs] steps.
 
         Returns (batch, stats). Batch arrays are [T, N]; ``valid`` masks
         out autoreset rows; ``vf_last`` is V(s_T) per env for GAE
-        bootstrap.
+        bootstrap. ``epsilon`` switches inference to epsilon-greedy.
         """
         if weights is not None:
             self.set_weights(weights)
@@ -101,7 +121,7 @@ class SingleAgentEnvRunner:
 
         t0 = time.perf_counter()
         for t in range(T):
-            action, logp, value = self._forward(self._obs)
+            action, logp, value = self._forward(self._obs, epsilon)
             next_obs, reward, term, trunc, _ = self.env.step(action)
             obs_buf[t] = self._obs
             act_buf[t] = action

@@ -18,11 +18,15 @@ from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions  # noqa: F401
 from ray_tpu.serve.deployment import Application, Deployment, deployment  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
+from ray_tpu.serve.multiplex import (  # noqa: F401
+    get_multiplexed_model_id,
+    multiplexed,
+)
 from ray_tpu.serve.proxy import Request  # noqa: F401
 
 __all__ = [
     "deployment", "Deployment", "Application", "run", "start", "shutdown",
     "status", "delete", "get_deployment_handle", "DeploymentHandle",
     "DeploymentResponse", "AutoscalingConfig", "HTTPOptions", "batch",
-    "Request",
+    "Request", "multiplexed", "get_multiplexed_model_id",
 ]

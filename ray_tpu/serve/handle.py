@@ -116,7 +116,7 @@ class Router:
             if key in self._inflight:
                 self._inflight[key] = max(0, self._inflight[key] - 1)
 
-    def choose(self):
+    def choose(self, model_id: str = ""):
         deadline = time.time() + 30
         while True:
             self._refresh()
@@ -129,40 +129,63 @@ class Router:
                     f"no replicas available for deployment {self._name!r}")
             time.sleep(0.05)
             self._refresh(force=True)
-        if len(replicas) == 1:
-            chosen = replicas[0]
-        else:
-            a, b = random.sample(replicas, 2)
+        chosen = None
+        if model_id:
+            # multiplex-aware sticky routing: prefer the replica this
+            # model id last landed on (its LRU cache holds the model) —
+            # reference: multiplexed-model-aware replica scheduler
             with self._lock:
-                la = self._inflight.get(self._key(a), 0)
-                lb = self._inflight.get(self._key(b), 0)
-            chosen = a if la <= lb else b
+                sticky = getattr(self, "_model_affinity", None)
+                if sticky is None:
+                    sticky = self._model_affinity = {}
+                want = sticky.get(model_id)
+            if want is not None:
+                for r in replicas:
+                    if self._key(r) == want:
+                        chosen = r
+                        break
+        if chosen is None:
+            if len(replicas) == 1:
+                chosen = replicas[0]
+            else:
+                a, b = random.sample(replicas, 2)
+                with self._lock:
+                    la = self._inflight.get(self._key(a), 0)
+                    lb = self._inflight.get(self._key(b), 0)
+                chosen = a if la <= lb else b
         key = self._key(chosen)
         with self._lock:
             self._inflight[key] = self._inflight.get(key, 0) + 1
+            if model_id:
+                self._model_affinity[model_id] = key
         return chosen, key
 
 
 class DeploymentHandle:
     def __init__(self, controller, deployment_name: str,
                  method_name: str = "__call__", stream: bool = False,
-                 stream_item_timeout_s: Optional[float] = None):
+                 stream_item_timeout_s: Optional[float] = None,
+                 multiplexed_model_id: str = ""):
         self._controller = controller
         self._name = deployment_name
         self._method = method_name
         self._stream = stream
         self._stream_item_timeout_s = stream_item_timeout_s
+        self._model_id = multiplexed_model_id
         self._router = Router(controller, deployment_name)
 
     def options(self, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
-                stream_item_timeout_s: Optional[float] = None
+                stream_item_timeout_s: Optional[float] = None,
+                multiplexed_model_id: Optional[str] = None
                 ) -> "DeploymentHandle":
         h = DeploymentHandle(self._controller, self._name,
                              method_name or self._method,
                              self._stream if stream is None else stream,
                              stream_item_timeout_s
-                             or self._stream_item_timeout_s)
+                             or self._stream_item_timeout_s,
+                             self._model_id if multiplexed_model_id is None
+                             else multiplexed_model_id)
         h._router = self._router  # share in-flight accounting
         return h
 
@@ -171,12 +194,13 @@ class DeploymentHandle:
         return _MethodAccessor(self)
 
     def remote(self, *args, **kwargs):
-        replica, key = self._router.choose()
+        replica, key = self._router.choose(model_id=self._model_id)
         if self._stream:
             # items stream incrementally (streaming generators); the
             # in-flight count drops when the generator is exhausted
             gen = replica.handle_request_stream.options(
-                num_returns="streaming").remote(self._method, args, kwargs)
+                num_returns="streaming").remote(self._method, args, kwargs,
+                                                self._model_id)
             item_timeout = self._stream_item_timeout_s
 
             def iterate():
@@ -189,11 +213,13 @@ class DeploymentHandle:
                     self._router._dec(key)
 
             return iterate()
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+        ref = replica.handle_request.remote(self._method, args, kwargs,
+                                            self._model_id)
 
         def redispatch():
-            r2, k2 = self._router.choose()
-            return r2.handle_request.remote(self._method, args, kwargs), k2
+            r2, k2 = self._router.choose(model_id=self._model_id)
+            return r2.handle_request.remote(self._method, args, kwargs,
+                                            self._model_id), k2
 
         return DeploymentResponse(ref, self._router, key, redispatch)
 
@@ -205,7 +231,7 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self._controller, self._name, self._method, self._stream,
-                 self._stream_item_timeout_s))
+                 self._stream_item_timeout_s, self._model_id))
 
 
 class _BoundMethod:

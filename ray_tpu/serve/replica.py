@@ -35,9 +35,13 @@ class ServeReplica:
                 self._callable, "reconfigure"):
             self._callable.reconfigure(user_config)
 
-    async def handle_request(self, method_name: str, args, kwargs):
+    async def handle_request(self, method_name: str, args, kwargs,
+                             multiplexed_model_id: str = ""):
+        from ray_tpu.serve.multiplex import _set_request_model_id
+
         self._ongoing += 1
         self._total += 1
+        token = _set_request_model_id(multiplexed_model_id)
         try:
             if self._is_class:
                 if method_name == "__call__":
@@ -55,22 +59,34 @@ class ServeReplica:
                 # sync callables run in a thread pool so concurrent
                 # requests overlap (reference: replica.py run_sync_in_
                 # threadpool) — keeps the ongoing-count signal honest for
-                # pow-2 routing and autoscaling
+                # pow-2 routing and autoscaling. copy_context: the
+                # multiplexed-model-id contextvar must be visible in the
+                # executor thread
+                import contextvars
+
                 loop = asyncio.get_event_loop()
+                ctx = contextvars.copy_context()
                 result = await loop.run_in_executor(
-                    None, lambda: fn(*args, **kwargs))
+                    None, lambda: ctx.run(fn, *args, **kwargs))
             if inspect.iscoroutine(result):
                 result = await result
             return result
         finally:
             self._ongoing -= 1
+            from ray_tpu.serve.multiplex import _model_id_ctx
 
-    def handle_request_stream(self, method_name: str, args, kwargs):
+            _model_id_ctx.reset(token)
+
+    def handle_request_stream(self, method_name: str, args, kwargs,
+                              multiplexed_model_id: str = ""):
         """Streaming requests: the user callable returns a generator whose
         items stream back via num_returns="streaming" actor-method calls
         (reference: replica streaming responses over generators)."""
+        from ray_tpu.serve.multiplex import _set_request_model_id, _model_id_ctx
+
         self._ongoing += 1
         self._total += 1
+        token = _set_request_model_id(multiplexed_model_id)
         try:
             if self._is_class:
                 fn = (self._callable if method_name == "__call__"
@@ -81,6 +97,7 @@ class ServeReplica:
                 yield item
         finally:
             self._ongoing -= 1
+            _model_id_ctx.reset(token)
 
     def reconfigure(self, user_config) -> None:
         if hasattr(self._callable, "reconfigure"):

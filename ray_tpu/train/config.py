@@ -56,6 +56,8 @@ class RunConfig:
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     verbose: int = 1
+    # tune lifecycle callbacks / per-trial loggers (tune/callbacks.py)
+    callbacks: Optional[list] = None
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.expanduser("~/ray_tpu_results")

@@ -17,7 +17,13 @@ from ray_tpu.tune.sample import (  # noqa: F401
     sample_from,
     uniform,
 )
+from ray_tpu.tune.callbacks import (  # noqa: F401
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+)
 from ray_tpu.tune.schedulers import (  # noqa: F401
+    PB2,
     AsyncHyperBandScheduler,
     FIFOScheduler,
     HyperBandScheduler,
@@ -48,5 +54,6 @@ __all__ = [
     "grid_search", "Searcher", "BasicVariantGenerator",
     "ConcurrencyLimiter", "TrialScheduler", "FIFOScheduler",
     "AsyncHyperBandScheduler", "HyperBandScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "with_parameters", "with_resources",
+    "PopulationBasedTraining", "PB2", "Callback", "JsonLoggerCallback",
+    "CSVLoggerCallback", "with_parameters", "with_resources",
 ]

@@ -167,7 +167,9 @@ class TuneController:
                  max_failures: int = 0,
                  trial_resources: Optional[Dict[str, float]] = None,
                  checkpoint_freq: int = 0,
-                 restore_state: Optional[Dict[str, Any]] = None):
+                 restore_state: Optional[Dict[str, Any]] = None,
+                 callbacks: Optional[List] = None):
+        self.callbacks = list(callbacks or [])
         self.trainable = trainable
         self._restore_state = restore_state
         self.is_function = not (isinstance(trainable, type)
@@ -269,6 +271,8 @@ class TuneController:
             trial.pending_ref = trial.actor.train.remote()
         trial.restore_from = None
         trial.status = "RUNNING"
+        for cb in self.callbacks:
+            cb.on_trial_start(trial.iteration, self.trials, trial)
 
     def _stop_trial(self, trial: Trial, status: str = "TERMINATED") -> None:
         trial.status = status
@@ -281,6 +285,11 @@ class TuneController:
                 pass
             trial.actor = None
         trial.pending_ref = None
+        for cb in self.callbacks:
+            if status == "TERMINATED":
+                cb.on_trial_complete(trial.iteration, self.trials, trial)
+            elif status == "ERROR":
+                cb.on_trial_error(trial.iteration, self.trials, trial)
 
     def get_trial(self, trial_id: str) -> Optional[Trial]:
         for t in self.trials:
@@ -325,6 +334,11 @@ class TuneController:
         ckpt = result.pop("_checkpoint", None)
         if ckpt:
             trial.checkpoint_path = ckpt
+        it = result.get("training_iteration", 0)
+        for cb in self.callbacks:
+            cb.on_trial_result(it, self.trials, trial, result)
+            if ckpt:
+                cb.on_checkpoint(it, self.trials, trial, ckpt)
         self.searcher.on_trial_result(trial.trial_id, result)
         decision = self.scheduler.on_trial_result(self, trial, result)
         if self._should_stop(trial, result):
@@ -461,6 +475,8 @@ class TuneController:
                         trial.pending_ref = trial.actor.train.remote()
             self.save_experiment_state()
         self.save_experiment_state()
+        for cb in self.callbacks:
+            cb.on_experiment_end(self.trials)
         return self.trials
 
 

@@ -170,6 +170,7 @@ class PopulationBasedTraining(TrialScheduler):
     def on_trial_result(self, controller, trial, result) -> str:
         t = result.get(self.time_attr, 0)
         score = self._score(result)
+        self._observe(trial, t, score)
         self._latest[trial.trial_id] = score
         last = self._last_perturb.get(trial.trial_id, 0)
         if t - last < self.interval:
@@ -189,3 +190,93 @@ class PopulationBasedTraining(TrialScheduler):
                 new_config = self._mutate(donor.config)
                 controller.exploit_trial(trial, donor, new_config)
         return self.CONTINUE
+
+    def _observe(self, trial, t, score) -> None:
+        """Hook for model-based variants (PB2)."""
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with GP-guided exploration (reference: pb2.py / the PB2 paper
+    "Provably Efficient Online Hyperparameter Optimization with
+    Population-Based Bandits"): instead of random perturbation, fit a GP
+    to (hyperparams -> score improvement) observations across the
+    population and pick the next config by UCB within bounds. Numpy-only
+    GP (RBF kernel) — no sklearn/GPy dependency.
+    """
+
+    def __init__(self, metric=None, mode=None,
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 1.0,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode, time_attr, perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction,
+                         resample_probability=0.0, seed=seed)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds="
+                             "{name: (low, high)}")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self._np_rng = np.random.RandomState(seed or 0)
+        # (normalized config vector, score delta) observations
+        self._X: list = []
+        self._y: list = []
+        self._prev_score: Dict[str, float] = {}
+
+    # ---- observation stream ----
+    def _vec(self, config: Dict[str, Any]) -> np.ndarray:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / max(hi - lo, 1e-12))
+        return np.asarray(out, np.float64)
+
+    def _observe(self, trial, t, score) -> None:
+        prev = self._prev_score.get(trial.trial_id)
+        self._prev_score[trial.trial_id] = score
+        if prev is None:
+            return
+        self._X.append(self._vec(trial.config))
+        self._y.append(score - prev)
+        if len(self._X) > 512:  # bound GP cost
+            self._X = self._X[-512:]
+            self._y = self._y[-512:]
+
+    # ---- GP-UCB selection replaces random mutation ----
+    def _mutate(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        new = dict(config)
+        n_cand = 128
+        cand = self._np_rng.uniform(size=(n_cand, len(self.bounds)))
+        if len(self._X) >= 4:
+            X = np.stack(self._X)
+            y = np.asarray(self._y, np.float64)
+            ystd = y.std() or 1.0
+            yn = (y - y.mean()) / ystd
+
+            def rbf(a, b, ls=0.3):
+                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+                return np.exp(-0.5 * d2 / ls ** 2)
+
+            K = rbf(X, X) + 1e-2 * np.eye(len(X))
+            Ks = rbf(cand, X)
+            try:
+                Kinv_y = np.linalg.solve(K, yn)
+                mu = Ks @ Kinv_y
+                Kinv_Ks = np.linalg.solve(K, Ks.T)
+                var = np.maximum(1e-12, 1.0 - (Ks * Kinv_Ks.T).sum(-1))
+                ucb = mu + self.kappa * np.sqrt(var)
+                best = cand[int(np.argmax(ucb))]
+            except np.linalg.LinAlgError:
+                best = cand[0]
+        else:
+            best = cand[0]
+        for i, (k, (lo, hi)) in enumerate(self.bounds.items()):
+            val = lo + best[i] * (hi - lo)
+            if isinstance(config.get(k), int):
+                val = int(round(val))
+            new[k] = val
+        return new

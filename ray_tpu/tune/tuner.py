@@ -148,6 +148,7 @@ class Tuner:
             trial_resources=tc.trial_resources,
             checkpoint_freq=tc.checkpoint_freq,
             restore_state=getattr(self, "_restore_state", None),
+            callbacks=rc.callbacks,
         )
         trials = controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)
