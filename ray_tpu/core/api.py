@@ -42,6 +42,7 @@ def init(
     address: Optional[str] = None,
     cluster_key: Optional[str] = None,
     storage: Optional[str] = None,
+    local_mode: bool = False,
     **_kwargs,
 ):
     """Start a single-node cluster in-process and connect the driver —
@@ -54,6 +55,16 @@ def init(
         if ignore_reinit_error:
             return runtime_mod.get_current_runtime()
         raise RuntimeError("ray_tpu.init() called twice")
+    if local_mode:
+        # inline debugging mode (reference: ray.init(local_mode=True)) —
+        # tasks/actors execute synchronously in this process
+        from .local_mode import LocalModeRuntime
+
+        _namespace = namespace
+        rt = LocalModeRuntime(namespace)
+        runtime_mod.set_current_runtime(rt)
+        object_ref_mod.set_runtime(rt)
+        return rt
     address = address or os.environ.get("RAY_TPU_ADDRESS")
     if address and address not in ("local", "auto"):
         from .client_runtime import ClientRuntime
@@ -92,7 +103,7 @@ def shutdown():
         return
     runtime_mod.set_current_runtime(None)
     object_ref_mod.set_runtime(None)
-    if getattr(rt, "mode", None) == "CLIENT":
+    if getattr(rt, "mode", None) in ("CLIENT", "LOCAL"):
         rt.disconnect()
         return
     if _head is not None:

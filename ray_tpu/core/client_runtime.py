@@ -32,6 +32,9 @@ class ClientRuntime:
         if tag != "welcome":
             raise ConnectionError(f"bad handshake from client server: {tag}")
         welcome = payload[0]
+        from .protocol import check_protocol
+
+        check_protocol(welcome)
         self.job_id = welcome["job_id"]
         self._node_id = welcome["node_id"]
         self._driver_task_id = welcome["driver_task_id"]

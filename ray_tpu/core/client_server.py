@@ -248,11 +248,14 @@ class ClientServer:
                 continue
             ch = Channel(conn)
             sess = _ClientSession(self, ch)
+            from .protocol import PROTOCOL_VERSION
+
             try:
                 ch.send("welcome", {
                     "job_id": sess.job_id,
                     "node_id": self.head.head_node.hex,
                     "driver_task_id": sess.driver_task_id,
+                    "proto": PROTOCOL_VERSION,
                 })
             except Exception:
                 continue

@@ -1,0 +1,324 @@
+"""Local mode: the whole API surface executed inline in the driver.
+
+Reference: ``ray.init(local_mode=True)``
+(python/ray/_private/worker.py LOCAL_MODE) — tasks run synchronously in
+the calling process at ``.remote()`` time, actors are plain in-process
+objects, and objects live in a dict. No workers, no scheduler, no
+subprocesses: breakpoints and stack traces behave like ordinary Python,
+which is the entire point. Semantics preserved: results arrive as
+ObjectRefs, exceptions re-raise at ``get()``, streaming generators yield
+per-item refs, named actors resolve, kv works.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import serialization
+from .exceptions import ActorDiedError, GetTimeoutError, TaskError
+from .ids import ActorID, JobID, ObjectID, TaskID
+
+
+class _StoredError:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+class LocalModeRuntime:
+    def __init__(self, namespace: str = "default"):
+        self.job_id = JobID.from_random()
+        self._driver_task_id = TaskID.for_driver_task(self.job_id)
+        self._objects: Dict[ObjectID, Any] = {}
+        self._functions: Dict[str, bytes] = {}
+        self._fn_cache: Dict[str, Any] = {}
+        self._actors: Dict[ActorID, Any] = {}
+        self._dead_actors: set = set()
+        self._named: Dict[tuple, ActorID] = {}
+        self._actor_meta: Dict[ActorID, dict] = {}
+        self._streams: Dict[TaskID, dict] = {}
+        self._kv: Dict[tuple, bytes] = {}
+        self._namespace = namespace
+        self._put_counter = 0
+        self._lock = threading.RLock()
+
+    # ---- identity ---------------------------------------------------------
+    @property
+    def mode(self) -> str:
+        return "LOCAL"
+
+    def is_initialized(self) -> bool:
+        return True
+
+    def next_task_id(self) -> TaskID:
+        return TaskID.from_random()
+
+    def runtime_context(self) -> dict:
+        return {
+            "job_id": self.job_id, "node_id": "local",
+            "worker_id": b"local-driver", "task_id": self._driver_task_id,
+            "actor_id": None, "accelerator_ids": {}, "mode": "LOCAL",
+        }
+
+    # ---- objects ----------------------------------------------------------
+    def put(self, value: Any, _owner=None):
+        from .object_ref import ObjectRef
+
+        with self._lock:
+            self._put_counter += 1
+            oid = ObjectID.for_put(self._driver_task_id, self._put_counter)
+            self._objects[oid] = value
+        return ObjectRef(oid)
+
+    def get(self, refs, timeout: Optional[float] = None) -> List[Any]:
+        out = []
+        for r in refs:
+            with self._lock:
+                if r.id not in self._objects:
+                    raise GetTimeoutError(
+                        f"local mode: object {r.id.hex()} was never "
+                        f"produced")
+                v = self._objects[r.id]
+            if isinstance(v, _StoredError):
+                raise v.exc
+            out.append(v)
+        return out
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        with self._lock:
+            ready = [r for r in refs if r.id in self._objects]
+        return ready[:num_returns], [r for r in refs
+                                     if r not in ready[:num_returns]]
+
+    # ---- functions --------------------------------------------------------
+    def register_function(self, function_id: str, payload: bytes) -> None:
+        self._functions[function_id] = payload
+
+    def get_function(self, function_id: str):
+        import pickle
+
+        if function_id not in self._fn_cache:
+            self._fn_cache[function_id] = pickle.loads(
+                self._functions[function_id])
+        return self._fn_cache[function_id]
+
+    # ---- execution --------------------------------------------------------
+    def _resolve(self, packed):
+        kind, payload = packed
+        if kind == "ref":
+            with self._lock:
+                if payload not in self._objects:
+                    raise GetTimeoutError(
+                        f"local mode: arg object {payload.hex()} was never "
+                        f"produced")
+                v = self._objects[payload]
+            if isinstance(v, _StoredError):
+                raise v.exc
+            return v
+        return serialization.deserialize(payload)
+
+    def _resolve_args(self, spec):
+        args = [self._resolve(a) for a in spec.args]
+        kwargs = {k: self._resolve(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def _store_results(self, spec, value) -> list:
+        from .object_ref import ObjectRef
+
+        rids = spec.return_ids()
+        with self._lock:
+            if spec.num_returns == 0:
+                pass
+            elif spec.num_returns == 1:
+                self._objects[rids[0]] = value
+            else:
+                vals = list(value)
+                if len(vals) != spec.num_returns:
+                    raise TaskError(
+                        spec.function_name,
+                        f"task returned {len(vals)} values, expected "
+                        f"num_returns={spec.num_returns}")
+                for oid, v in zip(rids, vals):
+                    self._objects[oid] = v
+        return [ObjectRef(oid) for oid in rids]
+
+    def _execute(self, spec, fn) -> list:
+        """Run a task or actor method inline; store results or the error.
+        The one execution body (tasks and actor methods must not drift)."""
+        import inspect
+
+        try:
+            args, kwargs = self._resolve_args(spec)
+            if spec.streaming:
+                gen = fn(*args, **kwargs)
+                items = []
+                with self._lock:
+                    rec = self._streams[spec.task_id] = {
+                        "items": items, "done": False, "error": False}
+                try:
+                    for i, item in enumerate(gen):
+                        oid = ObjectID.for_stream(spec.task_id, i)
+                        with self._lock:
+                            self._objects[oid] = item
+                            items.append(oid)
+                except BaseException:
+                    rec["error"] = True
+                    raise
+                finally:
+                    rec["done"] = True
+                return self._store_results(spec, len(items))
+            result = fn(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                import asyncio
+
+                result = asyncio.run(result)  # loop closed deterministically
+            return self._store_results(spec, result)
+        except BaseException as e:  # noqa: BLE001
+            return self._store_err(spec, e)
+
+    def submit_task(self, spec) -> list:
+        return self._execute(spec, self.get_function(spec.function_id))
+
+    def _store_err(self, spec, e) -> list:
+        from .object_ref import ObjectRef
+
+        import traceback
+
+        err = e if isinstance(e, (TaskError, ActorDiedError)) else TaskError(
+            spec.function_name, traceback.format_exc(), cause=e)
+        with self._lock:
+            for oid in spec.return_ids():
+                self._objects[oid] = _StoredError(err)
+        return [ObjectRef(oid) for oid in spec.return_ids()]
+
+    def stream_next(self, task_id, index: int, timeout=None):
+        with self._lock:
+            rec = self._streams.get(task_id)
+            if rec is None:
+                return ("end",)
+            if index < len(rec["items"]):
+                return ("item", rec["items"][index])
+            if rec.get("error"):
+                return ("error",)  # consumer re-raises via the primary ref
+            return ("end",) if rec["done"] else ("wait",)
+
+    # ---- actors -----------------------------------------------------------
+    def create_actor_record(self, spec, name, namespace, max_restarts,
+                            detached) -> None:
+        with self._lock:
+            if name and (namespace, name) in self._named:
+                raise ValueError(
+                    f"actor name {name!r} already taken in namespace "
+                    f"{namespace!r}")
+        cls = self.get_function(spec.function_id)
+        args, kwargs = self._resolve_args(spec)
+        instance = cls(*args, **kwargs)
+        with self._lock:
+            self._actors[spec.actor_id] = instance
+            self._actor_meta[spec.actor_id] = {
+                "class_name": getattr(cls, "__name__", "Actor"),
+                "name": name, "namespace": namespace,
+            }
+            if name:
+                self._named[(namespace, name)] = spec.actor_id
+
+    def actor_method_call(self, spec) -> list:
+        with self._lock:
+            instance = self._actors.get(spec.actor_id)
+        if instance is None:
+            return self._store_err(
+                spec, ActorDiedError(spec.actor_id, "actor is dead"))
+        method_name = spec.function_name.rsplit(".", 1)[-1]
+        return self._execute(spec, getattr(instance, method_name))
+
+    def get_actor_info(self, name: str, namespace: str):
+        with self._lock:
+            aid = self._named.get((namespace, name))
+            if aid is None or aid in self._dead_actors:
+                return None
+            meta = self._actor_meta[aid]
+        return {"actor_id": aid, "class_name": meta["class_name"],
+                "max_task_retries": 0}
+
+    def kill_actor(self, actor_id, no_restart: bool = True):
+        with self._lock:
+            self._actors.pop(actor_id, None)
+            self._dead_actors.add(actor_id)
+            for k, v in list(self._named.items()):
+                if v == actor_id:
+                    del self._named[k]
+
+    def cancel_task(self, oid, force: bool = False):
+        pass  # tasks already ran inline; nothing in flight to cancel
+
+    # ---- refs: no-ops (everything lives until shutdown) -------------------
+    def add_local_ref(self, oid) -> None:
+        pass
+
+    def remove_local_ref(self, oid) -> None:
+        pass
+
+    def add_borrow_ref(self, oid) -> None:
+        pass
+
+    # ---- cluster info -----------------------------------------------------
+    def kv(self, op: str, *args):
+        if op == "put":
+            key, value = args[0], args[1]
+            ns = args[2] if len(args) > 2 else "default"
+            self._kv[(ns, key)] = value
+            return True
+        if op == "get":
+            key = args[0]
+            ns = args[1] if len(args) > 1 else "default"
+            return self._kv.get((ns, key))
+        if op == "del":
+            key = args[0]
+            ns = args[1] if len(args) > 1 else "default"
+            return self._kv.pop((ns, key), None) is not None
+        if op == "keys":
+            prefix = args[0]
+            ns = args[1] if len(args) > 1 else "default"
+            return [k for (n, k) in self._kv if n == ns
+                    and k.startswith(prefix)]
+        if op == "exists":
+            key = args[0]
+            ns = args[1] if len(args) > 1 else "default"
+            return (ns, key) in self._kv
+        raise ValueError(f"unknown kv op {op!r}")
+
+    def available_resources(self):
+        import os
+
+        return {"CPU": float(os.cpu_count() or 1)}
+
+    def cluster_resources(self):
+        return self.available_resources()
+
+    def nodes(self):
+        return [{"NodeID": "local", "Alive": True,
+                 "Resources": self.cluster_resources(), "Labels": {}}]
+
+    def create_placement_group(self, bundles, strategy, name=""):
+        from .ids import PlacementGroupID
+
+        return PlacementGroupID.from_random()
+
+    def placement_group_op(self, op: str, *args):
+        if op == "ready" or op == "wait":
+            return True
+        return None
+
+    def state_list(self, kind: str, limit: int = 1000):
+        if kind == "nodes":
+            return [{"node_id": "local", "alive": True,
+                     "resources": self.cluster_resources(), "labels": {}}]
+        return []
+
+    def disconnect(self) -> None:
+        with self._lock:
+            self._objects.clear()
+            self._actors.clear()

@@ -278,6 +278,9 @@ def main(argv=None) -> int:
     channel.send("hello", {})
     tag, (welcome,) = channel.recv()
     assert tag == "welcome", tag
+    from .protocol import check_protocol
+
+    check_protocol(welcome)
     # adopt the head's config so scheduler/store thresholds agree cluster-wide
     set_global_config(Config.from_json(welcome["config"]))
 

@@ -30,6 +30,27 @@ from multiprocessing import connection as mpc
 from typing import Any, Callable, Dict, Optional, Tuple
 
 
+# Wire protocol version, carried in every welcome handshake (node daemon
+# join, client-driver connect). Bump on any incompatible change to message
+# tags/payload shapes — mixed-version clusters fail fast with a clear
+# error instead of unpickling garbage (the pickle-schema analog of the
+# reference's versioned protobuf wire format, src/ray/protobuf/).
+PROTOCOL_VERSION = 1
+
+
+class ProtocolVersionError(ConnectionError):
+    def __init__(self, theirs, ours=PROTOCOL_VERSION):
+        super().__init__(
+            f"wire protocol mismatch: peer speaks v{theirs}, this process "
+            f"speaks v{ours}; upgrade both sides to the same ray_tpu")
+
+
+def check_protocol(welcome: dict) -> None:
+    theirs = welcome.get("proto", 0)
+    if theirs != PROTOCOL_VERSION:
+        raise ProtocolVersionError(theirs)
+
+
 class Channel:
     """Thread-safe duplex message channel over a multiprocessing Connection."""
 

@@ -306,10 +306,13 @@ class Head:
             tag, _ = channel.recv()
             assert tag == "hello"
             node_id = NodeID.from_random()
+            from .protocol import PROTOCOL_VERSION
+
             channel.send("welcome", {
                 "node_hex": node_id.hex(),
                 "job_id": self.job_id.binary(),
                 "config": global_config().to_json(),
+                "proto": PROTOCOL_VERSION,
             })
             tag, (ready,) = channel.recv()
             assert tag == "node_ready"

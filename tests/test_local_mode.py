@@ -1,0 +1,129 @@
+"""Local mode (init(local_mode=True)): inline execution with full API
+semantics. Reference: ray.init(local_mode=True) debugging mode tests."""
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def local_mode():
+    ray_tpu.init(local_mode=True)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestLocalMode:
+    def test_tasks_and_objects(self, local_mode):
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        ref = ray_tpu.put(40)
+        assert ray_tpu.get(add.remote(ref, 2)) == 42
+        # chained refs
+        assert ray_tpu.get(add.remote(add.remote(1, 2), 3)) == 6
+        ready, not_ready = ray_tpu.wait([add.remote(1, 1)], num_returns=1)
+        assert len(ready) == 1 and not not_ready
+
+    def test_errors_reraise_at_get(self, local_mode):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("bad")
+
+        ref = boom.remote()  # executes inline but defers the raise
+        with pytest.raises(Exception):
+            ray_tpu.get(ref)
+
+    def test_actors_and_named_actors(self, local_mode):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self, start=0):
+                self.n = start
+
+            def add(self, k):
+                self.n += k
+                return self.n
+
+        c = Counter.options(name="ctr").remote(10)
+        assert ray_tpu.get(c.add.remote(5)) == 15
+        c2 = ray_tpu.get_actor("ctr")
+        assert ray_tpu.get(c2.add.remote(1)) == 16
+        ray_tpu.kill(c)
+        with pytest.raises(Exception):
+            ray_tpu.get_actor("ctr")
+
+    def test_multiple_returns(self, local_mode):
+        @ray_tpu.remote(num_returns=2)
+        def pair():
+            return 1, 2
+
+        a, b = pair.remote()
+        assert ray_tpu.get(a) == 1 and ray_tpu.get(b) == 2
+
+    def test_streaming_generator(self, local_mode):
+        @ray_tpu.remote(num_returns="streaming")
+        def gen(n):
+            for i in range(n):
+                yield i * i
+
+        out = [ray_tpu.get(r) for r in gen.remote(4)]
+        assert out == [0, 1, 4, 9]
+
+    def test_nested_tasks(self, local_mode):
+        @ray_tpu.remote
+        def inner(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def outer(x):
+            return ray_tpu.get(inner.remote(x)) + 1
+
+        assert ray_tpu.get(outer.remote(10)) == 21
+
+    def test_cluster_info(self, local_mode):
+        assert ray_tpu.cluster_resources().get("CPU", 0) >= 1
+        assert ray_tpu.nodes()[0]["Alive"]
+
+    def test_streaming_midstream_error_surfaces(self, local_mode):
+        @ray_tpu.remote(num_returns="streaming")
+        def gen():
+            yield 1
+            yield 2
+            raise ValueError("mid-stream")
+
+        it = gen.remote()
+        got = []
+        with pytest.raises(Exception):
+            for r in it:
+                got.append(ray_tpu.get(r))
+        assert got == [1, 2]
+
+    def test_duplicate_named_actor_rejected(self, local_mode):
+        @ray_tpu.remote
+        class A:
+            pass
+
+        A.options(name="dup").remote()
+        with pytest.raises(ValueError):
+            A.options(name="dup").remote()
+
+    def test_num_returns_mismatch_is_clear_error(self, local_mode):
+        @ray_tpu.remote(num_returns=3)
+        def two():
+            return 1, 2
+
+        refs = two.remote()
+        with pytest.raises(Exception, match="expected num_returns"):
+            ray_tpu.get(refs[0])
+
+
+def test_protocol_version_check():
+    from ray_tpu.core.protocol import (PROTOCOL_VERSION,
+                                       ProtocolVersionError, check_protocol)
+
+    check_protocol({"proto": PROTOCOL_VERSION})  # no raise
+    with pytest.raises(ProtocolVersionError):
+        check_protocol({"proto": PROTOCOL_VERSION + 1})
+    with pytest.raises(ProtocolVersionError):
+        check_protocol({})  # pre-versioning peer
