@@ -166,9 +166,13 @@ class ActorClass:
         opt = self._options
         actor_id = ActorID.from_random()
         out_args, out_kwargs, keepalive = prepare_args(runtime, args, kwargs)
-        num_cpus = opt.get("num_cpus")
+        explicit_cpus = opt.get("num_cpus")
+        num_cpus = explicit_cpus
         if num_cpus is None:
-            # reference semantics: actors default to 1 CPU for creation+life
+            # reference semantics (ray_option_utils actor defaults): 1 CPU
+            # to *schedule* the creation, 0 CPUs held while alive — the
+            # implicit CPU is returned once __init__ succeeds (see
+            # retained_resources below)
             num_cpus = 1 if not (opt.get("num_tpus") or opt.get("num_gpus")
                                  or opt.get("resources")) else 0
         spec = TaskSpec(
@@ -186,6 +190,16 @@ class ActorClass:
                 resources=opt.get("resources"),
                 memory=opt.get("memory"),
                 default_num_cpus=1.0,
+            ),
+            # lifetime reservation: only EXPLICIT asks persist — the
+            # implicit scheduling CPU returns after creation
+            retained_resources=parse_task_resources(
+                num_cpus=explicit_cpus if explicit_cpus is not None else 0,
+                num_tpus=opt.get("num_tpus"),
+                num_gpus=opt.get("num_gpus"),
+                resources=opt.get("resources"),
+                memory=opt.get("memory"),
+                default_num_cpus=0.0,
             ),
             max_retries=0,
             scheduling_strategy=resolve_scheduling_strategy(

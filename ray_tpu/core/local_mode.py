@@ -145,28 +145,31 @@ class LocalModeRuntime:
                     self._objects[oid] = v
         return [ObjectRef(oid) for oid in rids]
 
-    def _execute(self, spec, fn) -> list:
+    def _execute(self, spec, fn_thunk) -> list:
         """Run a task or actor method inline; store results or the error.
-        The one execution body (tasks and actor methods must not drift)."""
+        ``fn_thunk`` resolves the callable INSIDE the try so lookup errors
+        (missing method, dead class) defer to get() like every other
+        failure. The one execution body (tasks and actor methods must not
+        drift)."""
         import inspect
 
+        if spec.streaming:
+            # record exists before anything can fail, so a pre-iteration
+            # error surfaces as ("error",) — not a silently empty stream
+            with self._lock:
+                rec = self._streams[spec.task_id] = {
+                    "items": [], "done": False, "error": False}
         try:
+            fn = fn_thunk()
             args, kwargs = self._resolve_args(spec)
             if spec.streaming:
-                gen = fn(*args, **kwargs)
-                items = []
-                with self._lock:
-                    rec = self._streams[spec.task_id] = {
-                        "items": items, "done": False, "error": False}
+                items = rec["items"]
                 try:
-                    for i, item in enumerate(gen):
+                    for i, item in enumerate(fn(*args, **kwargs)):
                         oid = ObjectID.for_stream(spec.task_id, i)
                         with self._lock:
                             self._objects[oid] = item
                             items.append(oid)
-                except BaseException:
-                    rec["error"] = True
-                    raise
                 finally:
                     rec["done"] = True
                 return self._store_results(spec, len(items))
@@ -177,10 +180,14 @@ class LocalModeRuntime:
                 result = asyncio.run(result)  # loop closed deterministically
             return self._store_results(spec, result)
         except BaseException as e:  # noqa: BLE001
+            if spec.streaming:
+                rec["error"] = True
+                rec["done"] = True
             return self._store_err(spec, e)
 
     def submit_task(self, spec) -> list:
-        return self._execute(spec, self.get_function(spec.function_id))
+        return self._execute(spec,
+                             lambda: self.get_function(spec.function_id))
 
     def _store_err(self, spec, e) -> list:
         from .object_ref import ObjectRef
@@ -214,8 +221,20 @@ class LocalModeRuntime:
                     f"actor name {name!r} already taken in namespace "
                     f"{namespace!r}")
         cls = self.get_function(spec.function_id)
-        args, kwargs = self._resolve_args(spec)
-        instance = cls(*args, **kwargs)
+        try:
+            args, kwargs = self._resolve_args(spec)
+            instance = cls(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            # cluster parity: a failing __init__ surfaces as ActorDiedError
+            # at the first method-result get(), not at .remote()
+            with self._lock:
+                self._dead_actors.add(spec.actor_id)
+                self._actor_meta[spec.actor_id] = {
+                    "class_name": getattr(cls, "__name__", "Actor"),
+                    "name": None, "namespace": namespace,
+                    "creation_error": repr(e),
+                }
+            return
         with self._lock:
             self._actors[spec.actor_id] = instance
             self._actor_meta[spec.actor_id] = {
@@ -228,11 +247,14 @@ class LocalModeRuntime:
     def actor_method_call(self, spec) -> list:
         with self._lock:
             instance = self._actors.get(spec.actor_id)
+            meta = self._actor_meta.get(spec.actor_id, {})
         if instance is None:
+            cause = meta.get("creation_error") or "actor is dead"
             return self._store_err(
-                spec, ActorDiedError(spec.actor_id, "actor is dead"))
+                spec, ActorDiedError(spec.actor_id, cause))
         method_name = spec.function_name.rsplit(".", 1)[-1]
-        return self._execute(spec, getattr(instance, method_name))
+        return self._execute(spec,
+                             lambda: getattr(instance, method_name))
 
     def get_actor_info(self, name: str, namespace: str):
         with self._lock:

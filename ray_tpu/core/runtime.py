@@ -60,6 +60,9 @@ class TaskRecord:
     # each attempt settles exactly once and releases resources exactly once
     settling: bool = False
     released: bool = False
+    # actor creation only: scheduling-only resources were already returned
+    # (death/restart must then release retained_resources, not the full set)
+    shrunk: bool = False
 
 
 @dataclass
@@ -607,7 +610,10 @@ class Head:
         if not (spec.actor_id is None or spec.is_actor_creation):
             return None
         if spec.is_actor_creation and err_name is None:
-            return None  # successful creation keeps its resources
+            # successful creation keeps its LIFETIME resources; the
+            # scheduling-only portion (the implicit CPU) returns now
+            self._shrink_actor_reservation(rec, spec)
+            return None
         with self._lock:
             if rec.released:
                 return None
@@ -835,6 +841,59 @@ class Head:
             if rec is not None:
                 self._fail_task_now(rec, ActorDiedError(spec.actor_id, arec.death_cause))
 
+    def _shrink_actor_reservation(self, rec: TaskRecord, spec: TaskSpec) -> None:
+        """Release the scheduling-only part of an actor's reservation
+        (reference semantics: a default actor needs 1 CPU to be placed but
+        holds 0 CPUs while alive — ray_option_utils actor defaults)."""
+        from .resources import ResourceSet
+
+        retained = spec.retained_resources
+        if retained is None:
+            return
+        with self._lock:
+            # released: an actor-death release raced ahead of this
+            # creation-success settle and already returned the FULL
+            # reservation — crediting the delta again would let the
+            # scheduler over-commit the node
+            if rec.shrunk or rec.released:
+                return
+            rec.shrunk = True
+        delta = {k: v - retained._map.get(k, 0)
+                 for k, v in spec.resources._map.items()
+                 if v - retained._map.get(k, 0) > 0}
+        if not delta:
+            return
+        self.scheduler.release_partial(
+            rec.node_hex or "", spec, ResourceSet._from_fixed_map(delta),
+            binding=None)  # unit-instance resources are always retained
+
+    def _actor_release_set(self, crec: Optional[TaskRecord], cspec: TaskSpec):
+        """What an actor's death/restart must return: the retained set if
+        the scheduling-only portion was already released, else the full
+        creation reservation."""
+        if (crec is not None and crec.shrunk
+                and cspec.retained_resources is not None):
+            return cspec.retained_resources
+        return cspec.resources
+
+    def _release_actor_creation(self, arec: ActorRecord) -> None:
+        """Return a dead/restarting actor's reservation to its node or PG
+        bundle — exactly once per incarnation (graceful exit, kill, crash,
+        and restart paths all funnel here)."""
+        cspec = arec.creation_spec
+        if cspec is None:
+            return
+        crec = self.tasks.get(cspec.task_id)
+        if crec is None:
+            return
+        with self._lock:
+            if crec.released:
+                return
+            crec.released = True
+        self.scheduler.release_partial(
+            crec.node_hex or "", cspec,
+            self._actor_release_set(crec, cspec), crec.binding or {})
+
     def _handle_actor_failure(self, arec: ActorRecord, cause: str) -> None:
         """Worker/node hosting the actor died (reference: ReconstructActor)."""
         with self._lock:
@@ -866,11 +925,8 @@ class Head:
         if restart:
             self.gcs.update_actor(arec.actor_id, state="RESTARTING")
             # release old incarnation's resources and resubmit creation
+            self._release_actor_creation(arec)
             cspec = arec.creation_spec
-            crec_old = self.tasks.get(cspec.task_id)
-            if crec_old is not None:
-                self.scheduler.release(crec_old.node_hex or "", cspec,
-                                       crec_old.binding or {})
             import copy
 
             new_spec = copy.deepcopy(cspec)
@@ -882,6 +938,9 @@ class Head:
         else:
             self.gcs.update_actor(arec.actor_id, state="DEAD", death_cause=cause)
             self.gcs.remove_actor_name(arec.actor_id)
+            # a killed/crashed actor's reservation must come back (this
+            # branch previously leaked it)
+            self._release_actor_creation(arec)
             for mspec in pending:
                 rec = self.tasks.get(mspec.task_id)
                 if rec is not None:
@@ -923,11 +982,8 @@ class Head:
             self.gcs.update_actor(w.actor_id, state="DEAD",
                                   death_cause="exited gracefully")
             self.gcs.remove_actor_name(w.actor_id)
-            cspec = arec.creation_spec if arec else None
-            if cspec is not None:
-                crec = self.tasks.get(cspec.task_id)
-                if crec is not None:
-                    self.scheduler.release(crec.node_hex or "", cspec, crec.binding or {})
+            if arec is not None:
+                self._release_actor_creation(arec)
             for mspec in pending:
                 rec = self.tasks.get(mspec.task_id)
                 if rec is not None:

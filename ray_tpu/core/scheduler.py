@@ -174,7 +174,19 @@ class ClusterScheduler:
             self._release_locked(node_hex, spec, binding)
             self._wake.notify_all()
 
-    def _release_locked(self, node_hex: str, spec: TaskSpec, binding: dict) -> None:
+    def release_partial(self, node_hex: str, spec: TaskSpec,
+                        rset: ResourceSet,
+                        binding: Optional[dict] = None) -> None:
+        """Return an explicit subset of a task's reservation — the actor
+        scheduling-only-CPU path (reference: actors need 1 CPU to
+        schedule, hold 0 while alive). PG-aware like release()."""
+        with self._lock:
+            self._release_locked(node_hex, spec, binding, rset=rset)
+            self._wake.notify_all()
+
+    def _release_locked(self, node_hex: str, spec: TaskSpec, binding: dict,
+                        rset: Optional[ResourceSet] = None) -> None:
+        rset = spec.resources if rset is None else rset
         st = spec.scheduling_strategy
         if st.kind == "PLACEMENT_GROUP" and st.placement_group_id in self._pgs:
             pg = self._pgs[st.placement_group_id]
@@ -183,13 +195,13 @@ class ClusterScheduler:
                 # the in-use part comes back directly to the node here
                 nr = self._nodes.get(node_hex)
                 if nr is not None:
-                    nr.release(spec.resources, binding)
+                    nr.release(rset, binding)
             elif 0 <= st.bundle_index < len(pg.bundles):
-                pg.bundles[st.bundle_index].release(spec.resources, binding)
+                pg.bundles[st.bundle_index].release(rset, binding)
         else:
             nr = self._nodes.get(node_hex)
             if nr is not None:
-                nr.release(spec.resources, binding)
+                nr.release(rset, binding)
 
     def complete_and_next(self, node_hex: str, spec: TaskSpec, binding: dict):
         """Release a finished task's resources and, in the same lock hold,

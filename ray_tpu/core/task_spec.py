@@ -42,6 +42,11 @@ class TaskSpec:
     num_returns: int = 1
     streaming: bool = False  # generator task: yields stream via for_stream ids
     resources: ResourceSet = field(default_factory=ResourceSet)
+    # actor creation: the subset of `resources` held for the actor's
+    # LIFETIME; the remainder (the implicit 1 scheduling CPU — reference:
+    # actors need 1 CPU to schedule, 0 while alive) returns to the node
+    # once creation succeeds. None = retain everything.
+    retained_resources: Optional[ResourceSet] = None
     max_retries: int = 3
     retry_exceptions: bool = False
     scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)

@@ -127,3 +127,39 @@ def test_protocol_version_check():
         check_protocol({"proto": PROTOCOL_VERSION + 1})
     with pytest.raises(ProtocolVersionError):
         check_protocol({})  # pre-versioning peer
+
+
+class TestLocalModeDeferredErrors:
+    def test_failing_actor_init_defers_to_get(self, local_mode):
+        @ray_tpu.remote
+        class Broken:
+            def __init__(self):
+                raise RuntimeError("init boom")
+
+            def m(self):
+                return 1
+
+        b = Broken.remote()  # must NOT raise here (cluster parity)
+        with pytest.raises(Exception, match="init boom|dead"):
+            ray_tpu.get(b.m.remote())
+
+    def test_missing_method_defers_to_get(self, local_mode):
+        @ray_tpu.remote
+        class A:
+            def m(self):
+                return 1
+
+        a = A.remote()
+        ref = a.nope.remote()  # must NOT raise here
+        with pytest.raises(Exception):
+            ray_tpu.get(ref)
+
+    def test_streaming_prestart_error_raises(self, local_mode):
+        @ray_tpu.remote(num_returns="streaming")
+        def gen(n):
+            yield n
+
+        it = gen.remote()  # wrong arity: fails before iteration starts
+        with pytest.raises(Exception):
+            for r in it:
+                ray_tpu.get(r)

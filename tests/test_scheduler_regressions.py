@@ -88,3 +88,39 @@ def test_remove_pg_with_running_tasks_no_double_credit(ray_start_regular):
         time.sleep(0.2)
         avail = ray_tpu.available_resources()
     assert avail.get("CPU", 0) == 4.0
+
+
+def test_default_actor_holds_zero_cpus_alive(ray_start_regular):
+    """Reference semantics: a default actor needs 1 CPU to schedule but
+    holds 0 while alive — live actors must not starve plain tasks."""
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return 1
+
+    actors = [A.remote() for _ in range(4)]  # as many as cluster CPUs
+    ray_tpu.get([a.m.remote() for a in actors])
+    assert ray_tpu.available_resources().get("CPU", 0) == 4.0
+    # plain tasks schedule fine with all 4 actors alive
+    f = ray_tpu.remote(lambda x: x * 2)
+    assert sorted(ray_tpu.get([f.remote(i) for i in range(4)],
+                              timeout=60)) == [0, 2, 4, 6]
+
+
+def test_explicit_actor_cpus_held_and_released_on_kill(ray_start_regular):
+    @ray_tpu.remote(num_cpus=2)
+    class B:
+        def m(self):
+            return 1
+
+    b = B.remote()
+    ray_tpu.get(b.m.remote())
+    assert ray_tpu.available_resources().get("CPU", 0) == 2.0
+    ray_tpu.kill(b)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) == 4.0:
+            break
+        time.sleep(0.2)
+    # killed actor's lifetime reservation came back (this leaked before)
+    assert ray_tpu.available_resources().get("CPU", 0) == 4.0
