@@ -250,6 +250,11 @@ class LocalModeRuntime:
             meta = self._actor_meta.get(spec.actor_id, {})
         if instance is None:
             cause = meta.get("creation_error") or "actor is dead"
+            if spec.streaming:
+                # the stream must surface ("error",), not iterate empty
+                with self._lock:
+                    self._streams[spec.task_id] = {
+                        "items": [], "done": True, "error": True}
             return self._store_err(
                 spec, ActorDiedError(spec.actor_id, cause))
         method_name = spec.function_name.rsplit(".", 1)[-1]

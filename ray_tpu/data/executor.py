@@ -47,6 +47,12 @@ class DataContext:
     op_output_queue_cap: int = 32    # bounded queues => backpressure
     actor_pool_size: int = 2
     target_min_rows_per_block: int = 1
+    # per-operator memory budget in bytes (reference: ReservationOp-
+    # ResourceAllocator): dispatch throttles when (in-flight + queued)
+    # blocks x measured-average block size would exceed it. 0 = disabled.
+    # Sizes are measured from head-local store metadata; on multi-node
+    # clusters unmeasured remote blocks fall back to the running average.
+    op_memory_budget: int = 512 * 1024 * 1024
 
     _current: "DataContext" = None
 
@@ -313,6 +319,9 @@ class PhysicalOperator:
         self._seq_in = 0
         self._seq_out = 0
         self._ready_bufs: Dict[int, RefBundle] = {}
+        # measured output block sizes -> per-op memory budget enforcement
+        self._size_samples = 0
+        self._size_total = 0
 
     def _next_seq(self) -> int:
         s = self._seq_in
@@ -352,9 +361,51 @@ class PhysicalOperator:
         progress = False
         for ref in ready:
             ctx = self.pending.pop(ref)
+            # size sampling lives in the shared drain loop, not the
+            # overridable completion hook, so every operator subclass
+            # feeds the memory-budget estimator
+            self._note_output_size(ref)
             self._on_task_done(ref, ctx)
             progress = True
         return progress
+
+    def _note_output_size(self, ref) -> None:
+        try:
+            from ray_tpu.core import runtime as runtime_mod
+
+            rt = runtime_mod.get_current_runtime()
+            head = getattr(rt, "head", None)
+            if head is None:
+                return
+            for h in head.gcs.get_object_locations(ref.id):
+                node = head.nodes.get(h)
+                if node is not None and head._is_local(node):
+                    meta = node.store.read_meta(ref.id)
+                    if meta:
+                        self._size_samples += 1
+                        self._size_total += meta[0]
+                    return
+        except Exception:
+            pass  # sizes are an optimization; never fail the pipeline
+
+    def avg_block_bytes(self) -> Optional[int]:
+        if not self._size_samples:
+            return None
+        return self._size_total // self._size_samples
+
+    def memory_backpressure(self) -> bool:
+        """True when in-flight + queued output blocks would exceed the
+        per-op memory budget. Always admits ONE task so progress is
+        guaranteed regardless of budget vs block size."""
+        budget = self.ctx.op_memory_budget
+        if not budget or not self.pending:
+            return False
+        avg = self.avg_block_bytes()
+        if avg is None or avg <= 0:
+            return False
+        outstanding = (len(self.pending) + len(self.output_queue)
+                       + len(self._ready_bufs))
+        return outstanding * avg > budget
 
     def _on_task_done(self, ref, task_ctx) -> None:
         self._emit(task_ctx, RefBundle(ref))
@@ -394,6 +445,7 @@ class ReadOperator(PhysicalOperator):
         progress = False
         while (self._read_tasks and len(self.pending) < self._max_tasks
                and not out_backpressure
+               and not self.memory_backpressure()
                and len(self.output_queue) + len(self.pending)
                < self.ctx.op_output_queue_cap):
             rt = self._read_tasks.popleft()
@@ -428,6 +480,7 @@ class TaskPoolMapOperator(PhysicalOperator):
         progress = False
         while (self.input_queue and len(self.pending) < self._max_tasks
                and not out_backpressure
+               and not self.memory_backpressure()
                and len(self.output_queue) + len(self.pending)
                < self.ctx.op_output_queue_cap):
             bundle = self.input_queue.popleft()
@@ -478,6 +531,7 @@ class ActorPoolMapOperator(PhysicalOperator):
             self._start()
         progress = False
         while (self.input_queue and self._idle and not out_backpressure
+               and not self.memory_backpressure()
                and len(self.output_queue) + len(self.pending)
                < self.ctx.op_output_queue_cap):
             bundle = self.input_queue.popleft()

@@ -7,6 +7,7 @@ multi-learner gradient sync), PPO.
 """
 
 from .algorithm import Algorithm, EnvRunnerGroup
+from .appo import APPO, APPOConfig
 from .config import AlgorithmConfig
 from .dqn import DQN, DQNConfig, ReplayBuffer
 from .env_runner import SingleAgentEnvRunner, compute_gae
@@ -19,5 +20,5 @@ __all__ = [
     "Algorithm", "AlgorithmConfig", "EnvRunnerGroup",
     "SingleAgentEnvRunner", "compute_gae", "Learner", "LearnerGroup",
     "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
-    "ReplayBuffer", "JaxRLModule", "RLModuleSpec",
+    "APPO", "APPOConfig", "ReplayBuffer", "JaxRLModule", "RLModuleSpec",
 ]

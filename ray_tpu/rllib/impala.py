@@ -36,6 +36,46 @@ class IMPALAConfig(AlgorithmConfig):
         self.minibatch_size: int = 0  # 0 = whole batch per update
 
 
+def vtrace(values, boot, rewards, dones, target_logp, behavior_logp,
+           *, gamma: float, rho_bar: float, pg_rho_bar: float):
+    """V-trace targets + policy-gradient advantages (Espeholt et al.).
+
+    [T, N] time-major inputs; returns (vs, pg_adv, rho), everything
+    stop-gradient'd. IMPORTANT: rho feeds the V-trace TARGETS; without
+    the stop-grad the value loss backprops through rho into the policy
+    with an inverted sign (it lowers vs by lowering the probability of
+    positive-delta actions) and training diverges. Shared by the IMPALA
+    and APPO losses — fix V-trace math HERE, once.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    N = values.shape[1]
+    boot = jax.lax.stop_gradient(boot)
+    rho = jax.lax.stop_gradient(jnp.exp(target_logp - behavior_logp))
+    clipped_rho = jnp.minimum(rho_bar, rho)
+    cs = jnp.minimum(1.0, rho)
+    discounts = gamma * (1.0 - dones)
+    values_sg = jax.lax.stop_gradient(values)
+    next_values = jnp.concatenate([values_sg[1:], boot[None, :]], axis=0)
+    deltas = clipped_rho * (rewards + discounts * next_values - values_sg)
+
+    def backward(acc, xs):
+        delta_t, disc_t, c_t = xs
+        acc = delta_t + disc_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros((N,), jnp.float32),
+        (deltas, discounts, cs), reverse=True)
+    vs = jax.lax.stop_gradient(vs_minus_v + values_sg)
+    vs_next = jnp.concatenate([vs[1:], boot[None, :]], axis=0)
+    pg_adv = jax.lax.stop_gradient(
+        jnp.minimum(pg_rho_bar, rho) * (
+            rewards + discounts * vs_next - values_sg))
+    return vs, pg_adv, rho
+
+
 def impala_loss(config: IMPALAConfig):
     """(module, params, batch) -> (loss, stats) with inline V-trace.
 
@@ -71,36 +111,10 @@ def impala_loss(config: IMPALAConfig):
 
         # bootstrap with V(s_T) under current params
         _, boot = module.forward(params, mb["last_obs"])  # [N]
-        boot = jax.lax.stop_gradient(boot)
 
-        # IMPORTANT: rho feeds the V-trace TARGETS; without the stop-grad
-        # the value loss backprops through rho into the policy with an
-        # inverted sign (it lowers vs by lowering the probability of
-        # positive-delta actions) and training diverges
-        rho = jax.lax.stop_gradient(
-            jnp.exp(target_logp - behavior_logp))
-        clipped_rho = jnp.minimum(rho_bar, rho)
-        cs = jnp.minimum(1.0, rho)
-        discounts = gamma * (1.0 - dones)
-        values_sg = jax.lax.stop_gradient(values)
-        next_values = jnp.concatenate(
-            [values_sg[1:], boot[None, :]], axis=0)
-        deltas = clipped_rho * (
-            rewards + discounts * next_values - values_sg)
-
-        def backward(acc, xs):
-            delta_t, disc_t, c_t = xs
-            acc = delta_t + disc_t * c_t * acc
-            return acc, acc
-
-        _, vs_minus_v = jax.lax.scan(
-            backward, jnp.zeros((N,), jnp.float32),
-            (deltas, discounts, cs), reverse=True)
-        vs = jax.lax.stop_gradient(vs_minus_v + values_sg)
-        vs_next = jnp.concatenate([vs[1:], boot[None, :]], axis=0)
-        pg_adv = jnp.minimum(pg_rho_bar, rho) * (
-            rewards + discounts * vs_next - values_sg)
-        pg_adv = jax.lax.stop_gradient(pg_adv)
+        vs, pg_adv, rho = vtrace(
+            values, boot, rewards, dones, target_logp, behavior_logp,
+            gamma=gamma, rho_bar=rho_bar, pg_rho_bar=pg_rho_bar)
 
         w = valid / jnp.maximum(valid.sum(), 1.0)
         policy_loss = -(target_logp * pg_adv * w).sum()

@@ -163,3 +163,17 @@ class TestLocalModeDeferredErrors:
         with pytest.raises(Exception):
             for r in it:
                 ray_tpu.get(r)
+
+    def test_streaming_on_dead_actor_raises(self, local_mode):
+        @ray_tpu.remote
+        class G:
+            def gen(self, n):
+                yield n
+
+        g = G.remote()
+        ray_tpu.kill(g)
+        # streaming call on a dead actor must raise, not iterate empty
+        stream = g.gen.options(num_returns="streaming").remote(1)
+        with pytest.raises(Exception):
+            for r in stream:
+                ray_tpu.get(r)
