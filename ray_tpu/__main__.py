@@ -143,6 +143,13 @@ def main(argv=None) -> int:
     ls.add_argument("--address", default="http://127.0.0.1:8265")
     ls.add_argument("--limit", type=int, default=100)
 
+    up = sub.add_parser("up", help="launch a cluster from a YAML spec")
+    up.add_argument("config", help="cluster YAML path")
+    dn = sub.add_parser("down", help="tear down a launched cluster")
+    dn.add_argument("config")
+    cs = sub.add_parser("cluster-status", help="status of a launched cluster")
+    cs.add_argument("config")
+
     argv = list(sys.argv[1:] if argv is None else argv)
     # `start` hands everything through to the daemon parser directly
     # (argparse REMAINDER chokes on a leading --flag)
@@ -170,6 +177,23 @@ def main(argv=None) -> int:
         if args.kind == "jobs":
             args.kind = "jobs/"
         return _cmd_list(args)
+    if args.cmd == "up":
+        from ray_tpu.cluster_launcher import up as _up
+
+        _up(args.config)
+        return 0
+    if args.cmd == "down":
+        from ray_tpu.cluster_launcher import down as _down
+
+        return 0 if _down(args.config) else 1
+    if args.cmd == "cluster-status":
+        from ray_tpu.cluster_launcher import status as _status
+
+        try:
+            print(json.dumps(_status(args.config), indent=2))
+        except BrokenPipeError:  # `| head` closed the pipe
+            pass
+        return 0
     p.print_help()
     return 0
 

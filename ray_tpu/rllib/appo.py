@@ -27,7 +27,7 @@ class APPOConfig(AlgorithmConfig):
         self.clip_rho_threshold: float = 1.0  # V-trace target clip
         self.grad_clip: float = 40.0
         self.num_epochs: int = 1
-        self.minibatch_size: int = 0
+        self.minibatch_size: int = 0  # must stay 0 (whole sequence batch)
 
 
 def appo_loss(config: APPOConfig):

@@ -33,7 +33,9 @@ class IMPALAConfig(AlgorithmConfig):
         self.clip_pg_rho_threshold: float = 1.0
         self.grad_clip: float = 40.0
         self.num_epochs: int = 1  # IMPALA consumes each batch once
-        self.minibatch_size: int = 0  # 0 = whole batch per update
+        # must stay 0: time-major sequence batches are consumed whole
+        # (training_step raises on a non-zero value)
+        self.minibatch_size: int = 0
 
 
 def vtrace(values, boot, rewards, dones, target_logp, behavior_logp,
@@ -194,6 +196,10 @@ class IMPALA(Algorithm):
                 "logp": batch["logp"],
                 "last_obs": batch["last_obs"],
             }
+            if cfg.minibatch_size:
+                raise ValueError(
+                    "IMPALA/APPO consume whole time-major sequence "
+                    "batches; minibatch_size must stay 0")
             learner_stats = self.learner_group.update(
                 seq, num_epochs=cfg.num_epochs,
                 minibatch_size=0, seed=self._iteration,

@@ -9,13 +9,18 @@ serves requests with continuous batching via @serve.batch.
 from ray_tpu.serve.api import (  # noqa: F401
     delete,
     get_deployment_handle,
+    get_grpc_ingress,
     run,
     shutdown,
     start,
     status,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
-from ray_tpu.serve.config import AutoscalingConfig, HTTPOptions  # noqa: F401
+from ray_tpu.serve.config import (  # noqa: F401
+    AutoscalingConfig,
+    HTTPOptions,
+    gRPCOptions,
+)
 from ray_tpu.serve.deployment import Application, Deployment, deployment  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from ray_tpu.serve.multiplex import (  # noqa: F401
@@ -29,4 +34,5 @@ __all__ = [
     "status", "delete", "get_deployment_handle", "DeploymentHandle",
     "DeploymentResponse", "AutoscalingConfig", "HTTPOptions", "batch",
     "Request", "multiplexed", "get_multiplexed_model_id",
+    "gRPCOptions", "get_grpc_ingress",
 ]

@@ -19,12 +19,14 @@ from .proxy import HTTPProxy
 
 _controller = None
 _proxy: Optional[HTTPProxy] = None
+_grpc = None  # GRPCIngress when start() is given grpc_options
 
 
 def start(http_options: Optional[HTTPOptions] = None,
-          detached: bool = True):
-    """Start the Serve instance (controller actor + HTTP proxy)."""
-    global _controller, _proxy
+          detached: bool = True, grpc_options=None):
+    """Start the Serve instance (controller actor + HTTP proxy; with
+    ``grpc_options`` also the generic gRPC ingress)."""
+    global _controller, _proxy, _grpc
     if _controller is None:
         from .controller import ServeController
 
@@ -34,7 +36,18 @@ def start(http_options: Optional[HTTPOptions] = None,
     if _proxy is None:
         opts = http_options or HTTPOptions()
         _proxy = HTTPProxy(_controller, opts.host, opts.port)
+    if grpc_options is not None and _grpc is None:
+        from .grpc_ingress import GRPCIngress
+
+        _grpc = GRPCIngress(_controller, grpc_options.host,
+                            grpc_options.port,
+                            default_timeout_s=grpc_options.request_timeout_s)
     return _controller
+
+
+def get_grpc_ingress():
+    """The running GRPCIngress (None unless start() got grpc_options)."""
+    return _grpc
 
 
 def _deploy_one(app_or_dep, route_prefix: Optional[str],
@@ -122,7 +135,10 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
-    global _controller, _proxy
+    global _controller, _proxy, _grpc
+    if _grpc is not None:
+        _grpc.shutdown()
+        _grpc = None
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None

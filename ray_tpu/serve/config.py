@@ -27,6 +27,15 @@ class HTTPOptions:
 
 
 @dataclass
+class gRPCOptions:
+    """gRPC ingress config (reference: serve.config.gRPCOptions)."""
+
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = ephemeral
+    request_timeout_s: float = 60.0
+
+
+@dataclass
 class DeploymentConfig:
     name: str
     num_replicas: int = 1
