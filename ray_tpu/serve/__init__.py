@@ -10,6 +10,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     delete,
     get_deployment_handle,
     get_grpc_ingress,
+    get_proxy_addresses,
     run,
     shutdown,
     start,
@@ -34,5 +35,5 @@ __all__ = [
     "status", "delete", "get_deployment_handle", "DeploymentHandle",
     "DeploymentResponse", "AutoscalingConfig", "HTTPOptions", "batch",
     "Request", "multiplexed", "get_multiplexed_model_id",
-    "gRPCOptions", "get_grpc_ingress",
+    "gRPCOptions", "get_grpc_ingress", "get_proxy_addresses",
 ]

@@ -36,6 +36,8 @@ def start(http_options: Optional[HTTPOptions] = None,
     if _proxy is None:
         opts = http_options or HTTPOptions()
         _proxy = HTTPProxy(_controller, opts.host, opts.port)
+        if opts.proxy_location == "EveryNode":
+            _spawn_node_proxies(opts)
     if grpc_options is not None and _grpc is None:
         from .grpc_ingress import GRPCIngress
 
@@ -48,6 +50,118 @@ def start(http_options: Optional[HTTPOptions] = None,
 def get_grpc_ingress():
     """The running GRPCIngress (None unless start() got grpc_options)."""
     return _grpc
+
+
+_proxy_manager = None
+
+
+class _ProxyManager:
+    """Reconciles one ProxyActor per alive node (reference:
+    _private/proxy_state.py — the controller's continuous proxy
+    reconciliation, not a one-shot spawn): nodes joining later get a
+    proxy on the next tick; dead/unresponsive proxies are respawned.
+    Node proxies bind 0.0.0.0 so external load balancers can reach them
+    on the node's address."""
+
+    def __init__(self, controller, tick_s: float = 5.0):
+        import threading
+
+        self._controller = controller
+        self._proxies: dict = {}  # node_id -> actor handle
+        self._tick_s = tick_s
+        self._stop = threading.Event()
+        self.reconcile()  # synchronous first pass: start() fails loudly
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-proxy-reconciler")
+        self._thread.start()
+
+    def _spawn(self, node_id: str):
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+
+        from .proxy import ProxyActor
+
+        cls = ray_tpu.remote(ProxyActor)
+        a = cls.options(
+            num_cpus=0,
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=node_id, soft=False)).remote(
+            self._controller, "0.0.0.0", 0)
+        if not ray_tpu.get(a.ready.remote(), timeout=30):
+            ray_tpu.kill(a)
+            raise RuntimeError(
+                f"proxy on node {node_id} failed to bind (server thread "
+                f"died during startup)")
+        return a
+
+    def reconcile(self) -> None:
+        alive = {n["NodeID"] for n in ray_tpu.nodes() if n.get("Alive")}
+        for nid, a in list(self._proxies.items()):
+            dead = nid not in alive
+            if not dead:
+                try:
+                    ray_tpu.get(a.ready.remote(), timeout=10)
+                except Exception:
+                    dead = True
+            if dead:
+                self._proxies.pop(nid, None)
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        for nid in alive - set(self._proxies):
+            self._proxies[nid] = self._spawn(nid)
+
+    def _loop(self) -> None:
+        import logging
+
+        log = logging.getLogger("ray_tpu.serve")
+        while not self._stop.wait(self._tick_s):
+            try:
+                self.reconcile()
+            except Exception as e:  # noqa: BLE001
+                log.warning("proxy reconcile failed (retrying): %r", e)
+
+    def addresses(self) -> list:
+        out = []
+        for nid, a in list(self._proxies.items()):
+            try:
+                out.append(ray_tpu.get(a.address.remote(), timeout=10))
+            except Exception:
+                pass  # next reconcile respawns it
+        return out
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for a in self._proxies.values():
+            try:
+                ray_tpu.get(a.shutdown.remote(), timeout=5)
+            except Exception:
+                pass
+            finally:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        self._proxies.clear()
+
+
+def _spawn_node_proxies(opts) -> None:
+    global _proxy_manager
+    if _proxy_manager is None:
+        _proxy_manager = _ProxyManager(_controller)
+
+
+def get_proxy_addresses():
+    """[{node_id, host, port}] — per-node proxies under EveryNode (one
+    entry per node, keyed by real node id), else the head proxy."""
+    if _proxy_manager is not None:
+        return _proxy_manager.addresses()
+    if _proxy is not None:
+        ctx = ray_tpu.get_runtime_context()
+        return [{"node_id": ctx.get_node_id(), "host": _proxy.host,
+                 "port": _proxy.port}]
+    return []
 
 
 def _deploy_one(app_or_dep, route_prefix: Optional[str],
@@ -135,10 +249,13 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
-    global _controller, _proxy, _grpc
+    global _controller, _proxy, _grpc, _proxy_manager
     if _grpc is not None:
         _grpc.shutdown()
         _grpc = None
+    if _proxy_manager is not None:
+        _proxy_manager.shutdown()
+        _proxy_manager = None
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None

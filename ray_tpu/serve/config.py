@@ -24,6 +24,10 @@ class AutoscalingConfig:
 class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000
+    # "HeadOnly" (driver-resident proxy) or "EveryNode" (one proxy actor
+    # per alive node, each on an OS-assigned port — reference:
+    # ProxyLocation / proxy_state.py)
+    proxy_location: str = "HeadOnly"
 
 
 @dataclass

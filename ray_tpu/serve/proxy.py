@@ -54,7 +54,10 @@ class HTTPProxy:
         self._runner = None
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
-        self._started.wait(timeout=10)
+        if not self._started.wait(timeout=10):
+            raise RuntimeError(
+                f"HTTP proxy failed to bind {host}:{port} within 10s "
+                f"(server thread died or address unavailable)")
 
     def _get_handle(self, name: str):
         from .handle import DeploymentHandle
@@ -155,6 +158,10 @@ class HTTPProxy:
         loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, self.host, self.port)
         loop.run_until_complete(site.start())
+        # with port=0 report the OS-assigned port (per-node proxies)
+        for s in (site._server.sockets or []):
+            self.port = s.getsockname()[1]
+            break
         self._runner = runner
         self._started.set()
         loop.run_forever()
@@ -171,3 +178,27 @@ class HTTPProxy:
             asyncio.run_coroutine_threadsafe(stop(), loop)
             self._thread.join(timeout=5)
             self._loop = None
+
+
+class ProxyActor:
+    """Per-node HTTP proxy (reference: serve's proxy actors with
+    ProxyLocation.EveryNode — _private/proxy_state.py). The controller
+    spawns one on every alive node with node-affinity scheduling; each
+    binds its own port and registers (node, host, port) so external load
+    balancers can target any node."""
+
+    def __init__(self, controller, host: str = "0.0.0.0", port: int = 0):
+        self._proxy = HTTPProxy(controller, host, port)
+
+    def address(self):
+        import ray_tpu
+
+        node_id = ray_tpu.get_runtime_context().get_node_id()
+        return {"node_id": node_id, "host": self._proxy.host,
+                "port": self._proxy.port}
+
+    def ready(self) -> bool:
+        return self._proxy._started.is_set()
+
+    def shutdown(self) -> None:
+        self._proxy.shutdown()
