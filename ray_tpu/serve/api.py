@@ -33,11 +33,16 @@ def start(http_options: Optional[HTTPOptions] = None,
         _controller = ServeController.options(
             name="SERVE_CONTROLLER", max_concurrency=16).remote()
         ray_tpu.get(_controller.ping.remote())
-    if _proxy is None:
-        opts = http_options or HTTPOptions()
-        _proxy = HTTPProxy(_controller, opts.host, opts.port)
-        if opts.proxy_location == "EveryNode":
+    opts = http_options or HTTPOptions()
+    if opts.proxy_location == "EveryNode":
+        # proxies are per-node actors; no driver-resident proxy (the
+        # reference's ProxyLocation semantics — a second head proxy would
+        # just shadow the actor one on an unadvertised port). Gated on
+        # the manager, not _proxy, so a failed start() can be retried.
+        if _proxy_manager is None:
             _spawn_node_proxies(opts)
+    elif _proxy is None:
+        _proxy = HTTPProxy(_controller, opts.host, opts.port)
     if grpc_options is not None and _grpc is None:
         from .grpc_ingress import GRPCIngress
 
@@ -45,6 +50,10 @@ def start(http_options: Optional[HTTPOptions] = None,
                             grpc_options.port,
                             default_timeout_s=grpc_options.request_timeout_s)
     return _controller
+
+
+def _has_http_ingress() -> bool:
+    return _proxy is not None or _proxy_manager is not None
 
 
 def get_grpc_ingress():
@@ -70,7 +79,10 @@ class _ProxyManager:
         self._proxies: dict = {}  # node_id -> actor handle
         self._tick_s = tick_s
         self._stop = threading.Event()
-        self.reconcile()  # synchronous first pass: start() fails loudly
+        # one reconcile at a time: the ticker and direct callers must not
+        # double-spawn a node's proxy; shutdown excludes reconciles too
+        self._lock = threading.Lock()
+        self.reconcile(raise_on_error=True)  # first pass fails loudly
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-proxy-reconciler")
         self._thread.start()
@@ -94,23 +106,41 @@ class _ProxyManager:
                 f"died during startup)")
         return a
 
-    def reconcile(self) -> None:
-        alive = {n["NodeID"] for n in ray_tpu.nodes() if n.get("Alive")}
-        for nid, a in list(self._proxies.items()):
-            dead = nid not in alive
-            if not dead:
+    def reconcile(self, raise_on_error: bool = False) -> None:
+        import logging
+
+        log = logging.getLogger("ray_tpu.serve")
+        with self._lock:
+            if self._stop.is_set():
+                return
+            alive = {n["NodeID"] for n in ray_tpu.nodes()
+                     if n.get("Alive")}
+            for nid, a in list(self._proxies.items()):
+                dead = nid not in alive
+                if not dead:
+                    try:
+                        ray_tpu.get(a.ready.remote(), timeout=10)
+                    except Exception:
+                        dead = True
+                if dead:
+                    self._proxies.pop(nid, None)
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:
+                        pass
+            errors = []
+            for nid in alive - set(self._proxies):
+                # one bad node must not starve the others of proxies
                 try:
-                    ray_tpu.get(a.ready.remote(), timeout=10)
-                except Exception:
-                    dead = True
-            if dead:
-                self._proxies.pop(nid, None)
-                try:
-                    ray_tpu.kill(a)
-                except Exception:
-                    pass
-        for nid in alive - set(self._proxies):
-            self._proxies[nid] = self._spawn(nid)
+                    self._proxies[nid] = self._spawn(nid)
+                except Exception as e:  # noqa: BLE001
+                    errors.append((nid, e))
+                    log.warning("proxy spawn failed on node %s "
+                                "(next tick retries): %r", nid, e)
+            if errors and raise_on_error:
+                raise RuntimeError(
+                    f"proxy spawn failed on {len(errors)} node(s): "
+                    f"{errors[0][1]!r}")
 
     def _loop(self) -> None:
         import logging
@@ -133,7 +163,10 @@ class _ProxyManager:
 
     def shutdown(self) -> None:
         self._stop.set()
-        for a in self._proxies.values():
+        self._thread.join(timeout=15)  # no reconcile may outlive shutdown
+        with self._lock:
+            proxies, self._proxies = dict(self._proxies), {}
+        for a in proxies.values():
             try:
                 ray_tpu.get(a.shutdown.remote(), timeout=5)
             except Exception:
@@ -143,7 +176,6 @@ class _ProxyManager:
                     ray_tpu.kill(a)
                 except Exception:
                     pass
-        self._proxies.clear()
 
 
 def _spawn_node_proxies(opts) -> None:

@@ -190,11 +190,35 @@ class ProxyActor:
     def __init__(self, controller, host: str = "0.0.0.0", port: int = 0):
         self._proxy = HTTPProxy(controller, host, port)
 
+    @staticmethod
+    def _node_ip() -> str:
+        """This node's routable IP (a 0.0.0.0 bind address is useless to
+        an external load balancer). The UDP-connect trick never sends a
+        packet — it only asks the kernel for the egress interface."""
+        import socket
+
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect(("8.8.8.8", 80))
+                return s.getsockname()[0]
+            finally:
+                s.close()
+        except OSError:
+            pass
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
     def address(self):
         import ray_tpu
 
         node_id = ray_tpu.get_runtime_context().get_node_id()
-        return {"node_id": node_id, "host": self._proxy.host,
+        host = self._proxy.host
+        if host in ("0.0.0.0", "::"):
+            host = self._node_ip()
+        return {"node_id": node_id, "host": host,
                 "port": self._proxy.port}
 
     def ready(self) -> bool:

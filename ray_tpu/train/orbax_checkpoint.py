@@ -1,0 +1,65 @@
+"""Orbax-backed JAX state checkpointing for Train.
+
+Reference role: the framework-specific checkpoint utilities
+(python/ray/train/torch/... save/load helpers); TPU-native here means
+orbax — the JAX ecosystem's multihost-safe, sharding-aware checkpointer.
+Sharded arrays save/restore WITHOUT host gathering: each host writes its
+shards (OCDBT), and restore honors a target sharding tree, so a v5e-64
+checkpoint round-trips without ever materializing the full state on one
+host.
+
+Usage inside a Train worker::
+
+    import tempfile
+    from ray_tpu import train
+    from ray_tpu.train.orbax_checkpoint import (save_jax_state,
+                                                restore_jax_state)
+
+    path = tempfile.mkdtemp()
+    save_jax_state(path, state)
+    train.report({"loss": loss},
+                 checkpoint=train.Checkpoint.from_directory(path))
+
+    ckpt = train.get_checkpoint()
+    if ckpt:
+        state = restore_jax_state(ckpt.to_directory(), target=state)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_jax_state(path: str, state: Any) -> str:
+    """Save a JAX pytree (params/opt_state/...) under ``path``/state.
+
+    Sharded jax.Arrays are written distributed (every process must
+    call this — orbax coordinates via jax.distributed)."""
+    target = os.path.join(os.path.abspath(path), "state")
+    _checkpointer().save(target, state, force=True)
+    return target
+
+def restore_jax_state(path: str, target: Optional[Any] = None) -> Any:
+    """Restore a pytree saved by :func:`save_jax_state`.
+
+    With ``target`` (a pytree of like-shaped arrays, e.g. the freshly
+    initialized state), restored arrays adopt target's shardings —
+    the resharding path for restoring onto a different mesh."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    src = os.path.join(os.path.abspath(path), "state")
+    if target is None:
+        return _checkpointer().restore(src)
+    restore_args = jax.tree.map(
+        lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding)
+        if isinstance(x, jax.Array) and hasattr(x, "sharding")
+        else ocp.RestoreArgs(), target)
+    return _checkpointer().restore(src, restore_args=restore_args)

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import itertools
 import os
+import threading
+import time
 from typing import Any, Callable, Iterable, List, Optional
 
 import ray_tpu
@@ -70,6 +72,12 @@ class Pool:
         self._closed = False
         self._rr = itertools.count()
         self._outstanding: List[Any] = []
+        # single result-handler thread for callback dispatch (stdlib Pool
+        # shape): apply_async with a callback enqueues here instead of
+        # spawning a thread per call — joblib submits one per batch
+        self._cb_pending: dict = {}  # ref -> (callback, error_callback)
+        self._cb_lock = threading.Lock()
+        self._cb_thread = None
 
     # ---- helpers ----
 
@@ -124,12 +132,51 @@ class Pool:
     def apply(self, fn: Callable, args: tuple = (), kwargs: dict = None):
         return self.apply_async(fn, args, kwargs).get()
 
+    def _ensure_cb_thread(self) -> None:
+        with self._cb_lock:
+            if self._cb_thread is not None:
+                return
+            self._cb_thread = True  # claim before the thread object exists
+
+        def handler():
+            while not self._closed:
+                with self._cb_lock:
+                    refs = list(self._cb_pending.keys())
+                if not refs:
+                    time.sleep(0.01)
+                    continue
+                ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5)
+                for ref in ready:
+                    with self._cb_lock:
+                        cbs = self._cb_pending.pop(ref, None)
+                    if cbs is None:
+                        continue
+                    callback, error_callback = cbs
+                    try:
+                        value = ray_tpu.get(ref)
+                    except Exception as e:  # noqa: BLE001
+                        if error_callback is not None:
+                            error_callback(e)
+                        continue
+                    if callback is not None:
+                        callback(value)
+
+        self._cb_thread = threading.Thread(
+            target=handler, daemon=True, name="pool-result-handler")
+        self._cb_thread.start()
+
     def apply_async(self, fn: Callable, args: tuple = (),
-                    kwargs: dict = None) -> AsyncResult:
+                    kwargs: dict = None, callback: Callable = None,
+                    error_callback: Callable = None) -> AsyncResult:
         worker = self._workers[next(self._rr) % self._processes]
         ref = worker.run_one.remote(fn, args, kwargs or {})
         self._outstanding.append(ref)
-        return AsyncResult(ref)
+        res = AsyncResult(ref)
+        if callback is not None or error_callback is not None:
+            self._ensure_cb_thread()
+            with self._cb_lock:
+                self._cb_pending[ref] = (callback, error_callback)
+        return res
 
     def close(self) -> None:
         self._closed = True

@@ -4,6 +4,8 @@ Reference: python/ray/util/actor_pool.py, queue.py,
 multiprocessing/pool.py.
 """
 
+import os
+
 import pytest
 
 import ray_tpu
@@ -77,3 +79,46 @@ def test_multiprocessing_pool(ray_start_regular):
             [-4, -3, -2, -1, 0]
         r = p.apply_async(lambda a: a + 1, (41,))
         assert r.get(timeout=30) == 42
+
+
+def test_joblib_backend(ray_start_regular):
+    """joblib Parallel over cluster workers (reference: ray.util.joblib)."""
+    import joblib
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+
+    def work(x):
+        import os
+
+        return x * 3, os.getpid()
+
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(work)(i) for i in range(6))
+    assert [v for v, _ in out] == [0, 3, 6, 9, 12, 15]
+    assert os.getpid() not in {p for _, p in out}  # ran in workers
+
+
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    """Sharding-aware save/restore (ray_tpu.train.orbax_checkpoint)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel import make_mesh
+    from ray_tpu.train.orbax_checkpoint import (restore_jax_state,
+                                                save_jax_state)
+
+    mesh = make_mesh(axis_sizes={"data": 8})
+    sh = NamedSharding(mesh, P("data"))
+    state = {"w": jax.device_put(jnp.arange(64.0).reshape(8, 8), sh),
+             "step": jnp.asarray(7)}
+    save_jax_state(str(tmp_path), state)
+    target = {"w": jax.device_put(jnp.zeros((8, 8)), sh),
+              "step": jnp.asarray(0)}
+    out = restore_jax_state(str(tmp_path), target=target)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.arange(64.0).reshape(8, 8))
+    assert out["w"].sharding == sh and int(out["step"]) == 7
