@@ -89,6 +89,9 @@ def init(
     total = detect_resources(num_cpus=num_cpus, num_tpus=num_tpus,
                              num_gpus=num_gpus, extra=resources)
     _namespace = namespace
+    from ray_tpu.util.usage_stats import mark_session_started
+
+    mark_session_started()  # no-op unless RAY_TPU_USAGE_STATS_ENABLED=1
     _head = Head(total, labels=labels, storage=storage)
     rt = DriverRuntime(_head)
     runtime_mod.set_current_runtime(rt)
@@ -113,6 +116,12 @@ def shutdown():
             _head._client_server = None
         _head.shutdown()
         _head = None
+        try:
+            from ray_tpu.util.usage_stats import flush
+
+            flush()  # local-only, opt-in (RAY_TPU_USAGE_STATS_ENABLED)
+        except Exception:
+            pass  # telemetry must never break shutdown
 
 
 def start_client_server(host: str = "127.0.0.1", port: int = 0):

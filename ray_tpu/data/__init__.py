@@ -3,6 +3,11 @@
 Reference: python/ray/data/ (Dataset, streaming executor, datasources).
 """
 
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("data")
+del _rlu
+
+
 from ray_tpu.data.aggregate import (  # noqa: F401
     AbsMax,
     AggregateFn,

@@ -211,6 +211,9 @@ class Dataset:
     def to_jax(self, **kwargs) -> Iterator[Dict[str, Any]]:
         return self.iterator().to_jax(**kwargs)
 
+    def iter_torch_batches(self, **kwargs) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_torch_batches(**kwargs)
+
     def take(self, limit: int = 20) -> List[Any]:
         out: List[Any] = []
         for row in self.limit(limit).iter_rows():

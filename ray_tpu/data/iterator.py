@@ -131,6 +131,40 @@ class DataIterator:
                 local_shuffle_buffer_size=local_shuffle_buffer_size):
             yield place(batch)
 
+    def iter_torch_batches(
+        self,
+        *,
+        batch_size: int = 256,
+        columns: Optional[List[str]] = None,
+        dtypes: Optional[Dict[str, Any]] = None,
+        device: Optional[str] = None,
+        drop_last: bool = False,
+        prefetch_batches: int = 2,
+        local_shuffle_buffer_size: Optional[int] = None,
+    ) -> Iterator[Dict[str, Any]]:
+        """Yield dict-of-torch.Tensor batches (reference:
+        DataIterator.iter_torch_batches). torch here is a CPU-side
+        convenience (TPU compute goes through :meth:`to_jax`)."""
+        import torch
+
+        for batch in self.iter_batches(
+                batch_size=batch_size, batch_format="numpy",
+                drop_last=drop_last, prefetch_batches=prefetch_batches,
+                local_shuffle_buffer_size=local_shuffle_buffer_size):
+            if columns:
+                batch = {k: batch[k] for k in columns}
+            out = {}
+            for k, v in batch.items():
+                # copy: batch arrays can be read-only zero-copy views of
+                # the shared-memory store; torch requires writable memory
+                t = torch.as_tensor(np.array(v, copy=True))
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                if device:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def materialize_blocks(self) -> List[Any]:
         return list(self._source_fn())
 

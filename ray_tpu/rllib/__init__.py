@@ -6,6 +6,11 @@ policy/value nets), Learner/LearnerGroup (jitted updates, optional
 multi-learner gradient sync), PPO.
 """
 
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("rllib")
+del _rlu
+
+
 from .algorithm import Algorithm, EnvRunnerGroup
 from .appo import APPO, APPOConfig
 from .config import AlgorithmConfig

@@ -6,6 +6,11 @@ __init__ jits the model — each replica owns its compiled executable and
 serves requests with continuous batching via @serve.batch.
 """
 
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("serve")
+del _rlu
+
+
 from ray_tpu.serve.api import (  # noqa: F401
     delete,
     get_deployment_handle,

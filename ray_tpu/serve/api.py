@@ -52,10 +52,6 @@ def start(http_options: Optional[HTTPOptions] = None,
     return _controller
 
 
-def _has_http_ingress() -> bool:
-    return _proxy is not None or _proxy_manager is not None
-
-
 def get_grpc_ingress():
     """The running GRPCIngress (None unless start() got grpc_options)."""
     return _grpc
@@ -82,7 +78,18 @@ class _ProxyManager:
         # one reconcile at a time: the ticker and direct callers must not
         # double-spawn a node's proxy; shutdown excludes reconciles too
         self._lock = threading.Lock()
-        self.reconcile(raise_on_error=True)  # first pass fails loudly
+        try:
+            self.reconcile(raise_on_error=True)  # first pass fails loudly
+        except BaseException:
+            # don't leak the proxies that DID spawn: a retried start()
+            # would stack a second set beside the orphans
+            for a in self._proxies.values():
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+            self._proxies.clear()
+            raise
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-proxy-reconciler")
         self._thread.start()

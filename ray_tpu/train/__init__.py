@@ -6,6 +6,11 @@ get_dataset_shard. The torch/NCCL backends are replaced by JaxBackend
 (jax.distributed + GSPMD in-loop).
 """
 
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("train")
+del _rlu
+
+
 from ray_tpu.train.backend_executor import (  # noqa: F401
     Backend,
     BackendExecutor,

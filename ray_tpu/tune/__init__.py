@@ -3,6 +3,11 @@
 Reference: python/ray/tune/ (Tuner, TuneController, searchers, schedulers).
 """
 
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("tune")
+del _rlu
+
+
 from ray_tpu.tune.controller import Trainable, Trial, TuneController  # noqa: F401
 from ray_tpu.tune.sample import (  # noqa: F401
     choice,

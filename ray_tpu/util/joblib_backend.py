@@ -33,11 +33,13 @@ def register_ray_tpu() -> None:
             if n_jobs is None:
                 return 1
             if n_jobs < 0:
+                # joblib contract: -1 = all cluster CPUs, -2 = all but
+                # one, ... (n_cpus + 1 + n_jobs)
                 try:
-                    return max(1, int(ray_tpu.cluster_resources()
-                                      .get("CPU", 1)))
+                    cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
                 except Exception:
-                    return 1
+                    cpus = 1
+                return max(1, cpus + 1 + n_jobs)
             return n_jobs
 
         def configure(self, n_jobs=1, parallel=None, prefer=None,

@@ -139,10 +139,14 @@ class Pool:
             self._cb_thread = True  # claim before the thread object exists
 
         def handler():
-            while not self._closed:
+            # run until closed AND drained: close() must not drop pending
+            # callbacks (stdlib contract — submitted tasks' callbacks fire)
+            while True:
                 with self._cb_lock:
                     refs = list(self._cb_pending.keys())
                 if not refs:
+                    if self._closed:
+                        return
                     time.sleep(0.01)
                     continue
                 ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5)
@@ -168,6 +172,8 @@ class Pool:
     def apply_async(self, fn: Callable, args: tuple = (),
                     kwargs: dict = None, callback: Callable = None,
                     error_callback: Callable = None) -> AsyncResult:
+        if self._closed:
+            raise ValueError("Pool not running")  # stdlib contract
         worker = self._workers[next(self._rr) % self._processes]
         ref = worker.run_one.remote(fn, args, kwargs or {})
         self._outstanding.append(ref)
@@ -193,11 +199,14 @@ class Pool:
         if not self._closed:
             raise ValueError("Pool is still open")
         # stdlib contract: join() is the completion barrier for all
-        # submitted work
+        # submitted work — including callback dispatch
         if self._outstanding:
             ray_tpu.wait(self._outstanding,
                          num_returns=len(self._outstanding))
             self._outstanding.clear()
+        t = self._cb_thread
+        if isinstance(t, threading.Thread):
+            t.join(timeout=30)
 
     def __enter__(self):
         return self

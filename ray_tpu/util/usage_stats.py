@@ -1,0 +1,67 @@
+"""Usage stats: opt-in, LOCAL-ONLY session telemetry.
+
+Reference: python/ray/_private/usage/usage_lib.py — opt-in usage
+reporting with library/component tags. This environment is zero-egress,
+so the recorder only ever writes a local JSON file (one per session
+under ``/tmp/ray_tpu_usage/``); nothing leaves the machine. Disabled
+unless ``RAY_TPU_USAGE_STATS_ENABLED=1``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Dict
+
+_lock = threading.Lock()
+_session = {
+    "schema_version": "0.1",
+    "session_id": uuid.uuid4().hex,
+    "started_at": None,
+    "libraries_used": [],
+    "extra_tags": {},
+}
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "0") == "1"
+
+
+def record_library_usage(name: str) -> None:
+    """Note that a library (data/train/tune/serve/rllib/...) was used."""
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        if name not in _session["libraries_used"]:
+            _session["libraries_used"].append(name)
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _session["extra_tags"][str(key)] = str(value)
+
+
+def mark_session_started() -> None:
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _session["started_at"] = time.time()
+
+
+def flush() -> str | None:
+    """Write the session record locally; returns the path (or None)."""
+    if not usage_stats_enabled():
+        return None
+    out_dir = os.path.join("/tmp", "ray_tpu_usage")
+    os.makedirs(out_dir, exist_ok=True)
+    with _lock:
+        record = dict(_session, flushed_at=time.time())
+    path = os.path.join(out_dir, f"{record['session_id']}.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2)
+    return path

@@ -122,3 +122,38 @@ def test_orbax_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(np.asarray(out["w"]),
                                np.arange(64.0).reshape(8, 8))
     assert out["w"].sharding == sh and int(out["step"]) == 7
+
+
+def test_iter_torch_batches(ray_start_regular):
+    import torch
+
+    from ray_tpu import data
+
+    ds = data.range(100)
+    total = 0
+    for b in ds.iter_torch_batches(batch_size=32,
+                                   dtypes={"id": torch.float32}):
+        assert isinstance(b["id"], torch.Tensor)
+        assert b["id"].dtype == torch.float32
+        total += int(b["id"].sum().item())
+    assert total == sum(range(100))
+
+
+def test_usage_stats_local_only(monkeypatch, tmp_path):
+    from ray_tpu.util import usage_stats
+
+    # disabled by default: record/flush are no-ops
+    monkeypatch.delenv("RAY_TPU_USAGE_STATS_ENABLED", raising=False)
+    usage_stats.record_library_usage("data")
+    assert usage_stats.flush() is None
+    # opt-in: records land in a local JSON file
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "1")
+    usage_stats.mark_session_started()
+    usage_stats.record_library_usage("train")
+    usage_stats.record_extra_usage_tag("mesh", "dp8")
+    path = usage_stats.flush()
+    import json as _json
+
+    rec = _json.load(open(path))
+    assert "train" in rec["libraries_used"]
+    assert rec["extra_tags"]["mesh"] == "dp8"
