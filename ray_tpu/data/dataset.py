@@ -14,6 +14,7 @@ import time
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 _range = builtins.range  # the module exports data.range(); keep the builtin
+_zip = builtins.zip      # Dataset.zip shadows the builtin in this scope
 
 import numpy as np
 
@@ -301,6 +302,30 @@ class Dataset:
 
         for i in _range(n):
             s, e = starts[i], starts[i + 1]
+            ref = _slice_range_task.remote(s, e, mat._counts, *mat._refs)
+            out.append(MaterializedDataset(
+                L.LogicalPlan(L.InputData(
+                    [ref], [BlockMetadata(num_rows=e - s)])),
+                [ref], [e - s]))
+        return out
+
+    def split_at_indices(self, indices) -> List["MaterializedDataset"]:
+        """Split at the given row indices (reference:
+        Dataset.split_at_indices): k indices -> k+1 datasets covering
+        [0, i0), [i0, i1), ..., [ik-1, total)."""
+        indices = list(indices)
+        if any(b < a for a, b in _zip(indices, indices[1:])):
+            raise ValueError("indices must be non-decreasing")
+        if any(i < 0 for i in indices):
+            raise ValueError("indices must be non-negative")
+        mat = self.materialize()
+        total = sum(mat._counts)
+        bounds = [0] + [min(i, total) for i in indices] + [total]
+        from .executor import _slice_range_task
+
+        out = []
+        for s, e in _zip(bounds, bounds[1:]):
+            e = max(s, e)
             ref = _slice_range_task.remote(s, e, mat._counts, *mat._refs)
             out.append(MaterializedDataset(
                 L.LogicalPlan(L.InputData(

@@ -24,7 +24,9 @@ Usage::
 
 from ray_tpu.workflow.api import (  # noqa: F401
     Continuation,
+    EventListener,
     FunctionNode,
+    TimerListener,
     WorkflowStatus,
     cancel,
     continuation,
@@ -38,10 +40,13 @@ from ray_tpu.workflow.api import (  # noqa: F401
     resume_all,
     run,
     run_async,
+    sleep,
+    wait_for_event,
 )
 
 __all__ = [
     "run", "run_async", "resume", "resume_all", "get_output", "get_status",
     "get_metadata", "list_all", "cancel", "delete", "init", "continuation",
-    "Continuation", "FunctionNode", "WorkflowStatus",
+    "Continuation", "FunctionNode", "WorkflowStatus", "EventListener",
+    "TimerListener", "wait_for_event", "sleep",
 ]

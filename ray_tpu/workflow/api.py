@@ -141,6 +141,57 @@ def bind(remote_fn, *args, **kwargs) -> FunctionNode:
 
 
 # --------------------------------------------------------------------------- #
+# Events (reference: workflow/api.py wait_for_event + EventListener)
+# --------------------------------------------------------------------------- #
+
+
+class EventListener:
+    """Subclass and implement poll_for_event(); the workflow step blocks
+    (as an ordinary task) until it returns. Reference:
+    python/ray/workflow/event_listener.py — the async listener contract,
+    here a sync poll since steps are plain tasks."""
+
+    def poll_for_event(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+class TimerListener(EventListener):
+    """Fires after N seconds (reference: workflow.sleep's listener)."""
+
+    def poll_for_event(self, seconds: float):
+        import time as _t
+
+        _t.sleep(seconds)
+        return seconds
+
+
+def wait_for_event(listener_cls, *args, **kwargs) -> FunctionNode:
+    """A DAG node that completes when the listener observes its event.
+
+    Like any step, the observed event value is CHECKPOINTED: a resumed
+    workflow does not wait again for an event it already saw.
+    """
+    if not (isinstance(listener_cls, type)
+            and issubclass(listener_cls, EventListener)):
+        raise TypeError("wait_for_event expects an EventListener subclass")
+
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _wait_for_event(*a, **kw):
+        return listener_cls().poll_for_event(*a, **kw)
+
+    _wait_for_event.__name__ = f"event_{listener_cls.__name__}"
+    return FunctionNode(_wait_for_event, args, kwargs)
+
+
+def sleep(seconds: float) -> FunctionNode:
+    """Durable sleep step (reference: workflow.sleep) — checkpointed, so
+    a resume after the timer fired does not sleep again."""
+    return wait_for_event(TimerListener, seconds)
+
+
+# --------------------------------------------------------------------------- #
 # Storage layout
 # --------------------------------------------------------------------------- #
 

@@ -317,3 +317,19 @@ def test_range_tensor(ray_init):
     ds = rd.range_tensor(8, shape=(2, 2))
     batch = ds.take_batch(8, batch_format="numpy")
     assert batch["data"].shape == (8, 2, 2)
+
+
+def test_split_at_indices(ray_start_regular):
+    from ray_tpu import data
+
+    ds = data.range(10)
+    parts = ds.split_at_indices([3, 7])
+    rows = [[r["id"] for r in p.take_all()] for p in parts]
+    assert rows == [[0, 1, 2], [3, 4, 5, 6], [7, 8, 9]]
+    # out-of-range index clamps; decreasing raises
+    parts2 = data.range(4).split_at_indices([10])
+    assert [len(p.take_all()) for p in parts2] == [4, 0]
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        data.range(4).split_at_indices([3, 1])

@@ -113,3 +113,49 @@ class TestWorkflowContinuation:
         assert workflow.run(factorial.bind(5), workflow_id="fact") == 120
         # continuation steps are checkpointed too
         assert workflow.get_metadata("fact")["completed_steps"] >= 5
+
+
+class TestWorkflowEvents:
+    def test_wait_for_event_and_sleep(self, wf_storage):
+        class FileEvent(workflow.EventListener):
+            def poll_for_event(self, path):
+                import time as _t
+
+                while not os.path.exists(path):
+                    _t.sleep(0.05)
+                with open(path) as f:
+                    return f.read()
+
+        import tempfile
+        import threading
+        import time as _t
+
+        marker = os.path.join(tempfile.gettempdir(),
+                              f"wf_event_{os.getpid()}")
+        if os.path.exists(marker):
+            os.remove(marker)
+
+        def fire():
+            _t.sleep(0.5)
+            with open(marker, "w") as f:
+                f.write("fired")
+
+        threading.Thread(target=fire, daemon=True).start()
+        dag = add.bind(workflow.wait_for_event(FileEvent, marker), "!")
+        try:
+            assert workflow.run(dag, workflow_id="ev") == "fired!"
+        finally:
+            if os.path.exists(marker):
+                os.remove(marker)
+
+    def test_sleep_is_checkpointed(self, wf_storage):
+        import time as _t
+
+        dag = double.bind(workflow.sleep(0.3))
+        t0 = _t.monotonic()
+        assert workflow.run(dag, workflow_id="zz") == 0.6
+        assert _t.monotonic() - t0 >= 0.3
+        # resume: the timer step loads from its checkpoint, no re-sleep
+        t1 = _t.monotonic()
+        assert workflow.resume("zz") == 0.6
+        assert _t.monotonic() - t1 < 0.25
