@@ -48,7 +48,31 @@ def main():
     parser.add_argument("--seq", type=int, default=0)
     parser.add_argument("--config", default="bench",
                         choices=["debug", "small", "medium", "bench"])
+    parser.add_argument("--devices", type=int, default=0,
+                        help="run on N virtual CPU devices (re-execs with "
+                        "xla_force_host_platform_device_count=N) to measure "
+                        "the multi-chip GSPMD step; 0 = local devices")
+    parser.add_argument("--mesh", default="",
+                        help="axis spec for --devices runs, e.g. "
+                        "'fsdp=2,seq=2,tensor=2' (default fsdp=N)")
     args = parser.parse_args()
+
+    if args.devices and os.environ.get("_RAY_TPU_BENCH_CHILD") != "1":
+        import subprocess
+
+        env = dict(os.environ)
+        env["_RAY_TPU_BENCH_CHILD"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(
+            f"--xla_force_host_platform_device_count={args.devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        argv = [os.path.abspath(sys.argv[0])] + sys.argv[1:]
+        raise SystemExit(subprocess.run(
+            [sys.executable] + argv, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__))).returncode)
 
     import jax
     import numpy as np
@@ -58,7 +82,10 @@ def main():
 
     n_dev = len(jax.devices())
     if args.quick or jax.devices()[0].platform == "cpu":
-        cfg = LlamaConfig.debug()
+        # CPU (incl. --devices virtual mesh): debug config unless the user
+        # explicitly picked one small enough to step on host
+        cfg = (LlamaConfig.debug() if args.config == "bench"
+               else getattr(LlamaConfig, args.config)())
         batch, seq, steps = 8, 128, max(3, args.steps // 4)
     else:
         cfg = getattr(LlamaConfig, args.config)()
@@ -69,8 +96,15 @@ def main():
     if args.seq:
         seq = args.seq
 
-    # single-host mesh over all local chips: fsdp over chips
-    mesh = make_mesh(MeshConfig(data=1, fsdp=n_dev, seq=1, tensor=1))
+    # single-host mesh over all local chips: fsdp over chips (or --mesh spec)
+    axes = {"data": 1, "fsdp": n_dev, "seq": 1, "tensor": 1}
+    if args.mesh:
+        axes = {"data": 1, "fsdp": 1, "seq": 1, "tensor": 1}
+        for part in args.mesh.split(","):
+            k, v = part.split("=")
+            axes[k.strip()] = int(v)
+    mesh = make_mesh(MeshConfig(**axes))
+    n_dev = mesh.size  # per-chip metrics count only devices in the mesh
     init, step, data_sharding, _ = make_train_step(cfg, mesh)
     state = init(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)

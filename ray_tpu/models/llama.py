@@ -219,14 +219,51 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
     return x
 
 
-def _backbone(cfg: LlamaConfig, params, tokens, mesh=None):
-    """tokens [B, T] int32 -> final-normed hidden states [B, T, dim]."""
-    B, T = tokens.shape
-    x = params["embedding"].astype(cfg.dtype)[tokens]
+def embed_tokens(cfg, params, tokens, mesh=None, table_sharded=None):
+    """Token embedding lookup, partition-friendly.
+
+    Replicated table: plain gather. Vocab/embed-sharded table: one-hot
+    matmul contraction (MaxText ``use_iota_embed`` / t5x ``one_hot``
+    precedent) — GSPMD partitions dots natively (psum over the vocab shard
+    axis), whereas a gather from a sharded table triggers the
+    spmd_partitioner's "involuntary full rematerialization" fallback
+    (replicate + repartition). Costs one extra lm_head-sized matmul on the
+    MXU; the one-hot operand is sharded over batch/seq/vocab so it never
+    materializes unsharded.
+
+    ``table_sharded``: pass explicitly when the caller shards the table by
+    its own specs (pipeline path); default infers from DEFAULT_RULES.
+    """
+    emb = params["embedding"].astype(cfg.dtype)
+    if table_sharded is None and mesh is not None and mesh.size > 1:
+        from ray_tpu.parallel.sharding import _mesh_axes_for
+
+        def live(logical):
+            ax = _mesh_axes_for(logical, DEFAULT_RULES, mesh)
+            axs = ax if isinstance(ax, tuple) else (ax,) if ax else ()
+            return any(mesh.shape[a] > 1 for a in axs)
+
+        table_sharded = live("vocab") or live("embed")
+    if mesh is None or mesh.size == 1 or not table_sharded:
+        x = emb[tokens]
+    else:
+        from ray_tpu.parallel.sharding import constraint
+
+        hot = jax.nn.one_hot(tokens, emb.shape[0], dtype=cfg.dtype)
+        hot = constraint(hot, ("batch", "seq", "vocab"), mesh)
+        x = jnp.einsum("btv,vd->btd", hot, emb,
+                       preferred_element_type=jnp.float32).astype(cfg.dtype)
     if mesh is not None:
         from ray_tpu.parallel.sharding import constraint
 
         x = constraint(x, ("batch", "seq", None), mesh)
+    return x
+
+
+def _backbone(cfg: LlamaConfig, params, tokens, mesh=None):
+    """tokens [B, T] int32 -> final-normed hidden states [B, T, dim]."""
+    B, T = tokens.shape
+    x = embed_tokens(cfg, params, tokens, mesh)
     positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
 
     layer_fn = partial(_layer, cfg, mesh)
@@ -502,7 +539,11 @@ def make_pipeline_train_step(cfg: LlamaConfig, mesh, num_microbatches: int,
     def loss(params, tokens):
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         B, T = inputs.shape
-        x = params["embedding"].astype(cfg.dtype)[inputs]
+        # pipeline shards the table by its own specs P(ta, None): sharded
+        # iff the tensor axis is live — DEFAULT_RULES inference would
+        # misread a dp/fsdp batch axis as embed sharding
+        x = embed_tokens(cfg, params, inputs, mesh,
+                         table_sharded=ta is not None)
         positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
         x = pipe_fn(params["layers"], x, positions)
         x = rms_norm(x, params["final_norm"], cfg.norm_eps)

@@ -19,7 +19,7 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.models.llama import LlamaConfig, _attention
+from ray_tpu.models.llama import LlamaConfig, _attention, embed_tokens
 from ray_tpu.ops.layers import rms_norm, rotary_embedding
 from ray_tpu.ops.moe import moe_ffn
 from ray_tpu.parallel.sharding import DEFAULT_RULES, logical_sharding
@@ -123,11 +123,7 @@ def _layer(cfg: MoEConfig, mesh, x, p, positions):
 def forward_with_aux(cfg: MoEConfig, params, tokens, mesh=None):
     """tokens [B,T] -> (logits [B,T,V], total aux loss)."""
     B, T = tokens.shape
-    x = params["embedding"].astype(cfg.dtype)[tokens]
-    if mesh is not None:
-        from ray_tpu.parallel.sharding import constraint
-
-        x = constraint(x, ("batch", "seq", None), mesh)
+    x = embed_tokens(cfg, params, tokens, mesh)
     positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
 
     layer_fn = partial(_layer, cfg, mesh)
