@@ -50,7 +50,8 @@ class Cluster:
                  resources: Optional[Dict[str, float]] = None,
                  labels: Optional[Dict[str, str]] = None,
                  separate_process: bool = False,
-                 register_timeout: float = 30.0):
+                 register_timeout: float = 30.0,
+                 node_ip: Optional[str] = None):
         """Add a node: in-process by default (several raylets, one OS
         process — the reference Cluster fixture), or as a REAL separate OS
         process joining over TCP (``separate_process=True``), exercising the
@@ -61,7 +62,7 @@ class Cluster:
         if num_tpus:
             total["TPU"] = num_tpus
         if not separate_process:
-            return self.head.add_node(total, labels=labels)
+            return self.head.add_node(total, labels=labels, node_ip=node_ip)
         host, port = self.head.start_node_server()
         before = set(self.head.nodes)
         env = dict(os.environ)
@@ -79,7 +80,8 @@ class Cluster:
              "--num-tpus", str(total.get("TPU", 0)),
              "--resources", json.dumps(
                  {k: v for k, v in total.items() if k not in ("CPU", "TPU")}),
-             "--labels", json.dumps(labels or {})],
+             "--labels", json.dumps(labels or {})]
+            + (["--node-ip", node_ip] if node_ip else []),
             env=env,
         )
         self._procs.append(proc)

@@ -50,13 +50,18 @@ class WorkerHandle:
 
 class Node:
     def __init__(self, head, node_id: NodeID, resources: Dict[str, float],
-                 session_dir: str, labels: Optional[Dict[str, str]] = None):
+                 session_dir: str, labels: Optional[Dict[str, str]] = None,
+                 node_ip: str = "127.0.0.1"):
         cfg = global_config()
         self.head = head
         self.node_id = node_id
         self.hex = node_id.hex()
         self.session_dir = session_dir
         self.labels = labels or {}
+        # routable address of this host, advertised to workers (Train
+        # coordinator bootstrap) and in the object-server address; loopback
+        # for in-process nodes (reference: raylet node_ip_address)
+        self.node_ip = node_ip
         unit_names = set(cfg.unit_instance_resources.split(","))
         self.resources = NodeResources(resources, unit_instance_names=unit_names)
         self.resources.labels = self.labels
@@ -260,6 +265,7 @@ class Node:
             init_info = {
                 "worker_id": wid.binary(),
                 "node_hex": self.hex,
+                "node_ip": self.node_ip,
                 "job_id": self.head.job_id.binary(),
                 "arena_path": self.store.arena_path,
                 "arena_capacity": self.store.capacity,
@@ -459,12 +465,21 @@ class Node:
         threading.Thread(target=tail, daemon=True,
                          name=f"logtail-{self.hex[:6]}").start()
 
-    def start_object_server(self, authkey: bytes, host: str = "127.0.0.1"):
-        """Start the node-to-node chunk server (multi-host mode)."""
+    def start_object_server(self, authkey: bytes, host: Optional[str] = None):
+        """Start the node-to-node chunk server (multi-host mode).
+
+        Binds all interfaces when the node has a non-loopback ``node_ip``
+        and advertises that IP, so cross-host pulls get a routable address.
+        """
         from .object_transfer import ObjectServer
 
         if getattr(self, "object_server", None) is None:
-            self.object_server = ObjectServer(self.store, authkey, host)
+            if host is None:
+                host = ("127.0.0.1" if self.node_ip.startswith("127.")
+                        else "0.0.0.0")
+            self.object_server = ObjectServer(
+                self.store, authkey, host,
+                advertise_host=self.node_ip)
         return self.object_server
 
     def kill_worker(self, worker_id: WorkerID) -> None:

@@ -263,6 +263,10 @@ def main(argv=None) -> int:
     ap.add_argument("--resources", default="{}", help="JSON resource dict")
     ap.add_argument("--labels", default="{}", help="JSON label dict")
     ap.add_argument("--session-dir", default=None)
+    ap.add_argument("--node-ip", default=None,
+                    help="routable IP to advertise for this node (default: "
+                    "RAY_TPU_NODE_IP env, else the interface that reaches "
+                    "the head)")
     args = ap.parse_args(argv)
 
     from .accelerators import detect_resources
@@ -287,10 +291,16 @@ def main(argv=None) -> int:
     head = RemoteHead(channel, welcome, key)
     session_dir = args.session_dir or tempfile.mkdtemp(prefix="raytpu_node_")
 
+    node_ip = args.node_ip or os.environ.get("RAY_TPU_NODE_IP")
+    if not node_ip:
+        from .protocol import infer_node_ip
+
+        node_ip = infer_node_ip(parse_address(args.address)[0])
+
     from .node import Node
 
     node = Node(head, NodeID(bytes.fromhex(welcome["node_hex"])), resources,
-                session_dir, labels)
+                session_dir, labels, node_ip=node_ip)
     head.node = node
     server = node.start_object_server(key)
     channel.send("node_ready", {

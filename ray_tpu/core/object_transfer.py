@@ -55,12 +55,19 @@ def _pull_guard(dest_store, oid: ObjectID):
 class ObjectServer:
     """Per-node chunk server reading from the node's LocalObjectStore."""
 
-    def __init__(self, store, authkey: bytes, host: str = "127.0.0.1"):
+    def __init__(self, store, authkey: bytes, host: str = "127.0.0.1",
+                 advertise_host: Optional[str] = None):
         self.store = store
         self.authkey = authkey
         self._listener = mpc.Listener(address=(host, 0), family="AF_INET",
                                       authkey=authkey)
-        self.address: Tuple[str, int] = self._listener.address
+        bound_host, port = self._listener.address
+        # a 0.0.0.0 bind is unroutable as an advertised address: publish
+        # the node's real IP instead
+        self.address: Tuple[str, int] = (
+            (advertise_host, port)
+            if advertise_host and bound_host in ("0.0.0.0", "::")
+            else (bound_host, port))
         self._alive = True
         self._thread = threading.Thread(target=self._accept_loop, daemon=True,
                                         name="object-server")

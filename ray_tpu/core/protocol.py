@@ -153,6 +153,28 @@ def connect(address, authkey: bytes) -> Channel:
                               authkey=authkey))
 
 
+def infer_node_ip(peer_host: str = "8.8.8.8") -> str:
+    """IP of the local interface the kernel would route to ``peer_host``
+    (reference: ``services.get_node_ip_address``). The UDP connect never
+    sends a packet — it only selects the egress interface. Pass the head's
+    host to get the address peers on that network can reach."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((peer_host, 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        pass
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
 def parse_address(addr: str):
     """"host:port" -> (host, port); anything else is a unix-socket path."""
     if ":" in addr and not addr.startswith("/"):

@@ -171,14 +171,19 @@ class Head:
         self.node_server_address = None
         self._cluster_key: Optional[bytes] = None
         self._daemon_pool = None
+        # routable IP local nodes advertise (loopback until a non-loopback
+        # node server opens — see start_node_server)
+        self.node_ip = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
         # head node (the driver's node)
         self.head_node = self.add_node(resources, labels=labels)
 
     # ------------------------------------------------------------ membership
 
     def add_node(self, resources: Dict[str, float],
-                 labels: Optional[Dict[str, str]] = None) -> Node:
-        node = Node(self, NodeID.from_random(), resources, self.session_dir, labels)
+                 labels: Optional[Dict[str, str]] = None,
+                 node_ip: Optional[str] = None) -> Node:
+        node = Node(self, NodeID.from_random(), resources, self.session_dir,
+                    labels, node_ip=node_ip or self.node_ip)
         if self._cluster_key is not None:
             node.start_object_server(self._cluster_key)
         with self._lock:
@@ -214,9 +219,18 @@ class Head:
         self.node_server_address = self._node_listener.address
         self._daemon_pool = ThreadPoolExecutor(
             max_workers=16, thread_name_prefix="daemon-req")
+        # serving off-box daemons: local nodes must advertise a routable IP,
+        # not loopback, or cross-host pulls/Train bootstrap dial themselves
+        if self.node_ip.startswith("127.") and not host.startswith("127."):
+            from .protocol import infer_node_ip
+
+            self.node_ip = (host if host not in ("0.0.0.0", "::")
+                            else infer_node_ip())
         with self._lock:
             nodes = [n for n in self.nodes.values() if self._is_local(n)]
         for n in nodes:
+            if n.node_ip.startswith("127."):
+                n.node_ip = self.node_ip
             n.start_object_server(self._cluster_key)
         threading.Thread(target=self._node_accept_loop, daemon=True,
                          name="node-server").start()

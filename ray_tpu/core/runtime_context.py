@@ -15,6 +15,11 @@ class RuntimeContext:
     def get_node_id(self) -> str:
         return self._info["node_id"]
 
+    def get_node_ip(self) -> str:
+        """Routable IP of this process's node (reference:
+        ``ray.util.get_node_ip_address``); loopback for in-process nodes."""
+        return self._info.get("node_ip", "127.0.0.1")
+
     def get_worker_id(self) -> str:
         wid = self._info["worker_id"]
         return wid.hex() if isinstance(wid, bytes) else str(wid)

@@ -60,6 +60,7 @@ class WorkerRuntime:
         self.rpc = RpcClient(channel)
         self.worker_id: bytes = init_info["worker_id"]
         self.node_hex: str = init_info["node_hex"]
+        self.node_ip: str = init_info.get("node_ip", "127.0.0.1")
         self.job_id = JobID(init_info["job_id"])
         set_global_config(Config.from_json(init_info["config"]))
         self.arena = ArenaClient(init_info["arena_path"], init_info["arena_capacity"])
@@ -179,6 +180,7 @@ class WorkerRuntime:
         return {
             "job_id": self.job_id,
             "node_id": self.node_hex,
+            "node_ip": self.node_ip,
             "worker_id": self.worker_id,
             "task_id": tid,
             "actor_id": aid,

@@ -193,23 +193,10 @@ class ProxyActor:
     @staticmethod
     def _node_ip() -> str:
         """This node's routable IP (a 0.0.0.0 bind address is useless to
-        an external load balancer). The UDP-connect trick never sends a
-        packet — it only asks the kernel for the egress interface."""
-        import socket
+        an external load balancer)."""
+        from ray_tpu.core.protocol import infer_node_ip
 
-        try:
-            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-            try:
-                s.connect(("8.8.8.8", 80))
-                return s.getsockname()[0]
-            finally:
-                s.close()
-        except OSError:
-            pass
-        try:
-            return socket.gethostbyname(socket.gethostname())
-        except OSError:
-            return "127.0.0.1"
+        return infer_node_ip()
 
     def address(self):
         import ray_tpu

@@ -95,7 +95,7 @@ class TrainWorker:
         import socket
 
         ctx = ray_tpu.get_runtime_context()
-        return {"ip": "127.0.0.1", "hostname": socket.gethostname(),
+        return {"ip": ctx.get_node_ip(), "hostname": socket.gethostname(),
                 "node_id": ctx.get_node_id(),
                 "accelerator_ids": ctx.get_accelerator_ids()}
 

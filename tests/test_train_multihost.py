@@ -1,0 +1,60 @@
+"""Multi-host Train bootstrap: real node IPs in coordinator payloads.
+
+Round-2 weak item #2: TrainWorker.get_metadata hardcoded 127.0.0.1, so
+JaxBackend built a coordinator address that only worked single-machine
+(reference: train/torch/xla/config.py:41-67 builds the rendezvous from real
+worker IPs). Daemons now advertise a routable node_ip that flows through
+worker init -> runtime_context -> Train metadata.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train.backend_executor import JaxBackend, TrainWorker
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def two_ip_cluster():
+    c = Cluster(head_node_args={"num_cpus": 1})
+    n1 = c.add_node(num_cpus=1, separate_process=True, node_ip="127.0.0.2")
+    n2 = c.add_node(num_cpus=1, separate_process=True, node_ip="127.0.0.3")
+    yield c, n1, n2
+    c.shutdown()
+
+
+def test_distinct_node_ips_in_coordinator_payloads(two_ip_cluster):
+    c, n1, n2 = two_ip_cluster
+    WorkerActor = ray_tpu.remote(TrainWorker)
+    actors = []
+    for rank, node in enumerate((n1, n2)):
+        strat = NodeAffinitySchedulingStrategy(node_id=node.hex, soft=False)
+        actors.append(WorkerActor.options(
+            num_cpus=1, scheduling_strategy=strat).remote(
+            2, rank, 0, rank, "exp", "/tmp/trial"))
+    metadata = ray_tpu.get([a.get_metadata.remote() for a in actors],
+                           timeout=120)
+    ips = [m["ip"] for m in metadata]
+    assert ips == ["127.0.0.2", "127.0.0.3"], ips
+
+    payloads = JaxBackend(coordinator_port=9123).on_start(metadata)
+    # worker 0 hosts the coordinator: every worker must be handed ITS
+    # address, not loopback
+    for i, p in enumerate(payloads):
+        jd = p["jax_distributed"]
+        assert jd["coordinator_address"] == "127.0.0.2:9123"
+        assert jd["num_processes"] == 2 and jd["process_id"] == i
+        assert p["env"]["JAX_COORDINATOR_ADDRESS"] == "127.0.0.2:9123"
+
+
+def test_runtime_context_node_ip_defaults_loopback():
+    ray_tpu.init(num_cpus=1)
+    try:
+        @ray_tpu.remote
+        def ip():
+            return ray_tpu.get_runtime_context().get_node_ip()
+
+        assert ray_tpu.get(ip.remote()) == "127.0.0.1"
+    finally:
+        ray_tpu.shutdown()
