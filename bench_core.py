@@ -38,11 +38,23 @@ def main():
     ap.add_argument("--ops", default="", help="comma-separated subset")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--num-cpus", type=int, default=4)
+    ap.add_argument("--daemons", type=int, default=0,
+                    help="add N separate-process node daemons (direct-task "
+                    "spillback topology) and run a many-tasks op across "
+                    "them")
     args = ap.parse_args()
 
     import ray_tpu
 
-    ray_tpu.init(num_cpus=args.num_cpus)
+    cluster = None
+    if args.daemons:
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster(head_node_args={"num_cpus": args.num_cpus})
+        for _ in range(args.daemons):
+            cluster.add_node(num_cpus=args.num_cpus, separate_process=True)
+    else:
+        ray_tpu.init(num_cpus=args.num_cpus)
     results = {}
     selected = set(args.ops.split(",")) if args.ops else None
 
@@ -149,7 +161,24 @@ def main():
 
     run("wait_first_of_10", wait_one, 10)
 
-    ray_tpu.shutdown()
+    if args.daemons:
+        # scalability-envelope probe (reference: release/benchmarks
+        # distributed/test_many_tasks.py): direct path + spillback across
+        # the daemons; the head sees only batched events
+        def many_tasks_5k():
+            ray_tpu.get([nop.remote() for _ in range(5000)], timeout=600)
+
+        run("many_tasks_5k_across_daemons", many_tasks_5k, 5000)
+        from ray_tpu.core import runtime as _rt
+
+        head = _rt.get_current_runtime().head
+        print(f"# head.tasks after many-tasks: {len(head.tasks)} "
+              f"(direct path leaves no per-task head records)")
+
+    if cluster is not None:
+        cluster.shutdown()
+    else:
+        ray_tpu.shutdown()
     if args.json:
         print(json.dumps(results))
     return results

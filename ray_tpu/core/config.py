@@ -53,6 +53,18 @@ class Config:
     # + max_tasks_in_flight; hides the done->dispatch round trip)
     worker_pipeline_depth: int = 2
 
+    # ---- direct (head-bypass) task path ----
+    # Eligible plain CPU tasks execute via the submitter's node + one-hop
+    # peer spillback, with batched event reports to the head (reference:
+    # normal_task_submitter.cc — the GCS is out of the normal-task path)
+    direct_task_enabled: bool = True
+    # spill to a peer when the local queue exceeds factor * max_workers
+    direct_spill_queue_factor: float = 4.0
+    # executor nodes batch (object-location + observability) events to the
+    # head: flush at this many events or this age, whichever first
+    direct_event_batch_size: int = 200
+    direct_event_flush_ms: int = 20
+
     # ---- tasks / fault tolerance (reference: ray_config_def.h:138,414,835) ----
     task_retry_delay_ms: int = 0
     lineage_pinning_enabled: bool = True

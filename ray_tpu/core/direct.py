@@ -1,0 +1,204 @@
+"""Direct (head-bypass) task path: owner-side task table + eligibility.
+
+The reference keeps the GCS out of the normal-task hot path entirely: the
+submitting CoreWorker owns the task (retries, result table), leases a
+worker from its *local* raylet, and pushes the task directly
+(``src/ray/core_worker/transport/normal_task_submitter.cc:355``,
+``reference_count.h:61`` — ownership lives with the submitter). Round 2 of
+this framework routed every submit/finish through the single Head, capping
+throughput at what one GIL-bound process can relay.
+
+This module is the submitter side of the same decentralization: eligible
+plain tasks go straight to the submitting process's *node* (worker → its
+node over the existing channel; driver → the in-process head node), which
+executes them from its own worker pool — or spills them one hop to a peer
+node over the daemon↔daemon mesh — and replies directly to the owner.
+The head only sees small *batched* event reports (object locations +
+observability), amortized hundreds of tasks per message.
+
+Ownership semantics match the reference: if the owner dies, its in-flight
+direct tasks and their results are lost (Ray's owner-died behavior); if
+the executor dies, the owner retries per ``max_retries``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from . import serialization
+from .exceptions import TaskCancelledError, WorkerCrashedError
+from .ids import ObjectID, TaskID
+from .task_spec import TaskSpec
+
+# resources a node can grant from its worker-pool slots without head-side
+# accounting (unit-instance resources like TPU need index binding; custom
+# resources need cluster placement)
+_DIRECT_RESOURCES = {"CPU"}
+
+_SYSTEM_ERRS = ("WorkerCrashedError", "NodeDiedError")
+
+
+def direct_eligible(spec: TaskSpec) -> bool:
+    """Conservative hot-class test: plain <=1-CPU task, default placement,
+    inline args only. Ref args would need dependency staging at the node;
+    num_cpus>1 needs real resource accounting (a node grants direct tasks
+    one worker SLOT, ~1 CPU); both keep the head path."""
+    s = spec.scheduling_strategy
+    return (
+        spec.actor_id is None
+        and not spec.is_actor_creation
+        and not spec.streaming
+        and spec.runtime_env is None
+        and s.kind == "DEFAULT"
+        and s.placement_group_id is None
+        and s.node_id is None
+        and not spec.arg_object_ids()
+        and all(k in _DIRECT_RESOURCES for k, _ in spec.resources)
+        and spec.resources.get("CPU") <= 1.0
+    )
+
+
+class DirectTaskManager:
+    """Owner-side table of in-flight direct tasks + their inline results.
+
+    The analog of the reference CoreWorker's ``TaskManager`` + in-process
+    memory store (``task_manager.h:208``, ``memory_store.cc``): completion
+    wakes local getters; system failures retry by resubmitting through the
+    ``submit`` callback; user errors deserialize to raised exceptions.
+    """
+
+    def __init__(self, submit: Callable[[TaskSpec], None]):
+        self._submit = submit
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: Dict[TaskID, TaskSpec] = {}
+        self._cancelled: set = set()
+        # oids whose ObjectRef died before the task completed: their
+        # results are discarded on arrival instead of retained forever
+        self._dropped: set = set()
+        # oid -> (payload bytes | None, is_error); None payload = large
+        # result sealed in the executor node's store (get falls back to the
+        # store/locate path)
+        self._results: Dict[ObjectID, Tuple[Optional[bytes], bool]] = {}
+
+    # ------------------------------------------------------------ submit
+
+    def register(self, spec: TaskSpec) -> None:
+        with self._lock:
+            self._pending[spec.task_id] = spec
+
+    def cancel(self, oid: ObjectID) -> bool:
+        """Owner-side cancel: mark so the (already-running) result seals
+        TaskCancelledError on arrival. Returns True if it was pending."""
+        tid = oid.task_id()
+        with self._lock:
+            if tid in self._pending:
+                self._cancelled.add(tid)
+                return True
+        return False
+
+    # ------------------------------------------------------------ complete
+
+    def complete(self, task_id: TaskID, err_name: Optional[str],
+                 results: List[Tuple[ObjectID, Optional[bytes], bool]]) -> None:
+        """Executor reply. ``results`` entries: (oid, inline payload | None
+        for store-sealed, is_error)."""
+        resubmit = None
+        with self._lock:
+            spec = self._pending.get(task_id)
+            if spec is None:
+                return  # stale (superseded attempt)
+            cancelled = task_id in self._cancelled
+            if err_name is not None and not cancelled and self._retriable(
+                    spec, err_name):
+                spec.attempt += 1
+                resubmit = spec
+            else:
+                self._pending.pop(task_id, None)
+                self._cancelled.discard(task_id)
+                if cancelled:
+                    err = TaskCancelledError(
+                        f"task {task_id.hex()} cancelled")
+                    payload = serialization.serialize(err).to_bytes()
+                    for oid in spec.return_ids():
+                        self._results[oid] = (payload, True)
+                elif err_name in _SYSTEM_ERRS and not results:
+                    err = WorkerCrashedError(
+                        f"direct task {spec.function_name} lost its "
+                        f"executor ({err_name}), retries exhausted")
+                    payload = serialization.serialize(err).to_bytes()
+                    for oid in spec.return_ids():
+                        self._results[oid] = (payload, True)
+                else:
+                    for oid, payload, is_err in results:
+                        if oid in self._dropped:
+                            self._dropped.discard(oid)
+                        else:
+                            self._results[oid] = (payload, is_err)
+                self._cv.notify_all()
+        if resubmit is not None:
+            self._submit(resubmit)
+
+    @staticmethod
+    def _retriable(spec: TaskSpec, err_name: str) -> bool:
+        if spec.attempt >= spec.max_retries:
+            return False
+        if err_name in _SYSTEM_ERRS:
+            return True
+        return spec.retry_exceptions
+
+    # ------------------------------------------------------------ reads
+
+    def owns(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return (oid in self._results
+                    or oid.task_id() in self._pending)
+
+    def get_local(self, oid: ObjectID,
+                  timeout: Optional[float]) -> Optional[Tuple[Optional[bytes], bool]]:
+        """Blocking read of an owned result. Returns (payload|None, is_err),
+        or None if this manager does not own the object. A None payload
+        means the bytes live in a node store — caller falls through to the
+        store path."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if oid in self._results:
+                    return self._results[oid]
+                if oid.task_id() not in self._pending:
+                    return None
+                # one shared deadline across wakeups: every completion
+                # notifies this cv, so a per-wait timeout would restart
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    from .exceptions import GetTimeoutError
+
+                    raise GetTimeoutError(f"get timed out on {oid.hex()}")
+                self._cv.wait(remaining)
+
+    def ready_subset(self, oids) -> set:
+        """Non-blocking: which of ``oids`` are completed owned results."""
+        with self._lock:
+            return {o for o in oids if o in self._results}
+
+    def pending_oids(self, oids) -> set:
+        """Which of ``oids`` belong to still-pending owned tasks."""
+        with self._lock:
+            return {o for o in oids if o.task_id() in self._pending}
+
+    def wait_any(self, timeout: Optional[float]) -> None:
+        """Block until any completion lands (wait() integration)."""
+        with self._lock:
+            self._cv.wait(timeout)
+
+    def drop(self, oid: ObjectID) -> None:
+        """Owner released its ref: free the retained inline result (or
+        mark a still-pending task's result discard-on-arrival)."""
+        with self._lock:
+            if self._results.pop(oid, None) is None \
+                    and oid.task_id() in self._pending:
+                self._dropped.add(oid)

@@ -91,6 +91,22 @@ class Node:
         # exactly once, on whichever of (registration, process exit)
         # happens first
         self._starting_pids: set = set()
+        # ---- direct (head-bypass) task path state -----------------------
+        # locally-executing direct tasks: task_id -> (origin, spec)
+        self._direct: Dict[object, Tuple[tuple, TaskSpec]] = {}
+        # tasks forwarded to a peer: task_id -> (origin, spec, peer_hex)
+        self._forwarded: Dict[object, Tuple[tuple, TaskSpec, str]] = {}
+        self._peers: Dict[str, Channel] = {}      # peer_hex -> channel
+        # optimistic in-flight counts per peer: reported queue depths lag
+        # by a syncer period, so without this a submission burst dogpiles
+        # whichever peer last reported the lowest load
+        self._peer_inflight: Dict[str, int] = {}
+        self._peer_lock = threading.Lock()
+        self._peer_key: Optional[bytes] = None    # set by start_object_server
+        self._devents: List[tuple] = []           # batched head event reports
+        self._dev_lock = threading.Lock()
+        self._dev_first: float = 0.0
+        self._dev_flusher_started = False
         with self._lock:
             for _ in range(min(cfg.worker_prestart_count, self.max_workers)):
                 self._start_worker_locked()
@@ -116,6 +132,298 @@ class Node:
             return True
         except OSError:
             return False
+
+    # ---------------------------------------------------- direct task path
+    # (reference: normal_task_submitter.cc — submitter leases from its
+    # LOCAL raylet and pushes directly; the GCS sees only async events)
+
+    def submit_direct(self, spec: TaskSpec, origin: tuple) -> None:
+        """Execute an eligible plain task without head involvement.
+
+        ``origin`` routes the completion reply:
+          ("worker", worker_id)      — a worker on this node submitted it
+          ("driver", callback)       — the in-process driver submitted it
+          ("peer", channel)          — a peer node forwarded it here
+          ("node", node, inner)      — in-process peer hop: reply via node
+        """
+        if not self.alive:
+            self._reply_direct(origin, spec.task_id, "NodeDiedError", [])
+            return
+        if spec.direct_hops == 0 and origin[0] != "peer" and self._maybe_spill(
+                spec, origin):
+            return
+        with self._lock:
+            self._direct[spec.task_id] = (origin, spec, time.time())
+        self._ensure_direct_flusher()
+        try:
+            self.dispatch(spec, {})
+        except RuntimeError:
+            with self._lock:
+                self._direct.pop(spec.task_id, None)
+            self._reply_direct(origin, spec.task_id, "NodeDiedError", [])
+
+    def _finish_direct(self, origin: tuple, spec: TaskSpec, task_id,
+                       results, err_name: Optional[str],
+                       t_start: Optional[float] = None) -> None:
+        """Executor-side completion: seal inline results locally, batch the
+        event report to the head, reply straight to the owner."""
+        sealed = []
+        for oid, payload, is_err in results:
+            if payload is not None:
+                try:
+                    self.store.put_inline(oid, payload, is_err)
+                    sealed.append(oid)
+                except Exception:
+                    # store full: the owner still gets the inline payload,
+                    # but head-path consumers (ref args, borrowers) need a
+                    # resolvable location — seal in the head store instead
+                    try:
+                        self.head.on_sealed_payload(oid, payload, is_err)
+                    except Exception:
+                        pass
+        self._append_devent(spec, err_name, sealed, t_start)
+        self._reply_direct(origin, task_id, err_name, results)
+
+    def _reply_direct(self, origin: tuple, task_id, err_name,
+                      results) -> None:
+        kind = origin[0]
+        try:
+            if kind == "worker":
+                with self._lock:
+                    w = self._workers.get(origin[1])
+                if w is not None:
+                    w.channel.send("ddone", task_id, err_name, results)
+            elif kind == "driver":
+                origin[1](task_id, err_name, results)
+            elif kind == "peer":
+                origin[1].send("pdone", task_id, err_name, results)
+            elif kind == "node":
+                origin[1]._reply_direct(origin[2], task_id, err_name, results)
+        except (OSError, EOFError):
+            pass  # owner gone: its results die with it (owner-died semantics)
+
+    def cancel_direct(self, task_id, force: bool = False) -> None:
+        """Owner-initiated cancel of a direct task: drop it from the local
+        queue if not started, interrupt the worker if running, or forward
+        the cancel to the peer executing it (reference:
+        CoreWorker::CancelTask -> executor interrupt)."""
+        peer_hex = None
+        with self._lock:
+            fwd = self._forwarded.get(task_id)
+            if fwd is not None:
+                peer_hex = fwd[2]
+            elif task_id in self._direct:
+                for i, (spec, binding) in enumerate(self._local_queue):
+                    if spec.task_id == task_id:
+                        del self._local_queue[i]
+                        origin, spec, _t = self._direct.pop(task_id)
+                        break
+                else:
+                    origin = None
+            else:
+                return
+        if peer_hex is not None:
+            with self._peer_lock:
+                ch = self._peers.get(peer_hex)
+            if ch is not None:
+                try:
+                    ch.send("pcancel", task_id, force)
+                except (OSError, EOFError):
+                    pass
+            return
+        if origin is not None:  # was still queued: never ran
+            self._reply_direct(origin, task_id, "TaskCancelledError", [])
+            return
+        # running (or staged) on a worker: interrupt it
+        self.cancel_task(task_id, None, force)
+
+    # ---- spillback -------------------------------------------------------
+
+    def _maybe_spill(self, spec: TaskSpec, origin: tuple) -> bool:
+        cfg = global_config()
+        with self._lock:
+            depth = len(self._local_queue)
+        if depth <= cfg.direct_spill_queue_factor * self.max_workers:
+            return False
+        cands = self._peer_candidates()
+        if not cands:
+            return False
+        with self._peer_lock:
+            cands = [(h, handle, q + self._peer_inflight.get(h, 0))
+                     for h, handle, q in cands]
+        cands.sort(key=lambda c: c[2])
+        peer_hex, handle, queue = cands[0]
+        if queue >= depth:
+            return False  # everyone is as busy as we are
+        spec.direct_hops = 1
+        if not isinstance(handle, (tuple, list)):
+            # in-process peer Node: direct call, reply hops back through us
+            handle.submit_direct(spec, ("node", self, origin))
+            return True
+        ch = self._peer_channel(peer_hex, handle)
+        if ch is None:
+            return False
+        with self._lock:
+            self._forwarded[spec.task_id] = (origin, spec, peer_hex)
+        with self._peer_lock:
+            self._peer_inflight[peer_hex] = \
+                self._peer_inflight.get(peer_hex, 0) + 1
+        try:
+            ch.send("psubmit", pickle.dumps(spec))
+        except (OSError, EOFError):
+            with self._lock:
+                self._forwarded.pop(spec.task_id, None)
+            self._drop_peer(peer_hex)
+            return False
+        return True
+
+    def _peer_candidates(self) -> List[tuple]:
+        """[(hex, Node | addr, queue_depth)] of alive CPU peers."""
+        head = self.head
+        out: List[tuple] = []
+        view = getattr(head, "cluster_view", None)
+        if view is not None:  # daemon side (RemoteHead)
+            for e in view:
+                if (e.get("hex") != self.hex and e.get("alive")
+                        and e.get("addr")
+                        and e.get("resources", {}).get("CPU", 0) > 0):
+                    out.append((e["hex"], tuple(e["addr"]),
+                                e.get("queue", 0)))
+            return out
+        # in-process side: peers straight off the head's node table
+        with head._lock:
+            items = list(head.nodes.items())
+        for h, n in items:
+            if h == self.hex or not getattr(n, "alive", False):
+                continue
+            if hasattr(n, "store"):  # local Node
+                if n.resources.total.get("CPU") > 0:
+                    out.append((h, n, len(n._local_queue)))
+            else:  # NodeProxy: reach the daemon via its object server
+                load = head.node_loads.get(h, {})
+                if n.resources_total.get("CPU", 0) > 0:
+                    out.append((h, tuple(n.object_addr),
+                                load.get("queue_depth", 0)))
+        return out
+
+    def _peer_channel(self, peer_hex: str, addr) -> Optional[Channel]:
+        with self._peer_lock:
+            ch = self._peers.get(peer_hex)
+            if ch is not None:
+                return ch
+        key = self._peer_key or getattr(self.head, "cluster_key", None) \
+            or getattr(self.head, "_cluster_key", None)
+        if key is None:
+            return None
+        import multiprocessing.connection as mpc
+        import socket
+
+        try:
+            # mpc.Client has no connect timeout (~2 min OS default on a
+            # partitioned host, which would stall the submitter's reader
+            # loop): probe reachability with a bounded connect first
+            socket.create_connection(tuple(addr), timeout=2.0).close()
+            conn = mpc.Client(address=tuple(addr), family="AF_INET",
+                              authkey=key)
+            conn.send(("peer_hello", self.hex))
+            ch = Channel(conn)
+        except Exception:
+            return None
+        with self._peer_lock:
+            cur = self._peers.get(peer_hex)
+            if cur is not None:
+                ch.close()
+                return cur
+            self._peers[peer_hex] = ch
+        threading.Thread(target=self._peer_reader, args=(peer_hex, ch),
+                         daemon=True, name=f"peer-{peer_hex[:6]}").start()
+        return ch
+
+    def _peer_reader(self, peer_hex: str, ch: Channel) -> None:
+        while True:
+            try:
+                tag, payload = ch.recv()
+            except (EOFError, OSError, TypeError):
+                break
+            if tag == "pdone":
+                task_id, err_name, results = payload
+                with self._lock:
+                    entry = self._forwarded.pop(task_id, None)
+                with self._peer_lock:
+                    n = self._peer_inflight.get(peer_hex, 0)
+                    if n > 0:
+                        self._peer_inflight[peer_hex] = n - 1
+                if entry is not None:
+                    self._reply_direct(entry[0], task_id, err_name, results)
+        self._drop_peer(peer_hex)
+
+    def _drop_peer(self, peer_hex: str) -> None:
+        """Peer channel died: fail its forwarded tasks (owners retry)."""
+        with self._peer_lock:
+            ch = self._peers.pop(peer_hex, None)
+            self._peer_inflight.pop(peer_hex, None)
+        if ch is not None:
+            ch.close()
+        with self._lock:
+            lost = [(tid, e) for tid, e in self._forwarded.items()
+                    if e[2] == peer_hex]
+            for tid, _ in lost:
+                self._forwarded.pop(tid, None)
+        for tid, (origin, spec, _) in lost:
+            self._reply_direct(origin, tid, "NodeDiedError", [])
+
+    # ---- batched head events --------------------------------------------
+
+    def _append_devent(self, spec: TaskSpec, err_name, sealed_oids,
+                       t_start: Optional[float] = None) -> None:
+        cfg = global_config()
+        ev = (spec.task_id.binary(), spec.function_name, err_name,
+              sealed_oids, t_start or time.time(), time.time())
+        if hasattr(self.head, "nodes"):
+            # in-process node: the head is a method call away — publish
+            # synchronously so state API / timeline / waiters see the task
+            # immediately (batching only pays off across a daemon link)
+            self._publish_devents([ev])
+            return
+        flush = None
+        with self._dev_lock:
+            if not self._devents:
+                self._dev_first = time.monotonic()
+            self._devents.append(ev)
+            if len(self._devents) >= cfg.direct_event_batch_size:
+                flush, self._devents = self._devents, []
+        if flush:
+            self._publish_devents(flush)
+
+    def _publish_devents(self, batch) -> None:
+        try:
+            self.head.publish_direct_events(self.hex, batch)
+        except Exception:
+            pass  # head link lost: daemon is shutting down
+
+    def _ensure_direct_flusher(self) -> None:
+        if hasattr(self.head, "nodes"):
+            return  # in-process node: events publish synchronously
+        with self._dev_lock:
+            if self._dev_flusher_started:
+                return
+            self._dev_flusher_started = True
+        cfg = global_config()
+        interval = max(0.005, cfg.direct_event_flush_ms / 1000.0)
+
+        def loop():
+            while self.alive:
+                time.sleep(interval)
+                flush = None
+                with self._dev_lock:
+                    if self._devents and (time.monotonic() - self._dev_first
+                                          >= interval):
+                        flush, self._devents = self._devents, []
+                if flush:
+                    self._publish_devents(flush)
+
+        threading.Thread(target=loop, daemon=True,
+                         name=f"devents-{self.hex[:6]}").start()
 
     def _pump(self) -> None:
         """Match queued tasks with idle workers; start workers as needed.
@@ -298,6 +606,12 @@ class Node:
             elif tag == "rpc":
                 req_id, op, *args = payload
                 self._handler_pool.submit(self._handle_rpc, w, req_id, op, args)
+            elif tag == "dsubmit":
+                # direct (head-bypass) submission from this worker
+                spec = pickle.loads(payload[0])
+                self.submit_direct(spec, ("worker", w.worker_id))
+            elif tag == "dcancel":
+                self.cancel_direct(payload[0], payload[1])
             elif tag == "release":
                 for oid in payload[0]:
                     self.store.remove_ref(oid)
@@ -370,6 +684,7 @@ class Node:
     def _on_task_done(self, w: WorkerHandle, task_id, results, err_name) -> None:
         with self._lock:
             entry = w.assigned.pop(task_id, None)
+            direct = self._direct.pop(task_id, None)
             if entry is not None:
                 spec, binding, attempt = entry
                 if spec.is_actor_creation and err_name is None:
@@ -381,10 +696,15 @@ class Node:
             else:
                 # actor task done (worker stays "actor") or stale
                 spec, binding, attempt = None, None, None
-        # The head decides whether to seal results (it may retry instead).
-        self.head.on_task_finished(self, task_id, err_name, spec, binding,
-                                   results, worker_id=w.worker_id,
-                                   attempt=attempt)
+        if direct is not None:
+            # head-bypass path: owner settles (retries live there)
+            self._finish_direct(direct[0], direct[1], task_id, results,
+                                err_name, t_start=direct[2])
+        else:
+            # The head decides whether to seal results (it may retry).
+            self.head.on_task_finished(self, task_id, err_name, spec, binding,
+                                       results, worker_id=w.worker_id,
+                                       attempt=attempt)
         self._pump()
 
     def _on_worker_exit(self, w: WorkerHandle) -> None:
@@ -402,9 +722,17 @@ class Node:
             self._workers.pop(w.worker_id, None)
             assigned = list(w.assigned.values())
             w.assigned.clear()
+            direct = [self._direct.pop(s.task_id)
+                      for s, _, _ in assigned
+                      if s.task_id in self._direct]
+            direct_ids = {spec.task_id for _, spec, _ in direct}
         w.channel.close()
-        if assigned:
-            for spec, binding, _attempt in assigned:
+        head_assigned = [e for e in assigned if e[0].task_id not in direct_ids]
+        # direct tasks: the OWNER retries — report the crash straight back
+        for origin, spec, _t0 in direct:
+            self._reply_direct(origin, spec.task_id, "WorkerCrashedError", [])
+        if head_assigned:
+            for spec, binding, _attempt in head_assigned:
                 self.head.on_worker_crashed(self, w, spec, binding, prev_state)
         else:
             self.head.on_worker_crashed(self, w, None, None, prev_state)
@@ -477,9 +805,10 @@ class Node:
             if host is None:
                 host = ("127.0.0.1" if self.node_ip.startswith("127.")
                         else "0.0.0.0")
+            self._peer_key = authkey
             self.object_server = ObjectServer(
                 self.store, authkey, host,
-                advertise_host=self.node_ip)
+                advertise_host=self.node_ip, node=self)
         return self.object_server
 
     def kill_worker(self, worker_id: WorkerID) -> None:

@@ -148,6 +148,13 @@ class RemoteHead:
     def on_object_sealed(self, oid: ObjectID, node_hex: str) -> None:
         self._send("sealed", oid)
 
+    def publish_direct_events(self, node_hex: str, events) -> None:
+        self._send("devents", events)
+
+    def on_sealed_payload(self, oid: ObjectID, payload: bytes,
+                          is_error: bool) -> None:
+        self._send("sealed_payload", oid, payload, is_error)
+
     def on_stream_item(self, task_id, index: int) -> None:
         self._send("stream_item", task_id, index)
 

@@ -56,9 +56,10 @@ class ObjectServer:
     """Per-node chunk server reading from the node's LocalObjectStore."""
 
     def __init__(self, store, authkey: bytes, host: str = "127.0.0.1",
-                 advertise_host: Optional[str] = None):
+                 advertise_host: Optional[str] = None, node=None):
         self.store = store
         self.authkey = authkey
+        self.node = node  # owning Node: enables the peer control session
         self._listener = mpc.Listener(address=(host, 0), family="AF_INET",
                                       authkey=authkey)
         bound_host, port = self._listener.address
@@ -89,6 +90,11 @@ class ObjectServer:
         try:
             while True:
                 msg = conn.recv()
+                if msg[0] == "peer_hello" and self.node is not None:
+                    # switch to the node-to-node control session (direct-
+                    # task spillback; reference: NodeManagerService peer RPC)
+                    self._serve_peer(conn)
+                    return
                 if msg[0] != "pull":
                     break
                 oid = ObjectID(msg[1])
@@ -119,6 +125,28 @@ class ObjectServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _serve_peer(self, conn) -> None:
+        """Session with a peer node: accept forwarded direct tasks; the
+        executing node replies over this same channel ("pdone")."""
+        import pickle
+
+        from .protocol import Channel
+
+        ch = Channel(conn)
+        while self._alive:
+            try:
+                tag, payload = ch.recv()
+            except (EOFError, OSError, TypeError):
+                return  # origin node gone; in-flight replies fail silently
+            if tag == "psubmit":
+                try:
+                    spec = pickle.loads(payload[0])
+                except Exception:
+                    continue
+                self.node.submit_direct(spec, ("peer", ch))
+            elif tag == "pcancel":
+                self.node.cancel_direct(payload[0], payload[1])
 
     def close(self) -> None:
         self._alive = False

@@ -35,7 +35,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 # tags/payload shapes — mixed-version clusters fail fast with a clear
 # error instead of unpickling garbage (the pickle-schema analog of the
 # reference's versioned protobuf wire format, src/ray/protobuf/).
-PROTOCOL_VERSION = 1
+PROTOCOL_VERSION = 2  # v2: direct-task path (dsubmit/ddone/psubmit/devents)
 
 
 class ProtocolVersionError(ConnectionError):

@@ -251,6 +251,48 @@ class Head:
         info = self.gcs.nodes.get(proxy.hex)
         if info is not None:
             info.last_heartbeat = time.monotonic()
+        # keep daemons' peer-load views fresh for direct-task spillback
+        # (rate-limited; reference: RaySyncer periodic re-broadcast)
+        now = time.monotonic()
+        if now - getattr(self, "_last_view_broadcast", 0.0) > 0.5:
+            self._last_view_broadcast = now
+            self._broadcast_cluster_view()
+
+    def on_sealed_payload(self, oid: ObjectID, payload: bytes,
+                          is_error: bool) -> None:
+        """Rare-path escape hatch: an executor node couldn't store a direct
+        result (arena full) — seal the bytes in the head store so head-path
+        consumers can still resolve the ref."""
+        self.head_node.store.put_inline(oid, payload, is_error)
+        self.on_object_sealed(oid, self.head_node.hex)
+
+    def publish_direct_events(self, node_hex: str, events) -> None:
+        """Apply a node's batched direct-task event report: object
+        locations (for cross-node consumers) + observability events. The
+        head does no per-task bookkeeping on this path — this batch is its
+        ONLY involvement (reference: GcsTaskManager as a pure event sink,
+        gcs_task_manager.h:86)."""
+        from ray_tpu.util.metrics import registry
+
+        for task_id_b, fn_name, err_name, sealed_oids, t0, t1 in events:
+            for oid in sealed_oids:
+                # full seal handling: location + WAITING_DEPS wakeups
+                self.on_object_sealed(oid, node_hex)
+            if global_config().task_events_enabled:
+                # RUNNING + terminal pair so timeline/state get durations
+                self.gcs.record_task_event(TaskEvent(
+                    task_id=task_id_b, name=fn_name, state="RUNNING",
+                    node_hex=node_hex, ts=t0, attempt=0, error=None))
+                self.gcs.record_task_event(TaskEvent(
+                    task_id=task_id_b, name=fn_name,
+                    state="FAILED" if err_name else "FINISHED",
+                    node_hex=node_hex, ts=t1, attempt=0,
+                    error=err_name))
+        registry().record("ray_tpu_tasks_total", "counter",
+                          "task state transitions", (("state", "DIRECT"),),
+                          float(len(events)), mode="add")
+        with self._object_cv:
+            self._object_cv.notify_all()
 
     def _broadcast_cluster_view(self) -> None:
         """Fan the merged membership view out to every daemon (reference:
@@ -262,9 +304,23 @@ class Head:
                        if isinstance(n, NodeProxy) and n.alive]
         with self.gcs._lock:  # snapshot: registrations mutate concurrently
             infos = list(self.gcs.nodes.values())
-        view = [{"hex": info.hex, "alive": info.alive,
-                 "resources": info.resources_total}
-                for info in infos]
+        view = []
+        for info in infos:
+            node = self.nodes.get(info.hex)
+            addr = None
+            queue = 0
+            if node is not None:
+                if self._is_local(node):
+                    srv = getattr(node, "object_server", None)
+                    addr = list(srv.address) if srv else None
+                    queue = len(node._local_queue)
+                else:
+                    addr = list(node.object_addr)
+                    queue = self.node_loads.get(info.hex, {}).get(
+                        "queue_depth", 0)
+            view.append({"hex": info.hex, "alive": info.alive,
+                         "resources": info.resources_total,
+                         "addr": addr, "queue": queue})
         for p in proxies:
             p._send("cluster_view", version, view)
 
@@ -401,6 +457,10 @@ class Head:
                 proxy.last_pong = time.monotonic()
             elif tag == "sync":
                 self.on_node_sync(proxy, payload[0])
+            elif tag == "devents":
+                self.publish_direct_events(proxy.hex, payload[0])
+            elif tag == "sealed_payload":
+                self.on_sealed_payload(*payload)
             elif tag == "req":
                 req_id, op, args = payload
                 self._daemon_pool.submit(self._handle_daemon_req, proxy,
@@ -1434,12 +1494,21 @@ class Head:
 
 class DriverRuntime:
     def __init__(self, head: Head):
+        from .direct import DirectTaskManager
+
         self.head = head
         self.job_id = head.job_id
         self._driver_task_id = TaskID.for_driver_task(self.job_id)
         self._put_counter = 0
         self._lock = threading.Lock()
         self._fn_cache: Dict[str, Any] = {}
+        # direct (head-bypass) path: the driver owns its eligible plain
+        # tasks, submitted straight to the in-process head node
+        self.direct = DirectTaskManager(self._direct_submit)
+
+    def _direct_submit(self, spec: TaskSpec) -> None:
+        self.head.head_node.submit_direct(
+            spec, ("driver", self.direct.complete))
 
     @property
     def mode(self) -> str:
@@ -1477,7 +1546,11 @@ class DriverRuntime:
         out = []
         for r in refs:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-            payload, is_error = self.head.get_object_payload(r.id, remaining)
+            local = self.direct.get_local(r.id, remaining)
+            if local is not None and local[0] is not None:
+                payload, is_error = local
+            else:
+                payload, is_error = self.head.get_object_payload(r.id, remaining)
             value = serialization.deserialize(payload)
             if is_error:
                 raise value
@@ -1485,14 +1558,42 @@ class DriverRuntime:
         return out
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
-        ready_ids = set(self.head.wait_objects([r.id for r in refs], num_returns, timeout))
-        ready = [r for r in refs if r.id in ready_ids]
-        not_ready = [r for r in refs if r.id not in ready_ids]
-        return ready[:len(ready)], not_ready
+        oids = [r.id for r in refs]
+        if not self.direct.pending_oids(oids) and not self.direct.ready_subset(oids):
+            ready_ids = set(self.head.wait_objects(oids, num_returns, timeout))
+        else:
+            # direct-owned results resolve in-process; poll both sources
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while True:
+                ready_ids = set(self.direct.ready_subset(oids))
+                pending = self.direct.pending_oids(oids)
+                rest = [o for o in oids if o not in ready_ids
+                        and o not in pending]
+                if rest and len(ready_ids) < num_returns:
+                    ready_ids |= set(self.head.wait_objects(
+                        rest, num_returns - len(ready_ids), 0.0))
+                if len(ready_ids) >= num_returns:
+                    break
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                self.direct.wait_any(
+                    0.05 if remaining is None else min(0.05, remaining))
+        ready = [r for r in refs if r.id in ready_ids][:num_returns]
+        ready_set = {r.id for r in ready}
+        not_ready = [r for r in refs if r.id not in ready_set]
+        return ready, not_ready
 
     # ---- tasks ----
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
-        self.head.submit_spec(spec)
+        from .direct import direct_eligible
+
+        if global_config().direct_task_enabled and direct_eligible(spec):
+            self.direct.register(spec)
+            self._direct_submit(spec)
+        else:
+            self.head.submit_spec(spec)
         return [ObjectRef(oid) for oid in spec.return_ids()]
 
     def register_function(self, function_id: str, payload: bytes) -> None:
@@ -1520,6 +1621,10 @@ class DriverRuntime:
         self.head.kill_actor(actor_id, no_restart)
 
     def cancel_task(self, oid: ObjectID, force: bool = False):
+        if self.direct.cancel(oid):
+            # owner-side mark + node-side dequeue/interrupt
+            self.head.head_node.cancel_direct(oid.task_id(), force)
+            return
         self.head.cancel_task(oid, force)
 
     def kv(self, op: str, *args):
@@ -1534,6 +1639,7 @@ class DriverRuntime:
             self.head.ref_counts[oid] += 1
 
     def remove_local_ref(self, oid: ObjectID) -> None:
+        self.direct.drop(oid)
         with self.head._lock:
             self.head.ref_counts[oid] -= 1
             should_delete = self.head.ref_counts[oid] <= 0

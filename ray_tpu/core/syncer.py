@@ -30,6 +30,7 @@ def collect_load(node) -> Dict[str, Any]:
         load1 = 0.0
     return {
         "ts": time.time(),
+        "queue_depth": len(getattr(node, "_local_queue", ()) or ()),
         "store_capacity": store.capacity,
         "store_used": int(getattr(store.arena.allocator, "bytes_allocated",
                                   lambda: 0)())

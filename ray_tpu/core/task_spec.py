@@ -66,6 +66,9 @@ class TaskSpec:
     attempt: int = 0
     submitted_at: float = field(default_factory=time.time)
     owner_is_driver: bool = True
+    # direct (head-bypass) path: number of node-to-node spillback hops this
+    # spec has taken; capped at 1 so forwarding can never ping-pong
+    direct_hops: int = 0
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]

@@ -78,6 +78,11 @@ class WorkerRuntime:
         self._cancelled: set = set()
         self._shutdown = threading.Event()
         self.accelerator_binding: Dict[str, List[int]] = {}
+        # direct (head-bypass) path: this worker OWNS its eligible nested
+        # submissions (reference: submitter-side TaskManager + memory store)
+        from .direct import DirectTaskManager
+
+        self.direct = DirectTaskManager(self._direct_submit)
 
     # ------------------------------------------------------------------ API
     # (same surface the driver runtime exposes; public api dispatches here)
@@ -111,6 +116,17 @@ class WorkerRuntime:
         return out
 
     def _get_one(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+        # owned direct results resolve in-process (blocks until the
+        # executor's reply lands; no node round-trip)
+        local = self.direct.get_local(oid, timeout)
+        if local is not None:
+            payload, is_error = local
+            if payload is not None:
+                value = serialization.deserialize(payload)
+                if is_error:
+                    raise value
+                return value
+            # large result: sealed in a node store — fall through
         rep = self.rpc.call("store", "get", oid, timeout, timeout=None)
         kind = rep[0]
         if kind == "timeout":
@@ -128,14 +144,53 @@ class WorkerRuntime:
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         oids = [r.id for r in refs]
-        ready_ids = self.rpc.call("store", "wait", oids, num_returns, timeout, timeout=None)
-        ready_set = set(ready_ids)
-        ready = [r for r in refs if r.id in ready_set]
-        not_ready = [r for r in refs if r.id not in ready_set]
+        owned_pending = self.direct.pending_oids(oids)
+        if not owned_pending:
+            ready_set = set(self.direct.ready_subset(oids))
+            rest = [o for o in oids if o not in ready_set]
+            if rest and len(ready_set) < num_returns:
+                ready_set |= set(self.rpc.call(
+                    "store", "wait", rest,
+                    num_returns - len(ready_set), timeout, timeout=None))
+        else:
+            # some requested oids are still-running direct tasks this
+            # worker owns: poll both sources in rounds
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            while True:
+                ready_set = set(self.direct.ready_subset(oids))
+                pending = self.direct.pending_oids(oids)
+                rest = [o for o in oids if o not in ready_set
+                        and o not in pending]
+                if rest and len(ready_set) < num_returns:
+                    ready_set |= set(self.rpc.call(
+                        "store", "wait", rest,
+                        num_returns - len(ready_set), 0.0, timeout=None))
+                if len(ready_set) >= num_returns:
+                    break
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    break
+                self.direct.wait_any(
+                    0.05 if remaining is None else min(0.05, remaining))
+        ready = [r for r in refs if r.id in ready_set][:num_returns]
+        chosen = {r.id for r in ready}
+        not_ready = [r for r in refs if r.id not in chosen]
         return ready, not_ready
 
+    def _direct_submit(self, spec: TaskSpec) -> None:
+        self.channel.send("dsubmit", pickle.dumps(spec))
+
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:
-        self.rpc.call("rpc", "submit_task", pickle.dumps(spec))
+        from .direct import direct_eligible
+
+        if global_config().direct_task_enabled and direct_eligible(spec):
+            spec.owner_is_driver = False
+            self.direct.register(spec)
+            self._direct_submit(spec)
+        else:
+            self.rpc.call("rpc", "submit_task", pickle.dumps(spec))
         return [ObjectRef(oid) for oid in spec.return_ids()]
 
     def register_function(self, function_id: str, payload: bytes) -> None:
@@ -156,6 +211,10 @@ class WorkerRuntime:
         self.rpc.call("rpc", "kill_actor", actor_id, no_restart)
 
     def cancel_task(self, oid: ObjectID, force: bool = False):
+        if self.direct.cancel(oid):
+            # owner-side mark + node-side dequeue/interrupt
+            self.channel.send("dcancel", oid.task_id(), force)
+            return
         self.rpc.call("rpc", "cancel_task", oid, force)
 
     def kv(self, op: str, *args):
@@ -169,7 +228,7 @@ class WorkerRuntime:
         pass  # head-side counting covers worker borrows conservatively
 
     def remove_local_ref(self, oid: ObjectID) -> None:
-        pass
+        self.direct.drop(oid)
 
     def add_borrow_ref(self, oid: ObjectID) -> None:
         pass
@@ -233,6 +292,10 @@ class WorkerRuntime:
                     break
                 if tag == "rep":
                     self.rpc.handle_reply(*payload)
+                elif tag == "ddone":
+                    # direct-task completion (may resubmit a retry inline)
+                    task_id, err_name, results = payload
+                    self.direct.complete(task_id, err_name, results)
                 elif tag == "exec":
                     spec: TaskSpec = pickle.loads(payload[0])
                     binding = payload[1]

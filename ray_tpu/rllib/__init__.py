@@ -14,16 +14,23 @@ del _rlu
 from .algorithm import Algorithm, EnvRunnerGroup
 from .appo import APPO, APPOConfig
 from .config import AlgorithmConfig
-from .dqn import DQN, DQNConfig, ReplayBuffer
+from .continuous import (ContinuousEnvRunner, ContinuousModuleSpec,
+                         ContinuousRLModule)
+from .dqn import DQN, DQNConfig
 from .env_runner import SingleAgentEnvRunner, compute_gae
 from .learner import Learner, LearnerGroup
 from .impala import IMPALA, IMPALAConfig
 from .ppo import PPO, PPOConfig
+from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer, SumTree
 from .rl_module import JaxRLModule, RLModuleSpec
+from .sac import SAC, SACConfig
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "EnvRunnerGroup",
     "SingleAgentEnvRunner", "compute_gae", "Learner", "LearnerGroup",
     "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
-    "APPO", "APPOConfig", "ReplayBuffer", "JaxRLModule", "RLModuleSpec",
+    "APPO", "APPOConfig", "SAC", "SACConfig",
+    "ReplayBuffer", "PrioritizedReplayBuffer", "SumTree",
+    "ContinuousRLModule", "ContinuousModuleSpec", "ContinuousEnvRunner",
+    "JaxRLModule", "RLModuleSpec",
 ]

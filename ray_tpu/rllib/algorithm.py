@@ -24,18 +24,19 @@ from .env_runner import SingleAgentEnvRunner
 
 
 class EnvRunnerGroup:
-    def __init__(self, config, env_creator, module_spec):
+    def __init__(self, config, env_creator, module_spec,
+                 runner_cls=SingleAgentEnvRunner):
         self.config = config
         self._env_creator = env_creator
         self._module_spec = module_spec
         self._runner_cls = ray_tpu.remote(
-            num_cpus=config.num_cpus_per_env_runner)(SingleAgentEnvRunner)
+            num_cpus=config.num_cpus_per_env_runner)(runner_cls)
         self._runners: List[Any] = []
         self._healthy: List[bool] = []
         self.num_restarts = 0
-        self._local: Optional[SingleAgentEnvRunner] = None
+        self._local: Optional[Any] = None
         if config.num_env_runners <= 0:
-            self._local = SingleAgentEnvRunner(
+            self._local = runner_cls(
                 env_creator, module_spec, config.num_envs_per_env_runner,
                 config.rollout_fragment_length, seed=config.seed)
             return
@@ -141,9 +142,14 @@ class Algorithm(Trainable):
         self.obs_space = probe_env.observation_space
         self.act_space = probe_env.action_space
         probe_env.close()
-        self.env_runner_group = EnvRunnerGroup(
-            config, env_creator, config.rl_module_spec)
+        self.env_runner_group = self._make_env_runner_group(
+            config, env_creator)
         self.learner_group = self._build_learner_group()
+
+    def _make_env_runner_group(self, config, env_creator) -> EnvRunnerGroup:
+        """Hook for algorithms with non-default runners (e.g. SAC's
+        continuous-action runner)."""
+        return EnvRunnerGroup(config, env_creator, config.rl_module_spec)
 
     # subclasses provide the loss / update wiring
     def _build_learner_group(self):

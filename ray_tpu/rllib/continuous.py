@@ -1,0 +1,242 @@
+"""Continuous-action (Box space) RL components: module + env runner.
+
+The discrete stack (rl_module.py / env_runner.py) covers categorical
+policies; SAC-family algorithms need a squashed-Gaussian actor, twin
+Q(s,a) critics, and float action rollouts (reference:
+rllib/algorithms/sac/sac_torch_model.py + SingleAgentEnvRunner with Box
+spaces). Same functional-pytree style: a module is (init, forward_*) pure
+functions so it runs eagerly on CPU runners and jitted on TPU learners.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+def _mlp_init(rng, dims, out_dim, out_scale=1.0):
+    import jax
+    import jax.numpy as jnp
+
+    params = {}
+    keys = iter(jax.random.split(rng, len(dims) + 1))
+    d = dims[0]
+    for i, h in enumerate(dims[1:]):
+        params[f"w{i}"] = (jax.random.normal(next(keys), (d, h), jnp.float32)
+                           * np.sqrt(2.0 / d))
+        params[f"b{i}"] = jnp.zeros((h,), jnp.float32)
+        d = h
+    params["w_out"] = (jax.random.normal(next(keys), (d, out_dim),
+                                         jnp.float32) * out_scale)
+    params["b_out"] = jnp.zeros((out_dim,), jnp.float32)
+    return params
+
+
+def _mlp_apply(params, x, act, n_hidden):
+    for i in range(n_hidden):
+        x = act(x @ params[f"w{i}"] + params[f"b{i}"])
+    return x @ params["w_out"] + params["b_out"]
+
+
+class ContinuousRLModule:
+    """Squashed-Gaussian actor + twin Q critics over a Box action space.
+
+    forward_actor(actor_params, obs, key) -> (action in [-1,1], logp)
+    actor_dist(actor_params, obs)         -> (mean, log_std)
+    forward_q(q_params, obs, act)         -> q values [B]
+    All three take their own SUBTREE of init()'s {actor, q1, q2} pytree.
+    Action scaling to env bounds happens in the runner/algorithm.
+    """
+
+    def __init__(self, obs_dim: int, act_dim: int,
+                 hiddens: Sequence[int] = (256, 256),
+                 activation: str = "relu"):
+        import jax
+        import jax.numpy as jnp
+
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.hiddens = tuple(hiddens)
+        self.act = {"tanh": jnp.tanh, "relu": jax.nn.relu}[activation]
+
+    def init(self, rng) -> Dict[str, Any]:
+        import jax
+
+        k_actor, k_q1, k_q2 = jax.random.split(rng, 3)
+        dims = (self.obs_dim,) + self.hiddens
+        q_dims = (self.obs_dim + self.act_dim,) + self.hiddens
+        return {
+            "actor": _mlp_init(k_actor, dims, 2 * self.act_dim,
+                               out_scale=0.01),
+            "q1": _mlp_init(k_q1, q_dims, 1),
+            "q2": _mlp_init(k_q2, q_dims, 1),
+        }
+
+    def actor_dist(self, actor_params, obs):
+        import jax.numpy as jnp
+
+        out = _mlp_apply(actor_params, obs.astype(jnp.float32), self.act,
+                         len(self.hiddens))
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def forward_actor(self, actor_params, obs, key):
+        """Reparameterized tanh-squashed sample + its log-prob."""
+        import jax
+        import jax.numpy as jnp
+
+        mean, log_std = self.actor_dist(actor_params, obs)
+        std = jnp.exp(log_std)
+        u = mean + std * jax.random.normal(key, mean.shape)
+        a = jnp.tanh(u)
+        # N(u; mean, std) log-density + tanh change-of-variables
+        logp_u = (-0.5 * ((u - mean) / std) ** 2 - log_std
+                  - 0.5 * np.log(2.0 * np.pi)).sum(-1)
+        logp = logp_u - jnp.log1p(-a ** 2 + 1e-6).sum(-1)
+        return a, logp
+
+    def forward_q(self, q_params, obs, act):
+        import jax.numpy as jnp
+
+        x = jnp.concatenate([obs.astype(jnp.float32),
+                             act.astype(jnp.float32)], axis=-1)
+        return _mlp_apply(q_params, x, self.act, len(self.hiddens))[..., 0]
+
+
+@dataclass
+class ContinuousModuleSpec:
+    """Builds a continuous module from env spaces (Box action)."""
+
+    module_class: type = ContinuousRLModule
+    hiddens: Sequence[int] = (256, 256)
+    activation: str = "relu"
+    module_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def build(self, obs_space, act_space) -> ContinuousRLModule:
+        obs_dim = int(np.prod(obs_space.shape))
+        act_dim = int(np.prod(act_space.shape))
+        return self.module_class(obs_dim, act_dim, hiddens=self.hiddens,
+                                 activation=self.activation,
+                                 **self.module_kwargs)
+
+
+class ContinuousEnvRunner:
+    """Vectorized Box-action rollouts producing flat transitions.
+
+    Mirrors SingleAgentEnvRunner's fault-tolerance surface (sample /
+    set_weights / ping) but returns (s, a, r, s', done) transitions
+    directly — the natural unit for off-policy replay. ``random=True``
+    samples uniform actions (SAC warmup before learning_starts).
+    """
+
+    def __init__(self, env_creator: Callable, module_spec, num_envs: int,
+                 rollout_len: int, seed: int = 0, worker_idx: int = 0):
+        import gymnasium as gym
+
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.env = gym.vector.SyncVectorEnv(
+            [env_creator for _ in range(num_envs)])
+        space = self.env.single_action_space
+        self.act_low = np.asarray(space.low, np.float32)
+        self.act_high = np.asarray(space.high, np.float32)
+        self.module = module_spec.build(self.env.single_observation_space,
+                                        space)
+        self._rng = np.random.default_rng(seed * 10007 + worker_idx)
+        self._params = None
+        self._jit_forward = None
+        obs, _ = self.env.reset(seed=seed * 10007 + worker_idx)
+        self._obs = np.asarray(obs, np.float32)
+        self._prev_done = np.zeros(num_envs, bool)
+        self._ep_returns = np.zeros(num_envs, np.float64)
+        self._ep_lens = np.zeros(num_envs, np.int64)
+        self._completed_returns: list = []
+        self._completed_lens: list = []
+
+    def set_weights(self, weights) -> None:
+        self._params = weights
+
+    def ping(self) -> str:
+        return "ok"
+
+    def _scale(self, a: np.ndarray) -> np.ndarray:
+        """[-1, 1] -> env bounds."""
+        return self.act_low + (a + 1.0) * 0.5 * (self.act_high - self.act_low)
+
+    def _forward(self, obs: np.ndarray) -> np.ndarray:
+        import jax
+
+        if self._jit_forward is None:
+            fwd = self.module.forward_actor
+            self._jit_forward = jax.jit(
+                lambda p, o, k: fwd(p, o, k)[0])
+            self._jax = jax
+            self._key = jax.random.PRNGKey(int(self._rng.integers(0, 2**31)))
+        self._key, sub = self._jax.random.split(self._key)
+        return np.asarray(self._jit_forward(self._params, obs, sub))
+
+    def sample(self, weights: Optional[Dict] = None,
+               random: bool = False) -> Tuple[Dict, Dict]:
+        """One rollout of [rollout_len * num_envs] flat transitions.
+
+        Autoreset rows (gymnasium NEXT_STEP mode) are dropped; actions in
+        the batch are the squashed [-1,1] actions (what the learner needs),
+        env stepping uses the scaled version.
+        """
+        if weights is not None:
+            self.set_weights(weights)
+        T, N = self.rollout_len, self.num_envs
+        obs_l, act_l, rew_l, nobs_l, done_l, valid_l = [], [], [], [], [], []
+        t0 = time.perf_counter()
+        for _ in range(T):
+            if random or self._params is None:
+                a = self._rng.uniform(-1.0, 1.0,
+                                      (N,) + self.act_low.shape).astype(
+                    np.float32)
+            else:
+                a = self._forward(self._obs)
+            next_obs, reward, term, trunc, _ = self.env.step(self._scale(a))
+            next_obs = np.asarray(next_obs, np.float32)
+            done = term | trunc
+            valid = ~self._prev_done
+            obs_l.append(self._obs.copy())
+            act_l.append(a)
+            rew_l.append(np.asarray(reward, np.float32))
+            nobs_l.append(next_obs.copy())
+            # bootstrap masking uses TERMINATION only (time-limit
+            # truncation still bootstraps — standard SAC practice)
+            done_l.append(term.astype(np.float32))
+            valid_l.append(valid)
+
+            self._ep_returns[valid] += reward[valid]
+            self._ep_lens[valid] += 1
+            for i in np.nonzero(done & valid)[0]:
+                self._completed_returns.append(float(self._ep_returns[i]))
+                self._completed_lens.append(int(self._ep_lens[i]))
+                self._ep_returns[i] = 0.0
+                self._ep_lens[i] = 0
+            self._prev_done = done
+            self._obs = next_obs
+
+        m = np.concatenate(valid_l)
+        batch = {
+            "obs": np.concatenate(obs_l)[m],
+            "actions": np.concatenate(act_l)[m],
+            "rewards": np.concatenate(rew_l)[m],
+            "next_obs": np.concatenate(nobs_l)[m],
+            "dones": np.concatenate(done_l)[m],
+        }
+        stats = {
+            "episode_returns": self._completed_returns,
+            "episode_lens": self._completed_lens,
+            "env_steps": int(m.sum()),
+            "sample_time_s": time.perf_counter() - t0,
+        }
+        self._completed_returns = []
+        self._completed_lens = []
+        return batch, stats

@@ -19,6 +19,7 @@ import numpy as np
 from .algorithm import Algorithm, summarize_episode_stats
 from .config import AlgorithmConfig
 from .learner import LearnerGroup
+from .replay_buffers import ReplayBuffer
 
 
 class DQNConfig(AlgorithmConfig):
@@ -41,45 +42,6 @@ class DQNConfig(AlgorithmConfig):
         frac = min(1.0, timestep / max(1, self.epsilon_decay_steps))
         return self.epsilon_start + frac * (self.epsilon_end
                                             - self.epsilon_start)
-
-
-class ReplayBuffer:
-    """Uniform-sampling numpy ring buffer (reference:
-    utils/replay_buffers/replay_buffer.py — the base uniform buffer)."""
-
-    def __init__(self, capacity: int):
-        self.capacity = capacity
-        self._data: Optional[Dict[str, np.ndarray]] = None
-        self._pos = 0
-        self.size = 0
-
-    def add(self, transitions: Dict[str, np.ndarray]) -> None:
-        n = len(transitions["actions"])
-        if self._data is None:
-            self._data = {
-                k: np.empty((self.capacity,) + v.shape[1:], v.dtype)
-                for k, v in transitions.items()
-            }
-        for start in range(0, n, self.capacity):
-            chunk = {k: v[start:start + self.capacity]
-                     for k, v in transitions.items()}
-            m = len(chunk["actions"])
-            end = self._pos + m
-            if end <= self.capacity:
-                for k, v in chunk.items():
-                    self._data[k][self._pos:end] = v
-            else:
-                head = self.capacity - self._pos
-                for k, v in chunk.items():
-                    self._data[k][self._pos:] = v[:head]
-                    self._data[k][:end - self.capacity] = v[head:]
-            self._pos = end % self.capacity
-            self.size = min(self.capacity, self.size + m)
-
-    def sample(self, batch_size: int,
-               rng: np.random.Generator) -> Dict[str, np.ndarray]:
-        idx = rng.integers(0, self.size, batch_size)
-        return {k: v[idx] for k, v in self._data.items()}
 
 
 def transitions_from_rollout(batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

@@ -1,0 +1,257 @@
+"""SAC: soft actor-critic with twin critics and auto-tuned entropy.
+
+Analog of the reference's new-stack SAC (rllib/algorithms/sac/sac.py:524
+training_step; losses per sac_torch_learner.py): squashed-Gaussian actor,
+twin Q networks with polyak-averaged targets, temperature alpha tuned
+against a target entropy. The whole update — critic step, actor step,
+alpha step, target polyak — is ONE jitted function over the combined
+state pytree, so the entire off-policy backup stays on-device; the replay
+buffer (uniform or prioritized) feeds it numpy minibatches.
+
+This is the framework's continuous-action stress test of the Learner
+abstraction: three optimizers, in-graph target params, and stochastic
+reparameterized sampling, none of which the policy-gradient/Q algorithms
+needed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from .algorithm import Algorithm, EnvRunnerGroup, summarize_episode_stats
+from .config import AlgorithmConfig
+from .continuous import ContinuousEnvRunner, ContinuousModuleSpec
+from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = SAC
+        self.rl_module_spec = ContinuousModuleSpec()
+        self.buffer_size: int = 100_000
+        self.prioritized_replay: bool = False
+        self.learning_starts: int = 1_500
+        self.batch_size: int = 256
+        self.updates_per_iteration: int = 64
+        self.tau: float = 0.005              # polyak rate
+        self.actor_lr: float = 3e-4
+        self.critic_lr: float = 3e-4
+        self.alpha_lr: float = 3e-4
+        self.initial_alpha: float = 1.0
+        self.target_entropy: float | None = None  # None => -act_dim
+        self.grad_clip: float = 40.0
+        self.num_epochs: int = 1             # unused; API parity
+
+
+class SACLearner:
+    """Owns the combined SAC state; one jitted update per minibatch.
+
+    Not the generic Learner: SAC needs three optimizers, target params in
+    the state, and a PRNG carried across updates.
+    """
+
+    def __init__(self, module, config: SACConfig):
+        import jax
+        import optax
+
+        self.module = module
+        self.config = config
+        params = module.init(jax.random.PRNGKey(config.seed))
+        target_entropy = (config.target_entropy
+                          if config.target_entropy is not None
+                          else -float(module.act_dim))
+        self._opt_actor = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.actor_lr))
+        self._opt_critic = optax.chain(
+            optax.clip_by_global_norm(config.grad_clip),
+            optax.adam(config.critic_lr))
+        self._opt_alpha = optax.adam(config.alpha_lr)
+        import jax.numpy as jnp
+
+        critic = {"q1": params["q1"], "q2": params["q2"]}
+        log_alpha = jnp.asarray(np.log(config.initial_alpha), jnp.float32)
+        self.state = {
+            "actor": params["actor"],
+            "critic": critic,
+            "target_critic": jax.tree.map(jnp.asarray, critic),
+            "log_alpha": log_alpha,
+            "opt_actor": self._opt_actor.init(params["actor"]),
+            "opt_critic": self._opt_critic.init(critic),
+            "opt_alpha": self._opt_alpha.init(log_alpha),
+            "key": jax.random.PRNGKey(config.seed + 1),
+        }
+        self._update = jax.jit(self._build_update(target_entropy))
+
+    def _build_update(self, target_entropy: float):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        module, cfg = self.module, self.config
+        gamma, tau = cfg.gamma, cfg.tau
+        opt_actor, opt_critic, opt_alpha = (self._opt_actor,
+                                            self._opt_critic,
+                                            self._opt_alpha)
+
+        def q_both(critic, obs, act):
+            return (module.forward_q(critic["q1"], obs, act),
+                    module.forward_q(critic["q2"], obs, act))
+
+        def update(state, mb):
+            key, k_next, k_pi = jax.random.split(state["key"], 3)
+            alpha = jnp.exp(state["log_alpha"])
+            w = mb.get("weights")
+            iw = w if w is not None else jnp.ones_like(mb["rewards"])
+
+            # ---- critic: y = r + gamma (1-d) (min Q' - alpha logp') ----
+            a_next, logp_next = module.forward_actor(
+                state["actor"], mb["next_obs"], k_next)
+            q1_t, q2_t = q_both(state["target_critic"], mb["next_obs"],
+                                a_next)
+            y = mb["rewards"] + gamma * (1.0 - mb["dones"]) * (
+                jnp.minimum(q1_t, q2_t) - alpha * logp_next)
+            y = jax.lax.stop_gradient(y)
+
+            def critic_loss(critic):
+                q1, q2 = q_both(critic, mb["obs"], mb["actions"])
+                td = 0.5 * ((q1 - y) ** 2 + (q2 - y) ** 2)
+                return (iw * td).mean(), (q1, jnp.abs(q1 - y))
+
+            (c_loss, (q1_pred, td_abs)), c_grads = jax.value_and_grad(
+                critic_loss, has_aux=True)(state["critic"])
+            c_up, opt_c = opt_critic.update(c_grads, state["opt_critic"],
+                                            state["critic"])
+            critic = optax.apply_updates(state["critic"], c_up)
+
+            # ---- actor: alpha logp - min Q (critic frozen) -------------
+            def actor_loss(actor):
+                a, logp = module.forward_actor(actor, mb["obs"], k_pi)
+                q1, q2 = q_both(critic, mb["obs"], a)
+                return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+            (a_loss, logp_pi), a_grads = jax.value_and_grad(
+                actor_loss, has_aux=True)(state["actor"])
+            a_up, opt_a = opt_actor.update(a_grads, state["opt_actor"],
+                                           state["actor"])
+            actor = optax.apply_updates(state["actor"], a_up)
+
+            # ---- alpha: -log_alpha (logp + target_entropy) -------------
+            def alpha_loss(log_alpha):
+                return (-log_alpha * jax.lax.stop_gradient(
+                    logp_pi + target_entropy)).mean()
+
+            al_loss, al_grad = jax.value_and_grad(alpha_loss)(
+                state["log_alpha"])
+            al_up, opt_al = opt_alpha.update(al_grad, state["opt_alpha"])
+            log_alpha = optax.apply_updates(state["log_alpha"], al_up)
+
+            # ---- polyak target update ----------------------------------
+            target = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o,
+                                  state["target_critic"], critic)
+            new_state = {
+                "actor": actor, "critic": critic, "target_critic": target,
+                "log_alpha": log_alpha, "opt_actor": opt_a,
+                "opt_critic": opt_c, "opt_alpha": opt_al, "key": key,
+            }
+            stats = {
+                "critic_loss": c_loss, "actor_loss": a_loss,
+                "alpha_loss": al_loss, "alpha": alpha,
+                "q1_mean": q1_pred.mean(), "entropy": -logp_pi.mean(),
+            }
+            return new_state, stats, td_abs
+
+        return update
+
+    def update(self, mb: Dict[str, np.ndarray]):
+        """One minibatch update; returns (stats, |td| per row)."""
+        self.state, stats, td_abs = self._update(self.state, mb)
+        return ({k: float(v) for k, v in stats.items()},
+                np.asarray(td_abs))
+
+    def get_weights(self):
+        import jax
+
+        # the actor subtree — exactly what the runner's forward_actor takes
+        return jax.tree.map(np.asarray, self.state["actor"])
+
+    def get_state(self):
+        import jax
+        import pickle
+
+        return pickle.dumps(jax.tree.map(np.asarray, self.state))
+
+    def set_state(self, blob) -> None:
+        import pickle
+
+        self.state = pickle.loads(blob)
+
+
+class SAC(Algorithm):
+    config_class = SACConfig
+
+    def _build_learner_group(self):
+        module = self.algo_config.rl_module_spec.build(self.obs_space,
+                                                       self.act_space)
+        return SACLearner(module, self.algo_config)
+
+    def setup(self, config) -> None:
+        super().setup(config)
+        cfg = self.algo_config
+        buf_cls = (PrioritizedReplayBuffer if cfg.prioritized_replay
+                   else ReplayBuffer)
+        self.buffer = buf_cls(cfg.buffer_size)
+        self._timesteps = 0
+        self._num_updates = 0
+        self._rng = np.random.default_rng(cfg.seed)
+
+    def _make_env_runner_group(self, config, env_creator):
+        return EnvRunnerGroup(config, env_creator, config.rl_module_spec,
+                              runner_cls=ContinuousEnvRunner)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        warmup = self.buffer.size < cfg.learning_starts
+        weights = None if warmup else self.learner_group.get_weights()
+
+        stats = []
+        got, target_steps = 0, cfg.train_batch_size
+        while got < target_steps:
+            if self.env_runner_group.num_healthy == 0:
+                if cfg.restart_failed_env_runners:
+                    self.env_runner_group.restore_workers()
+                else:
+                    raise RuntimeError("all env runners are dead")
+            bs, ss = self.env_runner_group.sample(weights, random=warmup)
+            for b, s in zip(bs, ss):
+                self.buffer.add(b)
+                stats.append(s)
+                got += s["env_steps"]
+            if not bs:
+                self.env_runner_group.restore_workers()
+        self._timesteps += got
+
+        learner_stats: Dict[str, float] = {}
+        if self.buffer.size >= cfg.learning_starts:
+            agg = []
+            for _ in range(cfg.updates_per_iteration):
+                mb = self.buffer.sample(cfg.batch_size, self._rng)
+                indices = mb.pop("indices", None)
+                s, td_abs = self.learner_group.update(mb)
+                if indices is not None:
+                    self.buffer.update_priorities(indices, td_abs)
+                agg.append(s)
+                self._num_updates += 1
+            keys = agg[0].keys() if agg else ()
+            learner_stats = {k: float(np.mean([a[k] for a in agg]))
+                             for k in keys}
+        if cfg.restart_failed_env_runners:
+            self.env_runner_group.restore_workers()
+        result = summarize_episode_stats(stats)
+        result["learner"] = learner_stats
+        result["buffer_size"] = self.buffer.size
+        result["num_updates"] = self._num_updates
+        return result

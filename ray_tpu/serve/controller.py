@@ -24,7 +24,8 @@ class ServeController:
         self._deployments: Dict[str, dict] = {}  # name -> record
         self._routes: Dict[str, str] = {}        # route_prefix -> name
         self._lock = threading.RLock()
-        self._version = 0  # bumped on any change; routers poll this
+        self._version = 0  # bumped on any change; long-poll wakes watchers
+        self._version_cv = threading.Condition(self._lock)
         self._shutdown = False
         self._thread = threading.Thread(target=self._reconcile_loop,
                                         daemon=True)
@@ -59,7 +60,7 @@ class ServeController:
             auto = config.get("autoscaling")
             if auto:
                 rec["target"] = max(auto["min_replicas"], 1)
-            self._version += 1
+            self._version += 1; self._version_cv.notify_all()
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
@@ -69,7 +70,7 @@ class ServeController:
                     self._kill_replica(r)
             self._routes = {k: v for k, v in self._routes.items()
                             if v != name}
-            self._version += 1
+            self._version += 1; self._version_cv.notify_all()
 
     def shutdown(self) -> None:
         with self._lock:
@@ -79,7 +80,7 @@ class ServeController:
                     self._kill_replica(r)
             self._deployments.clear()
             self._routes.clear()
-            self._version += 1
+            self._version += 1; self._version_cv.notify_all()
 
     # ------------------------------------------------------------ queries
     def get_replicas(self, name: str) -> List[Any]:
@@ -87,8 +88,32 @@ class ServeController:
             rec = self._deployments.get(name)
             return [r["actor"] for r in rec["replicas"]] if rec else []
 
+    def get_replica_set(self, name: str) -> dict:
+        """Replicas + the routing-relevant deployment options in ONE call
+        (the router refresh path; avoids a separate option RPC on the
+        first request of every handle)."""
+        with self._lock:
+            rec = self._deployments.get(name)
+            if rec is None:
+                return {"replicas": [], "retry_on_replica_failure": True}
+            return {
+                "replicas": [r["actor"] for r in rec["replicas"]],
+                "retry_on_replica_failure": rec["config"].get(
+                    "retry_on_replica_failure", True),
+            }
+
     def get_version(self) -> int:
         return self._version
+
+    def wait_for_version(self, cur: int, timeout: float = 30.0) -> int:
+        """Long-poll: block until the config version moves past ``cur``
+        (reference: _private/long_poll.py:177 LongPollHost) so routers and
+        proxies learn of replica/route changes in milliseconds instead of
+        a polling period. Requires the controller's max_concurrency > 1."""
+        with self._version_cv:
+            self._version_cv.wait_for(
+                lambda: self._version != cur or self._shutdown, timeout)
+            return self._version
 
     def get_route_meta(self) -> Dict[str, dict]:
         """Per-route metadata the proxy needs (stream flag, timeout)."""
@@ -114,6 +139,11 @@ class ServeController:
                 }
                 for name, rec in self._deployments.items()
             }
+
+    def get_deployment_option(self, name: str, key: str, default=None):
+        with self._lock:
+            rec = self._deployments.get(name)
+            return rec["config"].get(key, default) if rec else default
 
     def deployment_ready(self, name: str) -> bool:
         with self._lock:
@@ -214,11 +244,11 @@ class ServeController:
                         dead = stale[0]
                         replicas.remove(dead)
                         self._kill_replica(dead)
-                        self._version += 1
+                        self._version += 1; self._version_cv.notify_all()
                         continue
                     if len(fresh) < target and len(replicas) <= target:
                         replicas.append(self._spawn_replica(rec))
-                        self._version += 1
+                        self._version += 1; self._version_cv.notify_all()
                     elif (len(ready) >= min(target, len(fresh))
                           and len(ready) > 0
                           and (len(replicas) > target
@@ -226,18 +256,18 @@ class ServeController:
                         dead = stale[0]
                         replicas.remove(dead)
                         self._kill_replica(dead)
-                        self._version += 1
+                        self._version += 1; self._version_cv.notify_all()
                     continue
                 diff = target - len(replicas)
                 if diff > 0:
                     for _ in range(diff):
                         replicas.append(self._spawn_replica(rec))
-                    self._version += 1
+                    self._version += 1; self._version_cv.notify_all()
                 elif diff < 0:
                     for _ in range(-diff):
                         dead = replicas.pop()
                         self._kill_replica(dead)
-                    self._version += 1
+                    self._version += 1; self._version_cv.notify_all()
 
     def _health_check(self) -> None:
         with self._lock:
@@ -263,7 +293,7 @@ class ServeController:
                         if r in rec["replicas"]:
                             rec["replicas"].remove(r)
                             self._kill_replica(r)
-                    self._version += 1
+                    self._version += 1; self._version_cv.notify_all()
 
     def _reconcile_loop(self) -> None:
         last_health = 0.0

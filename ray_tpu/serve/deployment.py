@@ -39,7 +39,8 @@ class Deployment:
                  route_prefix: Optional[str] = "/",
                  health_check_period_s: float = 2.0,
                  stream: bool = False,
-                 request_timeout_s: float = 60.0):
+                 request_timeout_s: float = 60.0,
+                 retry_on_replica_failure: bool = True):
         self._target = target
         self.name = name
         if isinstance(autoscaling_config, dict):
@@ -55,6 +56,7 @@ class Deployment:
             health_check_period_s=health_check_period_s,
             stream=stream,
             request_timeout_s=request_timeout_s,
+            retry_on_replica_failure=retry_on_replica_failure,
         )
 
     def options(self, **overrides) -> "Deployment":
@@ -90,6 +92,11 @@ class Deployment:
             "route_prefix": self._opts["route_prefix"],
             "stream": self._opts.get("stream", False),
             "request_timeout_s": self._opts.get("request_timeout_s", 60.0),
+            # a replica dying MID-REQUEST may have executed side effects:
+            # users with non-idempotent endpoints disable redispatch
+            # (reference: Serve gates request retries)
+            "retry_on_replica_failure": self._opts.get(
+                "retry_on_replica_failure", True),
         }
 
     def __repr__(self):

@@ -9,10 +9,71 @@ from __future__ import annotations
 import random
 import threading
 import time
+import weakref
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.core.exceptions import ActorDiedError
+
+
+class _LongPollClient:
+    """ONE long-poll watcher per (process, controller): a single blocked
+    wait_for_version call fans version changes out to every registered
+    router/proxy callback (reference: long_poll.py LongPollClient). Without
+    the sharing, each handle would park its own thread in one of the
+    controller's max_concurrency slots and ~16 handles would wedge it."""
+
+    def __init__(self, controller):
+        self._controller = controller
+        self._subs: List[weakref.ReferenceType] = []
+        self._lock = threading.Lock()
+        self.alive = True
+        self._version = -1
+        threading.Thread(target=self._loop, daemon=True,
+                         name="serve-longpoll").start()
+
+    def add(self, bound_method) -> None:
+        with self._lock:
+            self._subs.append(weakref.WeakMethod(bound_method))
+
+    def _loop(self) -> None:
+        while True:
+            try:
+                v = ray_tpu.get(self._controller.wait_for_version.remote(
+                    self._version, 25.0), timeout=35)
+            except Exception:
+                self.alive = False  # controller gone: fall back to polling
+                return
+            if v == self._version:
+                continue
+            self._version = v
+            with self._lock:
+                subs, dead = list(self._subs), []
+            for ref in subs:
+                cb = ref()
+                if cb is None:
+                    dead.append(ref)
+                    continue
+                try:
+                    cb()
+                except Exception:
+                    pass  # one stale subscriber must not stall the rest
+            if dead:
+                with self._lock:
+                    self._subs = [r for r in self._subs if r not in dead]
+
+
+_longpoll_clients: Dict[str, _LongPollClient] = {}
+_longpoll_lock = threading.Lock()
+
+
+def get_longpoll_client(controller) -> _LongPollClient:
+    key = str(getattr(controller, "_actor_id", id(controller)))
+    with _longpoll_lock:
+        c = _longpoll_clients.get(key)
+        if c is None or not c.alive:
+            c = _longpoll_clients[key] = _LongPollClient(controller)
+        return c
 
 
 class DeploymentResponse:
@@ -86,6 +147,20 @@ class Router:
         self._version = -1
         self._lock = threading.Lock()
         self._last_refresh = 0.0
+        self._poller_started = False
+        self.retry_on_replica_failure = True  # updated on refresh
+
+    def _on_longpoll(self) -> None:
+        self._refresh(force=True)
+
+    def _ensure_poller(self) -> None:
+        """Long-poll push: register with the process-wide shared watcher so
+        replica-set changes reach this router in milliseconds; the timed
+        poll in _refresh stays as the fallback if the watcher dies."""
+        if self._poller_started:
+            return
+        self._poller_started = True
+        get_longpoll_client(self._controller).add(self._on_longpoll)
 
     def _refresh(self, force: bool = False) -> None:
         now = time.time()
@@ -97,11 +172,15 @@ class Router:
         except Exception:
             return
         if version != self._version or not self._replicas:
-            replicas = ray_tpu.get(
-                self._controller.get_replicas.remote(self._name), timeout=5)
+            rset = ray_tpu.get(
+                self._controller.get_replica_set.remote(self._name),
+                timeout=5)
+            replicas = rset["replicas"]
             with self._lock:
                 self._replicas = replicas
                 self._version = version
+                self.retry_on_replica_failure = rset.get(
+                    "retry_on_replica_failure", True)
                 keys = {self._key(r) for r in replicas}
                 self._inflight = {k: v for k, v in self._inflight.items()
                                   if k in keys}
@@ -117,6 +196,7 @@ class Router:
                 self._inflight[key] = max(0, self._inflight[key] - 1)
 
     def choose(self, model_id: str = ""):
+        self._ensure_poller()
         deadline = time.time() + 30
         while True:
             self._refresh()
@@ -221,7 +301,10 @@ class DeploymentHandle:
             return r2.handle_request.remote(self._method, args, kwargs,
                                             self._model_id), k2
 
-        return DeploymentResponse(ref, self._router, key, redispatch)
+        # flag rides the router's replica refresh — no extra RPC here
+        return DeploymentResponse(
+            ref, self._router, key,
+            redispatch if self._router.retry_on_replica_failure else None)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

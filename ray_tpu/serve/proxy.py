@@ -58,6 +58,24 @@ class HTTPProxy:
             raise RuntimeError(
                 f"HTTP proxy failed to bind {host}:{port} within 10s "
                 f"(server thread died or address unavailable)")
+        # long-poll push of the route table (reference: long_poll.py);
+        # the 1 s TTL in the handler remains the fallback if this dies
+        from .handle import get_longpoll_client
+
+        get_longpoll_client(controller).add(self._on_route_push)
+
+    def _on_route_push(self) -> None:
+        import time as _time
+
+        import ray_tpu
+
+        try:
+            self._routes_cache = ray_tpu.get(
+                self._controller.get_route_meta.remote(), timeout=10)
+            # pushed data stays valid until the next push
+            self._routes_expiry = _time.monotonic() + 3600.0
+        except Exception:
+            self._routes_expiry = 0.0  # fall back to TTL polling
 
     def _get_handle(self, name: str):
         from .handle import DeploymentHandle

@@ -1,0 +1,50 @@
+"""Learning-curve gates for APPO and DQN (VERDICT weak #9).
+
+The round-2 review noted test_appo_budget / test_dqn smoke-test mechanics
+only — an APPO/DQN that cannot learn CartPole would still pass CI. These
+gates mirror test_ppo_learns_cartpole / test_impala_learns_cartpole
+(reference: rllib/utils/test_utils.py check_learning_achieved over
+tuned_examples budgets).
+"""
+
+from ray_tpu.rllib import APPOConfig, DQNConfig
+
+
+def test_appo_learns_cartpole():
+    config = (APPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                           rollout_fragment_length=64)
+              .training(lr=5e-4, entropy_coeff=0.01)
+              .debugging(seed=0))
+    algo = config.build()
+    best = 0.0
+    for _ in range(350):
+        r = algo.train()
+        best = max(best, r.get("episode_return_mean", 0.0))
+        if best >= 400:
+            break
+    algo.cleanup()
+    assert best >= 400, f"APPO failed to learn CartPole: best={best}"
+
+
+def test_dqn_learns_cartpole():
+    config = (DQNConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                           rollout_fragment_length=32)
+              .training(train_batch_size=256, lr=5e-4,
+                        buffer_size=50_000, learning_starts=1000,
+                        target_update_freq=250, updates_per_iteration=64,
+                        batch_size=64, epsilon_decay_steps=12_000)
+              .debugging(seed=0))
+    algo = config.build()
+    best = 0.0
+    for _ in range(200):
+        r = algo.train()
+        best = max(best, r.get("episode_return_mean", 0.0))
+        if best >= 300:
+            break
+    algo.cleanup()
+    # DQN on CartPole: 300+ mean return proves clear learning (random ~20)
+    assert best >= 300, f"DQN failed to learn CartPole: best={best}"

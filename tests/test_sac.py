@@ -1,0 +1,124 @@
+"""SAC (continuous control) + the standalone replay-buffer family.
+
+Round-3 VERDICT item 4: the off-policy/continuous corner of the algorithm
+space (reference: rllib/algorithms/sac/sac.py:524,
+utils/replay_buffers/prioritized_episode_buffer.py).
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.replay_buffers import (PrioritizedReplayBuffer,
+                                          ReplayBuffer, SumTree)
+from ray_tpu.rllib.sac import SAC, SACConfig
+
+
+class TestReplayBuffers:
+    def test_uniform_ring_wraps(self):
+        buf = ReplayBuffer(100)
+        buf.add({"obs": np.arange(250, dtype=np.float32).reshape(250, 1),
+                 "actions": np.arange(250)})
+        assert len(buf) == 100
+        s = buf.sample(64, np.random.default_rng(0))
+        assert (s["actions"] >= 150).all()  # only the newest survive
+
+    def test_sumtree_proportional(self):
+        t = SumTree(16)
+        t.set(np.arange(16), np.ones(16))
+        t.set(np.array([5]), np.array([9.0]))
+        assert abs(t.total - 24.0) < 1e-9
+        found = t.find_prefix(np.random.rand(8000) * t.total)
+        frac5 = (found == 5).mean()
+        assert 0.25 < frac5 < 0.5  # 9/24 = 0.375 expected
+
+    def test_per_reprioritization(self):
+        rng = np.random.default_rng(0)
+        p = PrioritizedReplayBuffer(128)
+        p.add({"obs": np.arange(64, dtype=np.float32).reshape(64, 1),
+               "actions": np.arange(64)})
+        s = p.sample(8, rng)
+        assert s["weights"].max() <= 1.0 + 1e-6
+        boosted = s["indices"]
+        p.update_priorities(boosted, np.full(len(boosted), 50.0))
+        s2 = p.sample(1000, rng)
+        assert np.isin(s2["indices"], boosted).mean() > 0.5
+
+
+class TestSAC:
+    def test_sac_mechanics(self):
+        cfg = (SACConfig()
+               .environment("Pendulum-v1")
+               .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                            rollout_fragment_length=32)
+               .training(train_batch_size=128, learning_starts=200,
+                         updates_per_iteration=4, batch_size=64)
+               .debugging(seed=0))
+        algo = cfg.build()
+        r1 = algo.train()
+        r2 = algo.train()
+        algo.cleanup()
+        assert r2["buffer_size"] > r1["buffer_size"]
+        assert r2["learner"], "no learner stats after learning_starts"
+        assert np.isfinite(r2["learner"]["critic_loss"])
+        # entropy temperature is being adapted
+        assert r2["learner"]["alpha"] != 1.0
+
+    def test_sac_prioritized_replay(self):
+        cfg = (SACConfig()
+               .environment("Pendulum-v1")
+               .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                            rollout_fragment_length=16)
+               .training(train_batch_size=64, learning_starts=64,
+                         updates_per_iteration=4, batch_size=32,
+                         prioritized_replay=True)
+               .debugging(seed=0))
+        algo = cfg.build()
+        r = algo.train()
+        r = algo.train()
+        algo.cleanup()
+        assert np.isfinite(r["learner"]["critic_loss"])
+
+    def test_sac_checkpoint_roundtrip(self, tmp_path):
+        cfg = (SACConfig()
+               .environment("Pendulum-v1")
+               .env_runners(num_env_runners=0, num_envs_per_env_runner=2,
+                            rollout_fragment_length=8)
+               .training(train_batch_size=16, learning_starts=16,
+                         updates_per_iteration=2, batch_size=8))
+        algo = cfg.build()
+        algo.train()
+        algo.save_checkpoint(str(tmp_path))
+        w0 = algo.learner_group.get_weights()
+        algo.cleanup()
+
+        algo2 = SAC.from_checkpoint(str(tmp_path), cfg.copy())
+        w1 = algo2.learner_group.get_weights()
+        algo2.cleanup()
+        for a, b in zip(np.asarray(list(w0.values()), dtype=object),
+                        np.asarray(list(w1.values()), dtype=object)):
+            np.testing.assert_allclose(a, b)
+
+
+def test_sac_learns_pendulum():
+    """Learning gate: mean return rises from ~-1300 (random) to >= -900
+    on Pendulum-v1 (reference: tuned_examples/sac/pendulum-sac.yaml
+    solves at ~-150; -900 proves clear learning within CI budget)."""
+    cfg = (SACConfig()
+           .environment("Pendulum-v1")
+           .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
+                        rollout_fragment_length=32)
+           .training(train_batch_size=128, learning_starts=1000,
+                     updates_per_iteration=128, batch_size=128,
+                     actor_lr=1e-3, critic_lr=1e-3, alpha_lr=1e-3)
+           .debugging(seed=0))
+    algo = cfg.build()
+    best = -1e9
+    for i in range(120):
+        r = algo.train()
+        ret = r.get("episode_return_mean")
+        if ret is not None:
+            best = max(best, ret)
+        if best >= -500:
+            break
+    algo.cleanup()
+    assert best >= -900, f"SAC failed to learn Pendulum: best={best}"
