@@ -64,6 +64,10 @@ class Config:
     # head: flush at this many events or this age, whichever first
     direct_event_batch_size: int = 200
     direct_event_flush_ms: int = 20
+    # direct tasks may hold at most this fraction of a node's worker slots
+    # while head-dispatched (resource-bound) work is waiting — prevents a
+    # direct-task flood from starving scheduler-placed tasks
+    direct_slot_fraction: float = 0.85
 
     # ---- tasks / fault tolerance (reference: ray_config_def.h:138,414,835) ----
     task_retry_delay_ms: int = 0

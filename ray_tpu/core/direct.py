@@ -1,8 +1,10 @@
 """Direct (head-bypass) task path: owner-side task table + eligibility.
 
 The reference keeps the GCS out of the normal-task hot path entirely: the
-submitting CoreWorker owns the task (retries, result table), leases a
-worker from its *local* raylet, and pushes the task directly
+submitting CoreWorker owns the task (retries, result table), resolves its
+dependencies locally (``src/ray/core_worker/transport/dependency_resolver.h:29``
+``LocalDependencyResolver``), leases a worker from its *local* raylet, and
+pushes the task directly
 (``src/ray/core_worker/transport/normal_task_submitter.cc:355``,
 ``reference_count.h:61`` — ownership lives with the submitter). Round 2 of
 this framework routed every submit/finish through the single Head, capping
@@ -16,6 +18,13 @@ node over the daemon↔daemon mesh — and replies directly to the owner.
 The head only sees small *batched* event reports (object locations +
 observability), amortized hundreds of tasks per message.
 
+Ref args are resolved **owner-side** before submission (the analog of
+``LocalDependencyResolver``): args produced by this owner's own direct
+tasks resolve in-process (inline payloads ship as hints in the spec; large
+results ship the sealing node's hex so the executor pulls peer-to-peer);
+external objects are waited on via the object directory, then submitted.
+A task never occupies a worker slot while its dependencies are pending.
+
 Ownership semantics match the reference: if the owner dies, its in-flight
 direct tasks and their results are lost (Ray's owner-died behavior); if
 the executor dies, the owner retries per ``max_retries``.
@@ -24,7 +33,7 @@ the executor dies, the owner retries per ``max_retries``.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from . import serialization
 from .exceptions import TaskCancelledError, WorkerCrashedError
@@ -38,12 +47,17 @@ _DIRECT_RESOURCES = {"CPU"}
 
 _SYSTEM_ERRS = ("WorkerCrashedError", "NodeDiedError")
 
+# inline-hint ceiling: small owned results are copied into the spec so the
+# executor never touches a store for them (mirrors the inline-arg path)
+_INLINE_HINT_MAX = 100 * 1024
+
 
 def direct_eligible(spec: TaskSpec) -> bool:
-    """Conservative hot-class test: plain <=1-CPU task, default placement,
-    inline args only. Ref args would need dependency staging at the node;
-    num_cpus>1 needs real resource accounting (a node grants direct tasks
-    one worker SLOT, ~1 CPU); both keep the head path."""
+    """Hot-class test: plain <=1-CPU task, default placement. Ref args are
+    fine — the owner resolves them before submission (dependency resolver)
+    and the executor pulls via location hints. num_cpus>1 needs real
+    resource accounting (a node grants direct tasks one worker SLOT, ~1
+    CPU), so it keeps the head path."""
     s = spec.scheduling_strategy
     return (
         spec.actor_id is None
@@ -53,7 +67,6 @@ def direct_eligible(spec: TaskSpec) -> bool:
         and s.kind == "DEFAULT"
         and s.placement_group_id is None
         and s.node_id is None
-        and not spec.arg_object_ids()
         and all(k in _DIRECT_RESOURCES for k, _ in spec.resources)
         and spec.resources.get("CPU") <= 1.0
     )
@@ -63,13 +76,28 @@ class DirectTaskManager:
     """Owner-side table of in-flight direct tasks + their inline results.
 
     The analog of the reference CoreWorker's ``TaskManager`` + in-process
-    memory store (``task_manager.h:208``, ``memory_store.cc``): completion
-    wakes local getters; system failures retry by resubmitting through the
-    ``submit`` callback; user errors deserialize to raised exceptions.
+    memory store + ``LocalDependencyResolver`` (``task_manager.h:208``,
+    ``memory_store.cc``, ``dependency_resolver.h:29``): completion wakes
+    local getters; system failures retry by resubmitting through the
+    ``submit`` callback; user errors deserialize to raised exceptions;
+    ref-arg tasks defer until every dependency is available somewhere.
+
+    Optional collaborators (wired by the owning runtime):
+      - ``ext_wait(oids, timeout) -> ready_list``: one bounded round of
+        availability-checking external (non-owned) objects against the
+        cluster object directory.
+      - ``pin(oids)`` / ``unpin(oids)``: keep ``spec.pinned_args`` alive
+        while the task is in flight (reference: submitter arg pinning).
     """
 
-    def __init__(self, submit: Callable[[TaskSpec], None]):
+    def __init__(self, submit: Callable[[TaskSpec], None],
+                 ext_wait: Optional[Callable] = None,
+                 pin: Optional[Callable] = None,
+                 unpin: Optional[Callable] = None):
         self._submit = submit
+        self._ext_wait = ext_wait
+        self._pin = pin
+        self._unpin = unpin
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: Dict[TaskID, TaskSpec] = {}
@@ -81,35 +109,184 @@ class DirectTaskManager:
         # result sealed in the executor node's store (get falls back to the
         # store/locate path)
         self._results: Dict[ObjectID, Tuple[Optional[bytes], bool]] = {}
+        # oid -> node hex that sealed a large (store-resident) result;
+        # shipped as a pull hint when the oid is a downstream task's arg
+        self._result_nodes: Dict[ObjectID, str] = {}
+        # ---- dependency resolver state ---------------------------------
+        # task_id -> set of oids still unavailable; submit fires when empty
+        self._deferred: Dict[TaskID, Set[ObjectID]] = {}
+        # external (non-owned) oid -> task_ids waiting on it
+        self._ext_waiting: Dict[ObjectID, Set[TaskID]] = {}
+        self._poller_started = False
 
     # ------------------------------------------------------------ submit
 
-    def register(self, spec: TaskSpec) -> None:
+    def register(self, spec: TaskSpec) -> Optional[TaskSpec]:
+        """Record ownership; resolve dependencies. Returns the spec when it
+        is ready to submit now, or None if it was deferred (the resolver
+        submits it when its deps become available)."""
+        if self._pin is not None and spec.pinned_args:
+            try:
+                self._pin(list(spec.pinned_args))
+            except Exception:
+                pass
+        arg_ids = spec.arg_object_ids()
         with self._lock:
             self._pending[spec.task_id] = spec
+            if not arg_ids:
+                return spec
+            owned: List[ObjectID] = []
+            ext: List[ObjectID] = []
+            for oid in arg_ids:
+                if oid in self._results:
+                    continue  # owned + completed: hint stamped at submit
+                if oid.task_id() in self._pending:
+                    owned.append(oid)  # owned + still running
+                else:
+                    ext.append(oid)  # external: availability via directory
+            if not owned and not ext:
+                self._stamp_hints_locked(spec)
+                return spec
+        # synchronous availability probe for external deps (outside the
+        # lock — the probe takes cluster locks / an RPC): the common case
+        # (args already materialized) submits immediately
+        if ext and self._ext_wait is not None:
+            try:
+                ready_now = set(self._ext_wait(list(ext), 0.0))
+            except Exception:
+                ready_now = set()
+            ext = [o for o in ext if o not in ready_now]
+        with self._lock:
+            # re-check under the lock: owned deps may have completed (or
+            # external ones sealed) during the probe window
+            missing = {o for o in owned if o not in self._results}
+            missing.update(o for o in ext if o not in self._results)
+            if not missing:
+                self._stamp_hints_locked(spec)
+                return spec
+            self._deferred[spec.task_id] = missing
+            ext_missing = [o for o in ext if o in missing]
+            for oid in ext_missing:
+                self._ext_waiting.setdefault(oid, set()).add(spec.task_id)
+            if ext_missing:
+                self._ensure_poller_locked()
+        return None
+
+    def _stamp_hints_locked(self, spec: TaskSpec) -> None:
+        """Attach resolution hints for args this owner knows about."""
+        hints: Dict[ObjectID, tuple] = {}
+        for oid in spec.arg_object_ids():
+            res = self._results.get(oid)
+            if res is not None:
+                payload, is_err = res
+                if payload is not None and len(payload) <= _INLINE_HINT_MAX:
+                    hints[oid] = ("inline", payload, is_err)
+                    continue
+                node_hex = self._result_nodes.get(oid)
+                if node_hex:
+                    hints[oid] = ("node", node_hex)
+        if hints:
+            spec.arg_hints = hints
+
+    def _ensure_poller_locked(self) -> None:
+        if self._poller_started or self._ext_wait is None:
+            return
+        self._poller_started = True
+        threading.Thread(target=self._poll_external, daemon=True,
+                         name="direct-dep-poller").start()
+
+    def _poll_external(self) -> None:
+        """Availability loop for external deps: one bounded ``ext_wait``
+        round over the union of outstanding oids (the directory wait is
+        cv-based on the head, so readiness propagates promptly)."""
+        while True:
+            with self._lock:
+                oids = list(self._ext_waiting.keys())
+                if not oids:
+                    self._poller_started = False
+                    return
+            try:
+                ready = self._ext_wait(oids, 0.2)
+            except Exception:
+                ready = []
+            if ready:
+                self.deps_available(ready)
+
+    def deps_available(self, oids) -> None:
+        """Mark objects available; submit any deferred spec whose last
+        missing dependency this satisfies."""
+        to_submit: List[TaskSpec] = []
+        ready_set = set(oids)
+        with self._lock:
+            for oid in ready_set:
+                self._ext_waiting.pop(oid, None)
+            for tid, deps in list(self._deferred.items()):
+                deps -= ready_set
+                if not deps:
+                    del self._deferred[tid]
+                    spec = self._pending.get(tid)
+                    if spec is not None and tid not in self._cancelled:
+                        self._stamp_hints_locked(spec)
+                        to_submit.append(spec)
+        for spec in to_submit:
+            self._submit(spec)
 
     def cancel(self, oid: ObjectID) -> bool:
         """Owner-side cancel: mark so the (already-running) result seals
-        TaskCancelledError on arrival. Returns True if it was pending."""
-        tid = oid.task_id()
+        TaskCancelledError on arrival; a still-deferred task is cancelled
+        entirely owner-side. Returns True if it was pending."""
+        sealed_spec = None
         with self._lock:
-            if tid in self._pending:
-                self._cancelled.add(tid)
-                return True
-        return False
+            tid = oid.task_id()
+            if tid not in self._pending:
+                return False
+            self._cancelled.add(tid)
+            if tid in self._deferred:
+                # never submitted: settle in place
+                del self._deferred[tid]
+                for waiters in self._ext_waiting.values():
+                    waiters.discard(tid)
+                sealed_spec = self._pending.pop(tid)
+                self._cancelled.discard(tid)
+                err = TaskCancelledError(f"task {tid.hex()} cancelled")
+                payload = serialization.serialize(err).to_bytes()
+                for roid in sealed_spec.return_ids():
+                    self._results[roid] = (payload, True)
+                self._cv.notify_all()
+        if sealed_spec is not None:
+            self._release_pins(sealed_spec)
+            # downstream tasks deferred on this task's returns must wake
+            # (they will run and raise the sealed TaskCancelledError)
+            self.deps_available(sealed_spec.return_ids())
+        return True
+
+    def _release_pins(self, spec: TaskSpec) -> None:
+        if self._unpin is not None and spec.pinned_args:
+            try:
+                self._unpin(list(spec.pinned_args))
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ complete
 
     def complete(self, task_id: TaskID, err_name: Optional[str],
-                 results: List[Tuple[ObjectID, Optional[bytes], bool]]) -> None:
+                 results: List[Tuple[ObjectID, Optional[bytes], bool]],
+                 exec_hex: Optional[str] = None) -> None:
         """Executor reply. ``results`` entries: (oid, inline payload | None
-        for store-sealed, is_error)."""
+        for store-sealed, is_error); ``exec_hex`` = node that sealed
+        store-resident results (pull hint for dependents)."""
         resubmit = None
+        settled_spec = None
+        sealed_oids: List[ObjectID] = []
         with self._lock:
             spec = self._pending.get(task_id)
             if spec is None:
                 return  # stale (superseded attempt)
-            cancelled = task_id in self._cancelled
+            # cancel is a no-op on an already-finished task (Ray
+            # semantics): only seal TaskCancelledError when the executor
+            # reports the task errored or never produced results
+            cancelled = (task_id in self._cancelled
+                         and (err_name is not None or not results))
             if err_name is not None and not cancelled and self._retriable(
                     spec, err_name):
                 spec.attempt += 1
@@ -117,12 +294,14 @@ class DirectTaskManager:
             else:
                 self._pending.pop(task_id, None)
                 self._cancelled.discard(task_id)
+                settled_spec = spec
                 if cancelled:
                     err = TaskCancelledError(
                         f"task {task_id.hex()} cancelled")
                     payload = serialization.serialize(err).to_bytes()
                     for oid in spec.return_ids():
                         self._results[oid] = (payload, True)
+                        sealed_oids.append(oid)
                 elif err_name in _SYSTEM_ERRS and not results:
                     err = WorkerCrashedError(
                         f"direct task {spec.function_name} lost its "
@@ -130,13 +309,25 @@ class DirectTaskManager:
                     payload = serialization.serialize(err).to_bytes()
                     for oid in spec.return_ids():
                         self._results[oid] = (payload, True)
+                        sealed_oids.append(oid)
                 else:
                     for oid, payload, is_err in results:
                         if oid in self._dropped:
                             self._dropped.discard(oid)
+                            # still sealed in the executor node's store:
+                            # dependents resolve via the directory
+                            sealed_oids.append(oid)
                         else:
                             self._results[oid] = (payload, is_err)
+                            if payload is None and exec_hex:
+                                self._result_nodes[oid] = exec_hex
+                            sealed_oids.append(oid)
                 self._cv.notify_all()
+        if settled_spec is not None:
+            self._release_pins(settled_spec)
+        if sealed_oids:
+            # downstream deferred tasks waiting on these results
+            self.deps_available(sealed_oids)
         if resubmit is not None:
             self._submit(resubmit)
 
@@ -180,6 +371,11 @@ class DirectTaskManager:
                     raise GetTimeoutError(f"get timed out on {oid.hex()}")
                 self._cv.wait(remaining)
 
+    def result_node(self, oid: ObjectID) -> Optional[str]:
+        """Node hex that sealed a store-resident owned result, if known."""
+        with self._lock:
+            return self._result_nodes.get(oid)
+
     def ready_subset(self, oids) -> set:
         """Non-blocking: which of ``oids`` are completed owned results."""
         with self._lock:
@@ -199,6 +395,7 @@ class DirectTaskManager:
         """Owner released its ref: free the retained inline result (or
         mark a still-pending task's result discard-on-arrival)."""
         with self._lock:
+            self._result_nodes.pop(oid, None)
             if self._results.pop(oid, None) is None \
                     and oid.task_id() in self._pending:
                 self._dropped.add(oid)

@@ -182,23 +182,28 @@ class Node:
                     except Exception:
                         pass
         self._append_devent(spec, err_name, sealed, t_start)
-        self._reply_direct(origin, task_id, err_name, results)
+        self._reply_direct(origin, task_id, err_name, results, self.hex)
 
     def _reply_direct(self, origin: tuple, task_id, err_name,
-                      results) -> None:
+                      results, exec_hex: Optional[str] = None) -> None:
         kind = origin[0]
         try:
             if kind == "worker":
                 with self._lock:
                     w = self._workers.get(origin[1])
                 if w is not None:
-                    w.channel.send("ddone", task_id, err_name, results)
+                    w.channel.send("ddone", task_id, err_name, results,
+                                   exec_hex)
             elif kind == "driver":
-                origin[1](task_id, err_name, results)
+                origin[1](task_id, err_name, results, exec_hex)
             elif kind == "peer":
-                origin[1].send("pdone", task_id, err_name, results)
+                origin[1].send("pdone", task_id, err_name, results, exec_hex)
             elif kind == "node":
-                origin[1]._reply_direct(origin[2], task_id, err_name, results)
+                peer = origin[1]
+                with peer._lock:
+                    peer._forwarded.pop(task_id, None)
+                peer._reply_direct(origin[2], task_id, err_name, results,
+                                   exec_hex)
         except (OSError, EOFError):
             pass  # owner gone: its results die with it (owner-died semantics)
 
@@ -223,6 +228,10 @@ class Node:
             else:
                 return
         if peer_hex is not None:
+            if not isinstance(peer_hex, str):
+                # in-process peer Node: cancel it there directly
+                peer_hex.cancel_direct(task_id, force)
+                return
             with self._peer_lock:
                 ch = self._peers.get(peer_hex)
             if ch is not None:
@@ -257,7 +266,11 @@ class Node:
             return False  # everyone is as busy as we are
         spec.direct_hops = 1
         if not isinstance(handle, (tuple, list)):
-            # in-process peer Node: direct call, reply hops back through us
+            # in-process peer Node: direct call, reply hops back through us.
+            # Tracked in _forwarded (peer stored as the Node object) so
+            # cancel_direct can reach the peer's queue/worker.
+            with self._lock:
+                self._forwarded[spec.task_id] = (origin, spec, handle)
             handle.submit_direct(spec, ("node", self, origin))
             return True
         ch = self._peer_channel(peer_hex, handle)
@@ -346,7 +359,10 @@ class Node:
             except (EOFError, OSError, TypeError):
                 break
             if tag == "pdone":
-                task_id, err_name, results = payload
+                try:
+                    task_id, err_name, results, exec_hex = payload
+                except ValueError:
+                    break  # malformed/mixed-version peer: drop it
                 with self._lock:
                     entry = self._forwarded.pop(task_id, None)
                 with self._peer_lock:
@@ -354,7 +370,8 @@ class Node:
                     if n > 0:
                         self._peer_inflight[peer_hex] = n - 1
                 if entry is not None:
-                    self._reply_direct(entry[0], task_id, err_name, results)
+                    self._reply_direct(entry[0], task_id, err_name, results,
+                                       exec_hex)
         self._drop_peer(peer_hex)
 
     def _drop_peer(self, peer_hex: str) -> None:
@@ -433,11 +450,29 @@ class Node:
         normal_task_submitter lease pipelining) so the worker starts the
         next task without waiting out the done->dispatch round trip.
         """
-        depth = max(1, global_config().worker_pipeline_depth)
+        cfg = global_config()
+        depth = max(1, cfg.worker_pipeline_depth)
+        direct_cap = max(1, int(self.max_workers * cfg.direct_slot_fraction))
         to_send: List[Tuple[WorkerHandle, TaskSpec, dict]] = []
         with self._lock:
+            # one scan per pump (not per task): assignments made in this
+            # call adjust the cached count below
+            direct_running = self._direct_running_locked()
             while self._local_queue:
+                idx = 0
                 spec, binding = self._local_queue[0]
+                if (spec.task_id in self._direct
+                        and direct_running >= direct_cap):
+                    # direct tasks at their slot cap: let a waiting
+                    # head-dispatched (resource-bound) task leapfrog so the
+                    # scheduler's placements aren't starved by a direct
+                    # flood (priority-inversion guard). With no head task
+                    # waiting the cap does not apply (work conservation).
+                    for j in range(1, len(self._local_queue)):
+                        s2, b2 = self._local_queue[j]
+                        if s2.task_id not in self._direct:
+                            idx, spec, binding = j, s2, b2
+                            break
                 w = None
                 while self._idle:
                     cand = self._idle.popleft()
@@ -468,7 +503,9 @@ class Node:
                                 break
                     if w is None:
                         break
-                self._local_queue.popleft()
+                del self._local_queue[idx]
+                if spec.task_id in self._direct:
+                    direct_running += 1
                 w.state = "busy"
                 # stamp the attempt at assignment: spec objects are shared
                 # with the head and mutate on retry, so a late finish must
@@ -498,6 +535,15 @@ class Node:
                 w.channel.send("unstage", tid)
             except OSError:
                 self._on_worker_dead(w)
+
+    def _direct_running_locked(self) -> int:
+        """Worker slots currently held by direct (head-bypass) tasks."""
+        n = 0
+        for w in self._workers.values():
+            for s, _, _ in w.assigned.values():
+                if s.task_id in self._direct:
+                    n += 1
+        return n
 
     # ------------------------------------------------------------ workers
 
@@ -612,6 +658,12 @@ class Node:
                 self.submit_direct(spec, ("worker", w.worker_id))
             elif tag == "dcancel":
                 self.cancel_direct(payload[0], payload[1])
+            elif tag == "dpin":
+                # one-way arg pin/unpin for this worker's direct tasks
+                try:
+                    self.head.apply_pin_delta(payload[0], payload[1])
+                except Exception:
+                    pass
             elif tag == "release":
                 for oid in payload[0]:
                     self.store.remove_ref(oid)
@@ -646,8 +698,10 @@ class Node:
     def _handle_store(self, w: WorkerHandle, req_id: int, op: str, args) -> None:
         try:
             if op == "get":
-                oid, timeout = args
-                rep = self.head.get_object_for_node(self, oid, timeout)
+                oid, timeout, *rest = args
+                hint = rest[0] if rest else None
+                rep = self.head.get_object_for_node(self, oid, timeout,
+                                                    hint=hint)
                 self._reply(w, req_id, True, rep)
             elif op == "wait":
                 oids, num_returns, timeout = args
@@ -792,6 +846,22 @@ class Node:
 
         threading.Thread(target=tail, daemon=True,
                          name=f"logtail-{self.hex[:6]}").start()
+
+    def update_node_ip(self, ip: str) -> None:
+        """Upgrade this node's advertised IP and push it to every
+        already-registered worker. Workers prestarted in __init__ received
+        init_info with the loopback IP before start_node_server() learned
+        the routable one; without this push an actor matched to such a
+        worker would advertise 127.0.0.1 as its coordinator address in a
+        multi-host Train bootstrap."""
+        self.node_ip = ip
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            try:
+                w.channel.send("node_ip", ip)
+            except OSError:
+                pass
 
     def start_object_server(self, authkey: bytes, host: Optional[str] = None):
         """Start the node-to-node chunk server (multi-host mode).

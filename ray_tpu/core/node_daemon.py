@@ -158,6 +158,9 @@ class RemoteHead:
     def on_stream_item(self, task_id, index: int) -> None:
         self._send("stream_item", task_id, index)
 
+    def apply_pin_delta(self, oids, delta: int) -> None:
+        self._send("pin_delta", oids, delta)
+
     def on_worker_metrics(self, source_id: str, snapshot: dict) -> None:
         self._send("worker_metrics", source_id, snapshot)
 
@@ -206,9 +209,14 @@ class RemoteHead:
             lambda t: ("wait_objects", (oids, num_returns, t)),
             lambda ready: len(ready) >= num_returns, timeout)
 
-    def get_object_for_node(self, node, oid: ObjectID, timeout):
+    def get_object_for_node(self, node, oid: ObjectID, timeout,
+                            hint: Optional[str] = None):
         """Local-store check, then head locate + direct pull from the source
-        node's object server (reference: pull_manager.h chunked pull)."""
+        node's object server (reference: pull_manager.h chunked pull).
+
+        ``hint`` (direct-path owner hint) short-circuits the head locate
+        entirely: the daemon pulls straight from the hinted peer's object
+        server found in the syncer-broadcast cluster view."""
         from .object_transfer import pull_object
 
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -220,6 +228,21 @@ class RemoteHead:
                     return ("inline", bytes(payload), is_err)
                 off, size, is_err = info
                 return ("arena", off, size, is_err)
+            if hint and hint != node.hex:
+                addr = next((tuple(e["addr"]) for e in self.cluster_view
+                             if e.get("hex") == hint and e.get("addr")),
+                            None)
+                hint = None  # one shot: failure falls to the locate loop
+                if addr is not None:
+                    res = pull_object(addr, self.cluster_key, oid,
+                                      dest_store=node.store)
+                    if res is not None:
+                        body, is_err = res
+                        if isinstance(body, tuple):
+                            _, off, size = body
+                            self.on_object_sealed(oid, node.hex)
+                            return ("arena", off, size, is_err)
+                        return ("inline", body, is_err)
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0:
                 return ("timeout",)

@@ -230,7 +230,7 @@ class Head:
             nodes = [n for n in self.nodes.values() if self._is_local(n)]
         for n in nodes:
             if n.node_ip.startswith("127."):
-                n.node_ip = self.node_ip
+                n.update_node_ip(self.node_ip)
             n.start_object_server(self._cluster_key)
         threading.Thread(target=self._node_accept_loop, daemon=True,
                          name="node-server").start()
@@ -257,6 +257,18 @@ class Head:
         if now - getattr(self, "_last_view_broadcast", 0.0) > 0.5:
             self._last_view_broadcast = now
             self._broadcast_cluster_view()
+
+    def apply_pin_delta(self, oids, delta: int) -> None:
+        """Batched ref-count adjustment (direct-path arg pinning)."""
+        to_delete = []
+        with self._lock:
+            for oid in oids:
+                self.ref_counts[oid] += delta
+                if delta < 0 and self.ref_counts[oid] <= 0:
+                    to_delete.append(oid)
+        if not self._stopped:
+            for oid in to_delete:
+                self.delete_object(oid)
 
     def on_sealed_payload(self, oid: ObjectID, payload: bytes,
                           is_error: bool) -> None:
@@ -461,6 +473,8 @@ class Head:
                 self.publish_direct_events(proxy.hex, payload[0])
             elif tag == "sealed_payload":
                 self.on_sealed_payload(*payload)
+            elif tag == "pin_delta":
+                self.apply_pin_delta(*payload)
             elif tag == "req":
                 req_id, op, args = payload
                 self._daemon_pool.submit(self._handle_daemon_req, proxy,
@@ -1279,11 +1293,15 @@ class Head:
         self._resolve_then_queue(rec)
         return True
 
-    def get_object_for_node(self, node: Node, oid: ObjectID, timeout: Optional[float]):
+    def get_object_for_node(self, node: Node, oid: ObjectID,
+                            timeout: Optional[float],
+                            hint: Optional[str] = None):
         """Worker get: ensure the object is readable on `node`; return either
         ("inline", bytes, is_err) or ("arena", offset, size, is_err).
         Transfers from a remote node's store when needed (reference:
-        object_manager.cc chunked pull)."""
+        object_manager.cc chunked pull). ``hint`` names a node believed to
+        hold the object (direct-path owner hint) — consulted when the
+        directory has no location yet."""
         deadline = None if timeout is None else time.monotonic() + timeout
         attempted_reconstruction = False
         while True:
@@ -1296,6 +1314,8 @@ class Head:
                 return ("arena", off, size, is_err)
             with self._lock:
                 locs = [h for h in self.gcs.get_object_locations(oid) if h in self.nodes]
+                if not locs and hint and hint in self.nodes:
+                    locs = [hint]
             if locs:
                 src = self.nodes[locs[0]]
                 if not self._is_local(src):
@@ -1504,7 +1524,12 @@ class DriverRuntime:
         self._fn_cache: Dict[str, Any] = {}
         # direct (head-bypass) path: the driver owns its eligible plain
         # tasks, submitted straight to the in-process head node
-        self.direct = DirectTaskManager(self._direct_submit)
+        self.direct = DirectTaskManager(
+            self._direct_submit,
+            ext_wait=lambda oids, t: head.wait_objects(
+                list(oids), len(oids), t),
+            pin=lambda oids: head.apply_pin_delta(oids, 1),
+            unpin=lambda oids: head.apply_pin_delta(oids, -1))
 
     def _direct_submit(self, spec: TaskSpec) -> None:
         self.head.head_node.submit_direct(
@@ -1590,8 +1615,9 @@ class DriverRuntime:
         from .direct import direct_eligible
 
         if global_config().direct_task_enabled and direct_eligible(spec):
-            self.direct.register(spec)
-            self._direct_submit(spec)
+            ready = self.direct.register(spec)
+            if ready is not None:  # else: dep resolver submits it later
+                self._direct_submit(ready)
         else:
             self.head.submit_spec(spec)
         return [ObjectRef(oid) for oid in spec.return_ids()]

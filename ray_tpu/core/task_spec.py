@@ -69,6 +69,11 @@ class TaskSpec:
     # direct (head-bypass) path: number of node-to-node spillback hops this
     # spec has taken; capped at 1 so forwarding can never ping-pong
     direct_hops: int = 0
+    # direct path, ref args: owner-side resolution hints shipped with the
+    # spec (reference: dependency_resolver.h resolves at the submitter).
+    # oid -> ("inline", payload, is_err) for small owned results, or
+    # ("node", node_hex) locating the store that sealed the object.
+    arg_hints: Optional[Dict[ObjectID, tuple]] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]

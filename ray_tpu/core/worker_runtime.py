@@ -82,7 +82,21 @@ class WorkerRuntime:
         # submissions (reference: submitter-side TaskManager + memory store)
         from .direct import DirectTaskManager
 
-        self.direct = DirectTaskManager(self._direct_submit)
+        # pin/unpin are ONE-WAY sends: complete() (and with it unpin) runs
+        # on the serve_forever channel-reader thread — a blocking RPC there
+        # would deadlock on its own reply. One-way messages are also FIFO
+        # with dsubmit on the same channel, so a pin always lands first.
+        self.direct = DirectTaskManager(
+            self._direct_submit,
+            ext_wait=self._ext_wait_objects,
+            pin=lambda oids: self.channel.send("dpin", oids, 1),
+            unpin=lambda oids: self.channel.send("dpin", oids, -1))
+
+    def _ext_wait_objects(self, oids, timeout):
+        """One availability round against the cluster object directory
+        (dependency resolver's external-object wait)."""
+        return self.rpc.call("store", "wait", list(oids), len(oids),
+                             timeout, timeout=None)
 
     # ------------------------------------------------------------------ API
     # (same surface the driver runtime exposes; public api dispatches here)
@@ -115,7 +129,8 @@ class WorkerRuntime:
             out.append(self._get_one(r.id, remaining))
         return out
 
-    def _get_one(self, oid: ObjectID, timeout: Optional[float]) -> Any:
+    def _get_one(self, oid: ObjectID, timeout: Optional[float],
+                 hint: Optional[str] = None) -> Any:
         # owned direct results resolve in-process (blocks until the
         # executor's reply lands; no node round-trip)
         local = self.direct.get_local(oid, timeout)
@@ -126,8 +141,10 @@ class WorkerRuntime:
                 if is_error:
                     raise value
                 return value
-            # large result: sealed in a node store — fall through
-        rep = self.rpc.call("store", "get", oid, timeout, timeout=None)
+            # large result: sealed in a node store — fall through, with
+            # the sealing node as a pull hint
+            hint = hint or self.direct.result_node(oid)
+        rep = self.rpc.call("store", "get", oid, timeout, hint, timeout=None)
         kind = rep[0]
         if kind == "timeout":
             raise GetTimeoutError(f"get timed out on {oid.hex()}")
@@ -187,8 +204,9 @@ class WorkerRuntime:
 
         if global_config().direct_task_enabled and direct_eligible(spec):
             spec.owner_is_driver = False
-            self.direct.register(spec)
-            self._direct_submit(spec)
+            ready = self.direct.register(spec)
+            if ready is not None:  # else: dep resolver submits it later
+                self._direct_submit(ready)
         else:
             self.rpc.call("rpc", "submit_task", pickle.dumps(spec))
         return [ObjectRef(oid) for oid in spec.return_ids()]
@@ -294,14 +312,19 @@ class WorkerRuntime:
                     self.rpc.handle_reply(*payload)
                 elif tag == "ddone":
                     # direct-task completion (may resubmit a retry inline)
-                    task_id, err_name, results = payload
-                    self.direct.complete(task_id, err_name, results)
+                    task_id, err_name, results, exec_hex = payload
+                    self.direct.complete(task_id, err_name, results,
+                                         exec_hex)
                 elif tag == "exec":
                     spec: TaskSpec = pickle.loads(payload[0])
                     binding = payload[1]
                     self._dispatch_exec(spec, binding)
                 elif tag == "cancel":
                     self._cancelled.add(payload[0])
+                elif tag == "node_ip":
+                    # node learned its routable IP after this worker
+                    # registered (head-node prestart race)
+                    self.node_ip = payload[0]
                 elif tag == "unstage":
                     # node reclaims a staged-but-unstarted task (another
                     # worker went idle); only possible pre-execution, so
@@ -414,10 +437,21 @@ class WorkerRuntime:
                          name=f"compiled-exec-{desc['method']}").start()
 
     def _resolve_args(self, spec: TaskSpec):
+        hints = spec.arg_hints or {}
+
         def resolve(v):
             kind, payload = v
             if kind == "ref":
-                return self._get_one(payload, None)
+                hint = hints.get(payload)
+                if hint is not None and hint[0] == "inline":
+                    # owner shipped the (small) arg bytes with the spec —
+                    # no store round-trip at all
+                    value = serialization.deserialize(hint[1])
+                    if hint[2]:
+                        raise value
+                    return value
+                node_hint = hint[1] if hint is not None else None
+                return self._get_one(payload, None, node_hint)
             return serialization.deserialize(payload)
 
         args = [resolve(a) for a in spec.args]
@@ -578,6 +612,12 @@ class WorkerRuntime:
 
 
 def worker_main(argv=None) -> None:
+    # SIGUSR1 -> all-thread dump to stderr (lands in the worker log file);
+    # the debugging hook for wedged workers (reference: ray stack)
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1)
     parser = argparse.ArgumentParser()
     parser.add_argument("--address", required=True)
     parser.add_argument("--authkey", required=True)

@@ -20,6 +20,8 @@ from .dqn import DQN, DQNConfig
 from .env_runner import SingleAgentEnvRunner, compute_gae
 from .learner import Learner, LearnerGroup
 from .impala import IMPALA, IMPALAConfig
+from .offline import (BC, BCConfig, CQL, CQLConfig, OfflineData,
+                      record_transitions)
 from .ppo import PPO, PPOConfig
 from .replay_buffers import PrioritizedReplayBuffer, ReplayBuffer, SumTree
 from .rl_module import JaxRLModule, RLModuleSpec
@@ -30,6 +32,8 @@ __all__ = [
     "SingleAgentEnvRunner", "compute_gae", "Learner", "LearnerGroup",
     "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
     "APPO", "APPOConfig", "SAC", "SACConfig",
+    "BC", "BCConfig", "CQL", "CQLConfig", "OfflineData",
+    "record_transitions",
     "ReplayBuffer", "PrioritizedReplayBuffer", "SumTree",
     "ContinuousRLModule", "ContinuousModuleSpec", "ContinuousEnvRunner",
     "JaxRLModule", "RLModuleSpec",

@@ -30,8 +30,12 @@ def start(http_options: Optional[HTTPOptions] = None,
     if _controller is None:
         from .controller import ServeController
 
+        # Generous concurrency: every handle/proxy parks one long-poll
+        # watcher (wait_for_version) for up to ~25s, so the budget must
+        # scale with watcher count — the reference LongPollHost is async
+        # for the same reason. Threads spawn lazily; idle slots are free.
         _controller = ServeController.options(
-            name="SERVE_CONTROLLER", max_concurrency=16).remote()
+            name="SERVE_CONTROLLER", max_concurrency=256).remote()
         ray_tpu.get(_controller.ping.remote())
     opts = http_options or HTTPOptions()
     if opts.proxy_location == "EveryNode":

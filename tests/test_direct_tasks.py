@@ -91,8 +91,9 @@ class TestDirectLocal:
 
         assert ray_tpu.get(parent.remote(20)) == sum(2 * i for i in range(20))
 
-    def test_ineligible_falls_back(self):
-        # ref args keep the head path (dependency staging lives there)
+    def test_ref_args_take_direct_path(self):
+        # round 4: ref args are owner-resolved (dependency resolver) and
+        # stay on the direct path — no head task record
         ref = ray_tpu.put(5)
 
         @ray_tpu.remote
@@ -100,6 +101,79 @@ class TestDirectLocal:
             return a + b
 
         assert ray_tpu.get(add.remote(ref, 2)) == 7
+        assert len(_head().tasks) == 0
+
+    def test_pending_direct_result_as_arg_defers(self):
+        # arg produced by a still-running direct task: the resolver defers
+        # submission until the dep completes, then ships an inline hint
+        @ray_tpu.remote
+        def slow_val():
+            import time as _t
+
+            _t.sleep(0.5)
+            return 20
+
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        dep = slow_val.remote()
+        out = add.remote(dep, 1)  # submitted while dep still running
+        assert ray_tpu.get(out, timeout=60) == 21
+        assert len(_head().tasks) == 0
+
+    def test_large_ref_arg_chain_stays_direct(self):
+        import numpy as np
+
+        @ray_tpu.remote
+        def make(n):
+            return np.ones(n, dtype=np.int64)
+
+        @ray_tpu.remote
+        def total(a):
+            return int(a.sum())
+
+        big = make.remote(500_000)  # > inline threshold: store-sealed
+        assert ray_tpu.get(total.remote(big), timeout=60) == 500_000
+        assert len(_head().tasks) == 0
+
+    def test_cancel_deferred_task_wakes_dependents(self):
+        # cancel a task that is still deferred on its dep; a task deferred
+        # on the CANCELLED task's output must still wake (and see the
+        # TaskCancelledError), not hang in _deferred forever
+        @ray_tpu.remote
+        def slow():
+            import time as _t
+
+            _t.sleep(1.0)
+            return 1
+
+        @ray_tpu.remote
+        def mid(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def leaf(x):
+            return x + 1
+
+        dep = slow.remote()
+        m = mid.remote(dep)      # deferred on dep
+        lf = leaf.remote(m)      # deferred on m
+        ray_tpu.cancel(m)
+        with pytest.raises(Exception):
+            ray_tpu.get(lf, timeout=30)
+
+    def test_error_propagates_through_ref_arg(self):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("upstream dead")
+
+        @ray_tpu.remote
+        def consume(v):
+            return v
+
+        with pytest.raises(Exception, match="upstream dead"):
+            ray_tpu.get(consume.remote(boom.remote()), timeout=60)
 
 
 class TestSpillback:
