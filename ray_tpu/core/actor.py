@@ -60,10 +60,12 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str,
-                 method_num_returns: Optional[Dict[str, int]] = None):
+                 method_num_returns: Optional[Dict[str, int]] = None,
+                 max_task_retries: int = 0):
         object.__setattr__(self, "_actor_id", actor_id)
         object.__setattr__(self, "_class_name", class_name)
         object.__setattr__(self, "_method_num_returns", method_num_returns or {})
+        object.__setattr__(self, "_max_task_retries", max_task_retries)
 
     def __getattr__(self, name: str):
         if (name.startswith("__") and name.endswith("__")
@@ -92,7 +94,9 @@ class ActorHandle:
             num_returns=num_returns,
             streaming=streaming,
             resources=parse_task_resources(num_cpus=0, default_num_cpus=0.0),
-            max_retries=0,
+            # actor-task retries follow the actor's max_task_retries
+            # (reference: ray_option_utils max_task_retries semantics)
+            max_retries=self._max_task_retries,
             actor_id=self._actor_id,
             pinned_args=[r.id for r in keepalive],
         )
@@ -109,7 +113,8 @@ class ActorHandle:
 
     def __reduce__(self):
         return (ActorHandle, (self._actor_id, self._class_name,
-                              self._method_num_returns))
+                              self._method_num_returns,
+                              self._max_task_retries))
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
@@ -221,7 +226,8 @@ class ActorClass:
             runtime.rpc.call(
                 "rpc", "create_actor",
                 pickle.dumps((spec, name, namespace, max_restarts, detached)))
-        return ActorHandle(actor_id, self.__name__, self._method_num_returns)
+        return ActorHandle(actor_id, self.__name__, self._method_num_returns,
+                           opt.get("max_task_retries", 0))
 
 
 def method(num_returns: int = 1):

@@ -210,7 +210,8 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
     info = rt.get_actor_info(name, namespace or _namespace)
     if info is None:
         raise ValueError(f"Failed to look up actor {name!r}")
-    return ActorHandle(info["actor_id"], info["class_name"])
+    return ActorHandle(info["actor_id"], info["class_name"],
+                       max_task_retries=info.get("max_task_retries", 0) or 0)
 
 
 def available_resources() -> Dict[str, float]:

@@ -58,6 +58,9 @@ class Config:
     # peer spillback, with batched event reports to the head (reference:
     # normal_task_submitter.cc — the GCS is out of the normal-task path)
     direct_task_enabled: bool = True
+    # actor method calls go caller->actor-node directly (head keeps the
+    # lifecycle FSM only); off = every a.m.remote() routes via the head
+    direct_actor_enabled: bool = True
     # spill to a peer when the local queue exceeds factor * max_workers
     direct_spill_queue_factor: float = 4.0
     # executor nodes batch (object-location + observability) events to the

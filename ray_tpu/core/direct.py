@@ -52,6 +52,23 @@ _SYSTEM_ERRS = ("WorkerCrashedError", "NodeDiedError")
 _INLINE_HINT_MAX = 100 * 1024
 
 
+# actor-call errors that mean the call NEVER EXECUTED on the target
+# (always safe to resubmit after re-resolving the actor's location)
+_ACTOR_LOC_ERRS = ("ActorMissingError", "NodeDiedError")
+# errors where the call may have started executing (resubmit only per
+# max_task_retries, matching the reference's at-most-once default)
+_ACTOR_SYS_ERRS = _ACTOR_LOC_ERRS + ("ActorDiedError", "WorkerCrashedError")
+
+
+def actor_call_eligible(spec: TaskSpec) -> bool:
+    """Direct-path test for actor method calls: everything except
+    streaming generators (their item protocol rides head task records)."""
+    return (spec.actor_id is not None
+            and not spec.is_actor_creation
+            and not spec.streaming
+            and spec.runtime_env is None)
+
+
 def direct_eligible(spec: TaskSpec) -> bool:
     """Hot-class test: plain <=1-CPU task, default placement. Ref args are
     fine — the owner resolves them before submission (dependency resolver)
@@ -98,6 +115,12 @@ class DirectTaskManager:
         self._ext_wait = ext_wait
         self._pin = pin
         self._unpin = unpin
+        # wired by DirectActorSubmitter: dep-ready + failure + completion
+        # routing for actor-call specs (ordered per-actor submission)
+        self._actor_ready_cb: Optional[Callable] = None
+        self._actor_failed_cb: Optional[Callable] = None
+        self._actor_done_cb: Optional[Callable] = None
+        self._actor_cancel_cb: Optional[Callable] = None
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: Dict[TaskID, TaskSpec] = {}
@@ -216,6 +239,7 @@ class DirectTaskManager:
         """Mark objects available; submit any deferred spec whose last
         missing dependency this satisfies."""
         to_submit: List[TaskSpec] = []
+        actor_ready: List[TaskSpec] = []
         ready_set = set(oids)
         with self._lock:
             for oid in ready_set:
@@ -226,10 +250,16 @@ class DirectTaskManager:
                     del self._deferred[tid]
                     spec = self._pending.get(tid)
                     if spec is not None and tid not in self._cancelled:
-                        self._stamp_hints_locked(spec)
-                        to_submit.append(spec)
+                        if spec.actor_id is not None:
+                            actor_ready.append(spec)  # ordered queue decides
+                        else:
+                            self._stamp_hints_locked(spec)
+                            to_submit.append(spec)
         for spec in to_submit:
             self._submit(spec)
+        if actor_ready and self._actor_ready_cb is not None:
+            for spec in actor_ready:
+                self._actor_ready_cb(spec)
 
     def cancel(self, oid: ObjectID) -> bool:
         """Owner-side cancel: mark so the (already-running) result seals
@@ -255,6 +285,11 @@ class DirectTaskManager:
                 self._cv.notify_all()
         if sealed_spec is not None:
             self._release_pins(sealed_spec)
+            if (sealed_spec.actor_id is not None
+                    and self._actor_cancel_cb is not None):
+                # unwedge the actor route: the cancelled call must leave
+                # the ordered queue or every later call stays blocked
+                self._actor_cancel_cb(sealed_spec)
             # downstream tasks deferred on this task's returns must wake
             # (they will run and raise the sealed TaskCancelledError)
             self.deps_available(sealed_spec.return_ids())
@@ -277,6 +312,7 @@ class DirectTaskManager:
         store-resident results (pull hint for dependents)."""
         resubmit = None
         settled_spec = None
+        actor_handoff = None
         sealed_oids: List[ObjectID] = []
         with self._lock:
             spec = self._pending.get(task_id)
@@ -287,7 +323,13 @@ class DirectTaskManager:
             # reports the task errored or never produced results
             cancelled = (task_id in self._cancelled
                          and (err_name is not None or not results))
-            if err_name is not None and not cancelled and self._retriable(
+            if (spec.actor_id is not None and err_name in _ACTOR_SYS_ERRS
+                    and not cancelled):
+                # actor transport failure: the ordered submitter decides
+                # (re-resolve + resubmit vs ActorDiedError) — outside the
+                # lock; the spec stays pending meanwhile
+                actor_handoff = spec
+            elif err_name is not None and not cancelled and self._retriable(
                     spec, err_name):
                 spec.attempt += 1
                 resubmit = spec
@@ -323,13 +365,45 @@ class DirectTaskManager:
                                 self._result_nodes[oid] = exec_hex
                             sealed_oids.append(oid)
                 self._cv.notify_all()
+        if actor_handoff is not None:
+            handled = (self._actor_failed_cb is not None
+                       and self._actor_failed_cb(actor_handoff, err_name))
+            if not handled:
+                from .exceptions import ActorDiedError
+
+                self.seal_error_local(actor_handoff, ActorDiedError(
+                    actor_handoff.actor_id,
+                    f"actor call failed ({err_name}), not retried"))
+            return
         if settled_spec is not None:
             self._release_pins(settled_spec)
+            if (settled_spec.actor_id is not None
+                    and self._actor_done_cb is not None):
+                self._actor_done_cb(settled_spec)
         if sealed_oids:
             # downstream deferred tasks waiting on these results
             self.deps_available(sealed_oids)
         if resubmit is not None:
+            resubmit.direct_hops = 0  # fresh routing for the retry
             self._submit(resubmit)
+
+    def seal_error_local(self, spec: TaskSpec, exc: Exception) -> None:
+        """Settle an owned task with ``exc`` on all its returns."""
+        payload = serialization.serialize(exc).to_bytes()
+        with self._lock:
+            if self._pending.pop(spec.task_id, None) is None:
+                return
+            self._cancelled.discard(spec.task_id)
+            self._deferred.pop(spec.task_id, None)
+            for oid in spec.return_ids():
+                self._results[oid] = (payload, True)
+            self._cv.notify_all()
+        self._release_pins(spec)
+        self.deps_available(spec.return_ids())
+
+    def stamp_hints(self, spec: TaskSpec) -> None:
+        with self._lock:
+            self._stamp_hints_locked(spec)
 
     @staticmethod
     def _retriable(spec: TaskSpec, err_name: str) -> bool:
@@ -399,3 +473,330 @@ class DirectTaskManager:
             if self._results.pop(oid, None) is None \
                     and oid.task_id() in self._pending:
                 self._dropped.add(oid)
+
+
+class _ActorRoute:
+    """Per-(owner, actor) submission state."""
+
+    __slots__ = ("seq", "loc", "state", "queue", "ready", "inflight",
+                 "parked", "death_cause", "send_buf", "sender_active",
+                 "pinned")
+
+    def __init__(self):
+        self.seq = 0
+        self.loc: Optional[str] = None
+        # UNRESOLVED | READY | WAITING | DEAD
+        self.state = "UNRESOLVED"
+        # head-pinned: NEW calls take the head path; calls already queued
+        # keep resolving + draining through the direct path (a pin must
+        # never strand them)
+        self.pinned = False
+        self.queue: List[TaskSpec] = []     # submitted, unsent (seq order)
+        self.ready: set = set()             # task_ids with deps resolved
+        self.inflight: Dict[TaskID, TaskSpec] = {}
+        self.parked: List[TaskSpec] = []    # failed in flight, to resubmit
+        self.death_cause: Optional[str] = None
+        # sends must leave the lock (reentrancy) yet stay ordered: the
+        # ready prefix moves into send_buf and exactly ONE thread drains it
+        self.send_buf: List[TaskSpec] = []
+        self.sender_active = False
+
+
+class DirectActorSubmitter:
+    """Owner-side ordered actor-call submission, head out of the path.
+
+    The analog of the reference's ActorTaskSubmitter + sequential submit
+    queue (``src/ray/core_worker/transport/actor_task_submitter.cc:482``
+    ``PushActorTask``, ``sequential_actor_submit_queue.cc``): calls carry a
+    per-(owner, actor) sequence number, ride the owner's node channel to
+    the actor's node (FIFO per route preserves order), and the executor
+    replies straight to the owner. The head is consulted only to RESOLVE
+    the actor's location (once per incarnation) and keeps the lifecycle
+    FSM; it never sees individual method calls.
+
+    Failure protocol: a location error (ActorMissingError/NodeDiedError —
+    the call never ran) parks the call for resubmission after the resolver
+    re-learns the actor's address; a death mid-call (ActorDiedError/
+    WorkerCrashedError) parks only when ``max_task_retries`` allows,
+    otherwise seals ActorDiedError (reference at-most-once semantics).
+    Parked + queued calls flush to the restarted actor in seq order.
+    """
+
+    def __init__(self, manager: DirectTaskManager,
+                 send: Callable[[TaskSpec], None],
+                 resolve: Callable[[Any], Optional[dict]]):
+        self._mgr = manager
+        self._send = send
+        self._resolve = resolve
+        self._lock = threading.Lock()
+        self._routes: Dict[Any, _ActorRoute] = {}
+        self._resolve_kick = threading.Event()
+        self._resolve_queue: set = set()  # actor_ids needing resolution
+        self._resolver_started = False
+        self._drained_cv = threading.Condition(self._lock)
+        manager._actor_ready_cb = self._on_dep_ready
+        manager._actor_failed_cb = self._on_call_failed
+        manager._actor_done_cb = self.on_call_done
+        manager._actor_cancel_cb = self.remove_call
+
+    # ------------------------------------------------------------ submit
+
+    def try_submit(self, spec: TaskSpec) -> bool:
+        """Returns True if the call was taken onto the direct path; False
+        = caller must use the head path (ineligible or head-pinned)."""
+        if not actor_call_eligible(spec):
+            return False
+        aid = spec.actor_id
+        with self._lock:
+            rt = self._routes.setdefault(aid, _ActorRoute())
+            if rt.pinned:
+                return False
+            spec.actor_seq = rt.seq
+            rt.seq += 1
+            # append under the SAME lock as seq assignment: the queue's
+            # seq-sorted invariant is what the prefix drain relies on
+            rt.queue.append(spec)
+        ready = self._mgr.register(spec)
+        dead_cause = None
+        with self._lock:
+            rt = self._routes[aid]
+            if rt.state == "DEAD":
+                dead_cause = rt.death_cause or "actor is dead"
+                try:
+                    rt.queue.remove(spec)
+                except ValueError:
+                    pass
+            elif ready is not None:
+                rt.ready.add(spec.task_id)
+        if dead_cause is not None:
+            from .exceptions import ActorDiedError
+
+            self._mgr.seal_error_local(spec, ActorDiedError(aid, dead_cause))
+            return True
+        self._drain(aid)
+        return True
+
+    def head_pin(self, actor_id, timeout: float = 30.0) -> None:
+        """Route this owner's future calls to ``actor_id`` via the head
+        (e.g. a streaming call needs head stream records). Drains in-flight
+        direct calls first so submission order is preserved across the
+        path switch."""
+        deadline = None if timeout is None else _mono() + timeout
+        with self._lock:
+            rt = self._routes.setdefault(actor_id, _ActorRoute())
+            rt.pinned = True
+        self._drain(actor_id)  # already-queued calls still flush direct
+        with self._lock:
+            rt = self._routes[actor_id]
+            while rt.queue or rt.inflight or rt.parked:
+                remaining = (None if deadline is None
+                             else deadline - _mono())
+                if remaining is not None and remaining <= 0:
+                    break
+                self._drained_cv.wait(remaining)
+
+    # ------------------------------------------------------------ drain
+
+    def _drain(self, aid) -> None:
+        """Send the longest dep-ready prefix of the queue (order gate:
+        a call with unresolved deps blocks everything behind it, matching
+        the reference's in-order actor scheduling queue). Sends happen
+        outside the lock but single-threaded per route (sender_active)."""
+        kick = False
+        i_am_sender = False
+        with self._lock:
+            rt = self._routes.get(aid)
+            if rt is None:
+                return
+            if rt.state in ("UNRESOLVED", "WAITING"):
+                rt.state = "WAITING"
+                self._resolve_queue.add(aid)
+                kick = True
+            elif rt.state == "READY":
+                while rt.queue and rt.queue[0].task_id in rt.ready:
+                    spec = rt.queue.pop(0)
+                    rt.ready.discard(spec.task_id)
+                    spec.actor_node_hex = rt.loc
+                    rt.inflight[spec.task_id] = spec
+                    rt.send_buf.append(spec)
+                if rt.send_buf and not rt.sender_active:
+                    rt.sender_active = True
+                    i_am_sender = True
+        if kick:
+            self._ensure_resolver()
+            self._resolve_kick.set()
+        if not i_am_sender:
+            return
+        while True:
+            with self._lock:
+                rt = self._routes.get(aid)
+                if rt is None or not rt.send_buf:
+                    if rt is not None:
+                        rt.sender_active = False
+                    return
+                spec = rt.send_buf.pop(0)
+            # fresh routing decision per send: a prior forward stamped
+            # direct_hops on this (shared) spec; without the reset a
+            # parked-and-resubmitted call would bounce ActorMissingError
+            # forever at the routing node
+            spec.direct_hops = 0
+            self._mgr.stamp_hints(spec)
+            self._send(spec)
+
+    def _on_dep_ready(self, spec: TaskSpec) -> None:
+        aid = spec.actor_id
+        with self._lock:
+            rt = self._routes.get(aid)
+            if rt is None:
+                return
+            rt.ready.add(spec.task_id)
+        self._drain(aid)
+
+    # ------------------------------------------------------------ failure
+
+    def _on_call_failed(self, spec: TaskSpec, err_name: str) -> bool:
+        """Transport/executor failure for an in-flight call. True = parked
+        for resubmission; False = let the manager seal ActorDiedError."""
+        aid = spec.actor_id
+        retry_ok = (err_name in _ACTOR_LOC_ERRS
+                    or spec.attempt < spec.max_retries)
+        with self._lock:
+            rt = self._routes.get(aid)
+            if rt is None or rt.state == "DEAD" or not retry_ok:
+                if rt is not None:
+                    rt.inflight.pop(spec.task_id, None)
+                    self._drained_cv.notify_all()
+                return False
+            rt.inflight.pop(spec.task_id, None)
+            if err_name not in _ACTOR_LOC_ERRS:
+                spec.attempt += 1  # executed-and-died consumes a retry
+            rt.parked.append(spec)
+            rt.state = "WAITING"
+            rt.loc = None
+            self._resolve_queue.add(aid)
+        self._ensure_resolver()
+        self._resolve_kick.set()
+        return True
+
+    # ------------------------------------------------------------ resolver
+
+    def _ensure_resolver(self) -> None:
+        with self._lock:
+            if self._resolver_started:
+                return
+            self._resolver_started = True
+        threading.Thread(target=self._resolve_loop, daemon=True,
+                         name="actor-resolver").start()
+
+    def _resolve_loop(self) -> None:
+        """Location resolution + restart watching (reference: actor table
+        subscription in GcsClient; here a poll while calls are parked)."""
+        backoff = 0.02
+        while True:
+            self._resolve_kick.wait(timeout=0.5)
+            self._resolve_kick.clear()
+            with self._lock:
+                pending = list(self._resolve_queue)
+            if not pending:
+                backoff = 0.02
+                continue
+            progress = False
+            for aid in pending:
+                try:
+                    info = self._resolve(aid)
+                except Exception:
+                    continue  # control link hiccup; retry next round
+                if info is not None and info.get("state") == "ALIVE" \
+                        and info.get("node_hex"):
+                    self._actor_alive(aid, info["node_hex"])
+                    progress = True
+                elif info is None or info.get("state") == "DEAD":
+                    self._actor_dead(aid, (info or {}).get(
+                        "death_cause") or "actor is dead")
+                    progress = True
+                # PENDING_CREATION / RESTARTING: keep polling
+            if not progress:
+                self._resolve_kick.wait(timeout=backoff)
+                self._resolve_kick.clear()
+                backoff = min(backoff * 2, 0.5)
+                with self._lock:
+                    if self._resolve_queue:
+                        self._resolve_kick.set()
+            else:
+                backoff = 0.02
+
+    def _actor_alive(self, aid, node_hex: str) -> None:
+        with self._lock:
+            rt = self._routes.get(aid)
+            if rt is None:
+                self._resolve_queue.discard(aid)
+                return
+            rt.loc = node_hex
+            if rt.state == "WAITING":
+                rt.state = "READY"
+            self._resolve_queue.discard(aid)
+            if rt.parked:
+                # failed calls precede queued-unsent ones (lower seq);
+                # they re-enter the queue front in seq order
+                rt.parked.sort(key=lambda s: s.actor_seq)
+                for spec in reversed(rt.parked):
+                    rt.queue.insert(0, spec)
+                    rt.ready.add(spec.task_id)
+                rt.parked.clear()
+        self._drain(aid)
+
+    def _actor_dead(self, aid, cause: str) -> None:
+        from .exceptions import ActorDiedError
+
+        with self._lock:
+            rt = self._routes.get(aid)
+            self._resolve_queue.discard(aid)
+            if rt is None:
+                return
+            rt.state = "DEAD"
+            rt.death_cause = cause
+            rt.loc = None
+            to_fail = rt.parked + rt.queue
+            rt.parked = []
+            rt.queue = []
+            rt.ready.clear()
+            self._drained_cv.notify_all()
+        for spec in to_fail:
+            self._mgr.seal_error_local(
+                spec, ActorDiedError(aid, cause))
+
+    # ------------------------------------------------------------ complete
+
+    def on_call_done(self, spec: TaskSpec) -> None:
+        """Successful completion bookkeeping (called by the runtime after
+        manager.complete seals results)."""
+        with self._lock:
+            rt = self._routes.get(spec.actor_id)
+            if rt is not None:
+                rt.inflight.pop(spec.task_id, None)
+                self._drained_cv.notify_all()
+
+    def remove_call(self, spec: TaskSpec) -> None:
+        """A call settled outside the normal flow (owner-side cancel):
+        remove it from every route structure so the ordered queue drains
+        past it."""
+        aid = spec.actor_id
+        with self._lock:
+            rt = self._routes.get(aid)
+            if rt is None:
+                return
+            rt.ready.discard(spec.task_id)
+            for lst in (rt.queue, rt.parked, rt.send_buf):
+                for i, s in enumerate(lst):
+                    if s.task_id == spec.task_id:
+                        del lst[i]
+                        break
+            rt.inflight.pop(spec.task_id, None)
+            self._drained_cv.notify_all()
+        self._drain(aid)
+
+
+def _mono() -> float:
+    import time as _time
+
+    return _time.monotonic()

@@ -94,6 +94,10 @@ class Node:
         # ---- direct (head-bypass) task path state -----------------------
         # locally-executing direct tasks: task_id -> (origin, spec)
         self._direct: Dict[object, Tuple[tuple, TaskSpec]] = {}
+        # actors hosted on this node: actor_id -> worker_id (the routing
+        # table for direct actor calls; reference: the actor's RPC address
+        # cached by ActorTaskSubmitter)
+        self._actor_workers: Dict[object, WorkerID] = {}
         # tasks forwarded to a peer: task_id -> (origin, spec, peer_hex)
         self._forwarded: Dict[object, Tuple[tuple, TaskSpec, str]] = {}
         self._peers: Dict[str, Channel] = {}      # peer_hex -> channel
@@ -138,7 +142,8 @@ class Node:
     # LOCAL raylet and pushes directly; the GCS sees only async events)
 
     def submit_direct(self, spec: TaskSpec, origin: tuple) -> None:
-        """Execute an eligible plain task without head involvement.
+        """Execute an eligible plain task (or route an actor call)
+        without head involvement.
 
         ``origin`` routes the completion reply:
           ("worker", worker_id)      — a worker on this node submitted it
@@ -148,6 +153,9 @@ class Node:
         """
         if not self.alive:
             self._reply_direct(origin, spec.task_id, "NodeDiedError", [])
+            return
+        if spec.actor_id is not None and not spec.is_actor_creation:
+            self._submit_direct_actor(spec, origin)
             return
         if spec.direct_hops == 0 and origin[0] != "peer" and self._maybe_spill(
                 spec, origin):
@@ -207,6 +215,70 @@ class Node:
         except (OSError, EOFError):
             pass  # owner gone: its results die with it (owner-died semantics)
 
+    def _submit_direct_actor(self, spec: TaskSpec, origin: tuple) -> None:
+        """Route a direct actor call: dispatch to the local actor worker,
+        or forward one hop to the node the owner believes hosts the actor
+        (reference: ActorTaskSubmitter::PushActorTask — caller to actor
+        process, the control plane never sees the call)."""
+        with self._lock:
+            wid = self._actor_workers.get(spec.actor_id)
+        if wid is not None:
+            with self._lock:
+                self._direct[spec.task_id] = (origin, spec, time.time())
+            self._ensure_direct_flusher()
+            if not self.dispatch_to_worker(wid, spec):
+                with self._lock:
+                    self._direct.pop(spec.task_id, None)
+                self._reply_direct(origin, spec.task_id, "ActorDiedError", [])
+            return
+        target = spec.actor_node_hex
+        if (target is None or target == self.hex or origin[0] == "peer"
+                or spec.direct_hops >= 1):
+            # stale owner location (or already forwarded once): bounce so
+            # the owner re-resolves via the head's actor FSM
+            self._reply_direct(origin, spec.task_id, "ActorMissingError", [])
+            return
+        handle = self._peer_handle_for(target)
+        if handle is None:
+            self._reply_direct(origin, spec.task_id, "ActorMissingError", [])
+            return
+        spec.direct_hops = 1
+        if not isinstance(handle, tuple):
+            # in-process peer Node
+            with self._lock:
+                self._forwarded[spec.task_id] = (origin, spec, handle)
+            handle.submit_direct(spec, ("node", self, origin))
+            return
+        ch = self._peer_channel(target, handle)
+        if ch is None:
+            self._reply_direct(origin, spec.task_id, "ActorMissingError", [])
+            return
+        with self._lock:
+            self._forwarded[spec.task_id] = (origin, spec, target)
+        try:
+            ch.send("psubmit", pickle.dumps(spec))
+        except (OSError, EOFError):
+            with self._lock:
+                self._forwarded.pop(spec.task_id, None)
+            self._drop_peer(target)
+            self._reply_direct(origin, spec.task_id, "ActorMissingError", [])
+
+    def _peer_handle_for(self, peer_hex: str):
+        """Node object (in-process) or (host, port) for a peer's object/
+        control server, from the head table or the syncer cluster view."""
+        head = self.head
+        if hasattr(head, "nodes"):  # in-process side
+            n = head.nodes.get(peer_hex)
+            if n is None:
+                return None
+            if hasattr(n, "store"):
+                return n
+            return tuple(n.object_addr)
+        for e in head.cluster_view:
+            if e.get("hex") == peer_hex and e.get("addr"):
+                return tuple(e["addr"])
+        return None
+
     def cancel_direct(self, task_id, force: bool = False) -> None:
         """Owner-initiated cancel of a direct task: drop it from the local
         queue if not started, interrupt the worker if running, or forward
@@ -243,8 +315,18 @@ class Node:
         if origin is not None:  # was still queued: never ran
             self._reply_direct(origin, task_id, "TaskCancelledError", [])
             return
-        # running (or staged) on a worker: interrupt it
-        self.cancel_task(task_id, None, force)
+        # running (or staged) on a worker: interrupt it. Actor calls are
+        # not in w.assigned — route the cancel via the actor index (and
+        # never force-kill: that would kill the actor, not the call).
+        with self._lock:
+            entry = self._direct.get(task_id)
+            awid = (self._actor_workers.get(entry[1].actor_id)
+                    if entry is not None and entry[1].actor_id is not None
+                    else None)
+        if awid is not None:
+            self.cancel_task(task_id, awid, False)
+        else:
+            self.cancel_task(task_id, None, force)
 
     # ---- spillback -------------------------------------------------------
 
@@ -744,6 +826,10 @@ class Node:
                 if spec.is_actor_creation and err_name is None:
                     w.state = "actor"
                     w.actor_id = spec.actor_id
+                    # direct actor-call routing table (set BEFORE the head
+                    # learns ALIVE, so owners resolving via the head never
+                    # race ahead of this index)
+                    self._actor_workers[spec.actor_id] = w.worker_id
                 elif w.state == "busy" and not w.assigned:
                     w.state = "idle"
                     self._idle.append(w)
@@ -765,7 +851,24 @@ class Node:
         with self._lock:
             w.state = "dead"
             self._workers.pop(w.worker_id, None)
+            lost = self._drop_actor_direct_locked(w)
+        for origin, spec in lost:
+            self._reply_direct(origin, spec.task_id, "ActorDiedError", [])
         self.head.on_worker_exit(self, w)
+
+    def _drop_actor_direct_locked(self, w: WorkerHandle):
+        """Remove a dead actor worker from the routing index and collect
+        its in-flight direct calls (they fail back to their owners)."""
+        if w.actor_id is None:
+            return []
+        if self._actor_workers.get(w.actor_id) == w.worker_id:
+            del self._actor_workers[w.actor_id]
+        lost = []
+        for tid, (origin, spec, _t0) in list(self._direct.items()):
+            if spec.actor_id == w.actor_id:
+                del self._direct[tid]
+                lost.append((origin, spec))
+        return lost
 
     def _on_worker_dead(self, w: WorkerHandle) -> None:
         with self._lock:
@@ -780,11 +883,14 @@ class Node:
                       for s, _, _ in assigned
                       if s.task_id in self._direct]
             direct_ids = {spec.task_id for _, spec, _ in direct}
+            lost_actor = self._drop_actor_direct_locked(w)
         w.channel.close()
         head_assigned = [e for e in assigned if e[0].task_id not in direct_ids]
         # direct tasks: the OWNER retries — report the crash straight back
         for origin, spec, _t0 in direct:
             self._reply_direct(origin, spec.task_id, "WorkerCrashedError", [])
+        for origin, spec in lost_actor:
+            self._reply_direct(origin, spec.task_id, "ActorDiedError", [])
         if head_assigned:
             for spec, binding, _attempt in head_assigned:
                 self.head.on_worker_crashed(self, w, spec, binding, prev_state)

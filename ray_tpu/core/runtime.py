@@ -1034,6 +1034,17 @@ class Head:
                 if rec is not None:
                     self._fail_task_now(rec, ActorDiedError(arec.actor_id, cause))
 
+    def actor_location(self, actor_id: ActorID) -> Optional[dict]:
+        """Direct-actor-path resolve: owners ask once per incarnation and
+        then call the actor's node directly (reference: the actor-table
+        subscription ActorTaskSubmitter uses for its cached RPC address)."""
+        with self._lock:
+            arec = self.actors.get(actor_id)
+            if arec is None:
+                return None
+            return {"state": arec.state, "node_hex": arec.node_hex,
+                    "death_cause": arec.death_cause}
+
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         with self._lock:
             arec = self.actors.get(actor_id)
@@ -1401,6 +1412,8 @@ class Head:
         if op == "kill_actor":
             self.kill_actor(args[0], args[1])
             return None
+        if op == "actor_location":
+            return self.actor_location(args[0])
         if op == "cancel_task":
             self.cancel_task(args[0], args[1])
             return None
@@ -1514,7 +1527,7 @@ class Head:
 
 class DriverRuntime:
     def __init__(self, head: Head):
-        from .direct import DirectTaskManager
+        from .direct import DirectActorSubmitter, DirectTaskManager
 
         self.head = head
         self.job_id = head.job_id
@@ -1530,6 +1543,11 @@ class DriverRuntime:
                 list(oids), len(oids), t),
             pin=lambda oids: head.apply_pin_delta(oids, 1),
             unpin=lambda oids: head.apply_pin_delta(oids, -1))
+
+        # direct actor calls: ordered caller->actor-node submission; the
+        # head only resolves locations and keeps the lifecycle FSM
+        self.direct_actors = DirectActorSubmitter(
+            self.direct, self._direct_submit, head.actor_location)
 
     def _direct_submit(self, spec: TaskSpec) -> None:
         self.head.head_node.submit_direct(
@@ -1702,6 +1720,14 @@ class DriverRuntime:
         ]
 
     def actor_method_call(self, spec: TaskSpec) -> List[ObjectRef]:
+        cfg = global_config()
+        if (cfg.direct_task_enabled and cfg.direct_actor_enabled
+                and self.direct_actors.try_submit(spec)):
+            return [ObjectRef(oid) for oid in spec.return_ids()]
+        # ineligible (e.g. streaming): pin this actor to the head path for
+        # this owner and drain in-flight direct calls first, preserving
+        # per-owner submission order across the path switch
+        self.direct_actors.head_pin(spec.actor_id)
         return self.submit_task(spec)
 
     def create_placement_group(self, bundles, strategy, name=""):

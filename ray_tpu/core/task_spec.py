@@ -58,6 +58,11 @@ class TaskSpec:
     actor_max_concurrency: int = 1
     actor_is_async: bool = False
     concurrency_group: str = ""
+    # direct actor path: per-(owner, actor) submission sequence number and
+    # the owner's cached location of the actor (routing hint; stale values
+    # bounce back as ActorMissingError and the owner re-resolves)
+    actor_seq: int = 0
+    actor_node_hex: Optional[str] = None
 
     # args promoted to the store for this call; pinned until the task settles
     pinned_args: List[ObjectID] = field(default_factory=list)

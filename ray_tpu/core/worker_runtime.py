@@ -91,6 +91,13 @@ class WorkerRuntime:
             ext_wait=self._ext_wait_objects,
             pin=lambda oids: self.channel.send("dpin", oids, 1),
             unpin=lambda oids: self.channel.send("dpin", oids, -1))
+        # direct actor calls (resolve runs on the submitter's own resolver
+        # thread, so a blocking RPC there is safe)
+        from .direct import DirectActorSubmitter
+
+        self.direct_actors = DirectActorSubmitter(
+            self.direct, self._direct_submit,
+            lambda aid: self.rpc.call("rpc", "actor_location", aid))
 
     def _ext_wait_objects(self, oids, timeout):
         """One availability round against the cluster object directory
@@ -275,6 +282,11 @@ class WorkerRuntime:
         return self.rpc.call("rpc", "nodes")
 
     def actor_method_call(self, spec: TaskSpec) -> List[ObjectRef]:
+        cfg = global_config()
+        if (cfg.direct_task_enabled and cfg.direct_actor_enabled
+                and self.direct_actors.try_submit(spec)):
+            return [ObjectRef(oid) for oid in spec.return_ids()]
+        self.direct_actors.head_pin(spec.actor_id)
         return self.submit_task(spec)
 
     def create_placement_group(self, bundles, strategy, name=""):

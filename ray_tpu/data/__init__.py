@@ -35,11 +35,14 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
     read_sql,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 from ray_tpu.data.datasource import (  # noqa: F401
     Datasink,
@@ -57,6 +60,7 @@ __all__ = [
     "from_blocks", "from_pandas", "from_arrow", "from_numpy",
     "read_parquet", "read_csv", "read_json", "read_numpy", "read_text",
     "read_binary_files", "read_sql", "from_torch", "read_datasource",
+    "read_images", "read_tfrecords", "read_webdataset",
     "AggregateFn", "Count", "Sum",
     "Min", "Max", "Mean", "Std", "AbsMax", "Quantile", "Block",
     "BlockAccessor",

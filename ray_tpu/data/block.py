@@ -49,11 +49,34 @@ def _to_arrow_array(values: List[Any]):
     if values and isinstance(values[0], np.ndarray):
         arrs = [np.asarray(v) for v in values]
         if all(a.shape == arrs[0].shape for a in arrs):
+            stacked = _tensor_array(np.stack(arrs))
+            if stacked is not None:
+                return stacked
             inner = pa.array(np.concatenate([a.ravel() for a in arrs]))
             offsets = np.arange(len(arrs) + 1) * arrs[0].size
             return pa.ListArray.from_arrays(
                 pa.array(offsets, pa.int32()), inner)
     return pa.array(values)
+
+
+def _tensor_array(stacked: np.ndarray):
+    """Shape-preserving tensor column (reference: ArrowTensorArray; here
+    Arrow's native fixed_shape_tensor extension type). None if the dtype
+    or rank is not tensor-representable (caller falls back to lists)."""
+    if stacked.ndim < 2 or not (
+            np.issubdtype(stacked.dtype, np.number)
+            or stacked.dtype == np.bool_):
+        return None
+    try:
+        return pa.FixedShapeTensorArray.from_numpy_ndarray(
+            np.ascontiguousarray(stacked))
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError, ValueError,
+            AttributeError):
+        return None
+
+
+def _is_tensor_type(t) -> bool:
+    return isinstance(t, getattr(pa, "FixedShapeTensorType", ()))
 
 
 def block_from_arrow(table: "pa.Table") -> Block:
@@ -71,7 +94,11 @@ def block_from_numpy(data: Dict[str, np.ndarray]) -> Block:
         if v.ndim <= 1:
             cols[k] = pa.array(v)
         else:
-            # multi-dim tensors: flattened list column + shape in metadata
+            tensor = _tensor_array(v)
+            if tensor is not None:
+                cols[k] = tensor
+                continue
+            # non-numeric tensors: flattened list column + shape metadata
             inner = pa.array(v.reshape(len(v), -1).ravel())
             offsets = np.arange(len(v) + 1) * int(np.prod(v.shape[1:]))
             cols[k] = pa.ListArray.from_arrays(
@@ -133,9 +160,18 @@ class BlockAccessor:
     def iter_rows(self) -> Iterator[Any]:
         if self._is_arrow:
             cols = self._block.column_names
-            data = [self._block.column(c) for c in cols]
+            data = []
+            for c in cols:
+                col = self._block.column(c)
+                if _is_tensor_type(col.type):
+                    # materialize once: rows get shaped ndarray views
+                    data.append(col.combine_chunks().to_numpy_ndarray())
+                else:
+                    data.append(col)
             for i in range(self._block.num_rows):
-                yield {c: data[j][i].as_py() for j, c in enumerate(cols)}
+                yield {c: (data[j][i] if isinstance(data[j], np.ndarray)
+                           else data[j][i].as_py())
+                       for j, c in enumerate(cols)}
         else:
             yield from iter(self._block)
 
@@ -164,7 +200,9 @@ class BlockAccessor:
             meta = self._block.schema.metadata or {}
             for name in self._block.column_names:
                 col = self._block.column(name)
-                if pa.types.is_list(col.type):
+                if _is_tensor_type(col.type):
+                    out[name] = col.combine_chunks().to_numpy_ndarray()
+                elif pa.types.is_list(col.type):
                     arr = np.array([np.asarray(x) for x in col.to_pylist()])
                     shape_key = f"shape:{name}".encode()
                     if shape_key in meta and len(arr):
@@ -242,7 +280,10 @@ def concat_blocks(blocks: List[Block]) -> Block:
     if pa is not None and all(isinstance(b, pa.Table) for b in blocks):
         try:
             return pa.concat_tables(blocks, promote_options="default")
-        except (pa.ArrowInvalid, pa.ArrowTypeError):
+        except (pa.ArrowInvalid, pa.ArrowTypeError,
+                pa.ArrowNotImplementedError):
+            # e.g. tensor columns with different per-block shapes: fall
+            # back to a row-wise rebuild (list block keeps the ndarrays)
             pass
     rows: List[Any] = []
     for b in blocks:

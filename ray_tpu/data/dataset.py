@@ -410,6 +410,18 @@ class Dataset:
     def write_json(self, path: str) -> None:
         self.write_datasink(JSONDatasink(path))
 
+    def write_tfrecords(self, path: str) -> None:
+        from .datasource_ml import TFRecordDatasink
+
+        self.write_datasink(TFRecordDatasink(path))
+
+    def write_webdataset(self, path: str, *,
+                         rows_per_shard: int = 1000) -> None:
+        from .datasource_ml import WebDatasetDatasink
+
+        self.write_datasink(WebDatasetDatasink(
+            path, rows_per_shard=rows_per_shard))
+
     # ------------------------------------------------------------- exports
     def to_pandas(self, limit: Optional[int] = None):
         import pandas as pd
@@ -786,4 +798,39 @@ def from_torch(torch_dataset, *, parallelism: int = -1) -> Dataset:
     from .datasource import TorchDatasource
 
     return read_datasource(TorchDatasource(torch_dataset),
+                           parallelism=parallelism)
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                include_paths: bool = False, labels=None,
+                parallelism: int = -1) -> Dataset:
+    """Image folder -> rows of {"image": HWC uint8 array} (reference:
+    ray.data.read_images / image_datasource.py:29). ``size=(H, W)``
+    resizes for static batch shapes; ``labels="dirname"`` adds the
+    ImageFolder-style parent-directory label."""
+    from .datasource_ml import ImageDatasource
+
+    return read_datasource(
+        ImageDatasource(paths, size=size, mode=mode,
+                        include_paths=include_paths, labels=labels),
+        parallelism=parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    """TFRecord files of tf.train.Example records, one row each
+    (reference: ray.data.read_tfrecords). Dependency-free wire codec —
+    no TensorFlow import on workers."""
+    from .datasource_ml import TFRecordDatasource
+
+    return read_datasource(TFRecordDatasource(paths),
+                           parallelism=parallelism)
+
+
+def read_webdataset(paths, *, decode: bool = True,
+                    parallelism: int = -1) -> Dataset:
+    """WebDataset tar shards -> one row per key-grouped sample
+    (reference: ray.data.read_webdataset)."""
+    from .datasource_ml import WebDatasetDatasource
+
+    return read_datasource(WebDatasetDatasource(paths, decode=decode),
                            parallelism=parallelism)

@@ -1,0 +1,422 @@
+"""ML-ingest datasources: images, TFRecords, WebDataset tar shards.
+
+Reference: python/ray/data/_internal/datasource/image_datasource.py:29,
+tfrecords_datasource.py, webdataset_datasource.py. TPU-first choices: the
+TFRecord wire codec (length/CRC framing + the tf.train.Example protobuf
+schema) is implemented dependency-free — a TPU ingest pipeline must not
+pull TensorFlow into every worker just to parse records — and images
+decode straight to HWC uint8 numpy, the layout `jax.device_put` wants.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import tarfile
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from .block import Block, BlockAccessor, build_block
+from .datasource import BlockMetadata, Datasink, FileBasedDatasource, ReadTask
+
+# --------------------------------------------------------------------------
+# images
+# --------------------------------------------------------------------------
+
+_IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+class ImageDatasource(FileBasedDatasource):
+    """Image-folder reader -> rows of {"image": HWC uint8, ["path"],
+    ["label"]} (reference: image_datasource.py:29 ImageDatasource).
+
+    ``mode``: PIL convert mode ("RGB", "L", ...); ``size``: optional
+    (H, W) resize so downstream batches stack into one dense array —
+    static shapes are what XLA wants from an input pipeline.
+    ``labels="dirname"`` labels each image with its parent directory name
+    (the torchvision ImageFolder convention).
+    """
+
+    def __init__(self, paths, *, size: Optional[tuple] = None,
+                 mode: str = "RGB", include_paths: bool = False,
+                 labels: Optional[str] = None):
+        super().__init__(paths)
+        self._paths = [p for p in self._paths
+                       if p.lower().endswith(_IMAGE_EXTS)]
+        if not self._paths:
+            raise FileNotFoundError(f"no image files under {paths}")
+        self._size = size
+        self._mode = mode
+        self._include_paths = include_paths
+        self._labels = labels
+
+    def _read_file(self, path: str):
+        from PIL import Image
+
+        with Image.open(path) as im:
+            im = im.convert(self._mode)
+            if self._size is not None:
+                im = im.resize((self._size[1], self._size[0]))
+            arr = np.asarray(im)
+        row: Dict[str, Any] = {"image": arr}
+        if self._include_paths:
+            row["path"] = path
+        if self._labels == "dirname":
+            row["label"] = os.path.basename(os.path.dirname(path))
+        yield build_block([row])
+
+
+# --------------------------------------------------------------------------
+# TFRecord wire format (dependency-free)
+# --------------------------------------------------------------------------
+
+# masked CRC32C (the TFRecord framing checksum). Table-driven CRC32C
+# (Castagnoli), then TF's rotate+offset mask.
+_CRC_TABLE = []
+
+
+def _crc32c_table():
+    if _CRC_TABLE:
+        return _CRC_TABLE
+    poly = 0x82F63B78
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+try:  # C-speed CRC32C when available (1 MB records: ms vs seconds)
+    import google_crc32c as _gcrc
+except ImportError:  # pragma: no cover
+    _gcrc = None
+
+
+def _crc32c(data: bytes) -> int:
+    if _gcrc is not None:
+        return _gcrc.value(data)
+    table = _crc32c_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---- minimal protobuf codec for tf.train.Example ----
+# Example{1: Features{1: map<string, Feature>}}; map entry {1: key, 2: val}
+# Feature = oneof {1: BytesList{1: bytes*}, 2: FloatList{1: packed float*},
+#                  3: Int64List{1: packed varint*}}
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, i: int):
+    shift = n = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def _ld(field: int, payload: bytes) -> bytes:  # length-delimited field
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _encode_feature(value) -> bytes:
+    if isinstance(value, bytes):
+        return _ld(1, _ld(1, value))  # BytesList
+    if isinstance(value, str):
+        return _ld(1, _ld(1, value.encode()))
+    arr = np.asarray(value)
+    if arr.ndim == 0:
+        arr = arr[None]
+    if np.issubdtype(arr.dtype, np.floating):
+        return _ld(2, _ld(1, arr.astype("<f4").tobytes()))  # packed floats
+    if np.issubdtype(arr.dtype, np.integer):
+        payload = b"".join(_varint(int(v) & 0xFFFFFFFFFFFFFFFF)
+                           for v in arr)
+        return _ld(3, _ld(1, payload))  # packed varints
+    raise TypeError(f"unsupported feature value {type(value)}")
+
+
+def encode_example(row: Dict[str, Any]) -> bytes:
+    """Serialize a row as a tf.train.Example message."""
+    entries = b""
+    for key, value in row.items():
+        entry = _ld(1, key.encode()) + _ld(2, _encode_feature(value))
+        entries += _ld(1, entry)
+    return _ld(1, entries)  # Example{1: Features{...entries}}
+
+
+def _decode_feature(buf: bytes):
+    i = 0
+    tag, i = _read_varint(buf, i)
+    field = tag >> 3
+    ln, i = _read_varint(buf, i)
+    inner = buf[i:i + ln]
+    if field == 1:  # BytesList
+        vals = []
+        j = 0
+        while j < len(inner):
+            t, j = _read_varint(inner, j)
+            ln2, j = _read_varint(inner, j)
+            vals.append(inner[j:j + ln2])
+            j += ln2
+        return vals[0] if len(vals) == 1 else vals
+    if field == 2:  # FloatList
+        j = 0
+        t, j = _read_varint(inner, j)
+        if t & 7 == 2:  # packed
+            ln2, j = _read_varint(inner, j)
+            arr = np.frombuffer(inner[j:j + ln2], dtype="<f4")
+        else:  # unpacked fixed32s
+            vals = []
+            j = 0
+            while j < len(inner):
+                t, j = _read_varint(inner, j)
+                vals.append(struct.unpack("<f", inner[j:j + 4])[0])
+                j += 4
+            arr = np.asarray(vals, np.float32)
+        return float(arr[0]) if arr.size == 1 else arr
+    if field == 3:  # Int64List
+        j = 0
+        t, j = _read_varint(inner, j)
+        if t & 7 == 2:  # packed
+            ln2, j = _read_varint(inner, j)
+            end = j + ln2
+            vals = []
+            while j < end:
+                v, j = _read_varint(inner, j)
+                if v >= 1 << 63:
+                    v -= 1 << 64
+                vals.append(v)
+        else:
+            vals = []
+            j = 0
+            while j < len(inner):
+                t, j = _read_varint(inner, j)
+                v, j = _read_varint(inner, j)
+                vals.append(v)
+        return vals[0] if len(vals) == 1 else np.asarray(vals, np.int64)
+    raise ValueError(f"unknown Feature field {field}")
+
+
+def decode_example(buf: bytes) -> Dict[str, Any]:
+    """Parse a tf.train.Example message into a row dict."""
+    row: Dict[str, Any] = {}
+    # Example -> Features
+    i = 0
+    tag, i = _read_varint(buf, i)
+    ln, i = _read_varint(buf, i)
+    features = buf[i:i + ln]
+    j = 0
+    while j < len(features):
+        tag, j = _read_varint(features, j)
+        ln2, j = _read_varint(features, j)
+        entry = features[j:j + ln2]
+        j += ln2
+        k = 0
+        key = value = None
+        while k < len(entry):
+            tag2, k = _read_varint(entry, k)
+            ln3, k = _read_varint(entry, k)
+            body = entry[k:k + ln3]
+            k += ln3
+            if tag2 >> 3 == 1:
+                key = body.decode()
+            else:
+                value = _decode_feature(body)
+        if key is not None:
+            row[key] = value
+    return row
+
+
+def read_tfrecord_file(path: str) -> Iterable[bytes]:
+    """Iterate raw record payloads (length/CRC framed)."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if len(header) < 12:
+                return
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:12])
+            if len_crc != _masked_crc(header[:8]):
+                raise ValueError(f"corrupt TFRecord length crc in {path}")
+            data = f.read(length)
+            f.read(4)  # data crc (trust after the length crc matched)
+            yield data
+
+
+def write_tfrecord_file(path: str, payloads: Iterable[bytes]) -> None:
+    with open(path, "wb") as f:
+        for data in payloads:
+            header = struct.pack("<Q", len(data))
+            f.write(header)
+            f.write(struct.pack("<I", _masked_crc(header)))
+            f.write(data)
+            f.write(struct.pack("<I", _masked_crc(data)))
+
+
+class TFRecordDatasource(FileBasedDatasource):
+    """TFRecord reader (reference: tfrecords_datasource.py) — each record
+    is parsed as tf.train.Example into one row; no TensorFlow import."""
+
+    def _read_file(self, path: str):
+        rows = [decode_example(p) for p in read_tfrecord_file(path)]
+        yield build_block(rows)
+
+
+class TFRecordDatasink(Datasink):
+    """write_tfrecords: one .tfrecords file per write task."""
+
+    def __init__(self, path: str):
+        self._path = path
+
+    def on_write_start(self) -> None:
+        os.makedirs(self._path, exist_ok=True)
+
+    def write(self, blocks: List[Block], ctx: Dict[str, Any]) -> Any:
+        written = []
+        for i, block in enumerate(blocks):
+            acc = BlockAccessor.for_block(block)
+            if acc.num_rows() == 0:
+                continue
+            fpath = os.path.join(
+                self._path, f"{ctx.get('task_idx', 0)}_{i:06d}.tfrecords")
+            write_tfrecord_file(
+                fpath, (encode_example(row) for row in acc.iter_rows()))
+            written.append(fpath)
+        return written
+
+
+# --------------------------------------------------------------------------
+# WebDataset (tar shards of key-grouped files)
+# --------------------------------------------------------------------------
+
+
+def _wds_decode(ext: str, data: bytes):
+    # webdataset extensions can be dotted ("emb.npy"): decode by the last
+    # component, keep the full extension as the column name
+    ext = ext.lower().split(".")[-1]
+    if ext in ("jpg", "jpeg", "png", "bmp", "webp"):
+        from PIL import Image
+
+        with Image.open(io.BytesIO(data)) as im:
+            return np.asarray(im.convert("RGB"))
+    if ext in ("cls", "id"):
+        return int(data.decode().strip())
+    if ext in ("txt", "text"):
+        return data.decode()
+    if ext == "json":
+        return json.loads(data.decode())
+    if ext == "npy":
+        return np.load(io.BytesIO(data), allow_pickle=False)
+    return data  # unknown extension: raw bytes
+
+
+def _wds_encode(ext: str, value) -> bytes:
+    ext = ext.lower().split(".")[-1]
+    if isinstance(value, bytes):
+        return value
+    if ext in ("cls", "id"):
+        return str(int(value)).encode()
+    if ext in ("txt", "text"):
+        return str(value).encode()
+    if ext == "json":
+        return json.dumps(value).encode()
+    if ext == "npy" or isinstance(value, np.ndarray):
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(value), allow_pickle=False)
+        return buf.getvalue()
+    return str(value).encode()
+
+
+class WebDatasetDatasource(FileBasedDatasource):
+    """WebDataset tar-shard reader (reference: webdataset_datasource.py):
+    files sharing a basename form one sample; the extension names the
+    column (`0001.jpg` + `0001.cls` -> {"__key__": "0001", "jpg": ...,
+    "cls": ...}). ``decode=False`` keeps raw bytes."""
+
+    def __init__(self, paths, *, decode: bool = True):
+        super().__init__(paths)
+        self._paths = [p for p in self._paths if p.endswith((".tar",))]
+        if not self._paths:
+            raise FileNotFoundError(f"no .tar shards under {paths}")
+        self._decode = decode
+
+    def _read_file(self, path: str):
+        samples: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        with tarfile.open(path) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                base = os.path.basename(member.name)
+                if "." not in base:
+                    continue
+                key, ext = base.split(".", 1)
+                data = tf.extractfile(member).read()
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                samples[key][ext] = (_wds_decode(ext, data)
+                                     if self._decode else data)
+        yield build_block([samples[k] for k in order])
+
+
+class WebDatasetDatasink(Datasink):
+    """write_webdataset: tar shards with ``rows_per_shard`` samples; each
+    non-__key__ column becomes a file named <key>.<column>."""
+
+    def __init__(self, path: str, *, rows_per_shard: int = 1000):
+        self._path = path
+        self._rows = rows_per_shard
+
+    def on_write_start(self) -> None:
+        os.makedirs(self._path, exist_ok=True)
+
+    def write(self, blocks: List[Block], ctx: Dict[str, Any]) -> Any:
+        task = ctx.get("task_idx", 0)
+        written = []
+        rows: List[dict] = []
+        for block in blocks:
+            acc = BlockAccessor.for_block(block)
+            rows.extend(acc.iter_rows())
+        for shard_i in range(0, len(rows), self._rows):
+            chunk = rows[shard_i:shard_i + self._rows]
+            fpath = os.path.join(
+                self._path, f"shard-{task}-{shard_i // self._rows:05d}.tar")
+            with tarfile.open(fpath, "w") as tf:
+                for j, row in enumerate(chunk):
+                    key = str(row.get("__key__", f"{task}{shard_i + j:08d}"))
+                    for col, value in row.items():
+                        if col == "__key__":
+                            continue
+                        data = _wds_encode(col, value)
+                        info = tarfile.TarInfo(name=f"{key}.{col}")
+                        info.size = len(data)
+                        tf.addfile(info, io.BytesIO(data))
+            written.append(fpath)
+        return written

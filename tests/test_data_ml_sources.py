@@ -1,0 +1,159 @@
+"""ML-ingest datasource round-trips: images, TFRecords, WebDataset
+(VERDICT round-3 ask #7; reference: ray.data read_images/read_tfrecords/
+read_webdataset, _internal/datasource/image_datasource.py:29)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def _make_images(root, classes=("cat", "dog"), per_class=3, size=(8, 10)):
+    rng = np.random.default_rng(0)
+    paths = []
+    for cls in classes:
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 255, (size[0], size[1], 3), dtype=np.uint8)
+            p = os.path.join(d, f"{cls}_{i}.png")
+            Image.fromarray(arr).save(p)
+            paths.append(p)
+    return paths
+
+
+def test_read_images_folder_with_labels(cluster, tmp_path):
+    _make_images(str(tmp_path))
+    ds = rd.read_images(str(tmp_path), labels="dirname", include_paths=True)
+    rows = ds.take_all()
+    assert len(rows) == 6
+    labels = sorted({r["label"] for r in rows})
+    assert labels == ["cat", "dog"]
+    assert rows[0]["image"].shape == (8, 10, 3)
+    assert rows[0]["image"].dtype == np.uint8
+
+
+def test_read_images_mixed_sizes_without_resize(cluster, tmp_path):
+    # different image sizes across blocks: combining ops must fall back
+    # to row blocks instead of crashing on tensor-schema mismatch
+    _make_images(str(tmp_path / "a"), classes=("x",), per_class=2,
+                 size=(4, 4))
+    _make_images(str(tmp_path / "b"), classes=("y",), per_class=2,
+                 size=(5, 7))
+    rows = rd.read_images(str(tmp_path)).take_all()
+    shapes = sorted({r["image"].shape for r in rows})
+    assert shapes == [(4, 4, 3), (5, 7, 3)]
+
+
+def test_read_images_resize_batches_stack(cluster, tmp_path):
+    _make_images(str(tmp_path), per_class=2)
+    ds = rd.read_images(str(tmp_path), size=(16, 16))
+    batch = ds.take_batch(4, batch_format="numpy")
+    assert batch["image"].shape == (4, 16, 16, 3)
+
+
+def test_tfrecords_roundtrip(cluster, tmp_path):
+    rows = [
+        {"name": f"row{i}", "score": float(i) / 3.0, "count": i,
+         "vec": np.arange(4, dtype=np.float32) + i,
+         "ids": np.asarray([i, i * 2, -i], np.int64)}
+        for i in range(20)
+    ]
+    path = str(tmp_path / "tfr")
+    rd.from_items(rows).write_tfrecords(path)
+    files = os.listdir(path)
+    assert files and all(f.endswith(".tfrecords") for f in files)
+
+    back = rd.read_tfrecords(path).take_all()
+    assert len(back) == 20
+    by_count = {int(r["count"]): r for r in back}
+    for i in range(20):
+        r = by_count[i]
+        assert r["name"] == b"row%d" % i or r["name"] == f"row{i}".encode()
+        assert abs(float(r["score"]) - i / 3.0) < 1e-6
+        np.testing.assert_allclose(np.asarray(r["vec"]),
+                                   np.arange(4, dtype=np.float32) + i)
+        assert list(np.asarray(r["ids"])) == [i, i * 2, -i]
+
+
+def test_tfrecords_wire_compatible_with_tensorflow(cluster, tmp_path):
+    """Our dependency-free codec must parse records written by TF itself
+    (and vice versa) — proof of wire-format compatibility."""
+    tf = pytest.importorskip("tensorflow")
+    path = str(tmp_path / "tf_native")
+    os.makedirs(path)
+    fpath = os.path.join(path, "native.tfrecords")
+    with tf.io.TFRecordWriter(fpath) as w:
+        for i in range(5):
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "x": tf.train.Feature(
+                    int64_list=tf.train.Int64List(value=[i, i + 1])),
+                "y": tf.train.Feature(
+                    float_list=tf.train.FloatList(value=[i * 0.5])),
+                "s": tf.train.Feature(
+                    bytes_list=tf.train.BytesList(value=[b"v%d" % i])),
+            }))
+            w.write(ex.SerializeToString())
+    rows = rd.read_tfrecords(path).take_all()
+    assert len(rows) == 5
+    rows.sort(key=lambda r: float(r["y"]))
+    assert list(np.asarray(rows[2]["x"])) == [2, 3]
+    assert rows[3]["s"] == b"v3"
+
+    # reverse direction: TF parses OUR records
+    ours = str(tmp_path / "ours")
+    rd.from_items([{"a": 7, "b": b"hello"}]).write_tfrecords(ours)
+    fname = os.path.join(ours, os.listdir(ours)[0])
+    recs = list(tf.data.TFRecordDataset([fname]))
+    ex = tf.train.Example.FromString(recs[0].numpy())
+    assert ex.features.feature["a"].int64_list.value[0] == 7
+    assert ex.features.feature["b"].bytes_list.value[0] == b"hello"
+
+
+def test_webdataset_roundtrip(cluster, tmp_path):
+    rng = np.random.default_rng(1)
+    rows = [
+        {"__key__": f"{i:04d}",
+         "jpg": rng.integers(0, 255, (6, 6, 3), dtype=np.uint8),
+         "cls": i % 3,
+         "txt": f"caption {i}",
+         "emb.npy": rng.normal(size=4).astype(np.float32)}
+        for i in range(12)
+    ]
+    # encode images as real JPEG bytes for the jpg column
+    import io as _io
+
+    for r in rows:
+        buf = _io.BytesIO()
+        Image.fromarray(r["jpg"]).save(buf, format="PNG")
+        r["jpg"] = buf.getvalue()
+
+    path = str(tmp_path / "wds")
+    rd.from_items(rows).write_webdataset(path, rows_per_shard=5)
+    shards = [f for f in os.listdir(path) if f.endswith(".tar")]
+    assert len(shards) >= 3  # 12 rows / 5 per shard (per write task)
+
+    back = rd.read_webdataset(path).take_all()
+    assert len(back) == 12
+    back.sort(key=lambda r: r["__key__"])
+    assert back[0]["__key__"] == "0000"
+    assert back[0]["cls"] == 0
+    assert back[0]["txt"] == "caption 0"
+    assert back[0]["jpg"].shape == (6, 6, 3)
+    np.testing.assert_allclose(back[3]["emb.npy"],
+                               np.asarray([r for r in rows
+                                           if r["__key__"] == "0003"
+                                           ][0]["emb.npy"]))

@@ -1,0 +1,293 @@
+"""Direct actor calls: the head is out of a.m.remote() (round-4 ask #1).
+
+Reference: src/ray/core_worker/transport/actor_task_submitter.cc:482
+PushActorTask + sequential_actor_submit_queue.cc — method calls go straight
+from the caller to the actor's node, sequence-ordered; the control plane
+keeps only the lifecycle FSM. Here: head.tasks must hold ONLY the actor
+CREATION record (one per incarnation), never per-call records.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core import runtime as runtime_mod
+
+
+def _head():
+    return runtime_mod.get_current_runtime().head
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.values = []
+
+    def add(self, v):
+        self.values.append(v)
+        return len(self.values)
+
+    def get(self):
+        return list(self.values)
+
+    def boom(self):
+        raise ValueError("actor method failed")
+
+    def pid(self):
+        import os
+
+        return os.getpid()
+
+
+class TestDirectActorLocal:
+    def setup_method(self):
+        ray_tpu.init(num_cpus=2)
+
+    def teardown_method(self):
+        ray_tpu.shutdown()
+
+    def test_no_per_call_head_records(self):
+        c = Counter.remote()
+        refs = [c.add.remote(i) for i in range(50)]
+        assert ray_tpu.get(refs)[-1] == 50
+        # only the CREATION task transited the head
+        head = _head()
+        assert len(head.tasks) == 1, f"head saw {len(head.tasks)} records"
+        assert all(r.spec.is_actor_creation for r in head.tasks.values())
+
+    def test_ordering_preserved(self):
+        c = Counter.remote()
+        for i in range(200):
+            c.add.remote(i)
+        assert ray_tpu.get(c.get.remote()) == list(range(200))
+
+    def test_method_error_propagates(self):
+        c = Counter.remote()
+        with pytest.raises(Exception, match="actor method failed"):
+            ray_tpu.get(c.boom.remote())
+        # actor still alive after a user error
+        assert ray_tpu.get(c.add.remote(1)) == 1
+
+    def test_ref_args_into_actor_calls(self):
+        c = Counter.remote()
+        dep = ray_tpu.put(41)
+
+        @ray_tpu.remote
+        def plus_one(x):
+            return x + 1
+
+        pending = plus_one.remote(dep)  # direct task; may still be running
+        c.add.remote(pending)           # actor call deferred on the dep
+        c.add.remote(99)                # must NOT overtake the deferred one
+        assert ray_tpu.get(c.get.remote(), timeout=60) == [42, 99]
+        assert len(_head().tasks) == 1
+
+    def test_calls_before_actor_ready_are_buffered(self):
+        @ray_tpu.remote
+        class Slow:
+            def __init__(self):
+                time.sleep(1.0)
+                self.v = []
+
+            def add(self, x):
+                self.v.append(x)
+                return list(self.v)
+
+        s = Slow.remote()
+        refs = [s.add.remote(i) for i in range(5)]  # submitted pre-ALIVE
+        assert ray_tpu.get(refs[-1], timeout=60) == [0, 1, 2, 3, 4]
+        assert len(_head().tasks) == 1
+
+    def test_kill_fails_inflight_and_future_calls(self):
+        @ray_tpu.remote
+        class Sleeper:
+            def nap(self, t):
+                time.sleep(t)
+                return "ok"
+
+        s = Sleeper.remote()
+        assert ray_tpu.get(s.nap.remote(0)) == "ok"
+        ref = s.nap.remote(30)
+        time.sleep(0.5)
+        ray_tpu.kill(s)
+        with pytest.raises(Exception):
+            ray_tpu.get(ref, timeout=60)
+        with pytest.raises(Exception):
+            ray_tpu.get(s.nap.remote(0), timeout=60)
+
+    def test_async_actor_direct(self):
+        @ray_tpu.remote
+        class Async:
+            async def work(self, i):
+                import asyncio
+
+                await asyncio.sleep(0.01)
+                return i * 2
+
+        a = Async.options(max_concurrency=8).remote()
+        out = ray_tpu.get([a.work.remote(i) for i in range(16)], timeout=60)
+        assert out == [i * 2 for i in range(16)]
+        assert len(_head().tasks) == 1
+
+
+class TestDirectActorEdgeCases:
+    def setup_method(self):
+        ray_tpu.init(num_cpus=2)
+
+    def teardown_method(self):
+        ray_tpu.shutdown()
+
+    def test_head_pin_flushes_queued_direct_calls(self):
+        """A streaming call (head path) while a dep-deferred direct call
+        is queued: the queued call must still flush once its dep lands —
+        pinning must never strand it (round-4 review finding)."""
+        @ray_tpu.remote
+        class Gen:
+            def consume(self, x):
+                return x + 1
+
+            def stream(self, n):
+                for i in range(n):
+                    yield i
+
+        @ray_tpu.remote
+        def slow_dep():
+            time.sleep(1.0)
+            return 10
+
+        g = Gen.remote()
+        r1 = g.consume.remote(slow_dep.remote())  # deferred on the dep
+        items = list(g.stream.options(
+            num_returns="streaming").remote(3))    # head-pins the actor
+        assert [ray_tpu.get(i) for i in items] == [0, 1, 2]
+        assert ray_tpu.get(r1, timeout=60) == 11
+
+    def test_cancel_deferred_call_unblocks_queue(self):
+        """Cancelling a dep-deferred actor call must not wedge later
+        calls behind it in the ordered queue (round-4 review finding)."""
+        @ray_tpu.remote
+        def never_quick():
+            time.sleep(5)
+            return 1
+
+        c = Counter.remote()
+        r1 = c.add.remote(never_quick.remote())  # deferred
+        ray_tpu.cancel(r1)
+        assert ray_tpu.get(c.add.remote(7), timeout=30) == 1
+        with pytest.raises(Exception):
+            ray_tpu.get(r1, timeout=30)
+
+
+class TestDirectActorRestart:
+    def test_restart_during_calls(self):
+        """Queued calls flush to the restarted actor or fail per
+        max_task_retries (VERDICT round-3 ask #1 'done' bar)."""
+        ray_tpu.init(num_cpus=2)
+        try:
+            import os
+
+            @ray_tpu.remote
+            class Crashy:
+                def __init__(self):
+                    self.n = 0
+
+                def bump(self):
+                    self.n += 1
+                    return self.n
+
+                def slow_bump(self):
+                    time.sleep(3)
+                    self.n += 1
+                    return self.n
+
+                def pid(self):
+                    return os.getpid()
+
+            c = Crashy.options(max_restarts=1, max_task_retries=2).remote()
+            assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
+            pid = ray_tpu.get(c.pid.remote(), timeout=60)
+            inflight = c.slow_bump.remote()  # running when the crash hits
+            time.sleep(0.5)
+            os.kill(pid, 9)  # hard-crash the incarnation from outside
+            time.sleep(0.3)
+            # calls during/after the crash retry onto the new incarnation,
+            # in order: the retried slow_bump lands first
+            out = ray_tpu.get([c.bump.remote() for _ in range(3)],
+                              timeout=120)
+            assert out == [2, 3, 4]  # fresh state + retried slow_bump
+            assert ray_tpu.get(inflight, timeout=60) == 1
+        finally:
+            ray_tpu.shutdown()
+
+    def test_no_retries_raises_actor_died(self):
+        ray_tpu.init(num_cpus=2)
+        try:
+            @ray_tpu.remote
+            class Crashy2:
+                def spin_die(self):
+                    import os
+                    import time as _t
+
+                    _t.sleep(0.2)
+                    os._exit(1)
+
+            c = Crashy2.options(max_restarts=0).remote()
+            ref = c.spin_die.remote()
+            with pytest.raises(Exception):
+                ray_tpu.get(ref, timeout=60)
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestDirectActorMultiNode:
+    def test_calls_route_to_peer_node_actor(self):
+        cluster = Cluster(head_node_args={"num_cpus": 1})
+        n2 = cluster.add_node(num_cpus=2, resources={"spot": 1})
+        try:
+            c = Counter.options(resources={"spot": 0.1}).remote()
+            refs = [c.add.remote(i) for i in range(30)]
+            assert ray_tpu.get(refs, timeout=120)[-1] == 30
+            assert ray_tpu.get(c.get.remote()) == list(range(30))
+            head = _head()
+            assert len(head.tasks) == 1
+            # the actor really lives on the peer node
+            arec = head.actors[c._actor_id]
+            assert arec.node_hex == n2.hex
+        finally:
+            cluster.shutdown()
+
+    def test_calls_route_to_daemon_actor_over_tcp(self):
+        cluster = Cluster(head_node_args={"num_cpus": 1})
+        n2 = cluster.add_node(num_cpus=2, resources={"spot": 1},
+                              separate_process=True)
+        try:
+            c = Counter.options(resources={"spot": 0.1}).remote()
+            refs = [c.add.remote(i) for i in range(30)]
+            assert ray_tpu.get(refs, timeout=180)[-1] == 30
+            assert ray_tpu.get(c.get.remote(), timeout=60) == list(range(30))
+            head = _head()
+            assert len(head.tasks) == 1
+            arec = head.actors[c._actor_id]
+            assert arec.node_hex == n2.hex
+        finally:
+            cluster.shutdown()
+
+    def test_worker_submits_actor_calls_directly(self):
+        """A task (worker-side owner) holding an actor handle calls it
+        without creating head records."""
+        ray_tpu.init(num_cpus=3)
+        try:
+            c = Counter.remote()
+
+            @ray_tpu.remote
+            def caller(handle, base):
+                refs = [handle.add.remote(base + i) for i in range(5)]
+                return ray_tpu.get(refs)[-1]
+
+            assert ray_tpu.get(caller.remote(c, 0), timeout=120) == 5
+            head = _head()
+            assert len(head.tasks) == 1  # creation only
+        finally:
+            ray_tpu.shutdown()
