@@ -220,14 +220,17 @@ class ActorClass:
         namespace = opt.get("namespace", "default")
         max_restarts = opt.get("max_restarts", 0)
         detached = opt.get("lifetime") == "detached"
+        max_task_retries = opt.get("max_task_retries", 0)
         if hasattr(runtime, "create_actor_record"):
-            runtime.create_actor_record(spec, name, namespace, max_restarts, detached)
+            runtime.create_actor_record(spec, name, namespace, max_restarts,
+                                        detached, max_task_retries)
         else:
             runtime.rpc.call(
                 "rpc", "create_actor",
-                pickle.dumps((spec, name, namespace, max_restarts, detached)))
+                pickle.dumps((spec, name, namespace, max_restarts, detached,
+                              max_task_retries)))
         return ActorHandle(actor_id, self.__name__, self._method_num_returns,
-                           opt.get("max_task_retries", 0))
+                           max_task_retries)
 
 
 def method(num_returns: int = 1):

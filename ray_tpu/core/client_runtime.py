@@ -144,9 +144,9 @@ class ClientRuntime:
         return self._fn_cache[function_id]
 
     def create_actor_record(self, spec, name, namespace, max_restarts,
-                            detached):
+                            detached, max_task_retries=0):
         self._call("create_actor", spec, name, namespace, max_restarts,
-                   detached)
+                   detached, max_task_retries)
 
     def get_actor_info(self, name: str, namespace: str):
         return self._call("get_actor_info", name, namespace)

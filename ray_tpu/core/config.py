@@ -82,6 +82,14 @@ class Config:
     health_check_period_ms: int = 2000
     health_check_failure_threshold: int = 10
 
+    # ---- head record GC (reference: task-event cap semantics,
+    # ray_config_def.h task_events_max_num_task_in_gcs area) ----
+    # settled head task records fold into the capped event ring after this
+    # TTL (kept while their results are referenced — lineage — or while
+    # the actor they created is alive); 0 disables the sweeper
+    task_record_ttl_s: float = 120.0
+    task_record_gc_period_s: float = 15.0
+
     # ---- observability ----
     log_to_driver: bool = True  # tail worker stdout/stderr to the driver
     task_events_enabled: bool = True

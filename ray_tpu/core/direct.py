@@ -141,6 +141,18 @@ class DirectTaskManager:
         # external (non-owned) oid -> task_ids waiting on it
         self._ext_waiting: Dict[ObjectID, Set[TaskID]] = {}
         self._poller_started = False
+        # wait() events set on every completion (mixed-wait integration)
+        self._wait_events: set = set()
+
+    def add_waiter(self, event) -> None:
+        self._wait_events.add(event)
+
+    def remove_waiter(self, event) -> None:
+        self._wait_events.discard(event)
+
+    def _wake_waiters(self) -> None:
+        for e in list(self._wait_events):
+            e.set()
 
     # ------------------------------------------------------------ submit
 
@@ -284,6 +296,7 @@ class DirectTaskManager:
                     self._results[roid] = (payload, True)
                 self._cv.notify_all()
         if sealed_spec is not None:
+            self._wake_waiters()
             self._release_pins(sealed_spec)
             if (sealed_spec.actor_id is not None
                     and self._actor_cancel_cb is not None):
@@ -365,6 +378,8 @@ class DirectTaskManager:
                                 self._result_nodes[oid] = exec_hex
                             sealed_oids.append(oid)
                 self._cv.notify_all()
+        if settled_spec is not None or sealed_oids:
+            self._wake_waiters()
         if actor_handoff is not None:
             handled = (self._actor_failed_cb is not None
                        and self._actor_failed_cb(actor_handoff, err_name))
@@ -398,6 +413,7 @@ class DirectTaskManager:
             for oid in spec.return_ids():
                 self._results[oid] = (payload, True)
             self._cv.notify_all()
+        self._wake_waiters()
         self._release_pins(spec)
         self.deps_available(spec.return_ids())
 
@@ -459,11 +475,6 @@ class DirectTaskManager:
         """Which of ``oids`` belong to still-pending owned tasks."""
         with self._lock:
             return {o for o in oids if o.task_id() in self._pending}
-
-    def wait_any(self, timeout: Optional[float]) -> None:
-        """Block until any completion lands (wait() integration)."""
-        with self._lock:
-            self._cv.wait(timeout)
 
     def drop(self, oid: ObjectID) -> None:
         """Owner released its ref: free the retained inline result (or

@@ -214,7 +214,7 @@ class LocalModeRuntime:
 
     # ---- actors -----------------------------------------------------------
     def create_actor_record(self, spec, name, namespace, max_restarts,
-                            detached) -> None:
+                            detached, max_task_retries=0) -> None:
         with self._lock:
             if name and (namespace, name) in self._named:
                 raise ValueError(

@@ -663,14 +663,21 @@ class Node:
         proc.wait()
         # a worker that died before registering would leak _num_starting
         # (and with it a phantom slot in _pump's active count) forever
+        died_starting = False
         with self._lock:
             if proc.pid in self._starting_pids:
                 self._starting_pids.discard(proc.pid)
                 self._num_starting = max(0, self._num_starting - 1)
+                died_starting = True
             for st in self._tail_files.values():
                 if st[1] == proc.pid and st[2] is None:
                     st[2] = time.monotonic()  # tailer drops it after a
                     # final read window
+        if died_starting and self.alive:
+            # the freed capacity must re-pump NOW: with all tasks already
+            # queued, no future event would ever start a replacement
+            # worker and the queue would strand forever
+            self._pump()
 
     def _accept_loop(self) -> None:
         import multiprocessing.context as _mpctx
@@ -683,39 +690,63 @@ class Node:
                 continue
             except (OSError, EOFError):
                 return
-            channel = Channel(conn)
-            try:
-                tag, (pid,) = channel.recv()
-                assert tag == "register"
-            except Exception:
-                channel.close()
-                continue
-            wid = WorkerID.from_random()
-            w = WorkerHandle(worker_id=wid, channel=channel, pid=pid, state="idle")
-            with self._lock:
-                if pid in self._starting_pids:
-                    self._starting_pids.discard(pid)
-                    self._num_starting = max(0, self._num_starting - 1)
-                self._workers[wid] = w
-                self._idle.append(w)
-            init_info = {
-                "worker_id": wid.binary(),
-                "node_hex": self.hex,
-                "node_ip": self.node_ip,
-                "job_id": self.head.job_id.binary(),
-                "arena_path": self.store.arena_path,
-                "arena_capacity": self.store.capacity,
-                "config": global_config().to_json(),
-            }
-            channel.send("init", init_info)
-            w.reader = threading.Thread(
-                target=self._reader_loop, args=(w,), daemon=True,
-                name=f"reader-{wid.hex()[:6]}",
-            )
-            w.reader.start()
-            self._pump()
+            # handshake off-thread: a slow registrant must not hold up
+            # accept() (concurrent prestarts would pile into the backlog)
+            threading.Thread(target=self._register_worker, args=(conn,),
+                             daemon=True,
+                             name=f"register-{self.hex[:6]}").start()
+
+    def _register_worker(self, conn) -> None:
+        channel = Channel(conn)
+        try:
+            tag, (pid,) = channel.recv()
+            assert tag == "register"
+        except Exception:
+            channel.close()
+            return
+        self._finish_register(channel, pid)
+
+    def _finish_register(self, channel, pid) -> None:
+        wid = WorkerID.from_random()
+        w = WorkerHandle(worker_id=wid, channel=channel, pid=pid, state="idle")
+        with self._lock:
+            if pid in self._starting_pids:
+                self._starting_pids.discard(pid)
+                self._num_starting = max(0, self._num_starting - 1)
+            self._workers[wid] = w
+            self._idle.append(w)
+        init_info = {
+            "worker_id": wid.binary(),
+            "node_hex": self.hex,
+            "node_ip": self.node_ip,
+            "job_id": self.head.job_id.binary(),
+            "arena_path": self.store.arena_path,
+            "arena_capacity": self.store.capacity,
+            "config": global_config().to_json(),
+        }
+        channel.send("init", init_info)
+        w.reader = threading.Thread(
+            target=self._reader_loop, args=(w,), daemon=True,
+            name=f"reader-{wid.hex()[:6]}",
+        )
+        w.reader.start()
+        self._pump()
 
     def _reader_loop(self, w: WorkerHandle) -> None:
+        try:
+            self._reader_loop_inner(w)
+        except Exception:
+            # a message-processing bug must NEVER silently kill this
+            # thread: the worker's done/rpc messages would go unread and
+            # its tasks hang forever. Log loudly and declare the worker
+            # dead so its work is retried.
+            import traceback
+
+            print(f"[ray_tpu] node {self.hex[:6]} worker-reader crashed:\n"
+                  + traceback.format_exc(), file=sys.stderr, flush=True)
+            self._on_worker_dead(w)
+
+    def _reader_loop_inner(self, w: WorkerHandle) -> None:
         while True:
             try:
                 tag, payload = w.channel.recv()
@@ -786,8 +817,10 @@ class Node:
                                                     hint=hint)
                 self._reply(w, req_id, True, rep)
             elif op == "wait":
-                oids, num_returns, timeout = args
-                ready = self.head.wait_objects(oids, num_returns, timeout)
+                oids, num_returns, timeout, *rest = args
+                fetch_local = rest[0] if rest else False
+                ready = self.head.wait_objects(oids, num_returns, timeout,
+                                               fetch_local)
                 self._reply(w, req_id, True, ready)
             elif op == "create":
                 oid, size = args

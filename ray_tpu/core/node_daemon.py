@@ -204,10 +204,47 @@ class RemoteHead:
                 bool, timeout)
         return self.rpc.call("req", "worker_rpc", (op, list(args)))
 
-    def wait_objects(self, oids, num_returns, timeout):
-        return self._bounded_rounds(
-            lambda t: ("wait_objects", (oids, num_returns, t)),
-            lambda ready: len(ready) >= num_returns, timeout)
+    def wait_objects(self, oids, num_returns, timeout, fetch_local=False):
+        if not fetch_local:
+            return self._bounded_rounds(
+                lambda t: ("wait_objects", (oids, num_returns, t)),
+                lambda ready: len(ready) >= num_returns, timeout)
+        # fetch_local on a daemon: ready = in THIS node's store; the wait
+        # pulls cluster-available objects down as they appear. Small
+        # objects arrive INLINE (never stored by the pull path), so track
+        # them in a fetched set — store.contains alone would re-pull them
+        # forever.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        node = self.node
+        fetched: set = set()
+        while True:
+            ready = [o for o in oids
+                     if o in fetched or node.store.contains(o)]
+            if len(ready) >= num_returns:
+                return ready[:num_returns]
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                return ready
+            round_t = (2.0 if remaining is None
+                       else max(0.05, min(remaining, 2.0)))
+            missing = [o for o in oids if o not in ready]
+            avail = self.rpc.call(
+                "req", "wait_objects", (missing, len(missing), round_t),
+                timeout=round_t + 30.0)
+            for oid in avail:
+                if node.store.contains(oid):
+                    continue
+                # bounded pull; failures re-locate on the next round
+                rep = self.get_object_for_node(node, oid, round_t)
+                if rep[0] == "inline":
+                    try:
+                        node.store.put_inline(oid, rep[1], rep[2])
+                    except Exception:
+                        pass
+                    fetched.add(oid)
+                elif rep[0] == "arena":
+                    fetched.add(oid)
 
     def get_object_for_node(self, node, oid: ObjectID, timeout,
                             hint: Optional[str] = None):

@@ -140,10 +140,13 @@ def make_listener(address, authkey: bytes) -> mpc.Listener:
     server sockets (src/ray/rpc/grpc_server.h); auth uses the
     multiprocessing HMAC challenge with the cluster key.
     """
+    # backlog: mpc's default of 1 drops concurrent connects (prestarted
+    # workers racing the accept-side handshake got ECONNREFUSED and died)
     if isinstance(address, str):
-        return mpc.Listener(address=address, family="AF_UNIX", authkey=authkey)
+        return mpc.Listener(address=address, family="AF_UNIX",
+                            backlog=64, authkey=authkey)
     return mpc.Listener(address=tuple(address), family="AF_INET",
-                        authkey=authkey)
+                        backlog=64, authkey=authkey)
 
 
 def connect(address, authkey: bytes) -> Channel:

@@ -63,6 +63,8 @@ class TaskRecord:
     # actor creation only: scheduling-only resources were already returned
     # (death/restart must then release retained_resources, not the full set)
     shrunk: bool = False
+    # monotonic stamp when the record reached FINISHED/FAILED (GC TTL)
+    settled_at: Optional[float] = None
 
 
 @dataclass
@@ -174,8 +176,71 @@ class Head:
         # routable IP local nodes advertise (loopback until a non-loopback
         # node server opens — see start_node_server)
         self.node_ip = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
+        # wait() waiters woken by any object seal (mixed direct+head wait)
+        self._seal_events: Set[threading.Event] = set()
+        # fetch_local pulls in flight (dedup across concurrent waits)
+        self._active_pulls: Set[ObjectID] = set()
         # head node (the driver's node)
         self.head_node = self.add_node(resources, labels=labels)
+        if global_config().task_record_ttl_s > 0:
+            threading.Thread(target=self._record_gc_loop, daemon=True,
+                             name="task-record-gc").start()
+
+    # ------------------------------------------------------- record GC
+
+    def _record_gc_loop(self) -> None:
+        """Fold settled task records into the (already-capped) event ring
+        after a TTL, bounding head memory for long-running drivers
+        (reference: GcsTaskManager's capped task storage). Records stay
+        while (a) their results are still referenced — lineage
+        reconstruction needs the spec — or (b) they created a still-alive
+        actor incarnation (its death must release the reservation)."""
+        cfg = global_config()
+        period = max(1.0, cfg.task_record_gc_period_s)
+        while not self._stopped:
+            time.sleep(period)
+            try:
+                self.gc_task_records(cfg.task_record_ttl_s)
+            except Exception:
+                pass  # never let bookkeeping kill the sweeper
+
+    def gc_task_records(self, ttl_s: float) -> int:
+        now = time.monotonic()
+        dropped = 0
+        stream_pins: List[ObjectID] = []
+        with self._lock:
+            for tid, rec in list(self.tasks.items()):
+                if rec.state not in ("FINISHED", "FAILED"):
+                    continue
+                if rec.settled_at is None or now - rec.settled_at < ttl_s:
+                    continue
+                spec = rec.spec
+                if spec.is_actor_creation:
+                    arec = self.actors.get(spec.actor_id)
+                    if (arec is not None and arec.state != "DEAD"
+                            and arec.creation_spec is spec):
+                        continue  # live incarnation: needed at death
+                if any(self.ref_counts.get(oid, 0) > 0
+                       for oid in spec.return_ids()):
+                    continue  # lineage: results still referenced
+                count = self.streams.pop(tid, None)
+                if count:
+                    stream_pins.extend(
+                        ObjectID.for_stream(tid, i) for i in range(count))
+                del self.tasks[tid]
+                dropped += 1
+            # dead-actor records past the TTL fold away too
+            for aid, arec in list(self.actors.items()):
+                if arec.state != "DEAD":
+                    continue
+                crec = self.tasks.get(arec.creation_spec.task_id) \
+                    if arec.creation_spec is not None else None
+                if crec is None or (crec.settled_at is not None
+                                    and now - crec.settled_at >= ttl_s):
+                    del self.actors[aid]
+        if stream_pins:
+            self.apply_pin_delta(stream_pins, -1)
+        return dropped
 
     # ------------------------------------------------------------ membership
 
@@ -641,7 +706,8 @@ class Head:
                                       results=None)
 
     def create_actor(self, spec: TaskSpec, name: Optional[str], namespace: str,
-                     max_restarts: int, detached: bool) -> None:
+                     max_restarts: int, detached: bool,
+                     max_task_retries: int = 0) -> None:
         arec = ActorRecord(spec.actor_id, creation_spec=spec, max_restarts=max_restarts)
         with self._lock:
             self.actors[spec.actor_id] = arec
@@ -649,6 +715,7 @@ class Head:
             actor_id=spec.actor_id, name=name, namespace=namespace,
             class_name=spec.function_name, state="PENDING_CREATION",
             max_restarts=max_restarts, detached=detached, creation_spec=None,
+            max_task_retries=max_task_retries,
         ))
         self.submit_spec(spec)
 
@@ -770,6 +837,7 @@ class Head:
                 self._retry_task(rec, results)
                 return
             rec.state = "FAILED"
+            rec.settled_at = time.monotonic()
             self._unpin_args(rec)
             self._record_event(spec, "FAILED", node.hex, error=err_name)
             self._seal_results(node, results)
@@ -778,6 +846,7 @@ class Head:
             self._after_seal(spec)
             return
         rec.state = "FINISHED"
+        rec.settled_at = time.monotonic()
         self._unpin_args(rec)
         self._record_event(spec, "FINISHED", node.hex)
         self._seal_results(node, results)
@@ -858,6 +927,7 @@ class Head:
         if _guard and not self._begin_settle(rec):
             return
         rec.state = "FAILED"
+        rec.settled_at = time.monotonic()
         self._unpin_args(rec)
         err = exc if isinstance(exc, (ActorDiedError, TaskCancelledError, ObjectLostError)) \
             else TaskError.from_exception(rec.spec.function_name, exc)
@@ -1113,6 +1183,8 @@ class Head:
 
     def on_object_sealed(self, oid: ObjectID, node_hex: str) -> None:
         self.gcs.add_object_location(oid, node_hex)
+        for e in list(self._seal_events):
+            e.set()
         waiters: List[TaskID] = []
         with self._object_cv:
             if oid in self._waiting_on:
@@ -1362,11 +1434,40 @@ class Head:
                 self._object_cv.wait(min(remaining, 0.2) if remaining else 0.2)
 
     def wait_objects(self, oids: List[ObjectID], num_returns: int,
-                     timeout: Optional[float]) -> List[ObjectID]:
+                     timeout: Optional[float],
+                     fetch_local: bool = False) -> List[ObjectID]:
+        """Readiness = the object exists somewhere; with ``fetch_local``,
+        readiness additionally requires local (in-process) availability,
+        and the wait itself triggers the pull from remote daemons
+        (reference: ray.wait fetch_local semantics)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            to_pull = []
             with self._lock:
-                ready = [oid for oid in oids if self.gcs.get_object_locations(oid)]
+                ready = []
+                for oid in oids:
+                    locs = self.gcs.get_object_locations(oid)
+                    if not locs:
+                        continue
+                    if not fetch_local:
+                        ready.append(oid)
+                        continue
+                    nodes = [self.nodes.get(h) for h in locs]
+                    if any(n is not None and self._is_local(n)
+                           for n in nodes):
+                        ready.append(oid)
+                    elif oid not in self._active_pulls:
+                        # head-level dedup: concurrent/looping waits share
+                        # one in-flight pull per object; a FAILED pull
+                        # leaves the set so the next round retries
+                        # (possibly from another replica)
+                        proxy = next((n for n in nodes if n is not None),
+                                     None)
+                        if proxy is not None:
+                            self._active_pulls.add(oid)
+                            to_pull.append((oid, proxy))
+            for oid, proxy in to_pull:
+                self._spawn_local_pull(oid, proxy)
             if len(ready) >= num_returns:
                 return ready[:num_returns]
             with self._object_cv:
@@ -1374,6 +1475,29 @@ class Head:
                 if remaining is not None and remaining <= 0:
                     return ready
                 self._object_cv.wait(min(remaining, 0.2) if remaining else 0.2)
+
+    def _spawn_local_pull(self, oid: ObjectID, proxy) -> None:
+        """Background chunked pull into the head store (fetch_local)."""
+        def run():
+            try:
+                rep = self._pull_from_proxy(proxy, oid, self.head_node.store)
+                if rep[0] == "inline":
+                    self.head_node.store.put_inline(oid, rep[1], rep[2])
+                self.on_object_sealed(oid, self.head_node.hex)
+            except Exception:
+                pass  # source lost mid-pull: the wait loop re-locates
+            finally:
+                with self._lock:
+                    self._active_pulls.discard(oid)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"fetch-{oid.hex()[:6]}").start()
+
+    def add_seal_waiter(self, event: threading.Event) -> None:
+        self._seal_events.add(event)
+
+    def remove_seal_waiter(self, event: threading.Event) -> None:
+        self._seal_events.discard(event)
 
     def delete_object(self, oid: ObjectID) -> None:
         with self._lock:
@@ -1395,8 +1519,8 @@ class Head:
             self.submit_spec(spec)
             return None
         if op == "create_actor":
-            spec, name, namespace, max_restarts, detached = pickle.loads(args[0])
-            self.create_actor(spec, name, namespace, max_restarts, detached)
+            unpacked = pickle.loads(args[0])
+            self.create_actor(*unpacked)
             return None
         if op == "register_function":
             self.gcs.register_function(args[0], args[1])
@@ -1601,12 +1725,17 @@ class DriverRuntime:
         return out
 
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        """Mixed wait over direct-owned results (in-process) and cluster
+        objects. Event-driven: direct completions and head seals both set
+        the waiter event — no fixed-period polling. ``fetch_local`` is
+        honored: remote-only objects only count as ready once their pull
+        (triggered by this wait) lands locally."""
         oids = [r.id for r in refs]
-        if not self.direct.pending_oids(oids) and not self.direct.ready_subset(oids):
-            ready_ids = set(self.head.wait_objects(oids, num_returns, timeout))
-        else:
-            # direct-owned results resolve in-process; poll both sources
-            deadline = None if timeout is None else time.monotonic() + timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ev = threading.Event()
+        self.direct.add_waiter(ev)
+        self.head.add_seal_waiter(ev)
+        try:
             while True:
                 ready_ids = set(self.direct.ready_subset(oids))
                 pending = self.direct.pending_oids(oids)
@@ -1614,15 +1743,19 @@ class DriverRuntime:
                         and o not in pending]
                 if rest and len(ready_ids) < num_returns:
                     ready_ids |= set(self.head.wait_objects(
-                        rest, num_returns - len(ready_ids), 0.0))
+                        rest, num_returns - len(ready_ids), 0.0,
+                        fetch_local=fetch_local))
                 if len(ready_ids) >= num_returns:
                     break
                 remaining = (None if deadline is None
                              else deadline - time.monotonic())
                 if remaining is not None and remaining <= 0:
                     break
-                self.direct.wait_any(
-                    0.05 if remaining is None else min(0.05, remaining))
+                ev.wait(0.5 if remaining is None else min(0.5, remaining))
+                ev.clear()
+        finally:
+            self.direct.remove_waiter(ev)
+            self.head.remove_seal_waiter(ev)
         ready = [r for r in refs if r.id in ready_ids][:num_returns]
         ready_set = {r.id for r in ready}
         not_ready = [r for r in refs if r.id not in ready_set]
@@ -1651,8 +1784,10 @@ class DriverRuntime:
             self._fn_cache[function_id] = pickle.loads(payload)
         return self._fn_cache[function_id]
 
-    def create_actor_record(self, spec, name, namespace, max_restarts, detached):
-        self.head.create_actor(spec, name, namespace, max_restarts, detached)
+    def create_actor_record(self, spec, name, namespace, max_restarts,
+                            detached, max_task_retries=0):
+        self.head.create_actor(spec, name, namespace, max_restarts, detached,
+                               max_task_retries)
 
     def get_actor_info(self, name: str, namespace: str):
         info = self.head.gcs.get_named_actor(name, namespace)

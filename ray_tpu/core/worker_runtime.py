@@ -175,29 +175,39 @@ class WorkerRuntime:
             if rest and len(ready_set) < num_returns:
                 ready_set |= set(self.rpc.call(
                     "store", "wait", rest,
-                    num_returns - len(ready_set), timeout, timeout=None))
+                    num_returns - len(ready_set), timeout, fetch_local,
+                    timeout=None))
         else:
             # some requested oids are still-running direct tasks this
-            # worker owns: poll both sources in rounds
+            # worker owns: event-driven rounds over both sources (direct
+            # completions set the event; cluster seals covered by the
+            # bounded head round)
             deadline = (None if timeout is None
                         else time.monotonic() + timeout)
-            while True:
-                ready_set = set(self.direct.ready_subset(oids))
-                pending = self.direct.pending_oids(oids)
-                rest = [o for o in oids if o not in ready_set
-                        and o not in pending]
-                if rest and len(ready_set) < num_returns:
-                    ready_set |= set(self.rpc.call(
-                        "store", "wait", rest,
-                        num_returns - len(ready_set), 0.0, timeout=None))
-                if len(ready_set) >= num_returns:
-                    break
-                remaining = (None if deadline is None
-                             else deadline - time.monotonic())
-                if remaining is not None and remaining <= 0:
-                    break
-                self.direct.wait_any(
-                    0.05 if remaining is None else min(0.05, remaining))
+            ev = threading.Event()
+            self.direct.add_waiter(ev)
+            try:
+                while True:
+                    ready_set = set(self.direct.ready_subset(oids))
+                    pending = self.direct.pending_oids(oids)
+                    rest = [o for o in oids if o not in ready_set
+                            and o not in pending]
+                    if rest and len(ready_set) < num_returns:
+                        ready_set |= set(self.rpc.call(
+                            "store", "wait", rest,
+                            num_returns - len(ready_set), 0.0, fetch_local,
+                            timeout=None))
+                    if len(ready_set) >= num_returns:
+                        break
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        break
+                    ev.wait(0.2 if remaining is None
+                            else min(0.2, remaining))
+                    ev.clear()
+            finally:
+                self.direct.remove_waiter(ev)
         ready = [r for r in refs if r.id in ready_set][:num_returns]
         chosen = {r.id for r in ready}
         not_ready = [r for r in refs if r.id not in chosen]
@@ -634,13 +644,27 @@ def worker_main(argv=None) -> None:
     parser.add_argument("--address", required=True)
     parser.add_argument("--authkey", required=True)
     args = parser.parse_args(argv)
-    try:
-        channel = connect(args.address, bytes.fromhex(args.authkey))
-    except (OSError, EOFError, Exception) as e:
-        # node shut down while we were starting; exit quietly
-        if "Authentication" in type(e).__name__ or isinstance(e, (OSError, EOFError)):
-            sys.exit(0)
-        raise
+    # Transient refusals are normal when prestarted workers race the
+    # node's accept handshake — retry with backoff before giving up. A
+    # MISSING socket means the node is gone: exit quietly at once.
+    channel = None
+    deadline = time.monotonic() + 15.0
+    delay = 0.05
+    while True:
+        try:
+            channel = connect(args.address, bytes.fromhex(args.authkey))
+            break
+        except FileNotFoundError:
+            sys.exit(0)  # node shut down before we started
+        except (OSError, EOFError, Exception) as e:
+            retriable = isinstance(e, (ConnectionError, EOFError, OSError))
+            if retriable and time.monotonic() < deadline:
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+                continue
+            if "Authentication" in type(e).__name__ or retriable:
+                sys.exit(0)  # node gone / cluster key rotated
+            raise
     channel.send("register", os.getpid())
     tag, payload = channel.recv()
     assert tag == "init", tag

@@ -171,6 +171,8 @@ def encode_example(row: Dict[str, Any]) -> bytes:
 
 
 def _decode_feature(buf: bytes):
+    if not buf:
+        return None  # tf.train.Feature() with no oneof set (valid TF)
     i = 0
     tag, i = _read_varint(buf, i)
     field = tag >> 3
@@ -340,6 +342,16 @@ def _wds_encode(ext: str, value) -> bytes:
     ext = ext.lower().split(".")[-1]
     if isinstance(value, bytes):
         return value
+    if ext in ("jpg", "jpeg", "png", "bmp", "webp") \
+            and isinstance(value, np.ndarray):
+        # decoded image column (read_webdataset decode=True): re-encode
+        # in the format the extension names, so read->write round-trips
+        from PIL import Image
+
+        buf = io.BytesIO()
+        fmt = {"jpg": "JPEG", "jpeg": "JPEG"}.get(ext, ext.upper())
+        Image.fromarray(value).save(buf, format=fmt)
+        return buf.getvalue()
     if ext in ("cls", "id"):
         return str(int(value)).encode()
     if ext in ("txt", "text"):

@@ -356,8 +356,13 @@ class PhysicalOperator:
         """Collect finished remote tasks; return True on progress."""
         if not self.pending:
             return False
+        # fetch_local=False: the executor only tracks READINESS — block
+        # bytes stay on their producing nodes and move (if ever) when a
+        # consuming task pulls them (reference: streaming executor waits
+        # with fetch_local=False)
         ready, _ = ray_tpu.wait(list(self.pending.keys()),
-                                num_returns=len(self.pending), timeout=0)
+                                num_returns=len(self.pending), timeout=0,
+                                fetch_local=False)
         progress = False
         for ref in ready:
             ctx = self.pending.pop(ref)
