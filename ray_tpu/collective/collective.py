@@ -205,8 +205,14 @@ class StoreGroup:
         self.group_name = group_name
         self._seq = 0
         self._p2p: Dict[tuple, int] = {}
-        # register membership
-        self._kv_put(f"member/{rank}", b"1")
+        # register membership (+ our node hex, so the src rank can scope
+        # broadcast pushes to MEMBER nodes instead of the whole cluster)
+        try:
+            node_hex = self.rt.runtime_context()["node_id"]
+        except Exception:
+            node_hex = ""
+        self._kv_put(f"member/{rank}",
+                     node_hex.encode() if node_hex else b"1")
         deadline = time.monotonic() + 60
         while len(self._members()) < world_size:
             if time.monotonic() > deadline:
@@ -227,9 +233,10 @@ class StoreGroup:
     def _members(self):
         return self.rt.kv("keys", self._key("member/"), self.NS)
 
-    def _put_tensor(self, seq: int, rank: int, tensor) -> None:
+    def _put_tensor(self, seq: int, rank: int, tensor):
         ref = self.rt.put(np.asarray(tensor))
         self._kv_put(f"t/{seq}/{rank}", ref.id.binary())
+        return ref
 
     def _get_tensor(self, seq: int, rank: int, timeout: float = 120.0):
         from ray_tpu.core.ids import ObjectID
@@ -277,12 +284,35 @@ class StoreGroup:
         k = flat.shape[0] // self.world_size
         return flat[self.rank * k:(self.rank + 1) * k]
 
+    def _member_node_hexes(self):
+        hexes = set()
+        for r in range(self.world_size):
+            raw = self._kv_get(f"member/{r}")
+            if raw and raw != b"1":
+                hexes.add(raw.decode())
+        return hexes
+
     def broadcast(self, tensor, src_rank: int = 0):
         seq = self._seq
         self._seq += 1
         if self.rank == src_rank:
-            self._put_tensor(seq, src_rank, tensor)
-            return np.asarray(tensor)
+            arr = np.asarray(tensor)
+            ref = self._put_tensor(seq, src_rank, arr)
+            # large payloads ride the binomial push tree so N receivers
+            # don't issue N serial pulls from this node — scoped to the
+            # GROUP's nodes, not the whole cluster (reference:
+            # push_manager.h broadcast; weight-sync hot path)
+            if arr.nbytes > 1 << 20:
+                try:
+                    targets = list(self._member_node_hexes())
+                    if hasattr(self.rt, "head"):
+                        self.rt.head.broadcast_object(ref.id, targets or None)
+                    else:
+                        self.rt.rpc.call("rpc", "broadcast_object",
+                                         ref.id, targets or None)
+                except Exception:
+                    pass  # best-effort prefetch; pulls still work
+            return arr
         return self._get_tensor(seq, src_rank)
 
     def send(self, tensor, dst_rank: int):

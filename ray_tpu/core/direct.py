@@ -110,11 +110,15 @@ class DirectTaskManager:
     def __init__(self, submit: Callable[[TaskSpec], None],
                  ext_wait: Optional[Callable] = None,
                  pin: Optional[Callable] = None,
-                 unpin: Optional[Callable] = None):
+                 unpin: Optional[Callable] = None,
+                 locate: Optional[Callable] = None):
         self._submit = submit
         self._ext_wait = ext_wait
         self._pin = pin
         self._unpin = unpin
+        # optional: hex of the node holding a LARGE external object (the
+        # locality signal for args this owner didn't produce)
+        self._locate = locate
         # wired by DirectActorSubmitter: dep-ready + failure + completion
         # routing for actor-call specs (ordered per-actor submission)
         self._actor_ready_cb: Optional[Callable] = None
@@ -208,7 +212,7 @@ class DirectTaskManager:
         return None
 
     def _stamp_hints_locked(self, spec: TaskSpec) -> None:
-        """Attach resolution hints for args this owner knows about."""
+        """Attach resolution + locality hints for the spec's ref args."""
         hints: Dict[ObjectID, tuple] = {}
         for oid in spec.arg_object_ids():
             res = self._results.get(oid)
@@ -218,6 +222,16 @@ class DirectTaskManager:
                     hints[oid] = ("inline", payload, is_err)
                     continue
                 node_hex = self._result_nodes.get(oid)
+                if node_hex:
+                    hints[oid] = ("node", node_hex)
+            elif self._locate is not None:
+                # external object: the directory knows who holds it (only
+                # LARGE objects return a hint — locality is pointless for
+                # bytes that fit in the spec)
+                try:
+                    node_hex = self._locate(oid)
+                except Exception:
+                    node_hex = None
                 if node_hex:
                     hints[oid] = ("node", node_hex)
         if hints:

@@ -157,8 +157,20 @@ class Node:
         if spec.actor_id is not None and not spec.is_actor_creation:
             self._submit_direct_actor(spec, origin)
             return
-        if spec.direct_hops == 0 and origin[0] != "peer" and self._maybe_spill(
-                spec, origin):
+        if spec.direct_hops == 0:
+            # locality first (reference: lease_policy.h:56
+            # LocalityAwareLeasePolicy — lease from the node holding the
+            # largest args): store-resident args are >100KB by definition
+            # while inline args ride in the spec, so the node hinted by
+            # the most store-resident args holds the most arg bytes.
+            loc = self._locality_target(spec)
+            if loc is not None and self._forward_direct(spec, origin, loc):
+                return
+        if spec.direct_hops <= 1 and self._maybe_spill(spec, origin):
+            # hop cap 2 (locality + one spill): a saturated arg-holder
+            # node sheds locality-forwarded fan-out to its peers instead
+            # of serializing the whole wave (reference: spillback applies
+            # at the lease target too)
             return
         with self._lock:
             self._direct[spec.task_id] = (origin, spec, time.time())
@@ -233,26 +245,32 @@ class Node:
             return
         target = spec.actor_node_hex
         if (target is None or target == self.hex or origin[0] == "peer"
-                or spec.direct_hops >= 1):
-            # stale owner location (or already forwarded once): bounce so
-            # the owner re-resolves via the head's actor FSM
+                or spec.direct_hops >= 1
+                or not self._forward_direct(spec, origin, target)):
+            # stale owner location (or already forwarded once, or the
+            # peer is unreachable): bounce so the owner re-resolves via
+            # the head's actor FSM
             self._reply_direct(origin, spec.task_id, "ActorMissingError", [])
-            return
+
+    def _forward_direct(self, spec: TaskSpec, origin: tuple,
+                        target: str) -> bool:
+        """Ship a direct task one hop to ``target``'s node (actor routing,
+        locality dispatch, spillback all ride this). False = unreachable
+        (caller decides the fallback)."""
         handle = self._peer_handle_for(target)
         if handle is None:
-            self._reply_direct(origin, spec.task_id, "ActorMissingError", [])
-            return
+            return False
         spec.direct_hops = 1
         if not isinstance(handle, tuple):
             # in-process peer Node
             with self._lock:
                 self._forwarded[spec.task_id] = (origin, spec, handle)
             handle.submit_direct(spec, ("node", self, origin))
-            return
+            return True
         ch = self._peer_channel(target, handle)
         if ch is None:
-            self._reply_direct(origin, spec.task_id, "ActorMissingError", [])
-            return
+            spec.direct_hops = 0
+            return False
         with self._lock:
             self._forwarded[spec.task_id] = (origin, spec, target)
         try:
@@ -261,7 +279,26 @@ class Node:
             with self._lock:
                 self._forwarded.pop(spec.task_id, None)
             self._drop_peer(target)
-            self._reply_direct(origin, spec.task_id, "ActorMissingError", [])
+            spec.direct_hops = 0
+            return False
+        return True
+
+    def _locality_target(self, spec: TaskSpec) -> Optional[str]:
+        """Peer node holding the most store-resident args, if not us."""
+        hints = spec.arg_hints
+        if not hints:
+            return None
+        counts: Dict[str, int] = {}
+        for h in hints.values():
+            if h[0] == "node":
+                counts[h[1]] = counts.get(h[1], 0) + 1
+        if not counts:
+            return None
+        best = max(counts, key=lambda k: counts[k])
+        if best == self.hex:
+            return None
+        # don't ship work to a node we can't see or that already left
+        return best
 
     def _peer_handle_for(self, peer_hex: str):
         """Node object (in-process) or (host, port) for a peer's object/
@@ -346,7 +383,7 @@ class Node:
         peer_hex, handle, queue = cands[0]
         if queue >= depth:
             return False  # everyone is as busy as we are
-        spec.direct_hops = 1
+        spec.direct_hops += 1
         if not isinstance(handle, (tuple, list)):
             # in-process peer Node: direct call, reply hops back through us.
             # Tracked in _forwarded (peer stored as the Node object) so
@@ -985,6 +1022,18 @@ class Node:
 
         threading.Thread(target=tail, daemon=True,
                          name=f"logtail-{self.hex[:6]}").start()
+
+    def push_object_to(self, oid, targets) -> int:
+        """Broadcast-tree hop: deliver ``oid`` from this node's store to
+        every (hex, addr) in ``targets`` (binomial fan-out)."""
+        from .object_transfer import fan_out_push
+
+        key = self._peer_key or getattr(self.head, "cluster_key", None) \
+            or getattr(self.head, "_cluster_key", None)
+        if key is None:
+            return 0
+        return fan_out_push(self.store, key, oid,
+                            [t for t in targets if t[0] != self.hex])
 
     def update_node_ip(self, ip: str) -> None:
         """Upgrade this node's advertised IP and push it to every

@@ -122,6 +122,12 @@ class RemoteHead:
                 self.node.cancel_task(*payload)
             elif tag == "store_delete":
                 self.node.store.delete(payload[0])
+            elif tag == "push_object":
+                # broadcast-tree root op from the head
+                oid, targets = payload
+                threading.Thread(
+                    target=self.node.push_object_to, args=(oid, targets),
+                    daemon=True, name="bcast-root").start()
             elif tag == "ping":
                 # health probe (reference: gcs_health_check_manager.h) —
                 # answered from the handler pool, so a wedged daemon

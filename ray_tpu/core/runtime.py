@@ -677,6 +677,15 @@ class Head:
                     self._waiting_on[oid].add(spec.task_id)
                 return
             rec.state = "QUEUED"
+        if (spec.locality_hex is None and spec.actor_id is None
+                and spec.scheduling_strategy.kind == "DEFAULT"):
+            counts: Dict[str, int] = {}
+            for oid in spec.arg_object_ids():
+                h = self.locate_large_object(oid)
+                if h:
+                    counts[h] = counts.get(h, 0) + 1
+            if counts:
+                spec.locality_hex = max(counts, key=lambda k: counts[k])
         self.scheduler.submit(spec)
 
     def _submit_actor_task(self, rec: TaskRecord) -> None:
@@ -1493,6 +1502,63 @@ class Head:
         threading.Thread(target=run, daemon=True,
                          name=f"fetch-{oid.hex()[:6]}").start()
 
+    def broadcast_object(self, oid: ObjectID,
+                         target_hexes: Optional[List[str]] = None) -> int:
+        """Push ``oid`` to every (or the given) alive node via a binomial
+        tree rooted at a holder (reference: push_manager.h broadcast; the
+        '1 GiB to 50+ nodes' envelope row). Returns the number of targets
+        the tree was asked to cover."""
+        with self._lock:
+            locs = [h for h in self.gcs.get_object_locations(oid)
+                    if h in self.nodes]
+            if not locs:
+                return 0
+            holder_hex = next((h for h in locs
+                               if self._is_local(self.nodes[h])), locs[0])
+            holder = self.nodes[holder_hex]
+            targets = []
+            for h, n in self.nodes.items():
+                if h == holder_hex or h in locs or not n.alive:
+                    continue
+                if self._is_local(n):
+                    srv = getattr(n, "object_server", None)
+                    if srv is not None:
+                        targets.append((h, tuple(srv.address)))
+                else:
+                    targets.append((h, tuple(n.object_addr)))
+            if target_hexes is not None:
+                want = set(target_hexes)
+                targets = [t for t in targets if t[0] in want]
+        if not targets:
+            return 0
+        if self._is_local(holder):
+            threading.Thread(target=holder.push_object_to,
+                             args=(oid, targets), daemon=True,
+                             name=f"bcast-{oid.hex()[:6]}").start()
+        else:
+            holder._send("push_object", oid, targets)
+        return len(targets)
+
+    def locate_large_object(self, oid: ObjectID) -> Optional[str]:
+        """Locality signal: hex of a node holding ``oid`` when the bytes
+        are big enough to prefer moving the task over the data
+        (reference: LocalityAwareLeasePolicy / Data locality_hints)."""
+        cfg = global_config()
+        with self._lock:
+            for h in self.gcs.get_object_locations(oid):
+                n = self.nodes.get(h)
+                if n is None:
+                    continue
+                if self._is_local(n):
+                    meta = n.store.read_meta(oid)
+                    if meta and meta[0] > cfg.max_direct_call_object_size:
+                        return h
+                    return None  # small object: no locality value
+                # daemon-held objects are store-resident (inline results
+                # from daemons land in the head store), so large enough
+                return h
+        return None
+
     def add_seal_waiter(self, event: threading.Event) -> None:
         self._seal_events.add(event)
 
@@ -1538,6 +1604,9 @@ class Head:
             return None
         if op == "actor_location":
             return self.actor_location(args[0])
+        if op == "broadcast_object":
+            return self.broadcast_object(
+                args[0], args[1] if len(args) > 1 else None)
         if op == "cancel_task":
             self.cancel_task(args[0], args[1])
             return None
@@ -1666,7 +1735,8 @@ class DriverRuntime:
             ext_wait=lambda oids, t: head.wait_objects(
                 list(oids), len(oids), t),
             pin=lambda oids: head.apply_pin_delta(oids, 1),
-            unpin=lambda oids: head.apply_pin_delta(oids, -1))
+            unpin=lambda oids: head.apply_pin_delta(oids, -1),
+            locate=head.locate_large_object)
 
         # direct actor calls: ordered caller->actor-node submission; the
         # head only resolves locations and keeps the lifecycle FSM

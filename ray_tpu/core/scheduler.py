@@ -281,7 +281,8 @@ class ClusterScheduler:
                    getattr(st, "placement_group_id", None),
                    getattr(st, "bundle_index", -1),
                    str(getattr(st, "node_id", None)),
-                   getattr(st, "soft", False))
+                   getattr(st, "soft", False),
+                   spec.locality_hex)
             spec._sched_sig = sig
         return sig
 
@@ -321,7 +322,9 @@ class ClusterScheduler:
             # soft: fall through to default with preference
             preferred = hexes[0]
         else:
-            preferred = None
+            # soft data-locality preference (reference: lease_policy.h:56
+            # LocalityAwareLeasePolicy — lease from the largest-arg node)
+            preferred = spec.locality_hex
 
         candidates = self._feasible_locked(spec.resources)
         if not candidates:

@@ -79,6 +79,9 @@ class TaskSpec:
     # oid -> ("inline", payload, is_err) for small owned results, or
     # ("node", node_hex) locating the store that sealed the object.
     arg_hints: Optional[Dict[ObjectID, tuple]] = None
+    # head path: soft scheduling preference for the node holding the
+    # task's largest args (reference: lease_policy.h:56)
+    locality_hex: Optional[str] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]
