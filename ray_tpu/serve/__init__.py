@@ -27,6 +27,7 @@ from ray_tpu.serve.config import (  # noqa: F401
     HTTPOptions,
     gRPCOptions,
 )
+from ray_tpu.serve.dag import DAGDriver, DAGNode, InputNode  # noqa: F401
 from ray_tpu.serve.deployment import Application, Deployment, deployment  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse  # noqa: F401
 from ray_tpu.serve.multiplex import (  # noqa: F401
@@ -41,4 +42,5 @@ __all__ = [
     "DeploymentResponse", "AutoscalingConfig", "HTTPOptions", "batch",
     "Request", "multiplexed", "get_multiplexed_model_id",
     "gRPCOptions", "get_grpc_ingress", "get_proxy_addresses",
+    "InputNode", "DAGNode", "DAGDriver",
 ]

@@ -251,13 +251,20 @@ def _deploy_one(app_or_dep, route_prefix: Optional[str],
     return resolve(app)
 
 
-def run(target: Union[Application, Deployment], *,
+def run(target, *,
         name: str = "default", route_prefix: Optional[str] = "/",
         blocking: bool = False,
         _wait_timeout: float = 30.0) -> DeploymentHandle:
-    """Deploy an application and return a handle to its ingress."""
+    """Deploy an application (or a deployment graph) and return a handle
+    to its ingress."""
+    from .dag import DAGNode
+
     start()
-    ingress = _deploy_one(target, route_prefix)
+    if isinstance(target, DAGNode):
+        ingress = _deploy_graph(target, route_prefix,
+                                wait_timeout=_wait_timeout)
+    else:
+        ingress = _deploy_one(target, route_prefix)
     deadline = time.time() + _wait_timeout
     while time.time() < deadline:
         if ray_tpu.get(_controller.deployment_ready.remote(ingress)):
@@ -271,6 +278,29 @@ def run(target: Union[Application, Deployment], *,
         except KeyboardInterrupt:
             pass
     return handle
+
+
+def _deploy_graph(output, route_prefix: Optional[str],
+                  wait_timeout: float = 30.0) -> str:
+    """Compile + deploy a call-DAG (reference:
+    _private/deployment_graph_build.py). Atomic property: every stage is
+    deployed AND ready before the ingress (the route flip) deploys, so
+    requests never enter a half-updated pipeline."""
+    from .dag import build_graph_app
+
+    stage_apps, make_ingress = build_graph_app(output)
+    handles: Dict[str, DeploymentHandle] = {}
+    for stage_name, app in stage_apps.items():
+        dep = app.deployment.options(name=stage_name)
+        _deploy_one(Application(dep, app.args, app.kwargs), None)
+        handles[stage_name] = DeploymentHandle(_controller, stage_name)
+    deadline = time.time() + wait_timeout
+    for stage_name in stage_apps:
+        while time.time() < deadline:
+            if ray_tpu.get(_controller.deployment_ready.remote(stage_name)):
+                break
+            time.sleep(0.05)
+    return _deploy_one(make_ingress(handles), route_prefix)
 
 
 def get_deployment_handle(deployment_name: str,
