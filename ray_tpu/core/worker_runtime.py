@@ -36,6 +36,14 @@ from .protocol import Channel, RpcClient, connect
 from .task_spec import TaskSpec
 
 
+def _is_arraylike(v) -> bool:
+    """jax.Array / np.ndarray results take the typed tensor channel.
+    Object dtypes can't view as raw bytes — they serialize instead."""
+    return (hasattr(v, "dtype") and hasattr(v, "shape")
+            and hasattr(v, "__array__")
+            and not getattr(v.dtype, "hasobject", True))
+
+
 class _ActorState:
     def __init__(self, instance, max_concurrency: int, is_async: bool):
         self.instance = instance
@@ -407,56 +415,97 @@ class WorkerRuntime:
         from ray_tpu.experimental.channel import (
             TAG_ERROR,
             TAG_STOP,
+            TAG_TENSOR,
             ChannelClosed,
             ShmChannel,
         )
 
-        ch_in = ShmChannel(desc["in_path"], desc["capacity"])
-        ch_out = ShmChannel(desc["out_path"], desc["capacity"])
+        ins = [ShmChannel(p, desc["capacity"]) for p in desc["in_paths"]]
+        outs = [ShmChannel(p, desc["capacity"]) for p in desc["out_paths"]]
         method = getattr(st.instance, desc["method"])
-        template = list(desc.get("args_template") or [("input",)])
+        template = list(desc.get("args_template") or [("edge", 0)])
+        device = bool(desc.get("device"))
 
-        def build_args(value):
-            return [value if t[0] == "input" else t[1] for t in template]
+        def close_all():
+            for ch in ins + outs:
+                ch.close()
+
+        def propagate(tag, payload=b""):
+            # STOP is best-effort/bounded (teardown); ERROR must NEVER be
+            # dropped — a missing message desyncs the downstream join's
+            # lockstep rounds forever
+            for ch in outs:
+                try:
+                    ch.write(payload, tag=tag,
+                             timeout=10.0 if tag == TAG_STOP else None)
+                except Exception:
+                    pass
 
         def loop():
-            while True:
-                try:
-                    tag, payload = ch_in.read(timeout=None)
-                except ChannelClosed:
-                    # propagate the stop sentinel downstream, then exit
-                    try:
-                        ch_out.write(b"", tag=TAG_STOP, timeout=10.0)
-                    except Exception:
-                        pass
-                    ch_in.close()
-                    ch_out.close()
-                    return
-                except Exception:
-                    return  # channel unlinked under us (teardown race)
-                if tag == TAG_ERROR:
-                    ch_out.write(payload, tag=TAG_ERROR)  # pass through
-                    continue
-                try:
-                    value = serialization.deserialize(payload)
-                    # run on the actor's executor so compiled executions
-                    # serialize with eager .remote() calls on the same
-                    # instance (the single-threaded actor contract);
-                    # async methods go through the actor's event loop
-                    if st.is_async and asyncio.iscoroutinefunction(method):
-                        result = asyncio.run_coroutine_threadsafe(
-                            method(*build_args(value)), st.loop).result()
-                    else:
-                        result = st.pool.submit(
-                            method, *build_args(value)).result()
-                    ch_out.write(serialization.serialize(result).to_bytes())
-                except Exception as e:  # noqa: BLE001 — ship to consumer
-                    err = TaskError.from_exception(desc["method"], e)
-                    ch_out.write(serialization.serialize(err).to_bytes(),
-                                 tag=TAG_ERROR)
+            try:
+                self._compiled_exec_loop(ins, outs, propagate, st, method,
+                                         template, device)
+            finally:
+                close_all()
 
         threading.Thread(target=loop, daemon=True,
                          name=f"compiled-exec-{desc['method']}").start()
+
+    def _compiled_exec_loop(self, ins, outs, propagate, st, method,
+                            template, device) -> None:
+        from ray_tpu.experimental.channel import (
+            TAG_ERROR,
+            TAG_STOP,
+            TAG_TENSOR,
+            ChannelClosed,
+        )
+
+        while True:
+            # one message per in-edge per execution (lockstep rounds;
+            # reference: per-execution index across CompiledTasks)
+            edge_vals = []
+            failed = None
+            for ch in ins:
+                try:
+                    tag, payload = ch.read(timeout=None, to_device=device)
+                except ChannelClosed:
+                    propagate(TAG_STOP)
+                    return
+                except Exception:
+                    return  # channel unlinked (teardown race)
+                if tag == TAG_ERROR:
+                    failed = payload  # upstream error passes through
+                elif tag == TAG_TENSOR:
+                    edge_vals.append(payload)
+                else:
+                    edge_vals.append(serialization.deserialize(payload))
+            if failed is not None:
+                propagate(TAG_ERROR, failed)
+                continue
+            try:
+                args = [edge_vals[t[1]] if t[0] == "edge" else t[1]
+                        for t in template]
+                # run on the actor's executor so compiled executions
+                # serialize with eager .remote() calls on the same
+                # instance (the single-threaded actor contract);
+                # async methods go through the actor's event loop
+                if st.is_async and asyncio.iscoroutinefunction(method):
+                    result = asyncio.run_coroutine_threadsafe(
+                        method(*args), st.loop).result()
+                else:
+                    result = st.pool.submit(method, *args).result()
+                if device and _is_arraylike(result):
+                    for ch in outs:
+                        ch.write_array(result)
+                else:
+                    payload = serialization.serialize(result).to_bytes()
+                    for ch in outs:
+                        ch.write(payload)
+            except Exception as e:  # noqa: BLE001 — ship to consumer
+                err = TaskError.from_exception(
+                    getattr(method, "__name__", "compiled"), e)
+                propagate(TAG_ERROR,
+                          serialization.serialize(err).to_bytes())
 
     def _resolve_args(self, spec: TaskSpec):
         hints = spec.arg_hints or {}

@@ -3,19 +3,30 @@ execute repeatedly without per-call scheduling.
 
 Analog of the reference's ray.dag (dag_node.py bind API +
 compiled_dag_node.py:143 CompiledTask / do_exec_tasks resident loops):
-each actor in the compiled chain runs a resident executor thread fed by
-shared-memory channels (experimental/channel.py); the driver writes the
-input into the first channel and reads the result from the last — the
-head, scheduler, and per-task bookkeeping are out of the loop entirely.
+each actor task in the compiled graph runs a resident executor thread fed
+by shared-memory channels (experimental/channel.py), ONE CHANNEL PER
+EDGE. The driver writes the input into every input edge and reads the
+result from the output edge — the head, scheduler, and per-task
+bookkeeping are out of the loop entirely.
 
-MVP scope: linear chains of single-node actors (the reference's common
-pipeline case); constant extra args are bound at compile time.
+Arbitrary DAGs are supported (round 4; reference compiles arbitrary
+graphs): multi-upstream nodes read one message per in-edge per
+execution, multi-consumer nodes fan their result out to every out-edge.
+Execution is lockstep per edge (single-slot rendezvous channels), so a
+diamond's branches run concurrently and join deterministically.
+
+``experimental_compile(device_channels=True)`` switches inter-actor
+edges to the typed tensor path (reference: the NCCL channel,
+torch_tensor_nccl_channel.py:191): jax/numpy results move device buffer
+-> shared slot -> consumer device with NO serialization layer — the
+channel STATS expose the accounting (serialized vs tensor bytes).
 
     with InputNode() as inp:
-        d = worker_b.double.bind(worker_a.inc.bind(inp))
-    compiled = d.experimental_compile()
-    ref = compiled.execute(5)       # -> CompiledDAGRef
-    value = ref.get()
+        a = worker_a.inc.bind(inp)
+        b = worker_b.double.bind(inp)
+        out = worker_c.add.bind(a, b)
+    compiled = out.experimental_compile()
+    value = compiled.execute(5).get()
     compiled.teardown()
 """
 
@@ -23,7 +34,7 @@ from __future__ import annotations
 
 import threading
 import uuid
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 from ray_tpu.core import serialization
 from ray_tpu.experimental.channel import (
@@ -55,21 +66,25 @@ class ClassMethodNode(DAGNode):
         self.actor = actor_handle
         self.method_name = method_name
         self.args = args
-        upstream = [a for a in args if isinstance(a, DAGNode)]
-        if len(upstream) != 1:
+        self.upstreams = [a for a in args if isinstance(a, DAGNode)]
+        if not self.upstreams:
             raise ValueError(
-                "compiled-graph MVP supports exactly one upstream node per "
-                f"bind; got {len(upstream)}")
-        self.upstream = upstream[0]
-        # positional template: the upstream value is substituted at its
-        # ORIGINAL argument position (scaled.bind(3, inp) != bind(inp, 3))
-        self.args_template = [
-            ("input",) if isinstance(a, DAGNode) else ("const", a)
-            for a in args
-        ]
+                "a compiled-graph node needs at least one DAGNode arg "
+                "(the InputNode or an upstream bind result)")
+        # positional template: DAGNode args become ("edge", k) in upstream
+        # order; constants are bound at compile time
+        k = 0
+        self.args_template = []
+        for a in args:
+            if isinstance(a, DAGNode):
+                self.args_template.append(("edge", k))
+                k += 1
+            else:
+                self.args_template.append(("const", a))
 
-    def experimental_compile(self, buffer_size_bytes: int = 4 * 1024 * 1024):
-        return CompiledDAG(self, buffer_size_bytes)
+    def experimental_compile(self, buffer_size_bytes: int = 4 * 1024 * 1024,
+                             device_channels: bool = False):
+        return CompiledDAG(self, buffer_size_bytes, device_channels)
 
 
 def _bind(actor_method, *args):
@@ -89,25 +104,63 @@ class CompiledDAGRef:
 
 
 class CompiledDAG:
-    def __init__(self, output_node: ClassMethodNode, buffer_size: int):
-        # topo order: walk upstream to the InputNode
-        chain: List[ClassMethodNode] = []
-        node = output_node
-        while isinstance(node, ClassMethodNode):
-            chain.append(node)
-            node = node.upstream
-        if not isinstance(node, InputNode):
-            raise ValueError("compiled DAG must terminate at an InputNode")
-        chain.reverse()
-        self._chain = chain
+    def __init__(self, output_node: ClassMethodNode, buffer_size: int,
+                 device_channels: bool = False):
+        # topological order: DFS post-order from the output (dedup by id)
+        nodes: List[ClassMethodNode] = []
+        seen: set = set()
+        input_ids: set = set()
+        # iterative post-order DFS (deep pipelines must not hit the
+        # interpreter recursion limit)
+        stack: List[tuple] = [(output_node, False)]
+        while stack:
+            n, expanded = stack.pop()
+            if isinstance(n, InputNode):
+                input_ids.add(id(n))
+                continue
+            if expanded:
+                nodes.append(n)
+                continue
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            stack.append((n, True))
+            for u in reversed(n.upstreams):
+                stack.append((u, False))
+        if not input_ids:
+            raise ValueError("compiled DAG must read from an InputNode")
+        self._nodes = nodes
         self._buffer_size = buffer_size
+        self._device = device_channels
         uid = uuid.uuid4().hex[:10]
-        n = len(chain)
-        paths = [channel_path(f"{uid}_{i}") for i in range(n + 1)]
-        self._channels = [ShmChannel(p, buffer_size, create=True)
-                          for p in paths]
-        self._in = self._channels[0]
-        self._out = self._channels[-1]
+        node_idx = {id(n): i for i, n in enumerate(nodes)}
+
+        # one channel per edge: (producer id | "input") -> consumer slot
+        self._channels: List[ShmChannel] = []
+        self._input_chans: List[ShmChannel] = []
+
+        def new_chan(name: str) -> ShmChannel:
+            ch = ShmChannel(channel_path(f"{uid}_{name}"), buffer_size,
+                            create=True)
+            self._channels.append(ch)
+            return ch
+
+        in_paths: Dict[int, List[str]] = {}
+        out_paths: Dict[int, List[str]] = {}
+        for i, n in enumerate(nodes):
+            in_paths[i] = []
+            out_paths.setdefault(i, [])
+            for k, u in enumerate(n.upstreams):
+                ch = new_chan(f"e{i}_{k}")
+                in_paths[i].append(ch.path)
+                if isinstance(u, InputNode):
+                    self._input_chans.append(ch)
+                else:
+                    out_paths.setdefault(node_idx[id(u)], []).append(ch.path)
+        out_ch = new_chan("out")
+        self._out = out_ch
+        out_paths[node_idx[id(output_node)]].append(out_ch.path)
+
         # split locks: a submitter blocked on a full pipeline must not
         # prevent a reader from draining results (that would deadlock)
         self._submit_lock = threading.Lock()
@@ -120,13 +173,14 @@ class CompiledDAG:
         import ray_tpu
 
         acks = []
-        for i, task in enumerate(chain):
+        for i, task in enumerate(nodes):
             acks.append(task.actor.__compiled_exec__.remote({
                 "method": task.method_name,
-                "in_path": paths[i],
-                "out_path": paths[i + 1],
+                "in_paths": in_paths[i],
+                "out_paths": out_paths[i],
                 "capacity": buffer_size,
                 "args_template": task.args_template,
+                "device": device_channels,
             }))
         ray_tpu.get(acks, timeout=60)
 
@@ -135,22 +189,39 @@ class CompiledDAG:
         with self._submit_lock:
             if self._torn_down:
                 raise RuntimeError("compiled DAG was torn down")
-            # bounded write: a full pipeline (single-slot channels, nothing
-            # consuming results) raises ChannelTimeout instead of blocking
-            # the driver forever
-            self._in.write(serialization.serialize(value).to_bytes(),
-                           timeout=timeout)
+            payload = serialization.serialize(value).to_bytes()
+            # bounded writes: a full pipeline (single-slot channels,
+            # nothing consuming results) raises ChannelTimeout instead of
+            # blocking the driver forever. A PARTIAL round (some input
+            # edges written, one timed out) permanently desyncs the
+            # lockstep joins — poison the DAG rather than return wrong
+            # values on later executes.
+            for i, ch in enumerate(self._input_chans):
+                try:
+                    ch.write(payload, timeout=timeout)
+                except Exception:
+                    if i == 0:
+                        raise  # nothing consumed: safe to retry
+                    self._torn_down = True
+                    raise RuntimeError(
+                        "compiled DAG input round was partially written "
+                        "(pipeline wedged?); the DAG is now poisoned — "
+                        "recompile to continue") from None
             seq = self._next_seq
             self._next_seq += 1
         return CompiledDAGRef(self, seq)
 
     def _read_result(self, seq: int, timeout: Optional[float]):
+        from ray_tpu.experimental.channel import TAG_TENSOR
+
         with self._read_lock:
             while self._next_read <= seq:
                 tag, payload = self._out.read(timeout)
                 self._results[self._next_read] = (tag, payload)
                 self._next_read += 1
             tag, payload = self._results.pop(seq)
+        if tag == TAG_TENSOR:
+            return payload  # typed array, no serialization layer
         value = serialization.deserialize(payload)
         if tag == TAG_ERROR:
             raise value
@@ -161,25 +232,25 @@ class CompiledDAG:
             if self._torn_down:
                 return
             self._torn_down = True
-        # drain unconsumed results first so the stop sentinel can flow
-        # through the (single-slot) pipeline, then keep draining until the
-        # sentinel comes out the far end; every step is bounded
-        stop_sent = False
-        for _ in range(self._next_seq + len(self._chain) + 2):
-            if not stop_sent:
+        # push stop sentinels into every input edge, then drain the output
+        # until the sentinel comes out the far end; every step is bounded
+        stop_sent = 0
+        for _ in range(self._next_seq + len(self._nodes) + 2):
+            while stop_sent < len(self._input_chans):
                 try:
-                    self._in.write(b"", tag=TAG_STOP, timeout=0.5)
-                    stop_sent = True
+                    self._input_chans[stop_sent].write(
+                        b"", tag=TAG_STOP, timeout=0.5)
+                    stop_sent += 1
                 except ChannelTimeout:
-                    pass  # input slot full: drain below, retry
+                    break  # slot full: drain below, retry
                 except Exception:
-                    stop_sent = True
+                    stop_sent += 1
             try:
                 self._out.read(timeout=2.0)
             except ChannelClosed:
                 break  # sentinel arrived: all loops exited
             except Exception:
-                if stop_sent:
+                if stop_sent >= len(self._input_chans):
                     break
         for ch in self._channels:
             ch.close(unlink=True)

@@ -32,6 +32,11 @@ _LENTAG = struct.Struct("<QB")  # at offset 16
 TAG_DATA = 0
 TAG_STOP = 1
 TAG_ERROR = 2
+TAG_TENSOR = 3  # typed array payload: no serialization layer at all
+
+# per-process transfer accounting (the "host-copy metric": serialized
+# bytes went through the pickle layer; tensor bytes moved buffer->buffer)
+STATS = {"serialized_bytes": 0, "tensor_bytes": 0}
 
 
 class ChannelTimeout(Exception):
@@ -96,32 +101,105 @@ class ShmChannel:
 
     # ---- API ----
 
-    def write(self, payload: bytes, tag: int = TAG_DATA,
-              timeout: Optional[float] = None) -> None:
-        if len(payload) > self.capacity:
+    def _publish(self, total_len: int, tag: int,
+                 timeout: Optional[float], fill) -> None:
+        """Single-slot publish protocol: wait for a free slot, let
+        ``fill`` write the payload bytes, then commit len/tag and LASTLY
+        the write_seq (the reader checks the seq before trusting the
+        rest), then ring the doorbell. The only place the invariants
+        live — both write paths ride it."""
+        if total_len > self.capacity:
             raise ValueError(
-                f"message of {len(payload)}B exceeds channel capacity "
+                f"message of {total_len}B exceeds channel capacity "
                 f"{self.capacity}B (raise buffer_size_bytes)")
         self._wait(lambda: (lambda w, r, _l, _t: r == w)(*self._header()),
                    self._bell_free, timeout)
         w, r, _, _ = self._header()
-        self._mm[_HDR.size:_HDR.size + len(payload)] = payload
-        # payload + len/tag first, write_seq last: the reader checks the
-        # seq before trusting the rest
-        _LENTAG.pack_into(self._mm, 16, len(payload), tag)
+        fill(self._mm, _HDR.size)
+        _LENTAG.pack_into(self._mm, 16, total_len, tag)
         _WSEQ.pack_into(self._mm, 0, w + 1)
         self._ring(self._bell_rdy)
 
-    def read(self, timeout: Optional[float] = None):
+    def write(self, payload: bytes, tag: int = TAG_DATA,
+              timeout: Optional[float] = None) -> None:
+        def fill(mm, off):
+            mm[off:off + len(payload)] = payload
+
+        self._publish(len(payload), tag, timeout, fill)
+        if tag == TAG_DATA or tag == TAG_ERROR:
+            STATS["serialized_bytes"] += len(payload)
+
+    def write_array(self, arr, timeout: Optional[float] = None) -> None:
+        """Device/typed-array fast path (reference: the NCCL tensor
+        channel, torch_tensor_nccl_channel.py:191 — tensors bypass the
+        serialization layer entirely). The device buffer lands in the
+        shared slot in ONE transfer: on the CPU backend ``np.asarray`` of
+        a jax.Array is a zero-copy view, so the only host copy is the
+        buffer->shm memcpy; on TPU it is the D2H DMA itself."""
+        import json
+
+        import numpy as _np
+
+        view = _np.asarray(arr)
+        if not view.flags.c_contiguous:
+            view = _np.ascontiguousarray(view)
+        raw = view.reshape(-1).view(_np.uint8)
+        meta = json.dumps({"dtype": str(view.dtype),
+                           "shape": list(view.shape)}).encode()
+
+        def fill(mm, off):
+            struct.pack_into("<I", mm, off, len(meta))
+            off += 4
+            mm[off:off + len(meta)] = meta
+            off += len(meta)
+            mm[off:off + raw.nbytes] = memoryview(raw)
+
+        self._publish(4 + len(meta) + raw.nbytes, TAG_TENSOR, timeout, fill)
+        STATS["tensor_bytes"] += raw.nbytes
+
+    def read(self, timeout: Optional[float] = None,
+             to_device: bool = False):
         self._wait(lambda: (lambda w, r, _l, _t: w > r)(*self._header()),
                    self._bell_rdy, timeout)
         w, r, length, tag = self._header()
+        if tag == TAG_TENSOR:
+            value = self._read_tensor(length, to_device)
+            _RSEQ.pack_into(self._mm, 8, r + 1)
+            self._ring(self._bell_free)
+            return (TAG_TENSOR, value)
         payload = bytes(self._mm[_HDR.size:_HDR.size + length])
         _RSEQ.pack_into(self._mm, 8, r + 1)  # only the reader's field
         self._ring(self._bell_free)
         if tag == TAG_STOP:
             raise ChannelClosed(self.path)
         return (tag, payload) if tag == TAG_ERROR else (TAG_DATA, payload)
+
+    def _read_tensor(self, length: int, to_device: bool):
+        """Materialize the typed payload BEFORE acking the slot (the
+        writer may overwrite after the ack). ``to_device`` puts straight
+        onto the local jax device from the mapped view — no intermediate
+        serialization buffer."""
+        import json
+
+        import numpy as _np
+
+        off = _HDR.size
+        (meta_len,) = struct.unpack_from("<I", self._mm, off)
+        off += 4
+        meta = json.loads(bytes(self._mm[off:off + meta_len]))
+        off += meta_len
+        dtype = _np.dtype(meta["dtype"])
+        shape = tuple(meta["shape"])
+        count = int(_np.prod(shape)) if shape else 1
+        view = _np.frombuffer(self._mm, dtype=dtype, count=count,
+                              offset=off).reshape(shape)
+        if to_device:
+            import jax
+
+            out = jax.device_put(view)
+            out.block_until_ready()
+            return out
+        return view.copy()
 
     def close(self, unlink: bool = False) -> None:
         try:

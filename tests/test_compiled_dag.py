@@ -121,3 +121,107 @@ def test_channel_direct():
             ch.write(b"x" * 2048)  # over capacity
     finally:
         ch.close(unlink=True)
+
+
+@ray_tpu.remote
+class Worker2:
+    def inc(self, x):
+        return x + 1
+
+    def double(self, x):
+        return x * 2
+
+    def add(self, a, b):
+        return a + b
+
+    def matmul(self, x):
+        import jax.numpy as jnp
+
+        return jnp.asarray(x) @ jnp.asarray(x).T
+
+    def rowsum(self, m):
+        import jax.numpy as jnp
+
+        return jnp.asarray(m).sum(axis=1)
+
+    def chan_stats(self):
+        from ray_tpu.experimental.channel import STATS
+
+        return dict(STATS)
+
+
+def test_diamond_dag(ray_start_regular):
+    """Round-4 ask #8: arbitrary DAGs — a diamond with a two-input join
+    (reference: compiled_dag_node.py:143 arbitrary CompiledTask graphs)."""
+    from ray_tpu.dag import InputNode
+
+    a = Worker2.remote()
+    b = Worker2.remote()
+    c = Worker2.remote()
+    with InputNode() as inp:
+        left = a.inc.bind(inp)       # x + 1
+        right = b.double.bind(inp)   # x * 2
+        out = c.add.bind(left, right)
+    compiled = out.experimental_compile()
+    try:
+        for x in (0, 3, 10):
+            assert compiled.execute(x).get(timeout=60) == (x + 1) + 2 * x
+        # pipelined executes across the diamond
+        refs = [compiled.execute(i) for i in range(3)]
+        assert [r.get(timeout=60) for r in refs] == [3 * i + 1
+                                                     for i in range(3)]
+    finally:
+        compiled.teardown()
+
+
+def test_multi_consumer_fanout(ray_start_regular):
+    """One node's result feeds two downstream consumers."""
+    from ray_tpu.dag import InputNode
+
+    a = Worker2.remote()
+    b = Worker2.remote()
+    c = Worker2.remote()
+    d = Worker2.remote()
+    with InputNode() as inp:
+        base = a.inc.bind(inp)          # x+1, consumed twice
+        l2 = b.double.bind(base)        # 2(x+1)
+        r2 = c.inc.bind(base)           # x+2
+        out = d.add.bind(l2, r2)        # 3x+4
+    compiled = out.experimental_compile()
+    try:
+        assert compiled.execute(5).get(timeout=60) == 3 * 5 + 4
+        assert compiled.execute(0).get(timeout=60) == 4
+    finally:
+        compiled.teardown()
+
+
+def test_device_channel_zero_serialization(ray_start_regular):
+    """Device-resident edges: jax results cross actor boundaries via the
+    typed tensor channel with ZERO serialization-layer bytes (reference:
+    torch_tensor_nccl_channel.py:191 — tensors bypass serialization)."""
+    import numpy as np
+
+    from ray_tpu.dag import InputNode
+
+    a = Worker2.remote()
+    b = Worker2.remote()
+    with InputNode() as inp:
+        mm = a.matmul.bind(inp)
+        out = b.rowsum.bind(mm)
+    compiled = out.experimental_compile(buffer_size_bytes=8 << 20,
+                                        device_channels=True)
+    try:
+        x = np.arange(128 * 64, dtype=np.float32).reshape(128, 64)
+        got = compiled.execute(x).get(timeout=120)
+        want = (x @ x.T).sum(axis=1)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4)
+        # the producing actor moved its (128,128) f32 result as raw
+        # tensor bytes — no serialization-layer copy
+        stats_a = ray_tpu.get(a.chan_stats.remote())
+        assert stats_a["tensor_bytes"] >= 128 * 128 * 4
+        assert stats_a["serialized_bytes"] == 0
+        stats_b = ray_tpu.get(b.chan_stats.remote())
+        assert stats_b["tensor_bytes"] >= 128 * 4
+        assert stats_b["serialized_bytes"] == 0
+    finally:
+        compiled.teardown()
