@@ -42,6 +42,9 @@ def main():
                     help="add N separate-process node daemons (direct-task "
                     "spillback topology) and run a many-tasks op across "
                     "them")
+    ap.add_argument("--many", type=int, default=50_000,
+                    help="task count for the many-tasks envelope probe "
+                    "(--daemons runs)")
     args = ap.parse_args()
 
     import ray_tpu
@@ -164,16 +167,42 @@ def main():
     if args.daemons:
         # scalability-envelope probe (reference: release/benchmarks
         # distributed/test_many_tasks.py): direct path + spillback across
-        # the daemons; the head sees only batched events
-        def many_tasks_5k():
-            ray_tpu.get([nop.remote() for _ in range(5000)], timeout=600)
+        # the daemons; the head sees only batched events. The driver
+        # process's CPU time per task is the head-flatness evidence: on
+        # the direct path the head does no per-task work, so cpu/task
+        # must stay flat as the count scales.
+        import resource
 
-        run("many_tasks_5k_across_daemons", many_tasks_5k, 5000)
         from ray_tpu.core import runtime as _rt
 
+        n = args.many
+
+        def cpu_s() -> float:
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            return ru.ru_utime + ru.ru_stime
+
+        # chunked submission keeps driver memory bounded at envelope scale
+        def many_tasks():
+            chunk = 5000
+            for start in range(0, n, chunk):
+                ray_tpu.get([nop.remote() for _ in
+                             range(min(chunk, n - start))], timeout=600)
+
+        c0, t0 = cpu_s(), time.perf_counter()
+        many_tasks()
+        dt = time.perf_counter() - t0
+        dcpu = cpu_s() - c0
+        rate = n / dt
+        cpu_us = dcpu / n * 1e6
+        results[f"many_tasks_{n}_across_daemons"] = rate
+        results["many_tasks_driver_cpu_us_per_task"] = cpu_us
+        print(f"{'many_tasks_%d_across_daemons' % n:<42s} {rate:>12.1f} /s")
+        print(f"{'many_tasks_driver_cpu_us_per_task':<42s} {cpu_us:>12.1f} us")
+
         head = _rt.get_current_runtime().head
-        print(f"# head.tasks after many-tasks: {len(head.tasks)} "
-              f"(direct path leaves no per-task head records)")
+        results["head_task_records_after_bench"] = len(head.tasks)
+        print(f"# head.tasks after all ops: {len(head.tasks)} "
+              f"(direct task+actor paths leave no per-call head records)")
 
     if cluster is not None:
         cluster.shutdown()

@@ -457,6 +457,9 @@ class Node:
             socket.create_connection(tuple(addr), timeout=2.0).close()
             conn = mpc.Client(address=tuple(addr), family="AF_INET",
                               authkey=key)
+            from .protocol import set_nodelay
+
+            set_nodelay(conn)
             conn.send(("peer_hello", self.hex))
             ch = Channel(conn)
         except Exception:

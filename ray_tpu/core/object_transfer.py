@@ -25,6 +25,7 @@ from typing import Optional, Tuple
 from .config import global_config
 from .exceptions import ObjectLostError
 from .ids import ObjectID
+from .protocol import set_nodelay as _set_nodelay
 
 # Serialize concurrent pulls of the same object into the same store: two
 # racing create(oid) calls would free each other's in-flight arena offset
@@ -82,6 +83,7 @@ class ObjectServer:
                 if not self._alive:
                     return
                 continue
+            _set_nodelay(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -243,6 +245,7 @@ def _pull_one(address, authkey: bytes, oid: ObjectID, dest_store, cfg):
     try:
         conn = mpc.Client(address=tuple(address), family="AF_INET",
                           authkey=authkey)
+        _set_nodelay(conn)
         conn.send(("pull", oid.binary()))
         msg = conn.recv()
         if msg[0] != "meta":
@@ -302,6 +305,7 @@ def push_object(address, authkey: bytes, oid: ObjectID, src_store,
     try:
         conn = mpc.Client(address=tuple(address), family="AF_INET",
                           authkey=authkey)
+        _set_nodelay(conn)
         conn.send(("push", oid.binary(), size, is_err, list(targets)))
         chunk = cfg.object_transfer_chunk_size
         sent = 0

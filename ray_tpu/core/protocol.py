@@ -149,12 +149,30 @@ def make_listener(address, authkey: bytes) -> mpc.Listener:
                         backlog=64, authkey=authkey)
 
 
+def set_nodelay(conn) -> None:
+    """Disable Nagle on a TCP multiprocessing Connection. The control
+    planes exchange small request/reply messages; Nagle + delayed ACK
+    adds tens of ms per round trip (measured: daemon-hosted actor calls
+    at 81/s vs 2.5k/s over unix sockets before this)."""
+    import socket
+
+    try:
+        s = socket.fromfd(conn.fileno(), socket.AF_INET,
+                          socket.SOCK_STREAM)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.close()  # fromfd dup'd the fd; the option sticks to the socket
+    except OSError:
+        pass
+
+
 def connect(address, authkey: bytes) -> Channel:
     if isinstance(address, str):
         return Channel(mpc.Client(address=address, family="AF_UNIX",
                                   authkey=authkey))
-    return Channel(mpc.Client(address=tuple(address), family="AF_INET",
-                              authkey=authkey))
+    conn = mpc.Client(address=tuple(address), family="AF_INET",
+                      authkey=authkey)
+    set_nodelay(conn)
+    return Channel(conn)
 
 
 def infer_node_ip(peer_host: str = "8.8.8.8") -> str:

@@ -445,6 +445,9 @@ class Head:
                 if self._stopped or self._node_listener is None:
                     return
                 continue
+            from .protocol import set_nodelay
+
+            set_nodelay(conn)
             threading.Thread(target=self._register_daemon,
                              args=(Channel(conn),), daemon=True).start()
 

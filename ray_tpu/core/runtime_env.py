@@ -8,8 +8,14 @@ env_vars apply around execution (set-and-restore for shared plain-task
 workers, permanent for actor-dedicated workers).
 
 Supported keys: ``env_vars`` (dict), ``working_dir`` (local dir path),
-``py_modules`` (list of local dir paths). conda/pip/container isolation
-is out of scope (workers share the interpreter).
+``py_modules`` (list of local dir paths), ``pip`` (list of requirement
+strings / local package paths, or ``{"packages": [...], "pip_install_
+options": [...]}``) — a content-addressed virtualenv is created once per
+host per requirement set (reference: runtime_env/pip.py) and its
+site-packages activates around execution. The venv uses
+``--system-site-packages`` so jax/the framework stay importable;
+container/conda isolation is out of scope (workers share the
+interpreter).
 """
 
 from __future__ import annotations
@@ -124,6 +130,70 @@ def _materialize(ref: dict, runtime) -> str:
     return dest
 
 
+def _materialize_pip_env(pip_spec, runtime) -> str:
+    """Create (once per host) the venv for a requirement set; returns its
+    site-packages path (reference: runtime_env/pip.py — per-env-hash venv
+    with delete-on-failure + cross-process locking)."""
+    import fcntl
+    import subprocess
+
+    if isinstance(pip_spec, dict):
+        reqs = list(pip_spec.get("packages") or [])
+        opts = list(pip_spec.get("pip_install_options") or [])
+    else:
+        reqs = list(pip_spec)
+        opts = []
+    digest = hashlib.blake2b(
+        ("\n".join(sorted(reqs) + sorted(opts))).encode(),
+        digest_size=12).hexdigest()
+    cache_root = os.path.join("/tmp", "raytpu_runtime_env")
+    os.makedirs(cache_root, exist_ok=True)
+    dest = os.path.join(cache_root, f"pip-{digest}")
+    marker = dest + ".ok"
+
+    def site_packages() -> str:
+        v = f"python{sys.version_info.major}.{sys.version_info.minor}"
+        return os.path.join(dest, "lib", v, "site-packages")
+
+    if os.path.exists(marker):
+        return site_packages()
+    with open(dest + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if os.path.exists(marker):
+            return site_packages()
+        import shutil
+        import venv
+
+        shutil.rmtree(dest, ignore_errors=True)  # prior failed attempt
+        try:
+            venv.create(dest, system_site_packages=True, with_pip=True,
+                        symlinks=True)
+            # when THIS interpreter itself lives in a venv (/opt/venv),
+            # system_site_packages points past it to the base python —
+            # bridge our site-packages in via a .pth so pip's build
+            # backend (setuptools) and the framework stay importable
+            host_sps = [p for p in sys.path if p.endswith("site-packages")
+                        and os.path.isdir(p)]
+            if host_sps:
+                with open(os.path.join(site_packages(),
+                                       "_raytpu_host.pth"), "w") as f:
+                    f.write("\n".join(host_sps) + "\n")
+            pip = os.path.join(dest, "bin", "pip")
+            proc = subprocess.run(
+                [pip, "install", "--disable-pip-version-check",
+                 "--no-input"] + opts + reqs,
+                capture_output=True, text=True, timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"pip install failed for runtime_env {reqs}:\n"
+                    + proc.stderr[-2000:])
+            open(marker, "w").close()
+        except BaseException:
+            shutil.rmtree(dest, ignore_errors=True)
+            raise
+    return site_packages()
+
+
 def apply_runtime_env(env: Optional[dict], runtime):
     """Worker side: apply before execution; returns a restore() callable
     (no-op when nothing was applied)."""
@@ -168,6 +238,12 @@ def apply_runtime_env(env: Optional[dict], runtime):
                 path = _materialize(mod, runtime)
                 sys.path.insert(0, path)
                 added_paths.append(path)
+
+        pip_spec = env.get("pip")
+        if pip_spec:
+            sp = _materialize_pip_env(pip_spec, runtime)
+            sys.path.insert(0, sp)
+            added_paths.append(sp)
     except BaseException:
         restore()  # partial application must not leak into later tasks
         raise

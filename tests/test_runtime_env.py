@@ -61,3 +61,57 @@ def test_py_modules(ray_start_regular, tmp_path):
         return mymod.answer()
 
     assert ray_tpu.get(use_module.remote()) == 99
+
+
+def test_pip_runtime_env_offline(tmp_path):
+    """Per-task pip venv (reference: runtime_env/pip.py): a local package
+    installs into a content-addressed venv once per host and activates
+    around execution only. Offline-safe flags (this box has no egress)."""
+    import textwrap
+
+    import ray_tpu
+
+    pkg = tmp_path / "pkg"
+    (pkg / "tiny_env_pkg").mkdir(parents=True)
+    (pkg / "tiny_env_pkg" / "__init__.py").write_text("MAGIC = 41\n")
+    (pkg / "setup.py").write_text(textwrap.dedent("""
+        from setuptools import setup, find_packages
+        setup(name="tiny-env-pkg", version="0.1",
+              packages=find_packages())
+    """))
+    env = {"pip": {"packages": [str(pkg)],
+                   "pip_install_options": [
+                       "--no-index", "--no-deps",
+                       "--no-build-isolation"]}}
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(runtime_env=env)
+        def uses_pkg():
+            import tiny_env_pkg
+
+            return tiny_env_pkg.MAGIC + 1
+
+        @ray_tpu.remote
+        def plain():
+            try:
+                import tiny_env_pkg  # noqa: F401
+
+                return "leaked"
+            except ImportError:
+                return "clean"
+
+        assert ray_tpu.get(uses_pkg.remote(), timeout=300) == 42
+        # the env must not leak into tasks without it
+        assert ray_tpu.get(plain.remote(), timeout=60) == "clean"
+
+        @ray_tpu.remote(runtime_env=env)
+        class WithEnv:
+            def magic(self):
+                import tiny_env_pkg
+
+                return tiny_env_pkg.MAGIC
+
+        a = WithEnv.remote()
+        assert ray_tpu.get(a.magic.remote(), timeout=300) == 41
+    finally:
+        ray_tpu.shutdown()
