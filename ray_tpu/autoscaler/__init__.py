@@ -15,8 +15,10 @@ from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig  # noqa: 
 from ray_tpu.autoscaler.node_provider import (  # noqa: F401
     LocalNodeProvider,
     NodeProvider,
+    TPUQueuedResourceProvider,
     TPUSliceProvider,
 )
 
 __all__ = ["Autoscaler", "AutoscalerConfig", "NodeProvider",
-           "LocalNodeProvider", "TPUSliceProvider"]
+           "LocalNodeProvider", "TPUSliceProvider",
+           "TPUQueuedResourceProvider"]

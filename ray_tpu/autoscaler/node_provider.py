@@ -103,14 +103,15 @@ class LocalNodeProvider(NodeProvider):
 
 
 class TPUSliceProvider(NodeProvider):
-    """TPU-slice provisioning seam (GKE node pools / Queued Resources).
+    """TPU-slice provisioning seam (injected callables).
 
     Zero-egress environments can't call cloud APIs, so actual provisioning
     is delegated to operator-supplied callables — e.g. wrappers over
     ``gcloud compute tpus queued-resources create`` or a KubeRay-style CRD
     reconciler. The autoscaler treats slices as atomic nodes: one
     create_node call = one slice request (the TPU analog of the
-    reference's per-VM cloud providers).
+    reference's per-VM cloud providers). For the full Queued-Resources
+    shape see :class:`TPUQueuedResourceProvider`.
     """
 
     def __init__(self, launch_fn: Callable[[dict], str],
@@ -128,3 +129,146 @@ class TPUSliceProvider(NodeProvider):
 
     def non_terminated_nodes(self) -> List[str]:
         return list(self._list())
+
+
+# accelerator type -> (chips per host, total chips); topology label is the
+# type's own chip grid (reference: accelerators/tpu.py pod shapes)
+_TPU_SHAPES = {
+    "v4-8": (4, 4), "v4-16": (4, 8), "v4-32": (4, 16),
+    "v5litepod-4": (4, 4), "v5litepod-8": (8, 8), "v5litepod-16": (4, 16),
+    "v5litepod-32": (4, 32), "v5litepod-64": (4, 64),
+    "v5p-8": (4, 4), "v5p-16": (4, 8),
+    "v6e-4": (4, 4), "v6e-8": (8, 8), "v6e-16": (4, 16),
+    "v6e-64": (4, 64), "v6e-256": (4, 256),
+}
+
+
+class TPUQueuedResourceProvider(NodeProvider):
+    """GCP Queued-Resources slice provider (reference: the cloud-provider
+    role of python/ray/autoscaler/_private/gcp/ + the TPU pod semantics of
+    accelerators/tpu.py:71).
+
+    One ``create_node`` = one queued-resource request for a whole slice.
+    Every host of a granted slice bootstraps (via the startup script this
+    provider composes) as a node daemon carrying the slice topology as
+    scheduler labels:
+
+        ray-tpu-slice=<qr name>, ray-tpu-accelerator=<type>,
+        ray-tpu-worker=<host index>
+
+    plus the ``TPU-<type>-head`` resource on worker 0 — the label set
+    gang-scheduling placement groups key on.
+
+    ``runner`` executes the gcloud invocations and returns stdout; the
+    default shells out, tests inject a fake (this box has zero egress).
+    The QR lifecycle (WAITING_FOR_RESOURCES -> PROVISIONING -> ACTIVE |
+    SUSPENDED/FAILED) is polled via ``list``; only non-terminal QRs count
+    as non_terminated (the autoscaler keeps demand pending meanwhile).
+    """
+
+    def __init__(self, head_address, cluster_key_hex: str, *,
+                 project: str, zone: str,
+                 runtime_version: str = "v2-alpha-tpuv5-lite",
+                 runner: Optional[Callable[[List[str]], str]] = None):
+        self._address = f"{head_address[0]}:{head_address[1]}"
+        self._key = cluster_key_hex
+        self._project = project
+        self._zone = zone
+        self._runtime = runtime_version
+        self._runner = runner or self._shell
+        self._lock = threading.Lock()
+        self._requested: Dict[str, dict] = {}  # qr name -> node_config
+
+    @staticmethod
+    def _shell(cmd: List[str]) -> str:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=300)
+        if out.returncode != 0:
+            raise RuntimeError(f"{' '.join(cmd)} failed: {out.stderr}")
+        return out.stdout
+
+    # ---- slice math ------------------------------------------------------
+
+    @staticmethod
+    def slice_shape(accelerator_type: str):
+        """(chips_per_host, total_chips, num_hosts) for a type."""
+        per_host, total = _TPU_SHAPES.get(accelerator_type, (4, 4))
+        return per_host, total, max(1, total // per_host)
+
+    def startup_script(self, qr_name: str, accelerator_type: str) -> str:
+        """Per-host bootstrap: join the head with slice-topology labels.
+        TPU_WORKER_ID is set by the TPU runtime on every pod host."""
+        import json
+
+        per_host, _total, _hosts = self.slice_shape(accelerator_type)
+        labels = {
+            "ray-tpu-slice": qr_name,
+            "ray-tpu-accelerator": accelerator_type,
+            "ray-tpu-worker": "${TPU_WORKER_ID}",
+        }
+        head_res = json.dumps({f"TPU-{accelerator_type}-head": 1})
+        return (
+            "#!/bin/bash\n"
+            f"RES='{{}}'\n"
+            f"if [ \"${{TPU_WORKER_ID}}\" = \"0\" ]; then RES='{head_res}'; fi\n"
+            f"python -m ray_tpu start --address {self._address} "
+            f"--key {self._key} --num-tpus {per_host} "
+            f"--resources \"$RES\" "
+            f"--labels '{json.dumps(labels)}'\n"
+        )
+
+    # ---- provider interface ---------------------------------------------
+
+    def create_node(self, node_config: dict) -> str:
+        acc = node_config.get("accelerator_type", "v5litepod-4")
+        qr_name = f"raytpu-qr-{uuid.uuid4().hex[:8]}"
+        cmd = [
+            "gcloud", "compute", "tpus", "queued-resources", "create",
+            qr_name,
+            f"--project={self._project}", f"--zone={self._zone}",
+            f"--node-id={qr_name}-node",
+            f"--accelerator-type={acc}",
+            f"--runtime-version={self._runtime}",
+            "--metadata-from-file",
+            f"startup-script={self._write_script(qr_name, acc)}",
+        ]
+        if node_config.get("spot"):
+            cmd.append("--spot")
+        if node_config.get("reserved"):
+            cmd.append("--reserved")
+        self._runner(cmd)
+        with self._lock:
+            self._requested[qr_name] = dict(node_config)
+        return qr_name
+
+    def _write_script(self, qr_name: str, acc: str) -> str:
+        import tempfile
+
+        path = os.path.join(tempfile.gettempdir(),
+                            f"raytpu_qr_{qr_name}.sh")
+        with open(path, "w") as f:
+            f.write(self.startup_script(qr_name, acc))
+        return path
+
+    def terminate_node(self, provider_id: str) -> None:
+        self._runner([
+            "gcloud", "compute", "tpus", "queued-resources", "delete",
+            provider_id, f"--project={self._project}",
+            f"--zone={self._zone}", "--quiet", "--force"])
+        with self._lock:
+            self._requested.pop(provider_id, None)
+
+    def non_terminated_nodes(self) -> List[str]:
+        import json
+
+        out = self._runner([
+            "gcloud", "compute", "tpus", "queued-resources", "list",
+            f"--project={self._project}", f"--zone={self._zone}",
+            "--format=json"])
+        alive = []
+        for qr in json.loads(out or "[]"):
+            name = qr.get("name", "").rsplit("/", 1)[-1]
+            state = (qr.get("state") or {}).get("state", "")
+            if state not in ("SUSPENDED", "FAILED", "DELETING"):
+                alive.append(name)
+        return alive

@@ -80,3 +80,108 @@ class TestAutoscalerE2E:
             time.sleep(0.5)
         assert scaler.provider.non_terminated_nodes() == []
         assert scaler.num_terminations >= 1
+
+
+def test_tpu_queued_resource_provider_end_to_end():
+    """Round-4 weak #9: a real Queued-Resources provider shape — gcloud
+    command composition, QR lifecycle states, slice-topology labels
+    flowing into scheduler labels — driven through the Autoscaler with a
+    fake gcloud runner (zero egress) and a REAL daemon standing in for
+    the granted slice host."""
+    import json
+    import shlex
+    import subprocess
+    import sys
+    import time
+
+    import ray_tpu
+    from ray_tpu.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        TPUQueuedResourceProvider,
+    )
+    from ray_tpu.core import runtime as runtime_mod
+
+    calls = []
+    state = {"qrs": {}}  # name -> lifecycle state
+
+    def fake_gcloud(cmd):
+        calls.append(cmd)
+        verb = cmd[4]
+        if verb == "create":
+            name = cmd[5]
+            state["qrs"][name] = "WAITING_FOR_RESOURCES"
+            return "{}"
+        if verb == "delete":
+            state["qrs"].pop(cmd[5], None)
+            return "{}"
+        if verb == "list":
+            return json.dumps([
+                {"name": f"projects/p/locations/z/queuedResources/{n}",
+                 "state": {"state": s}}
+                for n, s in state["qrs"].items()])
+        raise AssertionError(cmd)
+
+    ray_tpu.init(num_cpus=1)
+    daemon = None
+    autoscaler = None
+    try:
+        head = runtime_mod.get_current_runtime().head
+        addr = head.start_node_server("127.0.0.1", 0)
+        provider = TPUQueuedResourceProvider(
+            addr, head.cluster_key_hex, project="p", zone="z",
+            runner=fake_gcloud)
+
+        # the composed startup script carries the slice topology labels
+        script = provider.startup_script("raytpu-qr-test", "v5litepod-4")
+        assert "--num-tpus 4" in script
+        assert "ray-tpu-slice" in script and "raytpu-qr-test" in script
+        assert "TPU-v5litepod-4-head" in script
+
+        autoscaler = Autoscaler(head, provider, AutoscalerConfig(
+            max_workers=1, idle_timeout_s=60, interval_s=0.2,
+            node_config={"accelerator_type": "v5litepod-4",
+                         "num_tpus": 4}))
+
+        @ray_tpu.remote(num_tpus=1)
+        def on_slice():
+            ctx = ray_tpu.get_runtime_context()
+            return ctx.get_node_id()
+
+        ref = on_slice.remote()  # pending TPU demand drives a QR request
+        deadline = time.time() + 30
+        while time.time() < deadline and not state["qrs"]:
+            time.sleep(0.05)
+        assert state["qrs"], "autoscaler never requested a queued resource"
+        qr_name = next(iter(state["qrs"]))
+        create = next(c for c in calls if c[4] == "create")
+        assert f"--accelerator-type=v5litepod-4" in create
+        assert any(a.startswith("--metadata-from-file") for a in create)
+
+        # grant the QR and simulate host-0 bootstrapping with the
+        # provider's label contract (what the startup script runs)
+        state["qrs"][qr_name] = "ACTIVE"
+        labels = {"ray-tpu-slice": qr_name,
+                  "ray-tpu-accelerator": "v5litepod-4",
+                  "ray-tpu-worker": "0"}
+        daemon = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu", "start",
+             "--address", f"{addr[0]}:{addr[1]}",
+             "--key", head.cluster_key_hex,
+             "--num-cpus", "1", "--num-tpus", "4",
+             "--resources", json.dumps({"TPU-v5litepod-4-head": 1}),
+             "--labels", json.dumps(labels)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+        node_hex = ray_tpu.get(ref, timeout=120)
+        info = head.gcs.nodes.get(node_hex)
+        assert info is not None
+        assert info.labels.get("ray-tpu-slice") == qr_name
+        assert info.labels.get("ray-tpu-accelerator") == "v5litepod-4"
+        assert info.resources_total.get("TPU-v5litepod-4-head") == 1
+    finally:
+        if autoscaler is not None:
+            autoscaler.stop(terminate_nodes=False)
+        if daemon is not None:
+            daemon.terminate()
+        ray_tpu.shutdown()

@@ -146,7 +146,8 @@ def test_rolling_update_reconfigure(serve_instance):
         return "v2"
 
     handle = serve.run(v2.bind(), route_prefix=None)
-    deadline = time.time() + 20
+    # surge replica = a real worker cold start; generous under suite load
+    deadline = time.time() + 60
     while time.time() < deadline:
         if handle.remote(0).result() == "v2":
             break
