@@ -141,7 +141,7 @@ def test_tpu_queued_resource_provider_end_to_end():
         autoscaler = Autoscaler(head, provider, AutoscalerConfig(
             max_workers=1, idle_timeout_s=60, interval_s=0.2,
             node_config={"accelerator_type": "v5litepod-4",
-                         "num_tpus": 4}))
+                         "num_tpus": 4, "num_cpus": 1}))
 
         @ray_tpu.remote(num_tpus=1)
         def on_slice():
