@@ -100,6 +100,9 @@ class ActorHandle:
             actor_id=self._actor_id,
             pinned_args=[r.id for r in keepalive],
         )
+        from ray_tpu.util.tracing import current_context
+
+        spec.trace_ctx = current_context()
         refs = runtime.actor_method_call(spec)
         if streaming:
             from .object_ref import ObjectRefGenerator

@@ -142,6 +142,8 @@ class _ClientSession:
                                           args[1])
         if op == "state_list":
             return head.state_list(args[0], args[1])
+        if op in ("pub_publish", "pub_poll", "pub_cursor"):
+            return head.handle_worker_rpc(None, None, op, args)
         if op == "ping":
             return "pong"
         raise ValueError(f"unknown client op {op!r}")

@@ -804,7 +804,21 @@ class Node:
                     self._handle_store(w, req_id, op, args)
             elif tag == "rpc":
                 req_id, op, *args = payload
-                self._handler_pool.submit(self._handle_rpc, w, req_id, op, args)
+                if op == "pub_poll":
+                    # long-parking subscriber polls get their own thread —
+                    # they must not starve the bounded shared pool
+                    threading.Thread(
+                        target=self._handle_rpc, args=(w, req_id, op, args),
+                        daemon=True, name="pub-poll").start()
+                else:
+                    self._handler_pool.submit(self._handle_rpc, w, req_id,
+                                              op, args)
+            elif tag == "pub1":
+                # one-way fire-and-forget publish (tracing hot path)
+                try:
+                    self.head.publish_oneway(payload[0], payload[1])
+                except Exception:
+                    pass
             elif tag == "dsubmit":
                 # direct (head-bypass) submission from this worker
                 spec = pickle.loads(payload[0])

@@ -167,6 +167,9 @@ class RemoteHead:
     def apply_pin_delta(self, oids, delta: int) -> None:
         self._send("pin_delta", oids, delta)
 
+    def publish_oneway(self, channel: str, message) -> None:
+        self._send("pub1", channel, message)
+
     def on_worker_metrics(self, source_id: str, snapshot: dict) -> None:
         self._send("worker_metrics", source_id, snapshot)
 

@@ -1,0 +1,99 @@
+"""General pubsub: named channels, seq-cursored subscribers.
+
+Analog of the reference's pubsub service
+(``src/ray/pubsub/publisher.h:296`` Publisher/SubscriberState + the
+``SubscriberService`` channels in pubsub.proto) — the round-3 audit's
+"hard-wired broadcast tags, no general channel/subscriber service" gap.
+
+The broker lives on the head; every message gets a per-channel sequence
+number and lands in a bounded ring. Subscribers are CURSORS, not
+connections: a poll(channel, cursor, timeout) blocks on the broker's cv
+until messages past the cursor exist (or the bounded round ends), so
+subscribers survive head-link blips, duplicate nothing, and cost the
+broker zero state (the reference's long-poll semantics without per-
+subscriber server bookkeeping). A slow subscriber that falls more than
+the ring capacity behind observes a gap (returned explicitly) instead of
+unbounded buffering — the same overflow policy as the reference's
+publisher buffers.
+
+Public surface: ``ray_tpu.util.pubsub.publish/subscribe``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class PubsubBroker:
+    """Head-side channel registry (one per cluster)."""
+
+    def __init__(self, ring_capacity: int = 10_000):
+        self._cap = ring_capacity
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # channel -> (next_seq, ring of (seq, payload))
+        self._channels: Dict[str, Tuple[int, deque]] = {}
+        self._last_pub: Dict[str, float] = {}
+
+    def publish(self, channel: str, payload: Any) -> int:
+        """Append; returns the message's sequence number."""
+        with self._cv:
+            seq, ring = self._channels.get(channel, (0, None))
+            if ring is None:
+                ring = deque(maxlen=self._cap)
+            ring.append((seq, payload))
+            self._channels[channel] = (seq + 1, ring)
+            self._last_pub[channel] = time.monotonic()
+            self._cv.notify_all()
+            return seq
+
+    def gc(self, idle_ttl_s: float) -> int:
+        """Drop the payload rings of channels idle past the TTL; the
+        next_seq tombstone stays (an int), so late subscribers' cursors
+        remain valid and a future publish continues the sequence
+        (reference: publisher buffers are garbage-collected; the head
+        must not retain dead channels' payloads forever)."""
+        now = time.monotonic()
+        dropped = 0
+        with self._lock:
+            for ch, (seq, ring) in list(self._channels.items()):
+                if ring is None or not ring:
+                    continue
+                if now - self._last_pub.get(ch, 0.0) >= idle_ttl_s:
+                    self._channels[ch] = (seq, None)
+                    dropped += 1
+        return dropped
+
+    def poll(self, channel: str, cursor: int, timeout: float,
+             max_messages: int = 1000):
+        """One bounded long-poll round.
+
+        Returns (messages, next_cursor, gap): ``messages`` = payloads
+        with seq >= cursor (at most max_messages); ``gap`` is True when
+        the ring already dropped messages the cursor still expected
+        (subscriber fell behind by more than the ring capacity).
+        """
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._cv:
+            while True:
+                seq, ring = self._channels.get(channel, (0, None))
+                if ring:
+                    oldest = ring[0][0]
+                    if seq > cursor:
+                        gap = cursor < oldest
+                        start = max(cursor, oldest)
+                        msgs = [p for s, p in ring
+                                if s >= start][:max_messages]
+                        return msgs, start + len(msgs), gap
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [], cursor, False
+                self._cv.wait(min(remaining, 0.5))
+
+    def cursor(self, channel: str) -> int:
+        """The next-seq position (subscribe-from-now semantics)."""
+        with self._lock:
+            return self._channels.get(channel, (0, None))[0]

@@ -175,6 +175,9 @@ class RemoteFunction:
             runtime_env=runtime_env,
             pinned_args=[r.id for r in keepalive],
         )
+        from ray_tpu.util.tracing import current_context
+
+        spec.trace_ctx = current_context()
         refs = runtime.submit_task(spec)
         if streaming:
             from .object_ref import ObjectRefGenerator

@@ -157,6 +157,10 @@ class Head:
             store = FileStore(os.path.join(storage, "gcs"))
         self.gcs = GCS(store=store)
         self.gcs.add_job(JobInfo(self.job_id))
+        from .pubsub import PubsubBroker
+
+        # general pubsub channels (reference: src/ray/pubsub/publisher.h)
+        self.pubsub = PubsubBroker()
         self.scheduler = ClusterScheduler(self._dispatch_to_node)
         self.nodes: Dict[str, Node] = {}
         self._lock = threading.RLock()
@@ -201,6 +205,9 @@ class Head:
             time.sleep(period)
             try:
                 self.gc_task_records(cfg.task_record_ttl_s)
+                # idle pubsub rings fold to tombstones on the same cadence
+                self.pubsub.gc(idle_ttl_s=max(600.0,
+                                              cfg.task_record_ttl_s * 5))
             except Exception:
                 pass  # never let bookkeeping kill the sweeper
 
@@ -322,6 +329,10 @@ class Head:
         if now - getattr(self, "_last_view_broadcast", 0.0) > 0.5:
             self._last_view_broadcast = now
             self._broadcast_cluster_view()
+
+    def publish_oneway(self, channel: str, message) -> None:
+        """One-way pubsub publish from a node/worker (no reply)."""
+        self.pubsub.publish(channel, message)
 
     def apply_pin_delta(self, oids, delta: int) -> None:
         """Batched ref-count adjustment (direct-path arg pinning)."""
@@ -543,10 +554,20 @@ class Head:
                 self.on_sealed_payload(*payload)
             elif tag == "pin_delta":
                 self.apply_pin_delta(*payload)
+            elif tag == "pub1":
+                self.publish_oneway(*payload)
             elif tag == "req":
                 req_id, op, args = payload
-                self._daemon_pool.submit(self._handle_daemon_req, proxy,
-                                         req_id, op, args)
+                if op == "worker_rpc" and args and args[0] == "pub_poll":
+                    # parked subscriber polls must not occupy the bounded
+                    # daemon-request pool
+                    threading.Thread(
+                        target=self._handle_daemon_req,
+                        args=(proxy, req_id, op, args), daemon=True,
+                        name="pub-poll").start()
+                else:
+                    self._daemon_pool.submit(self._handle_daemon_req, proxy,
+                                             req_id, op, args)
 
     def _handle_daemon_req(self, proxy, req_id: int, op: str, args) -> None:
         try:
@@ -1610,6 +1631,16 @@ class Head:
         if op == "broadcast_object":
             return self.broadcast_object(
                 args[0], args[1] if len(args) > 1 else None)
+        if op == "pub_publish":
+            return self.pubsub.publish(args[0], args[1])
+        if op == "pub_poll":
+            # round length capped at 2s; the poll runs on a dedicated
+            # thread node-side, so parked subscribers can't starve the
+            # shared handler pools
+            return self.pubsub.poll(args[0], args[1], min(args[2], 2.0),
+                                    args[3] if len(args) > 3 else 1000)
+        if op == "pub_cursor":
+            return self.pubsub.cursor(args[0])
         if op == "cancel_task":
             self.cancel_task(args[0], args[1])
             return None

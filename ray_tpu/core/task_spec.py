@@ -82,6 +82,9 @@ class TaskSpec:
     # head path: soft scheduling preference for the node holding the
     # task's largest args (reference: lease_policy.h:56)
     locality_hex: Optional[str] = None
+    # cross-task trace context (trace_id, span_id) — reference:
+    # tracing_helper.py:88 propagates otel context inside the spec
+    trace_ctx: Optional[tuple] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)]

@@ -395,9 +395,16 @@ class WorkerRuntime:
                 lambda _f, tid=spec.task_id: self._staged.pop(tid, None))
 
     async def _execute_async(self, spec: TaskSpec, st: _ActorState) -> None:
+        span_cm = None
         try:
             if spec.task_id in self._cancelled:
                 raise TaskCancelledError(f"task {spec.task_id.hex()} cancelled")
+            if spec.trace_ctx is not None:
+                from ray_tpu.util.tracing import task_span
+
+                span_cm = task_span(spec)
+                if span_cm is not None:
+                    span_cm.__enter__()
             args, kwargs = self._resolve_args(spec)
             fn_name = spec.function_name.rsplit(".", 1)[-1]
             method = getattr(st.instance, fn_name)
@@ -408,6 +415,8 @@ class WorkerRuntime:
         except Exception as e:  # noqa: BLE001
             self._send_error(spec, e)
         finally:
+            if span_cm is not None:
+                span_cm.__exit__(None, None, None)
             self._current_task.task_id = None
             self._current_task.actor_id = None
 
@@ -531,9 +540,18 @@ class WorkerRuntime:
 
     def _execute(self, spec: TaskSpec, binding: Dict[str, List[int]]) -> None:
         restore_env = lambda: None  # noqa: E731
+        span_cm = None
         try:
             if spec.task_id in self._cancelled:
                 raise TaskCancelledError(f"task {spec.task_id.hex()} cancelled")
+            if spec.trace_ctx is not None:
+                # child span joins the caller's trace (reference:
+                # tracing_helper.py context propagation)
+                from ray_tpu.util.tracing import task_span
+
+                span_cm = task_span(spec)
+                if span_cm is not None:
+                    span_cm.__enter__()
             if binding:
                 self._apply_accelerator_binding(binding)
             if spec.runtime_env:
@@ -591,6 +609,8 @@ class WorkerRuntime:
         except Exception as e:  # noqa: BLE001
             self._send_error(spec, e)
         finally:
+            if span_cm is not None:
+                span_cm.__exit__(None, None, None)
             restore_env()
             self._current_task.task_id = None
             self._current_task.actor_id = None

@@ -1,0 +1,104 @@
+"""Public pubsub API over the head broker (reference: the pubsub
+channels of src/ray/pubsub/ exposed as a utility, the way
+ray.util.queue wraps the object store).
+
+    from ray_tpu.util import pubsub
+
+    sub = pubsub.subscribe("alerts")          # from-now cursor
+    pubsub.publish("alerts", {"sev": 1})
+    msgs = sub.poll(timeout=5)                # -> [{"sev": 1}]
+
+Works identically in the driver and inside tasks/actors (the worker path
+rides bounded head RPC rounds, so a poll never wedges a node thread).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+
+def _runtime():
+    from ray_tpu.core.runtime import get_current_runtime
+
+    rt = get_current_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    return rt
+
+
+def _call(op: str, *args):
+    rt = _runtime()
+    head = getattr(rt, "head", None)
+    if head is not None:  # in-process driver
+        return head.handle_worker_rpc(None, None, op, args)
+    rpc = getattr(rt, "rpc", None)
+    if rpc is not None:  # worker
+        return rpc.call("rpc", op, *args)
+    if hasattr(rt, "_call"):  # ray_tpu:// client driver
+        return rt._call(op, *args)
+    # local_mode: an in-process broker on the runtime object
+    broker = getattr(rt, "_pubsub_broker", None)
+    if broker is None:
+        from ray_tpu.core.pubsub import PubsubBroker
+
+        broker = rt._pubsub_broker = PubsubBroker()
+    if op == "pub_publish":
+        return broker.publish(*args)
+    if op == "pub_poll":
+        return broker.poll(*args)
+    return broker.cursor(*args)
+
+
+def publish(channel: str, message: Any) -> int:
+    """Publish to a named channel; returns the message's seq number."""
+    return _call("pub_publish", channel, message)
+
+
+def publish_nowait(channel: str, message: Any) -> None:
+    """Fire-and-forget publish: in workers this rides a one-way channel
+    message (no reply round trip — safe on hot paths / event loops)."""
+    rt = _runtime()
+    if getattr(rt, "head", None) is None and hasattr(rt, "channel"):
+        rt.channel.send("pub1", channel, message)
+        return
+    _call("pub_publish", channel, message)
+
+
+class Subscriber:
+    """Cursor over one channel; poll() never drops or duplicates unless
+    it fell behind the broker ring (then ``gap_observed`` flips True)."""
+
+    def __init__(self, channel: str, cursor: int):
+        self.channel = channel
+        self.cursor = cursor
+        self.gap_observed = False
+
+    def poll(self, timeout: float = 0.0,
+             max_messages: int = 1000) -> List[Any]:
+        """Messages published since the cursor (blocking up to timeout).
+        Bounded rounds client-side so no server thread parks for long."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        out: List[Any] = []
+        while True:
+            remaining = max(0.0, deadline - time.monotonic())
+            round_t = min(remaining, 1.0)
+            msgs, self.cursor, gap = _call(
+                "pub_poll", self.channel, self.cursor, round_t,
+                max_messages)
+            self.gap_observed = self.gap_observed or gap
+            out.extend(msgs)
+            if out or remaining <= round_t:
+                return out
+
+    def listen(self, poll_timeout: float = 1.0):
+        """Generator of messages, forever (daemon-thread consumers)."""
+        while True:
+            yield from self.poll(timeout=poll_timeout)
+
+
+def subscribe(channel: str, *, from_beginning: bool = False) -> Subscriber:
+    """Create a cursor; default = only messages published from now on
+    (matching the reference's subscribe-then-receive semantics)."""
+    cursor = 0 if from_beginning else _call("pub_cursor", channel)
+    return Subscriber(channel, cursor)

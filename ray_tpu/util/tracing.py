@@ -1,0 +1,132 @@
+"""Cross-task trace-context propagation + span recording.
+
+Reference: python/ray/util/tracing/tracing_helper.py:88 — remote calls
+carry the caller's OpenTelemetry context inside the TaskSpec so spans
+across task/actor boundaries join one trace. Same shape here without the
+otel dependency: a (trace_id, span_id) context rides ``spec.trace_ctx``;
+executors open a child span around user code and re-propagate to nested
+submissions; span records publish onto the general pubsub channel
+(``__tracing__``), so any process can collect a trace.
+
+    from ray_tpu.util import tracing
+
+    with tracing.trace("ingest") as root:
+        refs = [work.remote(x) for x in data]   # ctx propagates
+        ray_tpu.get(refs)
+    spans = tracing.get_spans(root.trace_id)    # driver + worker spans
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+_CHANNEL = "__tracing__"
+# contextvar (not a thread-local): asyncio isolates it per Task, so
+# interleaved traced calls on one async-actor event loop keep distinct
+# contexts and restores can't leak across coroutines
+_ctx_var: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_trace_ctx", default=None)
+
+
+class Span:
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str], name: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start = time.time()
+
+    def record(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "end": time.time(),
+        }
+
+
+def current_context() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) of the active span, or None. Stamped into
+    every TaskSpec submitted while active."""
+    return _ctx_var.get()
+
+
+def _set_context(ctx: Optional[Tuple[str, str]]) -> None:
+    _ctx_var.set(ctx)
+
+
+class _SpanCm:
+    def __init__(self, name: str, parent: Optional[Tuple[str, str]]):
+        if parent is not None:
+            trace_id, parent_span = parent
+        else:
+            trace_id, parent_span = uuid.uuid4().hex[:16], None
+        self.span = Span(trace_id, uuid.uuid4().hex[:8], parent_span, name)
+        self._saved = None
+
+    @property
+    def trace_id(self) -> str:
+        return self.span.trace_id
+
+    def __enter__(self) -> "_SpanCm":
+        self._saved = current_context()
+        _set_context((self.span.trace_id, self.span.span_id))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _set_context(self._saved)
+        _publish(self.span.record())
+        return None
+
+
+def trace(name: str) -> _SpanCm:
+    """Open a span (new root, or child of the active one). Tasks and
+    actor calls submitted inside carry the context."""
+    return _SpanCm(name, current_context())
+
+
+def _publish(record: dict) -> None:
+    try:
+        from ray_tpu.util import pubsub
+
+        # fire-and-forget: a blocking RPC here would stall the actor
+        # event loop / task thread on every traced completion
+        pubsub.publish_nowait(_CHANNEL, record)
+    except Exception:
+        pass  # tracing is best-effort; never fail user code
+
+
+def task_span(spec) -> Optional[_SpanCm]:
+    """Executor-side: child span around a traced task's user code
+    (installed by the worker runtime; returns None for untraced tasks)."""
+    ctx = getattr(spec, "trace_ctx", None)
+    if ctx is None:
+        return None
+    cm = _SpanCm(spec.function_name, tuple(ctx))
+    return cm
+
+
+def get_spans(trace_id: Optional[str] = None,
+              timeout: float = 2.0) -> List[Dict[str, Any]]:
+    """Collect recorded spans (optionally one trace), oldest first."""
+    from ray_tpu.util import pubsub
+
+    sub = pubsub.subscribe(_CHANNEL, from_beginning=True)
+    out = []
+    deadline = time.monotonic() + timeout
+    while True:
+        msgs = sub.poll(timeout=0.2)
+        out.extend(msgs)
+        if time.monotonic() > deadline:
+            break  # hard deadline even while spans keep arriving
+        if not msgs:
+            time.sleep(0.05)
+    if trace_id is not None:
+        out = [s for s in out if s.get("trace_id") == trace_id]
+    return sorted(out, key=lambda s: s["start"])

@@ -1,0 +1,120 @@
+"""General pubsub service (round-4; reference: src/ray/pubsub/
+publisher.h:296 — named channels, long-poll subscribers, bounded
+publisher buffers)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import pubsub
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+def test_publish_subscribe_driver(cluster):
+    sub = pubsub.subscribe("alerts")
+    assert sub.poll(timeout=0) == []
+    pubsub.publish("alerts", {"sev": 1})
+    pubsub.publish("alerts", {"sev": 2})
+    msgs = sub.poll(timeout=5)
+    assert msgs == [{"sev": 1}, {"sev": 2}]
+    assert sub.poll(timeout=0) == []  # cursor advanced, no duplicates
+
+
+def test_subscribe_from_now_skips_history(cluster):
+    pubsub.publish("hist", "old")
+    sub = pubsub.subscribe("hist")
+    pubsub.publish("hist", "new")
+    assert sub.poll(timeout=5) == ["new"]
+    sub_all = pubsub.subscribe("hist", from_beginning=True)
+    assert sub_all.poll(timeout=5) == ["old", "new"]
+
+
+def test_multiple_subscribers_fanout(cluster):
+    s1 = pubsub.subscribe("fan")
+    s2 = pubsub.subscribe("fan")
+    for i in range(5):
+        pubsub.publish("fan", i)
+    assert s1.poll(timeout=5) == list(range(5))
+    assert s2.poll(timeout=5) == list(range(5))
+
+
+def test_worker_and_actor_participation(cluster):
+    """Tasks publish, actors subscribe (and vice versa) — the channel is
+    cluster-global, not process-local."""
+    @ray_tpu.remote
+    class Listener:
+        def __init__(self):
+            self.sub = pubsub.subscribe("events")
+
+        def drain(self):
+            return self.sub.poll(timeout=10)
+
+    listener = Listener.remote()
+    ray_tpu.get(listener.drain.remote())  # ensure subscribed before pubs
+
+    @ray_tpu.remote
+    def emit(i):
+        return pubsub.publish("events", f"msg-{i}")
+
+    ray_tpu.get([emit.remote(i) for i in range(3)])
+    got = ray_tpu.get(listener.drain.remote(), timeout=60)
+    assert sorted(got) == ["msg-0", "msg-1", "msg-2"]
+
+
+def test_blocking_poll_wakes_on_publish(cluster):
+    sub = pubsub.subscribe("wake")
+    out = {}
+
+    def waiter():
+        t0 = time.monotonic()
+        out["msgs"] = sub.poll(timeout=30)
+        out["dt"] = time.monotonic() - t0
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    pubsub.publish("wake", "ping")
+    t.join(timeout=30)
+    assert out["msgs"] == ["ping"]
+    assert out["dt"] < 10  # woke on publish, not the full timeout
+
+
+def test_slow_subscriber_observes_gap(cluster):
+    from ray_tpu.core import runtime as runtime_mod
+
+    head = runtime_mod.get_current_runtime().head
+    head.pubsub._cap = 10  # shrink the ring for the test
+    sub = pubsub.subscribe("burst")
+    for i in range(50):
+        pubsub.publish("burst", i)
+    msgs = sub.poll(timeout=5)
+    assert msgs == list(range(40, 50))  # only the ring's tail
+    assert sub.gap_observed
+
+
+def test_pubsub_local_mode():
+    ray_tpu.init(local_mode=True)
+    try:
+        sub = pubsub.subscribe("lm")
+        pubsub.publish("lm", 1)
+        assert sub.poll(timeout=2) == [1]
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_ring_gc_keeps_cursors_valid(cluster):
+    from ray_tpu.core import runtime as runtime_mod
+
+    head = runtime_mod.get_current_runtime().head
+    pubsub.publish("gcch", "a")
+    sub = pubsub.subscribe("gcch")  # cursor at 1
+    assert head.pubsub.gc(idle_ttl_s=0) >= 1  # ring folds to tombstone
+    pubsub.publish("gcch", "b")  # sequence continues from the tombstone
+    assert sub.poll(timeout=5) == ["b"]

@@ -1,0 +1,59 @@
+"""Cross-task trace propagation (round-4; reference:
+python/ray/util/tracing/tracing_helper.py:88 — the caller's context
+rides the TaskSpec so spans across process boundaries join one trace)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def cluster():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_task_spans_join_the_callers_trace(cluster):
+    @ray_tpu.remote
+    def leaf(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def mid(x):
+        # nested submission inherits THIS task's span as parent
+        return ray_tpu.get(leaf.remote(x)) * 2
+
+    with tracing.trace("root") as root:
+        assert ray_tpu.get(mid.remote(10), timeout=120) == 22
+    spans = tracing.get_spans(root.trace_id, timeout=10)
+    names = {s["name"] for s in spans}
+    assert "root" in names and "mid" in names and "leaf" in names
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["mid"]["parent_id"] == by_name["root"]["span_id"]
+    assert by_name["leaf"]["parent_id"] == by_name["mid"]["span_id"]
+    assert len({s["trace_id"] for s in spans}) == 1
+
+
+def test_actor_calls_traced(cluster):
+    @ray_tpu.remote
+    class Worker:
+        def work(self, x):
+            return x * 3
+
+    a = Worker.remote()
+    with tracing.trace("actor-root") as root:
+        assert ray_tpu.get(a.work.remote(7), timeout=60) == 21
+    spans = tracing.get_spans(root.trace_id, timeout=10)
+    names = {s["name"] for s in spans}
+    assert any("work" in n for n in names)
+
+
+def test_untraced_tasks_record_nothing(cluster):
+    @ray_tpu.remote
+    def f():
+        return tracing.current_context()
+
+    # no active trace: no context propagates, no spans record
+    assert ray_tpu.get(f.remote(), timeout=60) is None
