@@ -156,6 +156,32 @@ def main():
 
     run("async_actor_calls_batch_1k", async_actor_batch, 1000)
 
+    # ---- head path comparison (regression gate: the direct path must
+    # beat routing every submit/finish through the head) ------------------
+    from ray_tpu.core.config import global_config as _gc
+
+    def _with_head_path(fn):
+        cfg = _gc()
+        cfg.direct_task_enabled = False
+        cfg.direct_actor_enabled = False
+        try:
+            fn()
+        finally:
+            cfg.direct_task_enabled = True
+            cfg.direct_actor_enabled = True
+
+    def headpath_tasks_batch():
+        _with_head_path(
+            lambda: ray_tpu.get([nop.remote() for _ in range(1000)]))
+
+    run("headpath_tasks_batch_1k", headpath_tasks_batch, 1000)
+
+    def headpath_actor_batch():
+        _with_head_path(
+            lambda: ray_tpu.get([a.m.remote() for _ in range(1000)]))
+
+    run("headpath_actor_calls_1k", headpath_actor_batch, 1000)
+
     # ---- wait -------------------------------------------------------------
     def wait_one():
         refs = [nop.remote() for _ in range(10)]
