@@ -100,9 +100,9 @@ class TestSAC:
 
 
 def test_sac_learns_pendulum():
-    """Learning gate: mean return rises from ~-1300 (random) to >= -900
+    """Learning gate: mean return rises from ~-1300 (random) to >= -600
     on Pendulum-v1 (reference: tuned_examples/sac/pendulum-sac.yaml
-    solves at ~-150; -900 proves clear learning within CI budget)."""
+    solves at ~-150; -600 proves strong learning within CI budget)."""
     cfg = (SACConfig()
            .environment("Pendulum-v1")
            .env_runners(num_env_runners=0, num_envs_per_env_runner=4,
@@ -121,4 +121,7 @@ def test_sac_learns_pendulum():
         if best >= -500:
             break
     algo.cleanup()
-    assert best >= -900, f"SAC failed to learn Pendulum: best={best}"
+    # round-4 tightening (round-3 audit: -900 "would pass a badly-tuned
+    # implementation"): convergence to the -500 early-exit lands well
+    # inside the CI budget on this contended box, so -600 is safe margin
+    assert best >= -600, f"SAC failed to learn Pendulum: best={best}"
