@@ -35,6 +35,7 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_binary_files,
     read_csv,
     read_datasource,
+    read_delta,
     read_images,
     read_json,
     read_numpy,
@@ -60,7 +61,7 @@ __all__ = [
     "from_blocks", "from_pandas", "from_arrow", "from_numpy",
     "read_parquet", "read_csv", "read_json", "read_numpy", "read_text",
     "read_binary_files", "read_sql", "from_torch", "read_datasource",
-    "read_images", "read_tfrecords", "read_webdataset",
+    "read_images", "read_tfrecords", "read_webdataset", "read_delta",
     "AggregateFn", "Count", "Sum",
     "Min", "Max", "Mean", "Std", "AbsMax", "Quantile", "Block",
     "BlockAccessor",

@@ -834,3 +834,16 @@ def read_webdataset(paths, *, decode: bool = True,
 
     return read_datasource(WebDatasetDatasource(paths, decode=decode),
                            parallelism=parallelism)
+
+
+def read_delta(table_path: str, *, version=None, columns=None,
+               parallelism: int = -1) -> Dataset:
+    """A Delta Lake table's active rows (reference: ray.data.read_delta
+    / the lakehouse connectors). Implements the open Delta log protocol
+    directly (JSON commits + parquet checkpoints); ``version`` time-
+    travels to that commit."""
+    from .datasource_ml import DeltaDatasource
+
+    return read_datasource(
+        DeltaDatasource(table_path, version=version, columns=columns),
+        parallelism=parallelism)

@@ -432,3 +432,90 @@ class WebDatasetDatasink(Datasink):
                         tf.addfile(info, io.BytesIO(data))
             written.append(fpath)
         return written
+
+
+# --------------------------------------------------------------------------
+# Delta Lake (lakehouse) reader
+# --------------------------------------------------------------------------
+
+
+def _delta_active_files(table_path: str,
+                        version: Optional[int] = None) -> List[str]:
+    """Replay the Delta transaction log -> active data files.
+
+    Implements the open Delta protocol directly (JSON commit files under
+    ``_delta_log/``, each a sequence of add/remove actions, plus optional
+    parquet checkpoints listed in ``_last_checkpoint``) — no deltalake
+    dependency (reference: ray.data.read_delta's role; the log replay is
+    the same add-minus-remove reconstruction the delta readers do).
+    ``version`` time-travels to that commit (inclusive).
+    """
+    log_dir = os.path.join(table_path, "_delta_log")
+    if not os.path.isdir(log_dir):
+        raise FileNotFoundError(f"{table_path} is not a Delta table "
+                                f"(no _delta_log/)")
+    active: Dict[str, bool] = {}
+    start_version = 0
+
+    # checkpoint fast-forward (only when not time-traveling before it)
+    ckpt_meta = os.path.join(log_dir, "_last_checkpoint")
+    if os.path.exists(ckpt_meta):
+        try:
+            meta = json.loads(open(ckpt_meta).read())
+            ckpt_v = int(meta["version"])
+        except (ValueError, KeyError):
+            ckpt_v = None
+        if ckpt_v is not None and (version is None or ckpt_v <= version):
+            import pyarrow.parquet as pq
+
+            ckpt = os.path.join(log_dir,
+                                f"{ckpt_v:020d}.checkpoint.parquet")
+            if os.path.exists(ckpt):
+                table = pq.read_table(ckpt)
+                for row in table.to_pylist():
+                    add = row.get("add")
+                    if add and add.get("path"):
+                        active[add["path"]] = True
+                    rem = row.get("remove")
+                    if rem and rem.get("path"):
+                        active.pop(rem["path"], None)
+                start_version = ckpt_v + 1
+
+    commits = []
+    for f in os.listdir(log_dir):
+        base = f.split(".")[0]
+        if f.endswith(".json") and base.isdigit():
+            v = int(base)
+            if v >= start_version and (version is None or v <= version):
+                commits.append((v, f))
+    for _v, f in sorted(commits):
+        with open(os.path.join(log_dir, f)) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                action = json.loads(line)
+                if "add" in action:
+                    active[action["add"]["path"]] = True
+                elif "remove" in action:
+                    active.pop(action["remove"]["path"], None)
+    return [os.path.join(table_path, p) for p in active]
+
+
+class DeltaDatasource(FileBasedDatasource):
+    """Delta-table reader: one read task per active parquet file."""
+
+    def __init__(self, table_path: str, *, version: Optional[int] = None,
+                 columns: Optional[List[str]] = None):
+        files = _delta_active_files(table_path, version)
+        if not files:
+            raise FileNotFoundError(
+                f"Delta table {table_path} has no active files"
+                + (f" at version {version}" if version is not None else ""))
+        self._paths = files
+        self._columns = columns
+
+    def _read_file(self, path: str):
+        import pyarrow.parquet as pq
+
+        yield pq.read_table(path, columns=self._columns)

@@ -157,3 +157,71 @@ def test_webdataset_roundtrip(cluster, tmp_path):
                                np.asarray([r for r in rows
                                            if r["__key__"] == "0003"
                                            ][0]["emb.npy"]))
+
+
+def _write_delta_table(root):
+    """Hand-build a Delta table: 3 commits incl. a remove + a checkpoint."""
+    import json as _json
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(os.path.join(root, "_delta_log"))
+
+    def data_file(name, ids):
+        pq.write_table(pa.table({"id": ids}), os.path.join(root, name))
+
+    def commit(v, actions):
+        with open(os.path.join(root, "_delta_log",
+                               f"{v:020d}.json"), "w") as f:
+            for a in actions:
+                f.write(_json.dumps(a) + "\n")
+
+    data_file("part-0.parquet", [1, 2])
+    data_file("part-1.parquet", [3, 4])
+    commit(0, [{"metaData": {"id": "t"}},
+               {"add": {"path": "part-0.parquet"}},
+               {"add": {"path": "part-1.parquet"}}])
+    # commit 1: compact part-0+part-1 into part-2
+    data_file("part-2.parquet", [1, 2, 3, 4, 5])
+    commit(1, [{"remove": {"path": "part-0.parquet"}},
+               {"remove": {"path": "part-1.parquet"}},
+               {"add": {"path": "part-2.parquet"}}])
+    data_file("part-3.parquet", [6])
+    commit(2, [{"add": {"path": "part-3.parquet"}}])
+
+
+def test_read_delta_log_replay_and_time_travel(cluster, tmp_path):
+    table = str(tmp_path / "delta")
+    _write_delta_table(table)
+    # latest: compacted file + the new add (removed files excluded)
+    rows = sorted(r["id"] for r in rd.read_delta(table).take_all())
+    assert rows == [1, 2, 3, 4, 5, 6]
+    # time travel to version 0: the original two files
+    rows0 = sorted(r["id"] for r in
+                   rd.read_delta(table, version=0).take_all())
+    assert rows0 == [1, 2, 3, 4]
+
+
+def test_read_delta_checkpoint(cluster, tmp_path):
+    import json as _json
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = str(tmp_path / "delta_ck")
+    _write_delta_table(table)
+    # checkpoint at version 1 (lists the state after the compaction)
+    ck = pa.table({
+        "add": [{"path": "part-2.parquet"}, None, None],
+        "remove": [None, {"path": "part-0.parquet"},
+                   {"path": "part-1.parquet"}],
+    })
+    pq.write_table(ck, os.path.join(
+        table, "_delta_log", f"{1:020d}.checkpoint.parquet"))
+    with open(os.path.join(table, "_delta_log", "_last_checkpoint"),
+              "w") as f:
+        f.write(_json.dumps({"version": 1}))
+    # replay = checkpoint state + commit 2 only
+    rows = sorted(r["id"] for r in rd.read_delta(table).take_all())
+    assert rows == [1, 2, 3, 4, 5, 6]
