@@ -20,7 +20,8 @@ from typing import Any, Dict, Iterable, List, Optional
 import numpy as np
 
 from .block import Block, BlockAccessor, build_block
-from .datasource import BlockMetadata, Datasink, FileBasedDatasource, ReadTask
+from .datasource import (BlockMetadata, Datasink, FileBasedDatasource,
+                         ParquetDatasource, ReadTask)
 
 # --------------------------------------------------------------------------
 # images
@@ -440,22 +441,32 @@ class WebDatasetDatasink(Datasink):
 
 
 def _delta_active_files(table_path: str,
-                        version: Optional[int] = None) -> List[str]:
-    """Replay the Delta transaction log -> active data files.
+                        version: Optional[int] = None):
+    """Replay the Delta transaction log -> [(file_path, partition_values)].
 
     Implements the open Delta protocol directly (JSON commit files under
-    ``_delta_log/``, each a sequence of add/remove actions, plus optional
-    parquet checkpoints listed in ``_last_checkpoint``) — no deltalake
-    dependency (reference: ray.data.read_delta's role; the log replay is
-    the same add-minus-remove reconstruction the delta readers do).
-    ``version`` time-travels to that commit (inclusive).
+    ``_delta_log/``, each a sequence of add/remove actions, plus parquet
+    checkpoints — single- or multi-part — named in ``_last_checkpoint``)
+    — no deltalake dependency (reference: ray.data.read_delta's role).
+    ``version`` time-travels to that commit (inclusive). Raises when the
+    log is not reconstructable (missing checkpoint parts / non-contiguous
+    commits after retention cleanup) instead of silently returning a
+    partial table.
     """
+    from urllib.parse import unquote
+
     log_dir = os.path.join(table_path, "_delta_log")
     if not os.path.isdir(log_dir):
         raise FileNotFoundError(f"{table_path} is not a Delta table "
                                 f"(no _delta_log/)")
-    active: Dict[str, bool] = {}
+    active: Dict[str, dict] = {}  # url-decoded rel path -> partitionValues
     start_version = 0
+
+    def apply(add, remove):
+        if add and add.get("path"):
+            active[unquote(add["path"])] = add.get("partitionValues") or {}
+        if remove and remove.get("path"):
+            active.pop(unquote(remove["path"]), None)
 
     # checkpoint fast-forward (only when not time-traveling before it)
     ckpt_meta = os.path.join(log_dir, "_last_checkpoint")
@@ -463,23 +474,29 @@ def _delta_active_files(table_path: str,
         try:
             meta = json.loads(open(ckpt_meta).read())
             ckpt_v = int(meta["version"])
+            parts = int(meta.get("parts") or 0)
         except (ValueError, KeyError):
-            ckpt_v = None
+            ckpt_v, parts = None, 0
         if ckpt_v is not None and (version is None or ckpt_v <= version):
             import pyarrow.parquet as pq
 
-            ckpt = os.path.join(log_dir,
-                                f"{ckpt_v:020d}.checkpoint.parquet")
-            if os.path.exists(ckpt):
-                table = pq.read_table(ckpt)
-                for row in table.to_pylist():
-                    add = row.get("add")
-                    if add and add.get("path"):
-                        active[add["path"]] = True
-                    rem = row.get("remove")
-                    if rem and rem.get("path"):
-                        active.pop(rem["path"], None)
-                start_version = ckpt_v + 1
+            if parts:
+                files = [os.path.join(
+                    log_dir,
+                    f"{ckpt_v:020d}.checkpoint.{i:010d}.{parts:010d}"
+                    f".parquet") for i in range(1, parts + 1)]
+            else:
+                files = [os.path.join(log_dir,
+                                      f"{ckpt_v:020d}.checkpoint.parquet")]
+            missing = [f for f in files if not os.path.exists(f)]
+            if missing:
+                raise FileNotFoundError(
+                    f"Delta checkpoint v{ckpt_v} named in _last_checkpoint "
+                    f"is missing parts: {missing} — table not readable")
+            for f in files:
+                for row in pq.read_table(f).to_pylist():
+                    apply(row.get("add"), row.get("remove"))
+            start_version = ckpt_v + 1
 
     commits = []
     for f in os.listdir(log_dir):
@@ -488,34 +505,64 @@ def _delta_active_files(table_path: str,
             v = int(base)
             if v >= start_version and (version is None or v <= version):
                 commits.append((v, f))
-    for _v, f in sorted(commits):
+    commits.sort()
+    # contiguity: after retention cleanup, a gap (or a start after the
+    # expected base) means the requested state is NOT reconstructable
+    expect = start_version
+    for v, _f in commits:
+        if v != expect:
+            raise FileNotFoundError(
+                f"Delta log gap: expected commit {expect}, found {v} "
+                f"(retention removed commits; cannot reconstruct"
+                + (f" version {version}" if version is not None else "")
+                + ")")
+        expect += 1
+    if version is not None and commits and commits[-1][0] != version:
+        raise FileNotFoundError(
+            f"Delta version {version} not found (latest commit: "
+            f"{commits[-1][0]})")
+    for _v, f in commits:
         with open(os.path.join(log_dir, f)) as fh:
             for line in fh:
                 line = line.strip()
                 if not line:
                     continue
                 action = json.loads(line)
-                if "add" in action:
-                    active[action["add"]["path"]] = True
-                elif "remove" in action:
-                    active.pop(action["remove"]["path"], None)
-    return [os.path.join(table_path, p) for p in active]
+                apply(action.get("add"), action.get("remove"))
+    return [(os.path.join(table_path, p), pv)
+            for p, pv in active.items()]
 
 
-class DeltaDatasource(FileBasedDatasource):
-    """Delta-table reader: one read task per active parquet file."""
+class DeltaDatasource(ParquetDatasource):
+    """Delta-table reader: one read task per active parquet file;
+    partition columns (stored in the log, not the files) are attached as
+    constant columns per file."""
 
     def __init__(self, table_path: str, *, version: Optional[int] = None,
                  columns: Optional[List[str]] = None):
-        files = _delta_active_files(table_path, version)
-        if not files:
-            raise FileNotFoundError(
-                f"Delta table {table_path} has no active files"
-                + (f" at version {version}" if version is not None else ""))
-        self._paths = files
+        entries = _delta_active_files(table_path, version)
+        # empty is a VALID table state (e.g. after DELETE-all)
+        self._paths = [p for p, _pv in entries]
+        self._partitions = {p: pv for p, pv in entries}
         self._columns = columns
 
+    def get_read_tasks(self, parallelism: int):
+        if not self._paths:
+            return [ReadTask(lambda: [build_block([])],
+                             BlockMetadata(num_rows=0))]
+        return super().get_read_tasks(parallelism)
+
     def _read_file(self, path: str):
+        import pyarrow as pa
         import pyarrow.parquet as pq
 
-        yield pq.read_table(path, columns=self._columns)
+        pv = self._partitions.get(path) or {}
+        file_cols = (None if self._columns is None
+                     else [c for c in self._columns if c not in pv])
+        table = pq.read_table(path, columns=file_cols)
+        for name, value in pv.items():
+            if self._columns is not None and name not in self._columns:
+                continue
+            table = table.append_column(
+                name, pa.array([value] * table.num_rows))
+        yield table

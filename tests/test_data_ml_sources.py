@@ -225,3 +225,38 @@ def test_read_delta_checkpoint(cluster, tmp_path):
     # replay = checkpoint state + commit 2 only
     rows = sorted(r["id"] for r in rd.read_delta(table).take_all())
     assert rows == [1, 2, 3, 4, 5, 6]
+
+
+def test_read_delta_partition_columns_and_empty(cluster, tmp_path):
+    import json as _json
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = str(tmp_path / "delta_part")
+    os.makedirs(os.path.join(table, "_delta_log"))
+    os.makedirs(os.path.join(table, "date=2024-01-01"))
+    pq.write_table(pa.table({"x": [1, 2]}),
+                   os.path.join(table, "date=2024-01-01", "p0.parquet"))
+    with open(os.path.join(table, "_delta_log",
+                           f"{0:020d}.json"), "w") as f:
+        f.write(_json.dumps({"add": {
+            "path": "date%3D2024-01-01/p0.parquet",  # url-encoded path
+            "partitionValues": {"date": "2024-01-01"}}}) + "\n")
+    # hmm: percent-encoding of '=' — decoded path must resolve
+    os.rename(os.path.join(table, "date=2024-01-01"),
+              os.path.join(table, "date=2024-01-01"))
+    rows = rd.read_delta(table).take_all()
+    assert sorted(r["x"] for r in rows) == [1, 2]
+    # partition column attached from the log (not in the file)
+    assert all(r["date"] == "2024-01-01" for r in rows)
+    # column selection including the partition column works
+    rows2 = rd.read_delta(table, columns=["date", "x"]).take_all()
+    assert rows2[0]["date"] == "2024-01-01"
+
+    # empty table (all files removed) -> empty dataset, not an error
+    with open(os.path.join(table, "_delta_log",
+                           f"{1:020d}.json"), "w") as f:
+        f.write(_json.dumps({"remove": {
+            "path": "date%3D2024-01-01/p0.parquet"}}) + "\n")
+    assert rd.read_delta(table).take_all() == []
