@@ -219,6 +219,15 @@ def apply_runtime_env(env: Optional[dict], runtime):
                 sys.path.remove(p)
             except ValueError:
                 pass
+        if added_paths:
+            # modules imported FROM the env must not leak into later
+            # tasks through the sys.modules cache (the path alone is not
+            # the isolation boundary)
+            roots = tuple(os.path.abspath(p) + os.sep for p in added_paths)
+            for name, mod in list(sys.modules.items()):
+                f = getattr(mod, "__file__", None)
+                if f and os.path.abspath(f).startswith(roots):
+                    sys.modules.pop(name, None)
 
     try:
         for k, v in (env.get("env_vars") or {}).items():
