@@ -71,6 +71,11 @@ class Config:
     # while head-dispatched (resource-bound) work is waiting — prevents a
     # direct-task flood from starving scheduler-placed tasks
     direct_slot_fraction: float = 0.85
+    # idle nodes pull queued direct tasks from the deepest-queued peer
+    # (work stealing — spillback is otherwise submit-time-only); 0 = off
+    direct_steal_enabled: bool = True
+    direct_steal_min_queue: int = 2  # only steal from peers at least this deep
+    direct_steal_interval_ms: int = 50
 
     # ---- tasks / fault tolerance (reference: ray_config_def.h:138,414,835) ----
     task_retry_delay_ms: int = 0

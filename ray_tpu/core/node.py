@@ -114,6 +114,11 @@ class Node:
         with self._lock:
             for _ in range(min(cfg.worker_prestart_count, self.max_workers)):
                 self._start_worker_locked()
+        if cfg.direct_steal_enabled:
+            # idle nodes get no pump events: a slow heartbeat re-evaluates
+            # stealing (rate-limited + cheap-idle-checked inside)
+            threading.Thread(target=self._steal_ticker, daemon=True,
+                             name=f"steal-{self.hex[:6]}").start()
 
     # ------------------------------------------------------------ dispatch
 
@@ -337,6 +342,14 @@ class Node:
             else:
                 return
         if peer_hex is not None:
+            if isinstance(peer_hex, tuple) and peer_hex[0] == "_stolen":
+                # stolen over TCP: the victim's server conn is duplex —
+                # forward the cancel to the thief
+                try:
+                    peer_hex[1].send("pcancel", task_id, force)
+                except (OSError, EOFError):
+                    pass
+                return
             if not isinstance(peer_hex, str):
                 # in-process peer Node: cancel it there directly
                 peer_hex.cancel_direct(task_id, force)
@@ -480,6 +493,14 @@ class Node:
                 tag, payload = ch.recv()
             except (EOFError, OSError, TypeError):
                 break
+            if tag == "pstolen":
+                # work we asked to steal: execute here, reply over ch
+                try:
+                    spec = pickle.loads(payload[0])
+                except Exception:
+                    continue
+                self.submit_direct(spec, ("peer", ch))
+                continue
             if tag == "pdone":
                 try:
                     task_id, err_name, results, exec_hex = payload
@@ -657,6 +678,126 @@ class Node:
                 w.channel.send("unstage", tid)
             except OSError:
                 self._on_worker_dead(w)
+        if not to_send and not unstage:
+            # nothing to do locally: try pulling work from a loaded peer
+            self._maybe_steal()
+
+    # ---- work stealing ---------------------------------------------------
+    # (round 4, audit weak #7: spillback was submit-time-only — a task
+    # queued behind a long task was never re-balanced. Idle nodes now PULL
+    # queued direct tasks from the deepest-queued peer over the same mesh
+    # the spill push uses; reference analog: LocalTaskManager spillback
+    # re-evaluation, inverted into a thief-initiated protocol.)
+
+    def _steal_ticker(self) -> None:
+        while self.alive:
+            time.sleep(0.5)
+            try:
+                self._maybe_steal()
+            except Exception:
+                pass
+
+    def _maybe_steal(self) -> None:
+        cfg = global_config()
+        if not cfg.direct_steal_enabled:
+            return
+        now = time.monotonic()
+        if now - getattr(self, "_last_steal", 0.0) < \
+                cfg.direct_steal_interval_ms / 1000.0:
+            return
+        self._last_steal = now
+        with self._lock:
+            if self._local_queue or not self._idle:
+                return
+            free = sum(1 for w in self._workers.values()
+                       if w.state == "idle")
+        cands = self._peer_candidates()
+        if not cands:
+            return
+        cands.sort(key=lambda c: -c[2])
+        peer_hex, handle, queue = cands[0]
+        if queue < cfg.direct_steal_min_queue:
+            return
+        want = max(1, min(free, queue // 2))
+        if not isinstance(handle, (tuple, list)):
+            # in-process peer: pop eligible tasks directly
+            for spec, origin in handle._pop_stealable(want):
+                with handle._lock:
+                    handle._forwarded[spec.task_id] = (origin, spec, self)
+                self.submit_direct(spec, ("node", handle, origin))
+            return
+        ch = self._peer_channel(peer_hex, handle)
+        if ch is None:
+            return
+        try:
+            ch.send("psteal", want)
+        except (OSError, EOFError):
+            self._drop_peer(peer_hex)
+
+    def _pop_stealable(self, k: int):
+        """Victim side: hand over up to k queued, unstarted direct plain
+        tasks (skip actor creations, resource-bound, already-hopped-out
+        tasks). Returns [(spec, origin)] with the _direct entries removed
+        — the caller forwards them and owns the reply routing."""
+        out = []
+        with self._lock:
+            keep = deque()
+            while self._local_queue and len(out) < k:
+                spec, binding = self._local_queue.pop()  # steal the TAIL
+                entry = self._direct.get(spec.task_id)
+                if (entry is None or binding or spec.is_actor_creation
+                        or spec.actor_id is not None
+                        or spec.direct_hops >= 2):
+                    keep.appendleft((spec, binding))
+                    continue
+                del self._direct[spec.task_id]
+                spec.direct_hops += 1
+                out.append((spec, entry[0]))
+            self._local_queue.extend(keep)
+        return out
+
+    def _serve_steal(self, ch: Channel, k: int) -> None:
+        """Victim side of a remote steal: ship tasks; replies come back
+        over the same channel ('pdone' handled by _serve_peer)."""
+        marker = ("_stolen", ch)
+        stolen = self._pop_stealable(int(k))
+        for i, (spec, origin) in enumerate(stolen):
+            with self._lock:
+                self._forwarded[spec.task_id] = (origin, spec, marker)
+            try:
+                ch.send("pstolen", pickle.dumps(spec))
+            except (OSError, EOFError):
+                # thief gone: run the rest ourselves (every popped task
+                # must land somewhere — a dropped one hangs its owner)
+                for spec2, origin2 in stolen[i:]:
+                    with self._lock:
+                        self._forwarded.pop(spec2.task_id, None)
+                        spec2.direct_hops -= 1
+                        self._direct[spec2.task_id] = (origin2, spec2,
+                                                       time.time())
+                    self.dispatch(spec2, {})
+                return
+
+    def on_peer_session_closed(self, ch) -> None:
+        """A peer session (thief) died: fail its in-flight stolen tasks
+        back to their owners (they retry per max_retries)."""
+        marker = ("_stolen", ch)
+        with self._lock:
+            lost = [(tid, e) for tid, e in self._forwarded.items()
+                    if e[2] == marker]
+            for tid, _e in lost:
+                self._forwarded.pop(tid, None)
+        for tid, (origin, spec, _m) in lost:
+            self._reply_direct(origin, tid, "NodeDiedError", [])
+
+    def on_peer_done(self, task_id, err_name, results, exec_hex) -> None:
+        """A completion for a task we handed to a peer (stolen or
+        spilled) arriving over either peer-session direction."""
+        with self._lock:
+            entry = self._forwarded.pop(task_id, None)
+        if entry is not None:
+            self._reply_direct(entry[0], task_id, err_name, results,
+                               exec_hex)
 
     def _direct_running_locked(self) -> int:
         """Worker slots currently held by direct (head-bypass) tasks."""

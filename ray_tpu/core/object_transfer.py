@@ -192,19 +192,28 @@ class ObjectServer:
         from .protocol import Channel
 
         ch = Channel(conn)
-        while self._alive:
-            try:
-                tag, payload = ch.recv()
-            except (EOFError, OSError, TypeError):
-                return  # origin node gone; in-flight replies fail silently
-            if tag == "psubmit":
+        try:
+            while self._alive:
                 try:
-                    spec = pickle.loads(payload[0])
-                except Exception:
-                    continue
-                self.node.submit_direct(spec, ("peer", ch))
-            elif tag == "pcancel":
-                self.node.cancel_direct(payload[0], payload[1])
+                    tag, payload = ch.recv()
+                except (EOFError, OSError, TypeError):
+                    return  # origin gone; stolen tasks fail in finally
+                if tag == "psubmit":
+                    try:
+                        spec = pickle.loads(payload[0])
+                    except Exception:
+                        continue
+                    self.node.submit_direct(spec, ("peer", ch))
+                elif tag == "pcancel":
+                    self.node.cancel_direct(payload[0], payload[1])
+                elif tag == "psteal":
+                    # idle peer pulls queued work (work stealing)
+                    self.node._serve_steal(ch, payload[0])
+                elif tag == "pdone":
+                    # completion of a task this node handed to the peer
+                    self.node.on_peer_done(*payload)
+        finally:
+            self.node.on_peer_session_closed(ch)
 
     def close(self) -> None:
         self._alive = False

@@ -1,0 +1,68 @@
+"""Work stealing: idle nodes pull queued direct tasks from loaded peers
+(round-4; closes the round-3 audit's 'spillback is submit-time-only'
+weakness — a task queued behind long work now re-balances)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core.config import global_config
+
+
+@pytest.fixture
+def no_submit_spill():
+    """Disable submit-time spillback so re-balancing can ONLY happen via
+    stealing."""
+    cfg = global_config()
+    saved = cfg.direct_spill_queue_factor
+    cfg.direct_spill_queue_factor = 10_000.0
+    yield
+    cfg.direct_spill_queue_factor = saved
+
+
+def _run_burst(n2):
+    # long enough that the queue outlives daemon worker cold-start (~3s)
+    # plus a couple of syncer/steal ticks
+    @ray_tpu.remote
+    def slowish(i):
+        time.sleep(0.15)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nodes = ray_tpu.get([slowish.remote(i) for i in range(60)],
+                        timeout=240)
+    return set(nodes)
+
+
+def test_idle_inprocess_peer_steals(no_submit_spill):
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    n2 = cluster.add_node(num_cpus=2)
+    try:
+        nodes = _run_burst(n2)
+        assert n2.hex in nodes, "idle peer never stole queued work"
+    finally:
+        cluster.shutdown()
+
+
+def test_idle_daemon_steals_over_tcp(no_submit_spill):
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    n2 = cluster.add_node(num_cpus=2, separate_process=True)
+    try:
+        nodes = _run_burst(n2)
+        assert n2.hex in nodes, "idle daemon never stole over TCP"
+    finally:
+        cluster.shutdown()
+
+
+def test_stealing_disabled_keeps_work_local(no_submit_spill):
+    cfg = global_config()
+    cfg.direct_steal_enabled = False
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    n2 = cluster.add_node(num_cpus=2)
+    try:
+        nodes = _run_burst(n2)
+        assert n2.hex not in nodes
+    finally:
+        cfg.direct_steal_enabled = True
+        cluster.shutdown()
