@@ -105,6 +105,12 @@ class Node:
         # by a syncer period, so without this a submission burst dogpiles
         # whichever peer last reported the lowest load
         self._peer_inflight: Dict[str, int] = {}
+        # peer-gossiped load: hex -> (version, queue_depth, recv_ts).
+        # Fresh entries overlay the head's cluster-view queue numbers,
+        # which lag by a report period (reference: RaySyncer peer bidi
+        # streams vs star rebroadcast — round-3 audit weak #10)
+        self._peer_loads: Dict[str, tuple] = {}
+        self._gossip_version = 0
         self._peer_lock = threading.Lock()
         self._peer_key: Optional[bytes] = None    # set by start_object_server
         self._devents: List[tuple] = []           # batched head event reports
@@ -390,7 +396,11 @@ class Node:
         if not cands:
             return False
         with self._peer_lock:
-            cands = [(h, handle, q + self._peer_inflight.get(h, 0))
+            now = time.monotonic()
+            fresh = {h: q for h, (v, q, ts) in self._peer_loads.items()
+                     if now - ts < 2.0}
+            cands = [(h, handle,
+                      fresh.get(h, q) + self._peer_inflight.get(h, 0))
                      for h, handle, q in cands]
         cands.sort(key=lambda c: c[2])
         peer_hex, handle, queue = cands[0]
@@ -493,6 +503,9 @@ class Node:
                 tag, payload = ch.recv()
             except (EOFError, OSError, TypeError):
                 break
+            if tag == "pload":
+                self.on_peer_load(*payload)
+                continue
             if tag == "pstolen":
                 # work we asked to steal: execute here, reply over ch
                 try:
@@ -693,9 +706,35 @@ class Node:
         while self.alive:
             time.sleep(0.5)
             try:
+                self._gossip_load()
                 self._maybe_steal()
             except Exception:
                 pass
+
+    def _gossip_load(self) -> None:
+        """Push this node's queue depth to every established peer
+        channel (one-way). Only connected peers hear it — exactly the
+        nodes actively exchanging work, where freshness matters."""
+        with self._peer_lock:
+            chans = list(self._peers.items())
+        if not chans:
+            return
+        self._gossip_version += 1
+        with self._lock:
+            depth = len(self._local_queue)
+        for peer_hex, ch in chans:
+            try:
+                ch.send("pload", self.hex, depth, self._gossip_version)
+            except (OSError, EOFError):
+                pass  # peer death handled by its reader
+
+    def on_peer_load(self, peer_hex: str, depth: int,
+                     version: int) -> None:
+        with self._peer_lock:
+            cur = self._peer_loads.get(peer_hex)
+            if cur is None or version >= cur[0]:
+                self._peer_loads[peer_hex] = (version, depth,
+                                              time.monotonic())
 
     def _maybe_steal(self) -> None:
         cfg = global_config()
@@ -714,6 +753,11 @@ class Node:
         cands = self._peer_candidates()
         if not cands:
             return
+        with self._peer_lock:
+            now = time.monotonic()
+            fresh = {h: q for h, (v, q, ts) in self._peer_loads.items()
+                     if now - ts < 2.0}
+        cands = [(h, handle, fresh.get(h, q)) for h, handle, q in cands]
         cands.sort(key=lambda c: -c[2])
         peer_hex, handle, queue = cands[0]
         if queue < cfg.direct_steal_min_queue:

@@ -206,6 +206,8 @@ class ObjectServer:
                     self.node.submit_direct(spec, ("peer", ch))
                 elif tag == "pcancel":
                     self.node.cancel_direct(payload[0], payload[1])
+                elif tag == "pload":
+                    self.node.on_peer_load(*payload)
                 elif tag == "psteal":
                     # idle peer pulls queued work (work stealing)
                     self.node._serve_steal(ch, payload[0])
