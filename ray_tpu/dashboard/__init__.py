@@ -68,6 +68,21 @@ refresh(); setInterval(refresh, 2000);
 </script></body></html>"""
 
 
+def _json_safe_list(msgs):
+    """Best-effort JSON projection of pubsub payloads (arbitrary python
+    objects publish fine; the HTTP surface shows their repr)."""
+    import json as _json
+
+    out = []
+    for m in msgs:
+        try:
+            _json.dumps(m)
+            out.append(m)
+        except (TypeError, ValueError):
+            out.append(repr(m))
+    return out
+
+
 class DashboardServer:
     """Stdlib HTTP server bound to a Head (+ optional JobManager)."""
 
@@ -166,6 +181,19 @@ class DashboardServer:
             h._json(self.head.state_list(path.rsplit("/", 1)[1], limit))
         elif path == "/api/jobs" or path == "/api/jobs/":
             h._json([j.to_dict() for j in self._jm().list_jobs()])
+        elif path == "/api/serve":
+            # Serve module (reference: dashboard/modules/serve): the
+            # controller's deployment summary, or {} when Serve is down
+            h._json(self._serve_summary())
+        elif path == "/api/pubsub":
+            # poll a pubsub channel over HTTP (tracing/event consumers):
+            # /api/pubsub?channel=X&cursor=N&timeout=S
+            channel = params.get("channel", "")
+            cursor = int(params.get("cursor", 0))
+            t = min(float(params.get("timeout", 0.0)), 10.0)
+            msgs, nxt, gap = self.head.pubsub.poll(channel, cursor, t)
+            h._json({"messages": _json_safe_list(msgs),
+                     "cursor": nxt, "gap": gap})
         else:
             m = self._JOB_RE.match(path)
             if m and (m.group(2) or "") == "/logs":
@@ -190,6 +218,22 @@ class DashboardServer:
                     h._json({"error": "not found"}, 404)
             else:
                 h._json({"error": "not found"}, 404)
+
+    def _serve_summary(self) -> dict:
+        import ray_tpu
+
+        try:
+            info = self.head.gcs.get_named_actor("SERVE_CONTROLLER",
+                                                 "default")
+            if info is None or info.state == "DEAD":
+                return {}
+            from ray_tpu.core.actor import ActorHandle
+
+            handle = ActorHandle(info.actor_id, info.class_name)
+            return ray_tpu.get(handle.list_deployments.remote(),
+                               timeout=10)
+        except Exception:
+            return {}
 
     def _authorized(self, h) -> bool:
         if not self.auth_token:

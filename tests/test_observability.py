@@ -92,3 +92,49 @@ def test_log_to_driver(ray_start_regular, capfd):
             return
         time.sleep(0.2)
     pytest.fail("worker stdout was not tailed to the driver")
+
+
+def test_dashboard_serve_and_pubsub_endpoints():
+    """Round-4 dashboard modules: /api/serve (deployment summary) and
+    /api/pubsub (HTTP channel polling) — reference: dashboard/modules/
+    serve + the pubsub surface."""
+    import json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.util import pubsub
+
+    ray_tpu.init(num_cpus=2)
+    dash = None
+    try:
+        dash = start_dashboard(port=0)
+        base = f"http://127.0.0.1:{dash.address[1]}"
+
+        # no serve instance yet -> {}
+        with urllib.request.urlopen(base + "/api/serve", timeout=10) as r:
+            assert json.loads(r.read()) == {}
+
+        @serve.deployment
+        def hello(x):
+            return "hi"
+
+        serve.run(hello.bind(), route_prefix=None)
+        with urllib.request.urlopen(base + "/api/serve", timeout=30) as r:
+            summary = json.loads(r.read())
+        assert "hello" in summary
+        assert summary["hello"]["num_replicas"] >= 1
+
+        pubsub.publish("dash-chan", {"k": 1})
+        pubsub.publish("dash-chan", {"k": 2})
+        url = base + "/api/pubsub?channel=dash-chan&cursor=0&timeout=2"
+        with urllib.request.urlopen(url, timeout=20) as r:
+            body = json.loads(r.read())
+        assert body["messages"] == [{"k": 1}, {"k": 2}]
+        assert body["cursor"] == 2
+    finally:
+        if dash is not None:
+            dash.stop()
+        serve.shutdown()
+        ray_tpu.shutdown()
