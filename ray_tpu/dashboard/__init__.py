@@ -15,6 +15,8 @@ control plane — with:
     GET  /api/jobs/<id>/logs    driver log text
     POST /api/jobs/<id>/stop    stop the driver
     DELETE /api/jobs/<id>       delete a terminal job
+    GET  /api/serve             Serve deployment summary
+    GET  /api/pubsub?channel=&cursor=&timeout=   poll a pubsub channel
 """
 
 from __future__ import annotations
