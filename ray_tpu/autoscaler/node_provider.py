@@ -207,6 +207,10 @@ class TPUQueuedResourceProvider(NodeProvider):
             "ray-tpu-worker": "${TPU_WORKER_ID}",
         }
         head_res = json.dumps({f"TPU-{accelerator_type}-head": 1})
+        # The labels JSON must ride inside DOUBLE quotes so the shell
+        # expands ${TPU_WORKER_ID} per host (single quotes would register
+        # every host with the literal string '${TPU_WORKER_ID}').
+        labels_sh = json.dumps(labels).replace('"', '\\"')
         return (
             "#!/bin/bash\n"
             f"RES='{{}}'\n"
@@ -214,7 +218,7 @@ class TPUQueuedResourceProvider(NodeProvider):
             f"python -m ray_tpu start --address {self._address} "
             f"--key {self._key} --num-tpus {per_host} "
             f"--resources \"$RES\" "
-            f"--labels '{json.dumps(labels)}'\n"
+            f"--labels \"{labels_sh}\"\n"
         )
 
     # ---- provider interface ---------------------------------------------

@@ -252,7 +252,12 @@ class Node:
             if not self.dispatch_to_worker(wid, spec):
                 with self._lock:
                     self._direct.pop(spec.task_id, None)
-                self._reply_direct(origin, spec.task_id, "ActorDiedError", [])
+                # delivery provably failed (worker gone or send raised
+                # before the call hit the wire): a location error — the
+                # owner re-resolves and resubmits without consuming the
+                # max_task_retries budget (never-executed is always safe)
+                self._reply_direct(origin, spec.task_id,
+                                   "ActorMissingError", [])
             return
         target = spec.actor_node_hex
         if (target is None or target == self.hex or origin[0] == "peer"
@@ -406,11 +411,11 @@ class Node:
         peer_hex, handle, queue = cands[0]
         if queue >= depth:
             return False  # everyone is as busy as we are
-        spec.direct_hops += 1
         if not isinstance(handle, (tuple, list)):
             # in-process peer Node: direct call, reply hops back through us.
             # Tracked in _forwarded (peer stored as the Node object) so
             # cancel_direct can reach the peer's queue/worker.
+            spec.direct_hops += 1
             with self._lock:
                 self._forwarded[spec.task_id] = (origin, spec, handle)
             handle.submit_direct(spec, ("node", self, origin))
@@ -418,6 +423,9 @@ class Node:
         ch = self._peer_channel(peer_hex, handle)
         if ch is None:
             return False
+        # Stamp the hop only once delivery is committed — a failed spill
+        # must leave the task eligible for later stealing/rebalancing.
+        spec.direct_hops += 1
         with self._lock:
             self._forwarded[spec.task_id] = (origin, spec, peer_hex)
         with self._peer_lock:
@@ -426,6 +434,7 @@ class Node:
         try:
             ch.send("psubmit", pickle.dumps(spec))
         except (OSError, EOFError):
+            spec.direct_hops -= 1
             with self._lock:
                 self._forwarded.pop(spec.task_id, None)
             self._drop_peer(peer_hex)
@@ -1124,13 +1133,21 @@ class Node:
             w.state = "dead"
             self._workers.pop(w.worker_id, None)
             lost = self._drop_actor_direct_locked(w)
-        for origin, spec in lost:
-            self._reply_direct(origin, spec.task_id, "ActorDiedError", [])
+        for origin, spec, err in lost:
+            self._reply_direct(origin, spec.task_id, err, [])
         self.head.on_worker_exit(self, w)
 
     def _drop_actor_direct_locked(self, w: WorkerHandle):
         """Remove a dead actor worker from the routing index and collect
-        its in-flight direct calls (they fail back to their owners)."""
+        its in-flight direct calls as (origin, spec, err_name).
+
+        Every ``_direct`` actor entry was already channel-sent to the
+        worker process (``_submit_direct_actor`` dispatches immediately),
+        so any of them MAY have executed: at-most-once demands
+        ActorDiedError (retries consume max_task_retries). The
+        provably-undelivered case — dispatch_to_worker failing — bounces
+        ActorMissingError at submit time instead (never-executed ->
+        always safe to resubmit, direct.py protocol)."""
         if w.actor_id is None:
             return []
         if self._actor_workers.get(w.actor_id) == w.worker_id:
@@ -1139,7 +1156,7 @@ class Node:
         for tid, (origin, spec, _t0) in list(self._direct.items()):
             if spec.actor_id == w.actor_id:
                 del self._direct[tid]
-                lost.append((origin, spec))
+                lost.append((origin, spec, "ActorDiedError"))
         return lost
 
     def _on_worker_dead(self, w: WorkerHandle) -> None:
@@ -1161,8 +1178,8 @@ class Node:
         # direct tasks: the OWNER retries — report the crash straight back
         for origin, spec, _t0 in direct:
             self._reply_direct(origin, spec.task_id, "WorkerCrashedError", [])
-        for origin, spec in lost_actor:
-            self._reply_direct(origin, spec.task_id, "ActorDiedError", [])
+        for origin, spec, err in lost_actor:
+            self._reply_direct(origin, spec.task_id, err, [])
         if head_assigned:
             for spec, binding, _attempt in head_assigned:
                 self.head.on_worker_crashed(self, w, spec, binding, prev_state)

@@ -296,9 +296,23 @@ def _deploy_graph(output, route_prefix: Optional[str],
         handles[stage_name] = DeploymentHandle(_controller, stage_name)
     deadline = time.time() + wait_timeout
     for stage_name in stage_apps:
-        while time.time() < deadline:
-            if ray_tpu.get(_controller.deployment_ready.remote(stage_name)):
-                break
+        while not ray_tpu.get(
+                _controller.deployment_ready.remote(stage_name)):
+            if time.time() >= deadline:
+                # Never flip the route onto a half-ready pipeline: the
+                # atomic-deploy property means a slow stage aborts the
+                # ingress deploy — and tears down the stages already
+                # deployed so failed graph deploys don't leak replicas.
+                for s in stage_apps:
+                    try:
+                        ray_tpu.get(
+                            _controller.delete_deployment.remote(s))
+                    except Exception:
+                        pass
+                raise TimeoutError(
+                    f"deployment graph stage {stage_name!r} not ready "
+                    f"within {wait_timeout}s; ingress not deployed and "
+                    f"all graph stages torn down")
             time.sleep(0.05)
     return _deploy_one(make_ingress(handles), route_prefix)
 

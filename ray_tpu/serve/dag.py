@@ -78,13 +78,36 @@ class _MethodBinder:
 
 
 def _app_getattr(self: Application, name: str):
+    # Scope the DSL: only names resolvable as methods of the deployed
+    # class become binders — a typo raises AttributeError like any other
+    # object, and hasattr(app, x) stays meaningful. Classes that resolve
+    # methods dynamically (__getattr__ delegation) are accepted as-is;
+    # methods assigned on the instance in __init__ are invisible here —
+    # use the explicit ``app.bind_method(name)`` escape hatch for those.
     if name.startswith("_"):
         raise AttributeError(name)
+    target = getattr(self.deployment, "_target", None)
+    if isinstance(target, type):
+        if callable(getattr(target, name, None)):
+            return _MethodBinder(self, name)
+        if hasattr(target, "__getattr__"):  # dynamic method resolution
+            return _MethodBinder(self, name)
+    raise AttributeError(
+        f"{type(self).__name__} has no attribute {name!r} (graph "
+        f"authoring exposes methods of "
+        f"{getattr(target, '__name__', target)!r}; for methods assigned "
+        f"on the instance use app.bind_method({name!r}))")
+
+
+def _app_bind_method(self: Application, name: str) -> _MethodBinder:
+    """Explicit binder for methods the class resolves only at runtime
+    (e.g. assigned in __init__): ``app.bind_method("embed").bind(x)``."""
     return _MethodBinder(self, name)
 
 
 # graph authoring surface on Application: `app.method.bind(...)`
 Application.__getattr__ = _app_getattr  # type: ignore[attr-defined]
+Application.bind_method = _app_bind_method  # type: ignore[attr-defined]
 
 
 class DAGDriver:

@@ -2,6 +2,8 @@
 scale-down. (Reference test strategy: autoscaler v2 reconciler unit tests
 + e2e with the local provider.)"""
 
+import json
+import os
 import time
 
 import pytest
@@ -137,6 +139,21 @@ def test_tpu_queued_resource_provider_end_to_end():
         assert "--num-tpus 4" in script
         assert "ray-tpu-slice" in script and "raytpu-qr-test" in script
         assert "TPU-v5litepod-4-head" in script
+        # the per-host worker-id label must actually EXPAND under bash:
+        # run the --labels word through the shell with TPU_WORKER_ID set
+        # and check the rendered JSON (regression: single quotes used to
+        # ship the literal string '${TPU_WORKER_ID}')
+        import re as _re
+        import subprocess as _sp
+        m = _re.search(r'--labels ("(?:[^"\\]|\\.)*")', script)
+        assert m, script
+        rendered = _sp.run(
+            ["bash", "-c", f"echo {m.group(1)}"],
+            capture_output=True, text=True,
+            env={**os.environ, "TPU_WORKER_ID": "3"}).stdout.strip()
+        labels = json.loads(rendered)
+        assert labels["ray-tpu-worker"] == "3", rendered
+        assert labels["ray-tpu-slice"] == "raytpu-qr-test"
 
         autoscaler = Autoscaler(head, provider, AutoscalerConfig(
             max_workers=1, idle_timeout_s=60, interval_s=0.2,
