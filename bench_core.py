@@ -156,6 +156,29 @@ def main():
 
     run("async_actor_calls_batch_1k", async_actor_batch, 1000)
 
+    # ---- streaming generators (direct reply-chain items) ------------------
+    @ray_tpu.remote
+    class Gen:
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    g = Gen.remote()
+
+    def stream_items_1k():
+        it = g.stream.options(num_returns="streaming").remote(1000)
+        for r in it:
+            pass
+
+    run("stream_items_1k", stream_items_1k, 1000)
+
+    def stream_items_consumed_1k():
+        it = g.stream.options(num_returns="streaming").remote(1000)
+        for r in it:
+            ray_tpu.get(r)
+
+    run("stream_items_consumed_1k", stream_items_consumed_1k, 1000)
+
     # ---- head path comparison (regression gate: the direct path must
     # beat routing every submit/finish through the head) ------------------
     from ray_tpu.core.config import global_config as _gc

@@ -61,12 +61,16 @@ _ACTOR_SYS_ERRS = _ACTOR_LOC_ERRS + ("ActorDiedError", "WorkerCrashedError")
 
 
 def actor_call_eligible(spec: TaskSpec) -> bool:
-    """Direct-path test for actor method calls: everything except
-    streaming generators (their item protocol rides head task records)."""
+    """Direct-path test for actor method calls. Streaming generator calls
+    are eligible too: their item announcements ride the direct reply
+    chain to the owner (``on_stream_item``), so the actor plane is
+    uniformly head-free (reference: streaming generator item reports go
+    submitter-side, core_worker.h:392 TryReadObjectRefStream). Per-call
+    runtime_env is deliberately NOT an exclusion: the actor process's env
+    is fixed at creation, so method calls can't change it — and routing
+    every call one way keeps per-caller ordering structural."""
     return (spec.actor_id is not None
-            and not spec.is_actor_creation
-            and not spec.streaming
-            and spec.runtime_env is None)
+            and not spec.is_actor_creation)
 
 
 def direct_eligible(spec: TaskSpec) -> bool:
@@ -74,12 +78,12 @@ def direct_eligible(spec: TaskSpec) -> bool:
     fine — the owner resolves them before submission (dependency resolver)
     and the executor pulls via location hints. num_cpus>1 needs real
     resource accounting (a node grants direct tasks one worker SLOT, ~1
-    CPU), so it keeps the head path."""
+    CPU), so it keeps the head path. Streaming tasks are eligible: items
+    stream back over the same reply chain as the completion."""
     s = spec.scheduling_strategy
     return (
         spec.actor_id is None
         and not spec.is_actor_creation
-        and not spec.streaming
         and spec.runtime_env is None
         and s.kind == "DEFAULT"
         and s.placement_group_id is None
@@ -87,6 +91,26 @@ def direct_eligible(spec: TaskSpec) -> bool:
         and all(k in _DIRECT_RESOURCES for k, _ in spec.resources)
         and spec.resources.get("CPU") <= 1.0
     )
+
+
+class _StreamState:
+    """Owner-side bookkeeping for one streaming (generator) task."""
+
+    __slots__ = ("count", "handed", "done", "dropped", "published",
+                 "exec_hex")
+
+    def __init__(self):
+        self.count = 0                 # items announced so far
+        self.handed: set = set()       # item oids returned by stream_next
+        self.done: Optional[Tuple[int, bool]] = None  # (total, is_error)
+        self.dropped = False           # generator ref released
+        # generator handle serialized out of this process: items + EOF are
+        # mirrored to the head so any consumer can read the stream
+        self.published = False
+        # node that executes the generator (every item announcement
+        # carries it): the location fallback when mirroring an item whose
+        # inline payload was already consumed+dropped locally
+        self.exec_hex: Optional[str] = None
 
 
 class DirectTaskManager:
@@ -111,11 +135,17 @@ class DirectTaskManager:
                  ext_wait: Optional[Callable] = None,
                  pin: Optional[Callable] = None,
                  unpin: Optional[Callable] = None,
-                 locate: Optional[Callable] = None):
+                 locate: Optional[Callable] = None,
+                 publish_stream_item: Optional[Callable] = None,
+                 publish_stream_eof: Optional[Callable] = None):
         self._submit = submit
         self._ext_wait = ext_wait
         self._pin = pin
         self._unpin = unpin
+        # one-way mirrors to the head for published streams (a generator
+        # handle that leaves this process); must not block on a reply
+        self._pub_item = publish_stream_item
+        self._pub_eof = publish_stream_eof
         # optional: hex of the node holding a LARGE external object (the
         # locality signal for args this owner didn't produce)
         self._locate = locate
@@ -139,6 +169,11 @@ class DirectTaskManager:
         # oid -> node hex that sealed a large (store-resident) result;
         # shipped as a pull hint when the oid is a downstream task's arg
         self._result_nodes: Dict[ObjectID, str] = {}
+        # streaming generator tasks owned by this manager: items arrive
+        # via on_stream_item over the direct reply chain (same FIFO as the
+        # final completion), the consumer reads via stream_next — the
+        # owner-side replacement for the head's stream records
+        self._streams: Dict[TaskID, _StreamState] = {}
         # ---- dependency resolver state ---------------------------------
         # task_id -> set of oids still unavailable; submit fires when empty
         self._deferred: Dict[TaskID, Set[ObjectID]] = {}
@@ -292,6 +327,7 @@ class DirectTaskManager:
         TaskCancelledError on arrival; a still-deferred task is cancelled
         entirely owner-side. Returns True if it was pending."""
         sealed_spec = None
+        pub_eof = None
         with self._lock:
             tid = oid.task_id()
             if tid not in self._pending:
@@ -308,8 +344,12 @@ class DirectTaskManager:
                 payload = serialization.serialize(err).to_bytes()
                 for roid in sealed_spec.return_ids():
                     self._results[roid] = (payload, True)
+                if sealed_spec.streaming:
+                    pub_eof = self._settle_stream_locked(sealed_spec, True)
                 self._cv.notify_all()
         if sealed_spec is not None:
+            if pub_eof is not None:
+                self._safe_pub_eof(*pub_eof)
             self._wake_waiters()
             self._release_pins(sealed_spec)
             if (sealed_spec.actor_id is not None
@@ -340,6 +380,7 @@ class DirectTaskManager:
         resubmit = None
         settled_spec = None
         actor_handoff = None
+        pub_eof = None
         sealed_oids: List[ObjectID] = []
         with self._lock:
             spec = self._pending.get(task_id)
@@ -391,7 +432,13 @@ class DirectTaskManager:
                             if payload is None and exec_hex:
                                 self._result_nodes[oid] = exec_hex
                             sealed_oids.append(oid)
+                if spec.streaming:
+                    pub_eof = self._settle_stream_locked(
+                        spec, err_name is not None or cancelled
+                        or any(e for _o, _p, e in results))
                 self._cv.notify_all()
+        if pub_eof is not None:
+            self._safe_pub_eof(*pub_eof)
         if settled_spec is not None or sealed_oids:
             self._wake_waiters()
         if actor_handoff is not None:
@@ -426,10 +473,164 @@ class DirectTaskManager:
             self._deferred.pop(spec.task_id, None)
             for oid in spec.return_ids():
                 self._results[oid] = (payload, True)
+            pub_eof = (self._settle_stream_locked(spec, True)
+                       if spec.streaming else None)
             self._cv.notify_all()
+        if pub_eof is not None:
+            self._safe_pub_eof(*pub_eof)
         self._wake_waiters()
         self._release_pins(spec)
         self.deps_available(spec.return_ids())
+
+    # ------------------------------------------------------------ streams
+
+    def _settle_stream_locked(self, spec: TaskSpec, is_err: bool):
+        """Record stream EOF. Returns (tid, total, is_err) when the EOF
+        must also be mirrored to the head (published stream) — the caller
+        pushes it AFTER releasing the lock (the mirror may be a channel
+        send or head call)."""
+        tid = spec.task_id
+        st = self._streams.get(tid)
+        if st is None:
+            st = self._streams[tid] = _StreamState()
+        st.done = (st.count, is_err)
+        if st.dropped:
+            self._purge_stream_locked(tid, st)
+        if st.published and self._pub_eof is not None:
+            return (tid, st.count, is_err)
+        return None
+
+    def _purge_stream_locked(self, tid: TaskID, st: _StreamState) -> None:
+        """Free retained item payloads the consumer never read; items that
+        were handed out as ObjectRefs release via their own ref drops."""
+        for i in range(st.count):
+            soid = ObjectID.for_stream(tid, i)
+            if soid not in st.handed:
+                self._results.pop(soid, None)
+                self._result_nodes.pop(soid, None)
+        self._streams.pop(tid, None)
+
+    def _safe_pub_item(self, tid, index, payload, node_hex) -> None:
+        try:
+            self._pub_item(tid, index, payload, node_hex)
+        except Exception:
+            pass  # head link gone: local consumers still work
+
+    def _safe_pub_eof(self, tid, total, is_err) -> None:
+        try:
+            self._pub_eof(tid, total, is_err)
+        except Exception:
+            pass
+
+    def publish_stream(self, task_id: TaskID) -> bool:
+        """A generator handle for ``task_id`` is leaving this process
+        (serialization): mirror already-announced items (+ EOF if settled)
+        to the head so ANY consumer can read the stream, and keep
+        mirroring future items as they arrive. FIFO of the owner's
+        channels guarantees the mirror reaches the head before the
+        serialized handle can reach any consumer. Returns False when this
+        manager does not own the stream (borrowed handle re-serialized —
+        the head already has it)."""
+        if self._pub_item is None:
+            return False
+        to_push: List[tuple] = []
+        eof = None
+        with self._lock:
+            st = self._streams.get(task_id)
+            spec = self._pending.get(task_id)
+            if st is None and (spec is None or not spec.streaming):
+                return False
+            if st is None:
+                st = self._streams[task_id] = _StreamState()
+            if st.published:
+                return True
+            st.published = True
+            for i in range(st.count):
+                soid = ObjectID.for_stream(task_id, i)
+                res = self._results.get(soid)
+                # payload gone (already consumed + ref dropped): fall back
+                # to the executor node's store copy as the location
+                to_push.append((i, res[0] if res else None,
+                                self._result_nodes.get(soid)
+                                or (None if res else st.exec_hex)))
+            eof = st.done
+        if not to_push and eof is None:
+            # zero items so far: an "open" marker (index -1) so the head
+            # knows the stream exists and consumers wait instead of erroring
+            self._safe_pub_item(task_id, -1, None, None)
+        for i, payload, node_hex in to_push:
+            self._safe_pub_item(task_id, i, payload, node_hex)
+        if eof is not None and self._pub_eof is not None:
+            self._safe_pub_eof(task_id, eof[0], eof[1])
+        return True
+
+    def on_stream_item(self, task_id: TaskID, index: int,
+                       payload: Optional[bytes],
+                       exec_hex: Optional[str] = None) -> None:
+        """A streamed item announcement arriving over the direct reply
+        chain (executor -> owner, FIFO with the final completion). Small
+        items carry their payload inline; large ones are store-resident at
+        ``exec_hex``. Items land in ``_results`` under their for_stream
+        oid, so reads, hints for dependent tasks, and ref drops all reuse
+        the normal owned-result machinery."""
+        oid = ObjectID.for_stream(task_id, index)
+        mirror = False
+        with self._lock:
+            spec = self._pending.get(task_id)
+            st = self._streams.get(task_id)
+            if spec is None and st is None:
+                return  # settled and consumed (or never ours): stale
+            if st is None:
+                st = self._streams[task_id] = _StreamState()
+            if st.dropped and not st.published:
+                return  # generator released, nobody else has it: discard
+            if index + 1 > st.count:
+                st.count = index + 1  # EOF total counts published items too
+            if exec_hex:
+                st.exec_hex = exec_hex
+            mirror = st.published and self._pub_item is not None
+            if st.dropped:
+                # local handle gone but a serialized copy lives elsewhere:
+                # mirror without retaining the payload here
+                pass
+            else:
+                self._results[oid] = (payload, False)
+                if payload is None and exec_hex:
+                    self._result_nodes[oid] = exec_hex
+            self._cv.notify_all()
+        if mirror:
+            self._safe_pub_item(task_id, index, payload, exec_hex)
+        self._wake_waiters()
+        # downstream tasks may be deferred on this item ref
+        self.deps_available([oid])
+
+    def stream_next(self, task_id: TaskID, index: int,
+                    timeout: Optional[float]):
+        """Owner-side next-item protocol (same contract as the head's
+        stream_next): ("item", oid) | ("end", total) | ("error",) |
+        ("wait",) after ``timeout``. Returns None when this manager does
+        not own the stream (caller falls back to the head path)."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._lock:
+            while True:
+                st = self._streams.get(task_id)
+                if st is not None and index < st.count:
+                    oid = ObjectID.for_stream(task_id, index)
+                    st.handed.add(oid)
+                    return ("item", oid)
+                pending = task_id in self._pending
+                if not pending:
+                    if st is None or st.done is None:
+                        return None  # not direct-owned: head path
+                    total, is_err = st.done
+                    return ("error",) if is_err else ("end", total)
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return ("wait",)
+                self._cv.wait(remaining if remaining is not None else 0.2)
 
     def stamp_hints(self, spec: TaskSpec) -> None:
         with self._lock:
@@ -492,30 +693,35 @@ class DirectTaskManager:
 
     def drop(self, oid: ObjectID) -> None:
         """Owner released its ref: free the retained inline result (or
-        mark a still-pending task's result discard-on-arrival)."""
+        mark a still-pending task's result discard-on-arrival). Dropping
+        a stream's primary return (the generator handle died) purges the
+        stream's unread items."""
         with self._lock:
             self._result_nodes.pop(oid, None)
             if self._results.pop(oid, None) is None \
                     and oid.task_id() in self._pending:
                 self._dropped.add(oid)
+            tid = oid.task_id()
+            st = self._streams.get(tid)
+            if st is not None:
+                st.handed.discard(oid)
+                if oid == ObjectID.for_task_return(tid, 0):
+                    st.dropped = True
+                    if tid not in self._pending:
+                        self._purge_stream_locked(tid, st)
 
 
 class _ActorRoute:
     """Per-(owner, actor) submission state."""
 
     __slots__ = ("seq", "loc", "state", "queue", "ready", "inflight",
-                 "parked", "death_cause", "send_buf", "sender_active",
-                 "pinned")
+                 "parked", "death_cause", "send_buf", "sender_active")
 
     def __init__(self):
         self.seq = 0
         self.loc: Optional[str] = None
         # UNRESOLVED | READY | WAITING | DEAD
         self.state = "UNRESOLVED"
-        # head-pinned: NEW calls take the head path; calls already queued
-        # keep resolving + draining through the direct path (a pin must
-        # never strand them)
-        self.pinned = False
         self.queue: List[TaskSpec] = []     # submitted, unsent (seq order)
         self.ready: set = set()             # task_ids with deps resolved
         self.inflight: Dict[TaskID, TaskSpec] = {}
@@ -568,14 +774,12 @@ class DirectActorSubmitter:
 
     def try_submit(self, spec: TaskSpec) -> bool:
         """Returns True if the call was taken onto the direct path; False
-        = caller must use the head path (ineligible or head-pinned)."""
+        = caller must use the head path (ineligible)."""
         if not actor_call_eligible(spec):
             return False
         aid = spec.actor_id
         with self._lock:
             rt = self._routes.setdefault(aid, _ActorRoute())
-            if rt.pinned:
-                return False
             spec.actor_seq = rt.seq
             rt.seq += 1
             # append under the SAME lock as seq assignment: the queue's
@@ -600,25 +804,6 @@ class DirectActorSubmitter:
             return True
         self._drain(aid)
         return True
-
-    def head_pin(self, actor_id, timeout: float = 30.0) -> None:
-        """Route this owner's future calls to ``actor_id`` via the head
-        (e.g. a streaming call needs head stream records). Drains in-flight
-        direct calls first so submission order is preserved across the
-        path switch."""
-        deadline = None if timeout is None else _mono() + timeout
-        with self._lock:
-            rt = self._routes.setdefault(actor_id, _ActorRoute())
-            rt.pinned = True
-        self._drain(actor_id)  # already-queued calls still flush direct
-        with self._lock:
-            rt = self._routes[actor_id]
-            while rt.queue or rt.inflight or rt.parked:
-                remaining = (None if deadline is None
-                             else deadline - _mono())
-                if remaining is not None and remaining <= 0:
-                    break
-                self._drained_cv.wait(remaining)
 
     # ------------------------------------------------------------ drain
 
@@ -819,9 +1004,3 @@ class DirectActorSubmitter:
             rt.inflight.pop(spec.task_id, None)
             self._drained_cv.notify_all()
         self._drain(aid)
-
-
-def _mono() -> float:
-    import time as _time
-
-    return _time.monotonic()

@@ -24,7 +24,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from .config import global_config
-from .ids import NodeID, WorkerID
+from .ids import NodeID, ObjectID, WorkerID
 from .object_store import LocalObjectStore
 from .protocol import Channel, make_listener
 from .resources import NodeResources
@@ -94,6 +94,10 @@ class Node:
         # ---- direct (head-bypass) task path state -----------------------
         # locally-executing direct tasks: task_id -> (origin, spec)
         self._direct: Dict[object, Tuple[tuple, TaskSpec]] = {}
+        # stream-item oids sealed locally for a direct streaming task;
+        # they ride the task's completion devent so the head's object
+        # directory learns their location in one batched report
+        self._direct_stream_oids: Dict[object, List[ObjectID]] = {}
         # actors hosted on this node: actor_id -> worker_id (the routing
         # table for direct actor calls; reference: the actor's RPC address
         # cached by ActorTaskSubmitter)
@@ -158,7 +162,7 @@ class Node:
 
         ``origin`` routes the completion reply:
           ("worker", worker_id)      — a worker on this node submitted it
-          ("driver", callback)       — the in-process driver submitted it
+          ("driver", done_cb, stream_cb) — the in-process driver submitted it
           ("peer", channel)          — a peer node forwarded it here
           ("node", node, inner)      — in-process peer hop: reply via node
         """
@@ -212,8 +216,36 @@ class Node:
                         self.head.on_sealed_payload(oid, payload, is_err)
                     except Exception:
                         pass
+        with self._lock:
+            stream_oids = self._direct_stream_oids.pop(task_id, None)
+        if stream_oids:
+            sealed.extend(stream_oids)
         self._append_devent(spec, err_name, sealed, t_start)
         self._reply_direct(origin, task_id, err_name, results, self.hex)
+
+    def _reply_stream_item(self, origin: tuple, task_id, index: int,
+                           data: Optional[bytes],
+                           exec_hex: Optional[str]) -> None:
+        """Route a stream-item announcement back along the same chain as
+        the eventual completion reply (FIFO on every hop, so the owner
+        always sees items before the final ddone)."""
+        kind = origin[0]
+        try:
+            if kind == "worker":
+                with self._lock:
+                    w = self._workers.get(origin[1])
+                if w is not None:
+                    w.channel.send("dstream", task_id, index, data,
+                                   exec_hex)
+            elif kind == "driver":
+                origin[2](task_id, index, data, exec_hex)
+            elif kind == "peer":
+                origin[1].send("pstream", task_id, index, data, exec_hex)
+            elif kind == "node":
+                origin[1]._reply_stream_item(origin[2], task_id, index,
+                                             data, exec_hex)
+        except (OSError, EOFError):
+            pass  # owner gone: items die with it (owner-died semantics)
 
     def _reply_direct(self, origin: tuple, task_id, err_name,
                       results, exec_hex: Optional[str] = None) -> None:
@@ -522,6 +554,9 @@ class Node:
                 except Exception:
                     continue
                 self.submit_direct(spec, ("peer", ch))
+                continue
+            if tag == "pstream":
+                self.on_peer_stream_item(*payload)
                 continue
             if tag == "pdone":
                 try:
@@ -852,6 +887,17 @@ class Node:
             self._reply_direct(entry[0], task_id, err_name, results,
                                exec_hex)
 
+    def on_peer_stream_item(self, task_id, index: int,
+                            data: Optional[bytes], exec_hex) -> None:
+        """A stream-item announcement for a task we handed to a peer:
+        pass it along toward the owner (the forwarding entry stays — the
+        completion is still to come, FIFO behind the items)."""
+        with self._lock:
+            entry = self._forwarded.get(task_id)
+        if entry is not None:
+            self._reply_stream_item(entry[0], task_id, index, data,
+                                    exec_hex)
+
     def _direct_running_locked(self) -> int:
         """Worker slots currently held by direct (head-bypass) tasks."""
         n = 0
@@ -1025,11 +1071,24 @@ class Node:
                     self.head.apply_pin_delta(payload[0], payload[1])
                 except Exception:
                     pass
+            elif tag == "dspub":
+                # one-way stream-item mirror (published direct stream)
+                try:
+                    self.head.publish_stream_item(*payload)
+                except Exception:
+                    pass
+            elif tag == "dseof":
+                # one-way stream-EOF mirror (published direct stream)
+                try:
+                    self.head.publish_stream_eof(*payload)
+                except Exception:
+                    pass
             elif tag == "release":
                 for oid in payload[0]:
                     self.store.remove_ref(oid)
             elif tag == "stream":
-                self.head.on_stream_item(*payload)
+                task_id, index, data = payload
+                self._on_worker_stream_item(task_id, index, data)
             elif tag == "metrics":
                 self.head.on_worker_metrics(
                     f"{self.hex[:6]}:{w.pid}", payload[0])
@@ -1049,6 +1108,38 @@ class Node:
                 # graceful actor exit
                 self._on_worker_exit(w)
                 return
+
+    def _on_worker_stream_item(self, task_id, index: int,
+                               data: Optional[bytes]) -> None:
+        """A worker announced stream item ``index``. Direct tasks route it
+        straight to the owner over the reply chain (zero head records);
+        head-path tasks keep the head stream-record protocol. Inline
+        payloads are also sealed locally so the object stays directory-
+        resolvable for borrowers (location rides the completion devent on
+        the direct path)."""
+        oid = ObjectID.for_stream(task_id, index)
+        with self._lock:
+            entry = self._direct.get(task_id)
+        if entry is not None:
+            if data is not None:
+                try:
+                    self.store.put_inline(oid, data, False)
+                    with self._lock:
+                        self._direct_stream_oids.setdefault(
+                            task_id, []).append(oid)
+                except Exception:
+                    pass  # store full: the owner's inline copy suffices
+            self._reply_stream_item(entry[0], task_id, index, data,
+                                    self.hex)
+            return
+        # head path: seal + register the location, then announce
+        if data is not None:
+            try:
+                self.store.put_inline(oid, data, False)
+                self.head.on_object_sealed(oid, self.hex)
+            except Exception:
+                pass
+        self.head.on_stream_item(task_id, index)
 
     def _reply(self, w: WorkerHandle, req_id: int, ok: bool, value) -> None:
         try:
@@ -1156,6 +1247,7 @@ class Node:
         for tid, (origin, spec, _t0) in list(self._direct.items()):
             if spec.actor_id == w.actor_id:
                 del self._direct[tid]
+                self._direct_stream_oids.pop(tid, None)
                 lost.append((origin, spec, "ActorDiedError"))
         return lost
 
@@ -1172,6 +1264,8 @@ class Node:
                       for s, _, _ in assigned
                       if s.task_id in self._direct]
             direct_ids = {spec.task_id for _, spec, _ in direct}
+            for tid in direct_ids:
+                self._direct_stream_oids.pop(tid, None)
             lost_actor = self._drop_actor_direct_locked(w)
         w.channel.close()
         head_assigned = [e for e in assigned if e[0].task_id not in direct_ids]

@@ -164,6 +164,13 @@ class RemoteHead:
     def on_stream_item(self, task_id, index: int) -> None:
         self._send("stream_item", task_id, index)
 
+    def publish_stream_item(self, task_id, index: int, payload,
+                            node_hex) -> None:
+        self._send("stream_pub_item", task_id, index, payload, node_hex)
+
+    def publish_stream_eof(self, task_id, total: int, is_err: bool) -> None:
+        self._send("stream_pub_eof", task_id, total, is_err)
+
     def apply_pin_delta(self, oids, delta: int) -> None:
         self._send("pin_delta", oids, delta)
 

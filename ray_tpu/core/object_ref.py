@@ -137,4 +137,13 @@ class ObjectRefGenerator:
         return self._primary
 
     def __reduce__(self):
+        # The handle is leaving this process: a direct-path stream lives
+        # only in its owner's buffer, so mirror it to the head first
+        # (publish_stream is a no-op for head-path/borrowed streams).
+        rt = get_runtime()
+        if rt is not None:
+            try:
+                rt.publish_stream(self._task_id)
+            except Exception:
+                pass
         return (ObjectRefGenerator, (self._task_id, self._primary))

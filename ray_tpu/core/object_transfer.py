@@ -214,6 +214,9 @@ class ObjectServer:
                 elif tag == "pdone":
                     # completion of a task this node handed to the peer
                     self.node.on_peer_done(*payload)
+                elif tag == "pstream":
+                    # stream item of a task this node handed to the peer
+                    self.node.on_peer_stream_item(*payload)
         finally:
             self.node.on_peer_session_closed(ch)
 

@@ -170,6 +170,13 @@ class Head:
         self._waiting_on: Dict[ObjectID, Set[TaskID]] = defaultdict(set)
         self.ref_counts: Dict[ObjectID, int] = defaultdict(int)
         self.streams: Dict[TaskID, int] = {}  # task_id -> items streamed
+        # published direct-path streams: task_id -> (total, is_err) EOF
+        # (direct tasks have no head task record to signal termination)
+        self.stream_eof: Dict[TaskID, Tuple[int, bool]] = {}
+        self._stream_eof_ts: Dict[TaskID, float] = {}  # for GC
+        # first time an UNKNOWN stream was queried: a cross-channel grace
+        # window for publish mirrors still in flight (stream_next)
+        self._stream_unknown_ts: Dict[TaskID, float] = {}
         self.node_loads: Dict[str, dict] = {}  # node hex -> syncer snapshot
         self._view_version = 0
         self._stopped = False
@@ -236,6 +243,26 @@ class Head:
                         ObjectID.for_stream(tid, i) for i in range(count))
                 del self.tasks[tid]
                 dropped += 1
+            # published direct-path streams have no task record: GC them
+            # off their own EOF timestamp once consumers released the items
+            for tid, (_total, _e) in list(self.stream_eof.items()):
+                ts = self._stream_eof_ts.get(tid)
+                if ts is not None and now - ts < ttl_s:
+                    continue
+                count = self.streams.get(tid, 0)
+                if any(self.ref_counts.get(ObjectID.for_stream(tid, i), 0)
+                       > 1 for i in range(count)):
+                    continue  # a consumer still holds item refs
+                self.streams.pop(tid, None)
+                self.stream_eof.pop(tid, None)
+                self._stream_eof_ts.pop(tid, None)
+                stream_pins.extend(
+                    ObjectID.for_stream(tid, i) for i in range(count))
+                dropped += 1
+            # stale unknown-stream grace markers (consumer stopped asking)
+            for tid, ts in list(self._stream_unknown_ts.items()):
+                if now - ts > 60.0:
+                    del self._stream_unknown_ts[tid]
             # dead-actor records past the TTL fold away too
             for aid, arec in list(self.actors.items()):
                 if arec.state != "DEAD":
@@ -523,6 +550,10 @@ class Head:
                 self.on_object_sealed(payload[0], proxy.hex)
             elif tag == "stream_item":
                 self.on_stream_item(payload[0], payload[1])
+            elif tag == "stream_pub_item":
+                self.publish_stream_item(*payload)
+            elif tag == "stream_pub_eof":
+                self.publish_stream_eof(*payload)
             elif tag == "worker_metrics":
                 self.on_worker_metrics(payload[0], payload[1])
             elif tag == "worker_log":
@@ -1314,6 +1345,35 @@ class Head:
                          name="metrics-http").start()
         return self._metrics_address
 
+    def publish_stream_item(self, task_id: TaskID, index: int,
+                            payload, node_hex) -> None:
+        """A direct-path stream owner is mirroring item ``index`` here
+        because its generator handle was serialized out of the owning
+        process: seal inline payloads in the head store (store-resident
+        items just register their location) and record the item so ANY
+        consumer's stream_next can read the stream. ``index == -1`` is the
+        stream-open marker (no items yet — consumers wait, not error)."""
+        if index < 0:
+            with self._object_cv:
+                self.streams.setdefault(task_id, 0)
+                self._object_cv.notify_all()
+            return
+        oid = ObjectID.for_stream(task_id, index)
+        if payload is not None:
+            self.on_sealed_payload(oid, payload, False)
+        elif node_hex:
+            self.on_object_sealed(oid, node_hex)
+        self.on_stream_item(task_id, index)
+
+    def publish_stream_eof(self, task_id: TaskID, total: int,
+                           is_err: bool) -> None:
+        """EOF marker for a published direct-path stream (the task has no
+        head task record, so stream_next needs this to terminate)."""
+        with self._object_cv:
+            self.stream_eof[task_id] = (int(total), bool(is_err))
+            self._stream_eof_ts[task_id] = time.monotonic()
+            self._object_cv.notify_all()
+
     def on_stream_item(self, task_id: TaskID, index: int) -> None:
         """A streaming task sealed item ``index`` (reference: streaming
         generator item report). The item gets an owner pin (same semantics
@@ -1338,9 +1398,31 @@ class Head:
                 rec = self.tasks.get(task_id)
                 if index < count:
                     return ("item", ObjectID.for_stream(task_id, index))
-                if rec is None or rec.state == "FAILED" or rec.cancelled:
+                eof = self.stream_eof.get(task_id)
+                if eof is not None:
+                    # published direct-path stream: EOF marker replaces
+                    # the task record
+                    self._stream_unknown_ts.pop(task_id, None)
+                    return ("error",) if eof[1] else ("end", eof[0])
+                if rec is None:
+                    if task_id not in self.streams:
+                        # Unknown here — but a publish mirror may still be
+                        # in flight on ANOTHER node->head channel (the
+                        # FIFO guarantee only covers the owner's own
+                        # channel). Grace-wait before declaring it dead.
+                        now = time.monotonic()
+                        first = self._stream_unknown_ts.setdefault(
+                            task_id, now)
+                        if now - first > 10.0:
+                            self._stream_unknown_ts.pop(task_id, None)
+                            return ("error",)
+                    else:
+                        self._stream_unknown_ts.pop(task_id, None)
+                    # published direct stream mid-flight (or mirror in
+                    # flight): wait
+                elif rec.state == "FAILED" or rec.cancelled:
                     return ("error",)
-                if rec.state == "FINISHED":
+                elif rec.state == "FINISHED":
                     return ("end", count)
                 remaining = (None if deadline is None
                              else deadline - time.monotonic())
@@ -1770,7 +1852,9 @@ class DriverRuntime:
                 list(oids), len(oids), t),
             pin=lambda oids: head.apply_pin_delta(oids, 1),
             unpin=lambda oids: head.apply_pin_delta(oids, -1),
-            locate=head.locate_large_object)
+            locate=head.locate_large_object,
+            publish_stream_item=head.publish_stream_item,
+            publish_stream_eof=head.publish_stream_eof)
 
         # direct actor calls: ordered caller->actor-node submission; the
         # head only resolves locations and keeps the lifecycle FSM
@@ -1779,7 +1863,8 @@ class DriverRuntime:
 
     def _direct_submit(self, spec: TaskSpec) -> None:
         self.head.head_node.submit_direct(
-            spec, ("driver", self.direct.complete))
+            spec, ("driver", self.direct.complete,
+                   self.direct.on_stream_item))
 
     @property
     def mode(self) -> str:
@@ -1914,7 +1999,16 @@ class DriverRuntime:
         return getattr(self.head.gcs, "kv_" + op)(*args)
 
     def stream_next(self, task_id, index: int, timeout=None):
+        # owner-side stream buffer first (direct-path streams); head path
+        # for streams this driver does not own
+        rep = self.direct.stream_next(task_id, index, timeout)
+        if rep is not None:
+            return rep
         return self.head.stream_next(task_id, index, timeout)
+
+    def publish_stream(self, task_id) -> None:
+        # generator handle serialized out of this process (object_ref)
+        self.direct.publish_stream(task_id)
 
     # ---- refs ----
     def add_local_ref(self, oid: ObjectID) -> None:
@@ -1963,10 +2057,9 @@ class DriverRuntime:
         if (cfg.direct_task_enabled and cfg.direct_actor_enabled
                 and self.direct_actors.try_submit(spec)):
             return [ObjectRef(oid) for oid in spec.return_ids()]
-        # ineligible (e.g. streaming): pin this actor to the head path for
-        # this owner and drain in-flight direct calls first, preserving
-        # per-owner submission order across the path switch
-        self.direct_actors.head_pin(spec.actor_id)
+        # direct path disabled by config (a whole-session toggle, so
+        # every call to every actor takes the same path and per-caller
+        # ordering is structural): head path
         return self.submit_task(spec)
 
     def create_placement_group(self, bundles, strategy, name=""):

@@ -98,7 +98,13 @@ class WorkerRuntime:
             self._direct_submit,
             ext_wait=self._ext_wait_objects,
             pin=lambda oids: self.channel.send("dpin", oids, 1),
-            unpin=lambda oids: self.channel.send("dpin", oids, -1))
+            unpin=lambda oids: self.channel.send("dpin", oids, -1),
+            # stream mirrors are one-way for the same reason as pin/unpin
+            # (EOF publishes can run on the channel-reader thread)
+            publish_stream_item=lambda tid, i, p, nh: self.channel.send(
+                "dspub", tid, i, p, nh),
+            publish_stream_eof=lambda tid, n, e: self.channel.send(
+                "dseof", tid, n, e))
         # direct actor calls (resolve runs on the submitter's own resolver
         # thread, so a blocking RPC there is safe)
         from .direct import DirectActorSubmitter
@@ -304,7 +310,9 @@ class WorkerRuntime:
         if (cfg.direct_task_enabled and cfg.direct_actor_enabled
                 and self.direct_actors.try_submit(spec)):
             return [ObjectRef(oid) for oid in spec.return_ids()]
-        self.direct_actors.head_pin(spec.actor_id)
+        # direct path disabled by config (a whole-session toggle, so
+        # every call to every actor takes the same path and per-caller
+        # ordering is structural): head path
         return self.submit_task(spec)
 
     def create_placement_group(self, bundles, strategy, name=""):
@@ -345,6 +353,12 @@ class WorkerRuntime:
                     task_id, err_name, results, exec_hex = payload
                     self.direct.complete(task_id, err_name, results,
                                          exec_hex)
+                elif tag == "dstream":
+                    # stream-item announcement for a direct task this
+                    # worker owns (FIFO with its ddone on this channel)
+                    task_id, index, data, exec_hex = payload
+                    self.direct.on_stream_item(task_id, index, data,
+                                               exec_hex)
                 elif tag == "exec":
                     spec: TaskSpec = pickle.loads(payload[0])
                     binding = payload[1]
@@ -666,21 +680,30 @@ class WorkerRuntime:
         self.channel.send("done", spec.task_id, results, None)
 
     def _finish_streaming(self, spec: TaskSpec, result: Any) -> None:
-        """Iterate a generator task: each yield becomes its own sealed
-        object (ObjectID.for_stream) announced to the head; the primary
-        return carries the final item count (reference: streaming
-        generators, _raylet.pyx:1074-1317)."""
+        """Iterate a generator task: each yield becomes one "stream" item
+        announcement to the node, which routes it to the OWNER over the
+        direct reply chain (or to the head for head-path tasks). Small
+        items ride inline in the announcement; large ones seal into the
+        store first (the blocking seal rpc returning before the send keeps
+        store-before-announce ordering). The primary return carries the
+        final item count (reference: streaming generators,
+        _raylet.pyx:1074-1317)."""
         from .ids import ObjectID as _OID
 
+        cfg = global_config()
         count = 0
         try:
             if result is not None and hasattr(result, "__iter__"):
                 for item in result:
-                    oid = _OID.for_stream(spec.task_id, count)
-                    self._store_object(oid, serialization.serialize(item),
-                                       is_error=False)
-                    # one-way after the seal rpc returns: order guaranteed
-                    self.channel.send("stream", spec.task_id, count)
+                    sobj = serialization.serialize(item)
+                    if sobj.total_bytes <= cfg.max_direct_call_object_size:
+                        self.channel.send("stream", spec.task_id, count,
+                                          sobj.to_bytes())
+                    else:
+                        oid = _OID.for_stream(spec.task_id, count)
+                        self._store_object(oid, sobj, is_error=False)
+                        self.channel.send("stream", spec.task_id, count,
+                                          None)
                     count += 1
         except Exception as e:  # mid-stream user error
             self._send_error(spec, e)
@@ -689,7 +712,16 @@ class WorkerRuntime:
         self._finish(spec, count)
 
     def stream_next(self, task_id, index: int, timeout=None):
+        # owner-side stream buffer first (direct-path streams); head path
+        # for streams this worker does not own
+        rep = self.direct.stream_next(task_id, index, timeout)
+        if rep is not None:
+            return rep
         return self.rpc.call("rpc", "stream_next", task_id, index, timeout)
+
+    def publish_stream(self, task_id) -> None:
+        # generator handle serialized out of this process (object_ref)
+        self.direct.publish_stream(task_id)
 
     def _send_error(self, spec: TaskSpec, exc: Exception) -> None:
         if isinstance(exc, TaskError):

@@ -139,10 +139,11 @@ class TestDirectActorEdgeCases:
     def teardown_method(self):
         ray_tpu.shutdown()
 
-    def test_head_pin_flushes_queued_direct_calls(self):
-        """A streaming call (head path) while a dep-deferred direct call
-        is queued: the queued call must still flush once its dep lands —
-        pinning must never strand it (round-4 review finding)."""
+    def test_streaming_call_behind_deferred_dep(self):
+        """A streaming call submitted while a dep-deferred direct call is
+        queued ahead of it: the ordered route gates the stream behind the
+        deferred call, and both complete on the direct path (round 5:
+        streaming is direct-eligible, head_pin is gone)."""
         @ray_tpu.remote
         class Gen:
             def consume(self, x):

@@ -65,6 +65,34 @@ class TestRecordGC:
             for i in range(n):
                 yield i
 
+        g = gen.remote(5)
+        tid = g._task_id
+        out = [ray_tpu.get(r) for r in g]
+        assert out == [0, 1, 2, 3, 4]
+        head = _head()
+        # direct-path streams never create head stream records (items
+        # ride the direct reply chain to the owner)
+        assert not head.streams and not head.stream_eof
+        # owner-side buffer purges when the generator handle is released
+        rt = runtime_mod.get_current_runtime()
+        assert tid in rt.direct._streams
+        del g
+        import gc
+
+        gc.collect()
+        deadline = time.monotonic() + 5
+        while tid in rt.direct._streams and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert tid not in rt.direct._streams
+
+    def test_head_path_stream_records_released(self):
+        # num_cpus=2 forces the head path: the head stream-record
+        # protocol (records + pins) must still GC
+        @ray_tpu.remote(num_returns="streaming", num_cpus=2)
+        def gen(n):
+            for i in range(n):
+                yield i
+
         out = [ray_tpu.get(r) for r in gen.remote(5)]
         assert out == [0, 1, 2, 3, 4]
         head = _head()

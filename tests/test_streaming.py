@@ -105,3 +105,103 @@ def test_stream_large_items(ray_start_regular):
 
     vals = [ray_tpu.get(r) for r in big_gen.remote()]
     assert [int(v[0]) for v in vals] == [0, 1, 2]
+
+
+def test_direct_stream_zero_head_records(ray_start_regular):
+    """Round-5 invariant: streaming rides the direct path end to end —
+    a task stream and an actor-call stream leave ZERO head task records
+    beyond the actor creation, and no head stream records at all
+    (items ride the direct reply chain to the owner)."""
+    from ray_tpu.core import runtime as runtime_mod
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i
+
+    @ray_tpu.remote
+    class A:
+        def stream(self, n):
+            for i in range(n):
+                yield i * 2
+
+    head = runtime_mod.get_current_runtime().head
+    a = A.remote()
+    assert ray_tpu.get(a.stream.options(  # warm the actor
+        num_returns="streaming").remote(1).completed()) == 1
+    before = len(head.tasks)
+
+    assert [ray_tpu.get(r) for r in gen.remote(4)] == [0, 1, 2, 3]
+    assert [ray_tpu.get(r)
+            for r in a.stream.options(
+                num_returns="streaming").remote(3)] == [0, 2, 4]
+
+    assert len(head.tasks) == before  # no new head task records
+    assert not head.streams           # no head stream records
+    assert not head.stream_eof        # nothing was published
+
+
+def test_stream_across_daemon_nodes(ray_start_cluster):
+    """Stream items hop the peer mesh: the producer actor lives on a
+    separate-process daemon, the driver consumes — item announcements
+    ride executor-worker -> daemon node -> head node -> owner, with the
+    completion FIFO behind them."""
+    cluster = ray_start_cluster
+    # capacity 2: the Producer actor holds one unit for life, the big()
+    # task needs the other
+    cluster.add_node(num_cpus=2, resources={"там": 2},
+                     separate_process=True)
+
+    @ray_tpu.remote(resources={"там": 1})
+    class Producer:
+        def stream(self, n):
+            for i in range(n):
+                yield ("item", i)
+
+    p = Producer.remote()
+    g = p.stream.options(num_returns="streaming").remote(5)
+    assert [ray_tpu.get(r) for r in g] == [("item", i) for i in range(5)]
+
+    # large items cross the mesh via the store path
+    import numpy as np
+
+    @ray_tpu.remote(resources={"там": 1}, num_returns="streaming")
+    def big():
+        for i in range(2):
+            yield np.full(150_000, i, dtype=np.int64)
+
+    vals = [ray_tpu.get(r) for r in big.remote()]
+    assert [int(v[0]) for v in vals] == [0, 1]
+
+
+def test_serve_streaming_and_data_split_head_free(ray_start_regular):
+    """Round-5 verdict ask #1 "done" criteria: a Serve streaming response
+    and a Data streaming_split iterator both run with zero new head task
+    records and zero head stream records."""
+    from ray_tpu import serve
+    from ray_tpu.core import runtime as runtime_mod
+
+    head = runtime_mod.get_current_runtime().head
+
+    @serve.deployment(stream=True)
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                yield f"chunk{i}"
+
+    h = serve.run(Streamer.bind())
+    assert list(h.options(stream=True).remote(2)) == ["chunk0", "chunk1"]
+    before = len(head.tasks)
+    assert list(h.options(stream=True).remote(3)) == [
+        "chunk0", "chunk1", "chunk2"]
+    assert len(head.tasks) == before, "serve streaming touched the head"
+    assert not head.streams
+    serve.shutdown()
+
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(20)
+    it = ds.streaming_split(1)[0]
+    total = sum(sum(b["id"]) for b in it.iter_batches(batch_size=5))
+    assert total == sum(range(20))
+    assert not head.streams, "streaming_split left head stream records"
