@@ -118,11 +118,14 @@ def main():
         if flagship:
             import optax
 
-            # bf16 first moment: the memory lever that fits ~1B on one
-            # v5e chip (see LlamaConfig.flagship)
-            optimizer = optax.adamw(3e-4, b1=0.9, b2=0.95,
-                                    weight_decay=0.1,
-                                    mu_dtype=jax.numpy.bfloat16)
+            # adafactor, bf16 momentum: the T5/PaLM TPU recipe. Peak HBM
+            # = fp32 params (4 B) + fp32 grads (4 B) + bf16 momentum (2 B)
+            # + factored second moment (~0) ~= 10 B/param; the bf16-mu
+            # adamw variant peaks at 14 B/param (fp32 nu + grads) and
+            # OOMs the 16 GB chip above ~950M params.
+            optimizer = optax.adafactor(
+                learning_rate=3e-4, momentum=0.9,
+                dtype_momentum=jax.numpy.bfloat16)
         init, step, data_sharding, _ = make_train_step(
             cfg, mesh, optimizer=optimizer)
         state = init(jax.random.PRNGKey(0))
@@ -178,12 +181,28 @@ def main():
     # line and the flagship MFU (round-4 VERDICT ask #10)
     if (not on_cpu and args.config == "bench" and not args.no_flagship
             and not args.batch and not args.seq):
-        try:
-            out["flagship"] = run_config(LlamaConfig.flagship(), 8, 2048,
-                                         max(5, args.steps // 2),
-                                         flagship=True)
-        except Exception as e:  # noqa: BLE001 — never lose the headline
-            out["flagship"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        # fallback ladder: full 1.04B, then the largest config that fits
+        # with the heavier bf16-mu adamw state (2048d/14L, 924M) — the
+        # committed artifact must carry a live flagship number even if
+        # the compile environment regresses (round-4 VERDICT ask #2)
+        ladder = [
+            ("flagship_1040m", LlamaConfig.flagship()),
+            ("fallback_924m", LlamaConfig(
+                vocab_size=32000, dim=2048, n_layers=14, n_heads=16,
+                n_kv_heads=8, mlp_dim=7168, max_seq_len=2048)),
+        ]
+        errors = []
+        for name, fcfg in ladder:
+            try:
+                out["flagship"] = run_config(fcfg, 8, 2048,
+                                             max(5, args.steps // 2),
+                                             flagship=True)
+                out["flagship"]["config"] = name
+                break
+            except Exception as e:  # noqa: BLE001 — never lose the headline
+                errors.append(f"{name}: {type(e).__name__}: {e}"[:200])
+        else:
+            out["flagship"] = {"error": " | ".join(errors)[:400]}
     print(json.dumps(out))
 
 

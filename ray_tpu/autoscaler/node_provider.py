@@ -254,25 +254,48 @@ class TPUQueuedResourceProvider(NodeProvider):
             f.write(self.startup_script(qr_name, acc))
         return path
 
+    # delete errors that mean the QR is already gone / already going:
+    # retrying is pointless and raising would abort the reconciler's
+    # whole scale-down pass (other victims never terminate)
+    _GONE_MARKERS = ("not_found", "notfound", "404", "409", "conflict",
+                     "already", "deleting", "does not exist")
+
     def terminate_node(self, provider_id: str) -> None:
-        self._runner([
-            "gcloud", "compute", "tpus", "queued-resources", "delete",
-            provider_id, f"--project={self._project}",
-            f"--zone={self._zone}", "--quiet", "--force"])
+        try:
+            self._runner([
+                "gcloud", "compute", "tpus", "queued-resources", "delete",
+                provider_id, f"--project={self._project}",
+                f"--zone={self._zone}", "--quiet", "--force"])
+        except Exception as e:  # noqa: BLE001 — classify, don't mask
+            msg = str(e).lower()
+            if not any(m in msg for m in self._GONE_MARKERS):
+                raise
+            # already deleted / delete in progress: converge silently
         with self._lock:
             self._requested.pop(provider_id, None)
 
     def non_terminated_nodes(self) -> List[str]:
         import json
 
-        out = self._runner([
-            "gcloud", "compute", "tpus", "queued-resources", "list",
-            f"--project={self._project}", f"--zone={self._zone}",
-            "--format=json"])
+        try:
+            out = self._runner([
+                "gcloud", "compute", "tpus", "queued-resources", "list",
+                f"--project={self._project}", f"--zone={self._zone}",
+                "--format=json"])
+        except Exception:
+            # transient list/describe failure (gcloud timeouts are the
+            # common QR-devops papercut): serve the last good view so one
+            # blip doesn't zero the provider count and double-launch.
+            # Never-succeeded listing still raises (misconfig, fail fast).
+            cached = getattr(self, "_last_alive", None)
+            if cached is None:
+                raise
+            return list(cached)
         alive = []
         for qr in json.loads(out or "[]"):
             name = qr.get("name", "").rsplit("/", 1)[-1]
             state = (qr.get("state") or {}).get("state", "")
             if state not in ("SUSPENDED", "FAILED", "DELETING"):
                 alive.append(name)
+        self._last_alive = list(alive)
         return alive

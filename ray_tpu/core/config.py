@@ -80,6 +80,10 @@ class Config:
     # ---- tasks / fault tolerance (reference: ray_config_def.h:138,414,835) ----
     task_retry_delay_ms: int = 0
     lineage_pinning_enabled: bool = True
+    # owner-side lineage for direct-path store-resident results: specs
+    # retained for reconstruction after the sealing node dies (reference:
+    # object_recovery_manager.h + max_lineage_bytes-style cap); 0 = off
+    direct_lineage_max: int = 4096
     actor_restart_delay_ms: int = 0
     # node prober: period * threshold = grace before a silent daemon is
     # declared dead (generous default — pongs share the daemon's handler

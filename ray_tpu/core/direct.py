@@ -33,6 +33,7 @@ the executor dies, the owner retries per ``max_retries``.
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from . import serialization
@@ -169,6 +170,18 @@ class DirectTaskManager:
         # oid -> node hex that sealed a large (store-resident) result;
         # shipped as a pull hint when the oid is a downstream task's arg
         self._result_nodes: Dict[ObjectID, str] = {}
+        # ---- lineage (store-resident results only) ---------------------
+        # tid -> settled spec retained for reconstruction: a store-sealed
+        # result dies with its node, and the owner is the only process
+        # that can resubmit the creating task (reference:
+        # object_recovery_manager.h:90 RecoverObject + reference_count.cc
+        # lineage pinning). Inline results live in _results and need no
+        # lineage. Bounded FIFO (direct_lineage_max) — eviction means
+        # "not reconstructable", matching the reference's lineage cap.
+        self._lineage: "OrderedDict[TaskID, TaskSpec]" = OrderedDict()
+        # tid -> store-resident return oids still referenced; when the
+        # last one is dropped the lineage entry is released
+        self._lineage_live: Dict[TaskID, Set[ObjectID]] = {}
         # streaming generator tasks owned by this manager: items arrive
         # via on_stream_item over the direct reply chain (same FIFO as the
         # final completion), the consumer reads via stream_next — the
@@ -421,6 +434,7 @@ class DirectTaskManager:
                         self._results[oid] = (payload, True)
                         sealed_oids.append(oid)
                 else:
+                    store_resident: List[ObjectID] = []
                     for oid, payload, is_err in results:
                         if oid in self._dropped:
                             self._dropped.discard(oid)
@@ -431,7 +445,17 @@ class DirectTaskManager:
                             self._results[oid] = (payload, is_err)
                             if payload is None and exec_hex:
                                 self._result_nodes[oid] = exec_hex
+                            if payload is None and not is_err:
+                                store_resident.append(oid)
                             sealed_oids.append(oid)
+                    if (store_resident and err_name is None
+                            and spec.actor_id is None
+                            and not spec.streaming):
+                        # plain task with live store-sealed results:
+                        # retain the spec for lineage reconstruction
+                        # (actor results are not reconstructable; stream
+                        # items have replay semantics of their own)
+                        self._record_lineage_locked(spec, store_resident)
                 if spec.streaming:
                     pub_eof = self._settle_stream_locked(
                         spec, err_name is not None or cancelled
@@ -462,6 +486,89 @@ class DirectTaskManager:
         if resubmit is not None:
             resubmit.direct_hops = 0  # fresh routing for the retry
             self._submit(resubmit)
+
+    # ------------------------------------------------------------ lineage
+
+    def _record_lineage_locked(self, spec: TaskSpec,
+                               store_oids: List[ObjectID]) -> None:
+        from .config import global_config
+
+        cap = global_config().direct_lineage_max
+        if cap <= 0:
+            return
+        self._lineage[spec.task_id] = spec
+        self._lineage_live[spec.task_id] = set(store_oids)
+        while len(self._lineage) > cap:
+            old_tid, _ = self._lineage.popitem(last=False)
+            self._lineage_live.pop(old_tid, None)
+
+    def owns_lineage(self, oid: ObjectID) -> bool:
+        """True when ``oid``'s creating task can be resubmitted from this
+        owner's lineage (or is already being re-executed)."""
+        with self._lock:
+            tid = oid.task_id()
+            return tid in self._lineage or tid in self._pending
+
+    def recover(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction: the store-sealed result ``oid`` has no
+        live location, so resubmit its creating task (reference:
+        object_recovery_manager.h:90 ``RecoverObject`` — resubmission
+        respects ``max_retries``, and lost owned args recover
+        recursively). Safe to call spuriously: re-execution reseals the
+        same oids and getters simply read the fresh copy. Returns True
+        when a recovery is running (now or already)."""
+        probe_args: List[ObjectID] = []
+        with self._lock:
+            tid = oid.task_id()
+            if tid in self._pending:
+                return True  # already being re-executed
+            spec = self._lineage.get(tid)
+            if spec is None or spec.attempt >= spec.max_retries:
+                return False
+            # candidate owned args whose bytes were store-resident: their
+            # nodes may be gone too — probed outside the lock (the locate
+            # callback takes cluster locks)
+            for aoid in spec.arg_object_ids():
+                res = self._results.get(aoid)
+                if res is not None and res[0] is None:
+                    probe_args.append(aoid)
+        lost_args: List[ObjectID] = []
+        for aoid in probe_args:
+            alive = None
+            try:
+                if self._locate is not None:
+                    alive = self._locate(aoid)
+                elif self._ext_wait is not None:
+                    alive = bool(self._ext_wait([aoid], 0.0))
+            except Exception:
+                alive = None
+            if not alive:
+                lost_args.append(aoid)
+        with self._lock:
+            spec = self._lineage.pop(tid, None)
+            if spec is None:
+                return tid in self._pending
+            self._lineage_live.pop(tid, None)
+            spec.attempt += 1
+            for roid in spec.return_ids():
+                self._results.pop(roid, None)
+                self._result_nodes.pop(roid, None)
+            recover_first = []
+            for aoid in lost_args:
+                if aoid.task_id() in self._lineage:
+                    # clear the stale entry so register() defers this
+                    # spec on the arg until its producer reseals it
+                    self._results.pop(aoid, None)
+                    self._result_nodes.pop(aoid, None)
+                    recover_first.append(aoid)
+        for aoid in recover_first:
+            self.recover(aoid)
+        spec.direct_hops = 0
+        spec.arg_hints = None  # stale node hints died with the node
+        ready = self.register(spec)
+        if ready is not None:
+            self._submit(ready)
+        return True
 
     def seal_error_local(self, spec: TaskSpec, exc: Exception) -> None:
         """Settle an owned task with ``exc`` on all its returns."""
@@ -702,6 +809,12 @@ class DirectTaskManager:
                     and oid.task_id() in self._pending:
                 self._dropped.add(oid)
             tid = oid.task_id()
+            live = self._lineage_live.get(tid)
+            if live is not None:
+                live.discard(oid)
+                if not live:
+                    self._lineage_live.pop(tid, None)
+                    self._lineage.pop(tid, None)
             st = self._streams.get(tid)
             if st is not None:
                 st.handed.discard(oid)

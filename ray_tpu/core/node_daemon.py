@@ -386,11 +386,18 @@ def main(argv=None) -> int:
                 session_dir, labels, node_ip=node_ip)
     head.node = node
     server = node.start_object_server(key)
+    # per-node dashboard agent (reference: dashboard/agent.py:26): logs,
+    # metrics, profile trigger — head dashboard proxies /api/nodes/<hex>/*
+    from ray_tpu.dashboard.agent import NodeAgent
+
+    loopback = node_ip in ("127.0.0.1", "localhost")
+    agent = NodeAgent(node, host="127.0.0.1" if loopback else "0.0.0.0")
     channel.send("node_ready", {
         "resources": resources,
         "labels": labels,
         "object_addr": list(server.address),
         "pid": os.getpid(),
+        "agent_addr": [node_ip, agent.address[1]],
     })
     from .syncer import NodeSyncer
 

@@ -25,7 +25,7 @@ import threading
 import time
 from collections import defaultdict, deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from . import serialization
 from .config import global_config
@@ -189,6 +189,9 @@ class Head:
         self.node_ip = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
         # wait() waiters woken by any object seal (mixed direct+head wait)
         self._seal_events: Set[threading.Event] = set()
+        # driver-owner lineage recovery for direct-path results (wired by
+        # DriverRuntime; consulted when a lost object has no head record)
+        self.direct_recover: Optional[Callable[[ObjectID], bool]] = None
         # fetch_local pulls in flight (dedup across concurrent waits)
         self._active_pulls: Set[ObjectID] = set()
         # head node (the driver's node)
@@ -513,6 +516,8 @@ class Head:
         proxy = NodeProxy(self, node_id, ready["resources"],
                           ready.get("labels"), channel,
                           ready["object_addr"], ready.get("pid"))
+        agent_addr = ready.get("agent_addr")
+        proxy.agent_addr = tuple(agent_addr) if agent_addr else None
         if self._stopped:
             proxy.shutdown()
             return
@@ -1480,7 +1485,20 @@ class Head:
             return False
         tid = oid.task_id()
         rec = self.tasks.get(tid)
-        if rec is None or rec.state in ("PENDING", "QUEUED", "RUNNING", "WAITING_DEPS"):
+        if rec is None:
+            # no head record: a direct-path result. The driver owner's
+            # lineage table can resubmit it (worker-owned results recover
+            # in the worker's own get path; a third process pulling a
+            # worker-owned lost object is not recoverable — the reference
+            # has the same owner-reachability constraint)
+            cb = self.direct_recover
+            if cb is not None:
+                try:
+                    return bool(cb(oid))
+                except Exception:
+                    return False
+            return False
+        if rec.state in ("PENDING", "QUEUED", "RUNNING", "WAITING_DEPS"):
             return False
         spec = rec.spec
         if spec.actor_id is not None:
@@ -1855,6 +1873,9 @@ class DriverRuntime:
             locate=head.locate_large_object,
             publish_stream_item=head.publish_stream_item,
             publish_stream_eof=head.publish_stream_eof)
+        # lost direct results resubmit from this owner's lineage when the
+        # head's get loops find no live location
+        head.direct_recover = self.direct.recover
 
         # direct actor calls: ordered caller->actor-node submission; the
         # head only resolves locations and keeps the lifecycle FSM

@@ -1,37 +1,53 @@
-"""Runtime environments: per-task/actor env_vars, working_dir, py_modules.
+"""Runtime environments: a plugin seam + the built-in env plugins.
 
 Analog of the reference's runtime-env subsystem
-(python/ray/_private/runtime_env/ + agent/runtime_env_agent.py:161):
-directories are zipped at submission, shipped through the GCS KV store,
-and materialized once per worker host into a content-addressed cache;
-env_vars apply around execution (set-and-restore for shared plain-task
-workers, permanent for actor-dedicated workers).
+(python/ray/_private/runtime_env/ + agent/runtime_env_agent.py:161) with
+its plugin interface (runtime_env/plugin.py RuntimeEnvPlugin): every
+``runtime_env`` dict key is owned by a plugin with three hooks —
 
-Supported keys: ``env_vars`` (dict), ``working_dir`` (local dir path),
-``py_modules`` (list of local dir paths), ``pip`` (list of requirement
-strings / local package paths, or ``{"packages": [...], "pip_install_
-options": [...]}``) — a content-addressed virtualenv is created once per
-host per requirement set (reference: runtime_env/pip.py) and its
-site-packages activates around execution. The venv uses
-``--system-site-packages`` so jax/the framework stay importable;
-container/conda isolation is out of scope (workers share the
-interpreter).
+    pack(value, runtime)      submitter side: replace local paths with
+                              content-addressed KV refs
+    create(value, runtime)    worker side, once per host (plugins cache
+                              by content hash): materialize, return a
+                              context
+    activate(context, state)  apply around execution; register undo via
+                              the ActivationState
+
+Built-ins registered through the same seam: ``env_vars``,
+``working_dir``, ``py_modules``, ``pip`` (per-requirement-set venvs) and
+``conda`` (env-yaml -> ``conda env create`` — honest error when no conda
+executable exists, e.g. this zero-egress image). Third-party plugins
+register via :func:`register_plugin` or the
+``RAY_TPU_RUNTIME_ENV_PLUGINS`` env var (``module:Class,...``), which
+worker processes load lazily (reference: RAY_RUNTIME_ENV_PLUGINS).
+
+Isolation boundary: workers share the interpreter, so pip/conda envs
+contribute ``sys.path`` entries (with module-cache purge on restore)
+rather than a separate python; container images are out of scope.
 """
 
 from __future__ import annotations
 
 import hashlib
 import io
+import json
 import os
 import sys
 import zipfile
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 _KV_NS = "runtime_env"
 _MAX_ZIP = 100 * 1024 * 1024
 # abspath -> (fingerprint, uploaded-ref): skip re-zipping an unchanged dir
 # on every .remote() call (submission-throughput killer otherwise)
 _upload_cache: Dict[str, Tuple[tuple, dict]] = {}
+
+_CACHE_ROOT = os.path.join("/tmp", "raytpu_runtime_env")
+
+
+# --------------------------------------------------------------------------- #
+# shared helpers
+# --------------------------------------------------------------------------- #
 
 
 def _zip_dir(path: str) -> bytes:
@@ -70,48 +86,32 @@ def _dir_fingerprint(base: str) -> tuple:
     return (n, total, latest)
 
 
-def pack_runtime_env(env: Optional[dict], runtime) -> Optional[dict]:
-    """Driver/submitter side: replace local paths with KV references."""
-    if not env:
-        return env
-    out = dict(env)
-
-    def upload(path: str) -> dict:
-        base = os.path.abspath(path)
-        fp = _dir_fingerprint(base)
-        cached = _upload_cache.get(base)
-        if cached is not None and cached[0] == fp:
-            # shutdown()+init() recreates the KV store: confirm the
-            # package still exists before trusting the cached ref
-            if runtime.kv("exists", cached[1]["kv_key"].encode(), _KV_NS):
-                return cached[1]
-        data = _zip_dir(path)
-        digest = hashlib.blake2b(data, digest_size=16).hexdigest()
-        key = f"pkg_{digest}".encode()
-        if not runtime.kv("exists", key, _KV_NS):
-            runtime.kv("put", key, data, _KV_NS, True)
-        ref = {"kv_key": key.decode(), "hash": digest,
-               "basename": os.path.basename(base)}
-        _upload_cache[base] = (fp, ref)
-        return ref
-
-    wd = out.get("working_dir")
-    if isinstance(wd, str):
-        out["working_dir"] = upload(wd)
-    mods = out.get("py_modules")
-    if mods:
-        out["py_modules"] = [upload(m) if isinstance(m, str) else m
-                             for m in mods]
-    return out
+def _upload_dir(path: str, runtime) -> dict:
+    base = os.path.abspath(path)
+    fp = _dir_fingerprint(base)
+    cached = _upload_cache.get(base)
+    if cached is not None and cached[0] == fp:
+        # shutdown()+init() recreates the KV store: confirm the
+        # package still exists before trusting the cached ref
+        if runtime.kv("exists", cached[1]["kv_key"].encode(), _KV_NS):
+            return cached[1]
+    data = _zip_dir(path)
+    digest = hashlib.blake2b(data, digest_size=16).hexdigest()
+    key = f"pkg_{digest}".encode()
+    if not runtime.kv("exists", key, _KV_NS):
+        runtime.kv("put", key, data, _KV_NS, True)
+    ref = {"kv_key": key.decode(), "hash": digest,
+           "basename": os.path.basename(base)}
+    _upload_cache[base] = (fp, ref)
+    return ref
 
 
 def _materialize(ref: dict, runtime) -> str:
     """Extract a KV-stored zip into the host-local content cache."""
     import fcntl
 
-    cache_root = os.path.join("/tmp", "raytpu_runtime_env")
-    os.makedirs(cache_root, exist_ok=True)
-    dest = os.path.join(cache_root, ref["hash"])
+    os.makedirs(_CACHE_ROOT, exist_ok=True)
+    dest = os.path.join(_CACHE_ROOT, ref["hash"])
     marker = dest + ".ok"
     if os.path.exists(marker):
         return dest
@@ -130,131 +130,391 @@ def _materialize(ref: dict, runtime) -> str:
     return dest
 
 
-def _materialize_pip_env(pip_spec, runtime) -> str:
-    """Create (once per host) the venv for a requirement set; returns its
-    site-packages path (reference: runtime_env/pip.py — per-env-hash venv
-    with delete-on-failure + cross-process locking)."""
-    import fcntl
-    import subprocess
-
-    if isinstance(pip_spec, dict):
-        reqs = list(pip_spec.get("packages") or [])
-        opts = list(pip_spec.get("pip_install_options") or [])
-    else:
-        reqs = list(pip_spec)
-        opts = []
-    digest = hashlib.blake2b(
-        ("\n".join(sorted(reqs) + sorted(opts))).encode(),
-        digest_size=12).hexdigest()
-    cache_root = os.path.join("/tmp", "raytpu_runtime_env")
-    os.makedirs(cache_root, exist_ok=True)
-    dest = os.path.join(cache_root, f"pip-{digest}")
-    marker = dest + ".ok"
-
-    def site_packages() -> str:
-        v = f"python{sys.version_info.major}.{sys.version_info.minor}"
-        return os.path.join(dest, "lib", v, "site-packages")
-
-    if os.path.exists(marker):
-        return site_packages()
-    with open(dest + ".lock", "w") as lock:
-        fcntl.flock(lock, fcntl.LOCK_EX)
-        if os.path.exists(marker):
-            return site_packages()
-        import shutil
-        import venv
-
-        shutil.rmtree(dest, ignore_errors=True)  # prior failed attempt
-        try:
-            venv.create(dest, system_site_packages=True, with_pip=True,
-                        symlinks=True)
-            # when THIS interpreter itself lives in a venv (/opt/venv),
-            # system_site_packages points past it to the base python —
-            # bridge our site-packages in via a .pth so pip's build
-            # backend (setuptools) and the framework stay importable
-            host_sps = [p for p in sys.path if p.endswith("site-packages")
-                        and os.path.isdir(p)]
-            if host_sps:
-                with open(os.path.join(site_packages(),
-                                       "_raytpu_host.pth"), "w") as f:
-                    f.write("\n".join(host_sps) + "\n")
-            pip = os.path.join(dest, "bin", "pip")
-            proc = subprocess.run(
-                [pip, "install", "--disable-pip-version-check",
-                 "--no-input"] + opts + reqs,
-                capture_output=True, text=True, timeout=600)
-            if proc.returncode != 0:
-                raise RuntimeError(
-                    f"pip install failed for runtime_env {reqs}:\n"
-                    + proc.stderr[-2000:])
-            open(marker, "w").close()
-        except BaseException:
-            shutil.rmtree(dest, ignore_errors=True)
-            raise
-    return site_packages()
+# --------------------------------------------------------------------------- #
+# plugin interface (reference: runtime_env/plugin.py RuntimeEnvPlugin)
+# --------------------------------------------------------------------------- #
 
 
-def apply_runtime_env(env: Optional[dict], runtime):
-    """Worker side: apply before execution; returns a restore() callable
-    (no-op when nothing was applied)."""
-    if not env:
-        return lambda: None
-    saved_env: Dict[str, Optional[str]] = {}
-    saved_cwd: Optional[str] = None
-    added_paths: List[str] = []
+class ActivationState:
+    """Undo journal one activation builds up; ``restore()`` unwinds it.
+    Passed to every plugin's ``activate`` so custom plugins compose with
+    the built-ins' set-and-restore semantics."""
 
-    def restore():
-        for k, old in saved_env.items():
+    def __init__(self):
+        self._saved_env: Dict[str, Optional[str]] = {}
+        self._saved_cwd: Optional[str] = None
+        self._added_paths: List[str] = []
+        self._deferred: List[Callable[[], None]] = []
+
+    # -- plugin-facing mutators (each records its own undo) --
+
+    def set_env(self, key: str, value: str) -> None:
+        if key not in self._saved_env:
+            self._saved_env[key] = os.environ.get(key)
+        os.environ[key] = str(value)
+
+    def chdir(self, path: str) -> None:
+        if self._saved_cwd is None:
+            self._saved_cwd = os.getcwd()
+        os.chdir(path)
+
+    def add_sys_path(self, path: str) -> None:
+        sys.path.insert(0, path)
+        self._added_paths.append(path)
+
+    def defer(self, fn: Callable[[], None]) -> None:
+        """Arbitrary custom undo, run during restore()."""
+        self._deferred.append(fn)
+
+    # -- runtime-facing --
+
+    def restore(self) -> None:
+        for fn in reversed(self._deferred):
+            try:
+                fn()
+            except Exception:
+                pass
+        for k, old in self._saved_env.items():
             if old is None:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = old
-        if saved_cwd is not None:
+        if self._saved_cwd is not None:
             try:
-                os.chdir(saved_cwd)
+                os.chdir(self._saved_cwd)
             except OSError:
                 pass
-        for p in added_paths:
+        for p in self._added_paths:
             try:
                 sys.path.remove(p)
             except ValueError:
                 pass
-        if added_paths:
+        if self._added_paths:
             # modules imported FROM the env must not leak into later
             # tasks through the sys.modules cache (the path alone is not
             # the isolation boundary)
-            roots = tuple(os.path.abspath(p) + os.sep for p in added_paths)
+            roots = tuple(os.path.abspath(p) + os.sep
+                          for p in self._added_paths)
             for name, mod in list(sys.modules.items()):
                 f = getattr(mod, "__file__", None)
                 if f and os.path.abspath(f).startswith(roots):
                     sys.modules.pop(name, None)
 
+
+class RuntimeEnvPlugin:
+    """One runtime_env key's implementation. Subclass + register."""
+
+    name: str = ""
+    # activation order: lower first (env_vars before path-contributing
+    # plugins, so a plugin can read task env vars)
+    priority: int = 10
+
+    def pack(self, value: Any, runtime) -> Any:
+        """Submitter side: make the value shippable (upload local paths)."""
+        return value
+
+    def create(self, value: Any, runtime) -> Any:
+        """Worker side: materialize once per host; returns the context
+        handed to ``activate``. Implementations cache by content hash."""
+        return value
+
+    def activate(self, context: Any, state: ActivationState) -> None:
+        raise NotImplementedError
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+_env_plugins_loaded = False
+
+
+def register_plugin(plugin) -> None:
+    """Register a plugin instance (or class — instantiated no-arg)."""
+    if isinstance(plugin, type):
+        plugin = plugin()
+    if not plugin.name:
+        raise ValueError("plugin needs a non-empty name")
+    _PLUGINS[plugin.name] = plugin
+
+
+def unregister_plugin(name: str) -> None:
+    _PLUGINS.pop(name, None)
+
+
+def _ensure_plugins() -> None:
+    """Built-ins + RAY_TPU_RUNTIME_ENV_PLUGINS (module:Class,...) — the
+    env var is how third-party plugins reach worker processes
+    (reference: RAY_RUNTIME_ENV_PLUGINS)."""
+    global _env_plugins_loaded
+    for cls in (EnvVarsPlugin, WorkingDirPlugin, PyModulesPlugin,
+                PipPlugin, CondaPlugin):
+        if cls.name not in _PLUGINS:
+            register_plugin(cls)
+    if _env_plugins_loaded:
+        return
+    _env_plugins_loaded = True
+    spec = os.environ.get("RAY_TPU_RUNTIME_ENV_PLUGINS", "")
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        mod_name, _, cls_name = entry.partition(":")
+        import importlib
+
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        if cls.name not in _PLUGINS:  # explicit registration wins
+            register_plugin(cls)
+
+
+# --------------------------------------------------------------------------- #
+# built-in plugins
+# --------------------------------------------------------------------------- #
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 0
+
+    def activate(self, context, state: ActivationState) -> None:
+        for k, v in (context or {}).items():
+            state.set_env(k, str(v))
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 10
+
+    def pack(self, value, runtime):
+        return _upload_dir(value, runtime) if isinstance(value, str) \
+            else value
+
+    def create(self, value, runtime):
+        return _materialize(value, runtime) if isinstance(value, dict) \
+            else None
+
+    def activate(self, path, state: ActivationState) -> None:
+        if path:
+            state.chdir(path)
+            state.add_sys_path(path)
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 20
+
+    def pack(self, value, runtime):
+        return [_upload_dir(m, runtime) if isinstance(m, str) else m
+                for m in (value or [])]
+
+    def create(self, value, runtime):
+        return [_materialize(m, runtime) for m in (value or [])
+                if isinstance(m, dict)]
+
+    def activate(self, paths, state: ActivationState) -> None:
+        for p in paths or ():
+            state.add_sys_path(p)
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    """Per-requirement-set virtualenv (reference: runtime_env/pip.py —
+    per-env-hash venv with delete-on-failure + cross-process locking)."""
+
+    name = "pip"
+    priority = 30
+
+    def create(self, pip_spec, runtime) -> Optional[str]:
+        import fcntl
+        import subprocess
+
+        if not pip_spec:
+            return None
+        if isinstance(pip_spec, dict):
+            reqs = list(pip_spec.get("packages") or [])
+            opts = list(pip_spec.get("pip_install_options") or [])
+        else:
+            reqs = list(pip_spec)
+            opts = []
+        digest = hashlib.blake2b(
+            ("\n".join(sorted(reqs) + sorted(opts))).encode(),
+            digest_size=12).hexdigest()
+        os.makedirs(_CACHE_ROOT, exist_ok=True)
+        dest = os.path.join(_CACHE_ROOT, f"pip-{digest}")
+        marker = dest + ".ok"
+
+        def site_packages() -> str:
+            v = f"python{sys.version_info.major}.{sys.version_info.minor}"
+            return os.path.join(dest, "lib", v, "site-packages")
+
+        if os.path.exists(marker):
+            return site_packages()
+        with open(dest + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if os.path.exists(marker):
+                return site_packages()
+            import shutil
+            import venv
+
+            shutil.rmtree(dest, ignore_errors=True)  # prior failed attempt
+            try:
+                venv.create(dest, system_site_packages=True, with_pip=True,
+                            symlinks=True)
+                # when THIS interpreter itself lives in a venv (/opt/venv),
+                # system_site_packages points past it to the base python —
+                # bridge our site-packages in via a .pth so pip's build
+                # backend (setuptools) and the framework stay importable
+                host_sps = [p for p in sys.path
+                            if p.endswith("site-packages")
+                            and os.path.isdir(p)]
+                if host_sps:
+                    with open(os.path.join(site_packages(),
+                                           "_raytpu_host.pth"), "w") as f:
+                        f.write("\n".join(host_sps) + "\n")
+                pip = os.path.join(dest, "bin", "pip")
+                proc = subprocess.run(
+                    [pip, "install", "--disable-pip-version-check",
+                     "--no-input"] + opts + reqs,
+                    capture_output=True, text=True, timeout=600)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"pip install failed for runtime_env {reqs}:\n"
+                        + proc.stderr[-2000:])
+                open(marker, "w").close()
+            except BaseException:
+                shutil.rmtree(dest, ignore_errors=True)
+                raise
+        return site_packages()
+
+    def activate(self, sp, state: ActivationState) -> None:
+        if sp:
+            state.add_sys_path(sp)
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """Conda envs (reference: runtime_env/conda.py). Value forms:
+    an ``environment.yml`` path, a dict spec (JSON is valid YAML, so
+    dicts serialize directly), or the name of a pre-existing env.
+
+    Materialization shells out to the host's ``conda`` — on hosts
+    without one (like this zero-egress image) ``create`` raises an
+    honest RuntimeError instead of pretending. Because workers share
+    the interpreter, activation prepends the env's ``bin`` to PATH and
+    bridges its site-packages ONLY when the env's python matches the
+    running interpreter's major.minor."""
+
+    name = "conda"
+    priority = 40
+
+    def pack(self, value, runtime):
+        if isinstance(value, str) and (os.sep in value
+                                       or os.path.isfile(value)):
+            with open(value) as f:
+                return {"yaml": f.read()}
+        if isinstance(value, dict) and "yaml" not in value:
+            # a dict env spec: JSON-serialize (YAML superset) for hashing
+            return {"yaml": json.dumps(value, sort_keys=True)}
+        return value  # named env or already-packed
+
+    def _conda_exe(self) -> str:
+        import shutil
+
+        exe = os.environ.get("CONDA_EXE") or shutil.which("conda")
+        if not exe:
+            raise RuntimeError(
+                "runtime_env 'conda' requires a conda executable on the "
+                "worker host (none found in PATH or CONDA_EXE); this "
+                "image has no conda — use 'pip' envs instead")
+        return exe
+
+    def create(self, value, runtime):
+        import fcntl
+        import subprocess
+
+        if isinstance(value, str):  # pre-existing named env
+            exe = self._conda_exe()
+            out = subprocess.run([exe, "env", "list", "--json"],
+                                 capture_output=True, text=True, timeout=60)
+            for prefix in json.loads(out.stdout or "{}").get("envs", []):
+                if os.path.basename(prefix) == value:
+                    return {"prefix": prefix}
+            raise RuntimeError(f"conda env {value!r} not found")
+        yaml_text = value["yaml"]
+        digest = hashlib.blake2b(yaml_text.encode(),
+                                 digest_size=12).hexdigest()
+        os.makedirs(_CACHE_ROOT, exist_ok=True)
+        dest = os.path.join(_CACHE_ROOT, f"conda-{digest}")
+        marker = dest + ".ok"
+        if os.path.exists(marker):
+            return {"prefix": dest}
+        exe = self._conda_exe()  # fail fast before taking the lock
+        with open(dest + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if os.path.exists(marker):
+                return {"prefix": dest}
+            import shutil
+            import tempfile
+
+            shutil.rmtree(dest, ignore_errors=True)
+            with tempfile.NamedTemporaryFile(
+                    "w", suffix=".yml", delete=False) as f:
+                f.write(yaml_text)
+                spec_path = f.name
+            try:
+                proc = subprocess.run(
+                    [exe, "env", "create", "-p", dest, "-f", spec_path],
+                    capture_output=True, text=True, timeout=1800)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        "conda env create failed:\n" + proc.stderr[-2000:])
+                open(marker, "w").close()
+            except BaseException:
+                shutil.rmtree(dest, ignore_errors=True)
+                raise
+            finally:
+                os.unlink(spec_path)
+        return {"prefix": dest}
+
+    def activate(self, context, state: ActivationState) -> None:
+        prefix = context["prefix"]
+        state.set_env("PATH", os.path.join(prefix, "bin") + os.pathsep
+                      + os.environ.get("PATH", ""))
+        state.set_env("CONDA_PREFIX", prefix)
+        v = f"python{sys.version_info.major}.{sys.version_info.minor}"
+        sp = os.path.join(prefix, "lib", v, "site-packages")
+        if os.path.isdir(sp):
+            state.add_sys_path(sp)
+
+
+# --------------------------------------------------------------------------- #
+# runtime entry points (same surface as before the plugin refactor)
+# --------------------------------------------------------------------------- #
+
+
+def pack_runtime_env(env: Optional[dict], runtime) -> Optional[dict]:
+    """Driver/submitter side: run every key's plugin ``pack`` hook."""
+    if not env:
+        return env
+    _ensure_plugins()
+    out = {}
+    for key, value in env.items():
+        plugin = _PLUGINS.get(key)
+        if plugin is None:
+            raise ValueError(
+                f"unknown runtime_env key {key!r} (no plugin registered; "
+                f"known: {sorted(_PLUGINS)})")
+        out[key] = plugin.pack(value, runtime)
+    return out
+
+
+def apply_runtime_env(env: Optional[dict], runtime):
+    """Worker side: create+activate each key's plugin (priority order);
+    returns a restore() callable (no-op when nothing was applied)."""
+    if not env:
+        return lambda: None
+    _ensure_plugins()
+    state = ActivationState()
     try:
-        for k, v in (env.get("env_vars") or {}).items():
-            saved_env[k] = os.environ.get(k)
-            os.environ[k] = str(v)
-
-        wd = env.get("working_dir")
-        if isinstance(wd, dict):
-            path = _materialize(wd, runtime)
-            saved_cwd = os.getcwd()
-            os.chdir(path)
-            sys.path.insert(0, path)
-            added_paths.append(path)
-
-        for mod in env.get("py_modules") or ():
-            if isinstance(mod, dict):
-                path = _materialize(mod, runtime)
-                sys.path.insert(0, path)
-                added_paths.append(path)
-
-        pip_spec = env.get("pip")
-        if pip_spec:
-            sp = _materialize_pip_env(pip_spec, runtime)
-            sys.path.insert(0, sp)
-            added_paths.append(sp)
+        for plugin in sorted(
+                (p for k, p in _PLUGINS.items()
+                 if k in env and env[k] is not None),
+                key=lambda p: p.priority):
+            context = plugin.create(env[plugin.name], runtime)
+            plugin.activate(context, state)
     except BaseException:
-        restore()  # partial application must not leak into later tasks
+        state.restore()  # partial application must not leak into later tasks
         raise
-
-    return restore
+    return state.restore

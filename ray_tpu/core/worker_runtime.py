@@ -152,9 +152,11 @@ class WorkerRuntime:
 
     def _get_one(self, oid: ObjectID, timeout: Optional[float],
                  hint: Optional[str] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
         # owned direct results resolve in-process (blocks until the
         # executor's reply lands; no node round-trip)
         local = self.direct.get_local(oid, timeout)
+        owned_store = False
         if local is not None:
             payload, is_error = local
             if payload is not None:
@@ -164,8 +166,32 @@ class WorkerRuntime:
                 return value
             # large result: sealed in a node store — fall through, with
             # the sealing node as a pull hint
+            owned_store = self.direct.owns_lineage(oid)
             hint = hint or self.direct.result_node(oid)
-        rep = self.rpc.call("store", "get", oid, timeout, hint, timeout=None)
+        if owned_store:
+            # bounded first round: if the sealing node died, this owner is
+            # the only process that can resubmit the creating task (owner
+            # lineage — reference object_recovery_manager.h:90). The 2 s
+            # grace absorbs location-report lag before declaring loss.
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            probe_t = 2.0 if remaining is None else min(remaining, 2.0)
+            rep = self.rpc.call("store", "get", oid, probe_t, hint,
+                                timeout=None)
+            if rep[0] == "timeout":
+                located = self.rpc.call("store", "wait", [oid], 1, 0.0,
+                                        timeout=None)
+                if not located and self.direct.recover(oid):
+                    remaining = (None if deadline is None
+                                 else max(0.0, deadline - time.monotonic()))
+                    return self._get_one(oid, remaining)
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                rep = self.rpc.call("store", "get", oid, remaining, hint,
+                                    timeout=None)
+        else:
+            rep = self.rpc.call("store", "get", oid, timeout, hint,
+                                timeout=None)
         kind = rep[0]
         if kind == "timeout":
             raise GetTimeoutError(f"get timed out on {oid.hex()}")

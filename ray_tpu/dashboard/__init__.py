@@ -17,6 +17,13 @@ control plane — with:
     DELETE /api/jobs/<id>       delete a terminal job
     GET  /api/serve             Serve deployment summary
     GET  /api/pubsub?channel=&cursor=&timeout=   poll a pubsub channel
+    GET  /api/nodes/<hex>/logs[/<name>]     per-node agent: log browse/tail
+    GET  /api/nodes/<hex>/metrics           per-node agent: metrics snapshot
+    POST /api/nodes/<hex>/profile           per-node agent: profiler trace
+
+The /api/nodes/<hex>/* family proxies to the node's dashboard agent
+(agent.py — reference: dashboard/agent.py:26): separate-process daemons
+over their agent HTTP address, in-process nodes by direct call.
 """
 
 from __future__ import annotations
@@ -149,6 +156,7 @@ class DashboardServer:
 
         self._server = http.server.ThreadingHTTPServer((host, port), Handler)
         self.address = self._server.server_address
+        self._local_agents: dict = {}  # hex -> NodeAgentCore (local nodes)
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="dashboard-http",
             daemon=True)
@@ -196,6 +204,8 @@ class DashboardServer:
             msgs, nxt, gap = self.head.pubsub.poll(channel, cursor, t)
             h._json({"messages": _json_safe_list(msgs),
                      "cursor": nxt, "gap": gap})
+        elif path.startswith("/api/nodes/"):
+            self._node_agent_get(h, path, params)
         else:
             m = self._JOB_RE.match(path)
             if m and (m.group(2) or "") == "/logs":
@@ -220,6 +230,66 @@ class DashboardServer:
                     h._json({"error": "not found"}, 404)
             else:
                 h._json({"error": "not found"}, 404)
+
+    # ---- per-node agent proxy (reference: dashboard/agent.py) -------------
+
+    def _resolve_agent(self, node_hex: str):
+        """(local NodeAgentCore | None, daemon agent addr | None)."""
+        node = self.head.nodes.get(node_hex)
+        if node is None:
+            return None, None
+        if self.head._is_local(node):
+            core = self._local_agents.get(node_hex)
+            if core is None:
+                from .agent import NodeAgentCore
+
+                core = self._local_agents[node_hex] = NodeAgentCore(node)
+            return core, None
+        return None, getattr(node, "agent_addr", None)
+
+    def _proxy_agent(self, h, addr, path: str, method: str = "GET",
+                     body: bytes = b"") -> None:
+        import urllib.request
+
+        url = f"http://{addr[0]}:{addr[1]}{path}"
+        req = urllib.request.Request(url, data=body or None, method=method)
+        if body:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=70) as resp:
+                h._send(resp.status, resp.read())
+        except Exception as e:  # noqa: BLE001 — agent down / net error
+            h._json({"error": f"node agent unreachable: {e!r}"}, 502)
+
+    def _node_agent_get(self, h, path: str, params: dict) -> None:
+        parts = path.split("/")  # '', 'api', 'nodes', <hex>, rest...
+        if len(parts) < 5:
+            h._json({"error": "not found"}, 404)
+            return
+        node_hex, rest = parts[3], "/".join(parts[4:])
+        core, addr = self._resolve_agent(node_hex)
+        if core is None and addr is None:
+            h._json({"error": "unknown node or no agent"}, 404)
+            return
+        if core is None:
+            qs = "&".join(f"{k}={v}" for k, v in params.items())
+            self._proxy_agent(h, addr,
+                              f"/api/{rest}" + (f"?{qs}" if qs else ""))
+            return
+        if rest == "logs":
+            h._json(core.list_logs())
+        elif rest.startswith("logs/"):
+            try:
+                text, nxt = core.read_log(
+                    rest[len("logs/"):], int(params.get("offset", 0)),
+                    int(params.get("limit", 64 * 1024)))
+                h._json({"text": text, "next_offset": nxt})
+            except FileNotFoundError:
+                h._json({"error": "not found"}, 404)
+        elif rest == "metrics":
+            h._json(core.metrics())
+        else:
+            h._json({"error": "not found"}, 404)
 
     def _serve_summary(self) -> dict:
         import ray_tpu
@@ -251,6 +321,19 @@ class DashboardServer:
                     401)
             return
         path = h.path.split("?", 1)[0]
+        if path.startswith("/api/nodes/") and path.endswith("/profile"):
+            node_hex = path.split("/")[3]
+            core, addr = self._resolve_agent(node_hex)
+            if core is None and addr is None:
+                h._json({"error": "unknown node or no agent"}, 404)
+                return
+            body = h._body()
+            if core is not None:
+                h._json(core.profile(int(body.get("duration_ms", 500))))
+            else:
+                self._proxy_agent(h, addr, "/api/profile", method="POST",
+                                  body=json.dumps(body).encode())
+            return
         if path in ("/api/jobs", "/api/jobs/"):
             body = h._body()
             if not body.get("entrypoint"):

@@ -35,7 +35,9 @@ from ray_tpu.data.dataset import (  # noqa: F401
     read_binary_files,
     read_csv,
     read_datasource,
+    read_avro,
     read_delta,
+    read_iceberg,
     read_images,
     read_json,
     read_numpy,
@@ -62,6 +64,7 @@ __all__ = [
     "read_parquet", "read_csv", "read_json", "read_numpy", "read_text",
     "read_binary_files", "read_sql", "from_torch", "read_datasource",
     "read_images", "read_tfrecords", "read_webdataset", "read_delta",
+    "read_avro", "read_iceberg",
     "AggregateFn", "Count", "Sum",
     "Min", "Max", "Mean", "Std", "AbsMax", "Quantile", "Block",
     "BlockAccessor",

@@ -847,3 +847,29 @@ def read_delta(table_path: str, *, version=None, columns=None,
     return read_datasource(
         DeltaDatasource(table_path, version=version, columns=columns),
         parallelism=parallelism)
+
+
+def read_avro(paths, *, parallelism: int = -1) -> Dataset:
+    """Avro OCF files, one row per record (reference: ray.data.read_avro).
+    Dependency-free OCF codec — no avro/fastavro import on workers."""
+    from .avro import AvroDatasource
+
+    return read_datasource(AvroDatasource(paths), parallelism=parallelism)
+
+
+def read_iceberg(table_path: str, *, snapshot_id=None,
+                 as_of_timestamp_ms=None, columns=None,
+                 parallelism: int = -1) -> Dataset:
+    """An Apache Iceberg table's live rows (reference:
+    ray.data.read_iceberg / iceberg_datasource.py, which wraps
+    pyiceberg; here the v1/v2 metadata protocol is implemented
+    directly). Time travel via ``snapshot_id`` or
+    ``as_of_timestamp_ms``; schema evolution and identity partition
+    columns handled per file."""
+    from .iceberg import IcebergDatasource
+
+    return read_datasource(
+        IcebergDatasource(table_path, snapshot_id=snapshot_id,
+                          as_of_timestamp_ms=as_of_timestamp_ms,
+                          columns=columns),
+        parallelism=parallelism)

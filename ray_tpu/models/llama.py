@@ -87,11 +87,13 @@ class LlamaConfig:
     @staticmethod
     def flagship(vocab_size: int = 32000) -> "LlamaConfig":
         """~1.04B params, head_dim=128 — the largest config that fits one
-        v5e chip (16 GB HBM) with remat and a bf16-mu optimizer:
-        10 B/param steady state (fp32 params + nu, bf16 mu) ~= 10.4 GB,
-        leaving headroom for remat activations + the chunked xent head.
-        The 8B-on-64-chips projection extrapolates from this config's
-        per-chip MFU and the multi-mesh collective costs in
+        v5e chip (16 GB HBM) with remat and an adafactor optimizer
+        (factored second moment, bf16 momentum — the T5/PaLM TPU recipe):
+        peak ~10 B/param (fp32 params + fp32 grads + bf16 momentum)
+        ~= 10.4 GB, leaving headroom for remat activations + the chunked
+        xent head. adamw variants peak at 14 B/param (fp32 nu) and OOM
+        above ~950M. The 8B-on-64-chips projection extrapolates from this
+        config's per-chip MFU and the multi-mesh collective costs in
         BENCH_MULTI.md."""
         return LlamaConfig(vocab_size=vocab_size, dim=2048, n_layers=16,
                            n_heads=16, n_kv_heads=8, mlp_dim=7168,

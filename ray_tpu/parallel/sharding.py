@@ -106,7 +106,9 @@ def opt_state_shardings(optimizer, sample_params, param_shardings, default):
     path suffix equals a param path gets that param's sharding. (Shape
     matching is wrong: e.g. wq/wo share a shape but have transposed
     specs.) Leaves with no matching param path (step counters, scalars)
-    get ``default``.
+    get ``default``, as do path-matched leaves whose shape differs from
+    the param's — factored states like adafactor's ``v_row``/``v_col``
+    drop a dimension, so the param's spec cannot apply.
     """
     import jax
     from jax.tree_util import tree_flatten_with_path, tree_map_with_path
@@ -116,14 +118,17 @@ def opt_state_shardings(optimizer, sample_params, param_shardings, default):
     by_path = {}
     for (path, leaf), ps in zip(flat_params,
                                 jax.tree.leaves(param_shardings)):
-        by_path[tuple(str(k) for k in path)] = ps
+        by_path[tuple(str(k) for k in path)] = (ps, tuple(leaf.shape))
 
     def match(path, leaf):
         p = tuple(str(k) for k in path)
         for start in range(len(p)):
-            ps = by_path.get(p[start:])
-            if ps is not None:
-                return ps
+            hit = by_path.get(p[start:])
+            if hit is not None:
+                ps, shape = hit
+                if tuple(getattr(leaf, "shape", ())) == shape:
+                    return ps
+                return default
         return default
 
     return tree_map_with_path(match, opt_state)

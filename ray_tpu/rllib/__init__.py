@@ -20,6 +20,10 @@ from .dqn import DQN, DQNConfig
 from .env_runner import SingleAgentEnvRunner, compute_gae
 from .learner import Learner, LearnerGroup
 from .impala import IMPALA, IMPALAConfig
+from .multi_agent import (MultiAgentEnvRunner, MultiAgentLearnerGroup,
+                          MultiRLModuleSpec, map_all_to)
+from .multi_agent_env import MultiAgentEnv, SimpleSpread
+from .multi_agent_episode import MultiAgentEpisode
 from .offline import (BC, BCConfig, CQL, CQLConfig, OfflineData,
                       record_transitions)
 from .ppo import PPO, PPOConfig
@@ -37,4 +41,7 @@ __all__ = [
     "ReplayBuffer", "PrioritizedReplayBuffer", "SumTree",
     "ContinuousRLModule", "ContinuousModuleSpec", "ContinuousEnvRunner",
     "JaxRLModule", "RLModuleSpec",
+    "MultiAgentEnv", "MultiAgentEnvRunner", "MultiAgentEpisode",
+    "MultiAgentLearnerGroup", "MultiRLModuleSpec", "SimpleSpread",
+    "map_all_to",
 ]

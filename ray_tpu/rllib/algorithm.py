@@ -139,16 +139,51 @@ class Algorithm(Trainable):
         self._timesteps_total = 0
         env_creator = config.make_env_creator()
         probe_env = env_creator()
-        self.obs_space = probe_env.observation_space
-        self.act_space = probe_env.action_space
+        self.ma_spec = None
+        self.module_spaces = None
+        if config.is_multi_agent():
+            if not getattr(self, "_supports_multi_agent", False):
+                raise ValueError(
+                    f"{type(self).__name__} does not support multi-agent "
+                    "configs (use PPO)")
+            self.ma_spec = self._make_multi_spec(config)
+            self.module_spaces = self.ma_spec.module_spaces(probe_env)
+            self.obs_space = self.act_space = None
+        else:
+            self.obs_space = probe_env.observation_space
+            self.act_space = probe_env.action_space
         probe_env.close()
         self.env_runner_group = self._make_env_runner_group(
             config, env_creator)
         self.learner_group = self._build_learner_group()
 
+    @staticmethod
+    def _make_multi_spec(config):
+        import functools
+
+        from .multi_agent import MultiRLModuleSpec, map_all_to
+        from .rl_module import RLModuleSpec
+
+        policies = config.policies or {"default_policy": None}
+        specs = {pid: (s if s is not None else RLModuleSpec())
+                 for pid, s in policies.items()}
+        mapping = config.policy_mapping_fn
+        if mapping is None:
+            if len(specs) != 1:
+                raise ValueError(
+                    "multiple policies need a policy_mapping_fn")
+            mapping = functools.partial(map_all_to, next(iter(specs)))
+        return MultiRLModuleSpec(module_specs=specs,
+                                 policy_mapping_fn=mapping)
+
     def _make_env_runner_group(self, config, env_creator) -> EnvRunnerGroup:
         """Hook for algorithms with non-default runners (e.g. SAC's
         continuous-action runner)."""
+        if self.ma_spec is not None:
+            from .multi_agent import MultiAgentEnvRunner
+
+            return EnvRunnerGroup(config, env_creator, self.ma_spec,
+                                  runner_cls=MultiAgentEnvRunner)
         return EnvRunnerGroup(config, env_creator, config.rl_module_spec)
 
     # subclasses provide the loss / update wiring

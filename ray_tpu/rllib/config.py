@@ -39,6 +39,11 @@ class AlgorithmConfig:
         self.num_learners: int = 0  # 0 = learner in the driver process
         self.num_cpus_per_learner: float = 1.0
         self.mesh = None  # jax mesh for the local learner's pjit update
+        # multi-agent (reference: AlgorithmConfig.multi_agent —
+        # policies + policy_mapping_fn select the MultiAgentEnvRunner /
+        # MultiRLModule path)
+        self.policies: Dict[str, Optional[RLModuleSpec]] = {}
+        self.policy_mapping_fn: Optional[Callable[[str], str]] = None
 
     # ---- builder sections (each returns self for chaining) ----
 
@@ -84,6 +89,23 @@ class AlgorithmConfig:
         if mesh is not None:
             self.mesh = mesh
         return self
+
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None):
+        """Declare policy modules + the agent->policy mapping.
+        ``policies`` is a dict {policy_id: RLModuleSpec | None} or an
+        iterable of policy ids; the mapping fn must be picklable (it
+        ships to env-runner actors)."""
+        if policies is not None:
+            if isinstance(policies, dict):
+                self.policies = dict(policies)
+            else:
+                self.policies = {pid: None for pid in policies}
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def is_multi_agent(self) -> bool:
+        return bool(self.policies) or self.policy_mapping_fn is not None
 
     def debugging(self, *, seed=None):
         if seed is not None:

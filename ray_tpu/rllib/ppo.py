@@ -75,13 +75,63 @@ def ppo_loss(config: PPOConfig):
 
 class PPO(Algorithm):
     config_class = PPOConfig
+    _supports_multi_agent = True  # via config.multi_agent(...)
 
     def _build_learner_group(self) -> LearnerGroup:
+        if self.ma_spec is not None:
+            from .multi_agent import MultiAgentLearnerGroup
+
+            return MultiAgentLearnerGroup(
+                self.algo_config, self.ma_spec, self.module_spaces,
+                ppo_loss(self.algo_config))
         return LearnerGroup(self.algo_config, self.algo_config.rl_module_spec,
                             self.obs_space, self.act_space,
                             ppo_loss(self.algo_config))
 
+    def _multi_agent_training_step(self) -> Dict[str, Any]:
+        """Sample per-policy trajectory chunks, GAE each, update each
+        policy's learner on its own experience (reference:
+        multi_agent_env_runner.py sample + LearnerGroup.update over a
+        MultiRLModule)."""
+        from .multi_agent import gae_trajectory
+
+        cfg = self.algo_config
+        weights = self.learner_group.get_weights()
+        per_module: Dict[str, list] = {}
+        stats = []
+        got = 0
+        while got < cfg.train_batch_size:
+            if self.env_runner_group.num_healthy == 0:
+                if cfg.restart_failed_env_runners:
+                    self.env_runner_group.restore_workers()
+                else:
+                    raise RuntimeError("all env runners are dead")
+            bs, ss = self.env_runner_group.sample(weights)
+            for b, s in zip(bs, ss):
+                for mid, trajs in b.items():
+                    per_module.setdefault(mid, []).extend(trajs)
+                stats.append(s)
+                got += s["env_steps"]
+            if not bs:
+                self.env_runner_group.restore_workers()
+        flat = {}
+        for mid, trajs in per_module.items():
+            parts = [gae_trajectory(t, cfg.gamma, cfg.lambda_)
+                     for t in trajs]
+            flat[mid] = {k: np.concatenate([p[k] for p in parts])
+                         for k in parts[0]}
+        learner_stats = self.learner_group.update(
+            flat, num_epochs=cfg.num_epochs,
+            minibatch_size=cfg.minibatch_size, seed=self._iteration)
+        if cfg.restart_failed_env_runners:
+            self.env_runner_group.restore_workers()
+        result = summarize_episode_stats(stats)
+        result["learner"] = learner_stats
+        return result
+
     def training_step(self) -> Dict[str, Any]:
+        if self.ma_spec is not None:
+            return self._multi_agent_training_step()
         cfg = self.algo_config
         weights = self.learner_group.get_weights()
         batches, stats = [], []

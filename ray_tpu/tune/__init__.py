@@ -39,7 +39,9 @@ from ray_tpu.tune.schedulers import (  # noqa: F401
 from ray_tpu.tune.search import (  # noqa: F401
     BasicVariantGenerator,
     ConcurrencyLimiter,
+    OptunaSearch,
     Searcher,
+    TPESearch,
 )
 from ray_tpu.tune.session import get_checkpoint, get_trial_dir, report  # noqa: F401
 from ray_tpu.tune.tuner import (  # noqa: F401
@@ -57,7 +59,8 @@ __all__ = [
     "uniform", "quniform", "loguniform", "qloguniform", "randint",
     "qrandint", "lograndint", "randn", "choice", "sample_from",
     "grid_search", "Searcher", "BasicVariantGenerator",
-    "ConcurrencyLimiter", "TrialScheduler", "FIFOScheduler",
+    "ConcurrencyLimiter", "OptunaSearch", "TPESearch",
+    "TrialScheduler", "FIFOScheduler",
     "AsyncHyperBandScheduler", "HyperBandScheduler", "MedianStoppingRule",
     "PopulationBasedTraining", "PB2", "Callback", "JsonLoggerCallback",
     "CSVLoggerCallback", "with_parameters", "with_resources",

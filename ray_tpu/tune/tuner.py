@@ -16,7 +16,7 @@ from ray_tpu.train.config import RunConfig
 
 from .controller import Trainable, Trial, TuneController
 from .schedulers import TrialScheduler
-from .search import Searcher
+from .search import BasicVariantGenerator, Searcher
 
 
 @dataclass
@@ -127,6 +127,15 @@ class Tuner:
         searcher = tc.search_alg
         if searcher is not None and hasattr(searcher, "set_space"):
             searcher.set_space(param_space)
+        if searcher is not None and not isinstance(
+                searcher, BasicVariantGenerator) and tc.num_samples:
+            # model-based searchers suggest forever: bound the run by
+            # TuneConfig.num_samples (reference: SearchGenerator wrapping
+            # in tune.run)
+            from .search import SearchGenerator
+
+            searcher = SearchGenerator(searcher, param_space,
+                                       tc.num_samples)
         restore_path = getattr(self, "_restore_path", None)
         if restore_path:
             # continue in the SAME experiment dir so trial dirs/checkpoints

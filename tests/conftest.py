@@ -24,6 +24,20 @@ jax.config.update("jax_platforms", "cpu")
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _dump_stacks_on_hang():
+    """Per-test hang telemetry: if any single test exceeds 10 minutes,
+    dump every thread's stack to stderr (the suite has shown rare
+    whole-run wedges with idle workers — stacks are the only way to
+    find the blocked wait on a box with no gdb/py-spy)."""
+    import faulthandler
+
+    window = float(os.environ.get("RAY_TPU_TEST_HANG_DUMP_S", "600"))
+    faulthandler.dump_traceback_later(window, exit=False)
+    yield
+    faulthandler.cancel_dump_traceback_later()
+
+
 @pytest.fixture
 def ray_start_regular():
     """Single-node cluster fixture (reference: conftest.py ray_start_regular)."""

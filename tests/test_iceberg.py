@@ -1,0 +1,263 @@
+"""Iceberg reader + Avro codec (round-4 VERDICT missing #5 / ask #8).
+
+Reference: python/ray/data/_internal/datasource/iceberg_datasource.py
+(pyiceberg-backed there; here the v1/v2 metadata protocol — JSON
+metadata, Avro manifest list/manifests, parquet data — is implemented
+directly, like the Delta reader). The table under test is hand-built
+with the in-repo Avro writer: two snapshots, snapshot-select + timestamp
+time travel, schema evolution (old files null-fill the new column),
+identity partition values living only in metadata, and a
+delete-replaces-file case.
+"""
+
+import json
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ray_tpu.data.avro import read_ocf, write_ocf
+
+MANIFEST_ENTRY_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"]},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "r2", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "partition", "type": {
+                    "type": "record", "name": "r102", "fields": [
+                        {"name": "region", "type": ["null", "string"]}]}},
+                {"name": "record_count", "type": "long"},
+                {"name": "file_size_in_bytes", "type": "long"},
+            ]}},
+    ]}
+
+MANIFEST_FILE_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "partition_spec_id", "type": "int"},
+        {"name": "content", "type": "int"},
+        {"name": "added_snapshot_id", "type": "long"},
+    ]}
+
+
+def _write_data_file(path, rows, columns):
+    table = pa.table({c: [r[c] for r in rows] for c in columns})
+    pq.write_table(table, path)
+    return os.path.getsize(path)
+
+
+def _manifest_entry(file_path, n, size, region=None):
+    return {"status": 1, "snapshot_id": 1,
+            "data_file": {"content": 0, "file_path": file_path,
+                          "file_format": "PARQUET",
+                          "partition": {"region": region},
+                          "record_count": n,
+                          "file_size_in_bytes": size}}
+
+
+@pytest.fixture()
+def iceberg_table(tmp_path):
+    """Two-snapshot partitioned table. Snapshot 100: two files (regions
+    us/eu), schema {id, name}. Snapshot 200: eu file REPLACED (deleted +
+    new), schema adds 'score' (evolution) — the us file predates it."""
+    root = tmp_path / "tbl"
+    (root / "data").mkdir(parents=True)
+    (root / "metadata").mkdir()
+    loc = f"file://{root}"
+
+    us = str(root / "data" / "us-0.parquet")
+    eu1 = str(root / "data" / "eu-0.parquet")
+    eu2 = str(root / "data" / "eu-1.parquet")
+    n_us = _write_data_file(us, [{"id": 1, "name": "ann"},
+                                 {"id": 2, "name": "bob"}],
+                            ["id", "name"])
+    n_eu1 = _write_data_file(eu1, [{"id": 3, "name": "cid"}],
+                             ["id", "name"])
+    n_eu2 = _write_data_file(
+        eu2, [{"id": 4, "name": "dee", "score": 9.5},
+              {"id": 5, "name": "eve", "score": 7.0}],
+        ["id", "name", "score"])
+
+    md = root / "metadata"
+    # snapshot 100 manifests
+    m1 = str(md / "m1.avro")
+    write_ocf(m1, MANIFEST_ENTRY_SCHEMA, [
+        _manifest_entry(f"{loc}/data/us-0.parquet", 2, n_us, "us"),
+        _manifest_entry(f"{loc}/data/eu-0.parquet", 1, n_eu1, "eu"),
+    ])
+    ml1 = str(md / "snap-100.avro")
+    write_ocf(ml1, MANIFEST_FILE_SCHEMA, [
+        {"manifest_path": f"{loc}/metadata/m1.avro",
+         "manifest_length": os.path.getsize(m1),
+         "partition_spec_id": 0, "content": 0, "added_snapshot_id": 100}])
+    # snapshot 200: deleting eu-0 REWRITES its containing manifest (m1 ->
+    # m1b: us carried as EXISTING, eu-0 tombstoned with status=2 —
+    # Iceberg deletes never cascade across manifests) and adds m2 with
+    # the replacement file
+    m1b = str(md / "m1b.avro")
+    kept = _manifest_entry(f"{loc}/data/us-0.parquet", 2, n_us, "us")
+    kept["status"] = 0  # EXISTING
+    gone = _manifest_entry(f"{loc}/data/eu-0.parquet", 1, n_eu1, "eu")
+    gone["status"] = 2  # DELETED
+    write_ocf(m1b, MANIFEST_ENTRY_SCHEMA, [kept, gone])
+    m2 = str(md / "m2.avro")
+    write_ocf(m2, MANIFEST_ENTRY_SCHEMA, [
+        _manifest_entry(f"{loc}/data/eu-1.parquet", 2, n_eu2, "eu"),
+    ])
+    ml2 = str(md / "snap-200.avro")
+    write_ocf(ml2, MANIFEST_FILE_SCHEMA, [
+        {"manifest_path": f"{loc}/metadata/m1b.avro",
+         "manifest_length": os.path.getsize(m1b),
+         "partition_spec_id": 0, "content": 0, "added_snapshot_id": 200},
+        {"manifest_path": f"{loc}/metadata/m2.avro",
+         "manifest_length": os.path.getsize(m2),
+         "partition_spec_id": 0, "content": 0, "added_snapshot_id": 200}])
+
+    schema_v1 = {"schema-id": 0, "type": "struct", "fields": [
+        {"id": 1, "name": "id", "type": "long", "required": True},
+        {"id": 2, "name": "name", "type": "string", "required": False},
+        {"id": 3, "name": "region", "type": "string", "required": False},
+    ]}
+    schema_v2 = {"schema-id": 1, "type": "struct", "fields": [
+        {"id": 1, "name": "id", "type": "long", "required": True},
+        {"id": 2, "name": "name", "type": "string", "required": False},
+        {"id": 3, "name": "region", "type": "string", "required": False},
+        {"id": 4, "name": "score", "type": "double", "required": False},
+    ]}
+    meta = {
+        "format-version": 2, "table-uuid": "t-1", "location": loc,
+        "current-snapshot-id": 200,
+        "current-schema-id": 1,
+        "schemas": [schema_v1, schema_v2],
+        "partition-specs": [{"spec-id": 0, "fields": [
+            {"name": "region", "transform": "identity",
+             "source-id": 3, "field-id": 1000}]}],
+        "snapshots": [
+            {"snapshot-id": 100, "timestamp-ms": 1000,
+             "schema-id": 0,
+             "manifest-list": f"{loc}/metadata/snap-100.avro"},
+            {"snapshot-id": 200, "timestamp-ms": 2000,
+             "schema-id": 1,
+             "manifest-list": f"{loc}/metadata/snap-200.avro"},
+        ],
+    }
+    (md / "v3.metadata.json").write_text(json.dumps(meta))
+    (md / "version-hint.text").write_text("3")
+    return str(root)
+
+
+class TestAvroCodec:
+    def test_round_trip_all_types(self, tmp_path):
+        schema = {"type": "record", "name": "t", "fields": [
+            {"name": "l", "type": "long"},
+            {"name": "s", "type": "string"},
+            {"name": "d", "type": "double"},
+            {"name": "b", "type": "boolean"},
+            {"name": "raw", "type": "bytes"},
+            {"name": "opt", "type": ["null", "int"]},
+            {"name": "arr", "type": {"type": "array", "items": "long"}},
+            {"name": "m", "type": {"type": "map", "values": "string"}},
+            {"name": "e", "type": {"type": "enum", "name": "col",
+                                   "symbols": ["R", "G", "B"]}},
+            {"name": "fx", "type": {"type": "fixed", "name": "f8",
+                                    "size": 4}},
+        ]}
+        recs = [{"l": -(2 ** 40), "s": "héllo", "d": 2.5, "b": True,
+                 "raw": b"\x00\xff", "opt": None, "arr": [1, 2, 3],
+                 "m": {"a": "x"}, "e": "G", "fx": b"abcd"},
+                {"l": 7, "s": "", "d": -0.0, "b": False, "raw": b"",
+                 "opt": 41, "arr": [], "m": {}, "e": "B",
+                 "fx": b"wxyz"}]
+        p = str(tmp_path / "t.avro")
+        for codec in ("null", "deflate"):
+            write_ocf(p, schema, recs, codec=codec)
+            _s, out = read_ocf(p)
+            assert out == recs
+
+    def test_read_avro_dataset(self, tmp_path, ray_start_regular):
+        import ray_tpu.data as rd
+
+        schema = {"type": "record", "name": "row", "fields": [
+            {"name": "k", "type": "long"}, {"name": "v", "type": "string"}]}
+        p = str(tmp_path / "rows.avro")
+        write_ocf(p, schema, [{"k": i, "v": f"s{i}"} for i in range(5)])
+        rows = rd.read_avro(p).take_all()
+        assert rows == [{"k": i, "v": f"s{i}"} for i in range(5)]
+
+
+class TestIcebergReader:
+    def test_current_snapshot_with_evolution_and_partitions(
+            self, iceberg_table, ray_start_regular):
+        import ray_tpu.data as rd
+
+        rows = sorted(rd.read_iceberg(iceberg_table).take_all(),
+                      key=lambda r: r["id"])
+        assert [r["id"] for r in rows] == [1, 2, 4, 5]  # eu-0 replaced
+        # partition column comes from metadata, not the files
+        assert [r["region"] for r in rows] == ["us", "us", "eu", "eu"]
+        # schema evolution: pre-evolution files read score as None
+        assert rows[0]["score"] is None
+        assert rows[2]["score"] == 9.5
+
+    def test_snapshot_time_travel(self, iceberg_table, ray_start_regular):
+        import ray_tpu.data as rd
+
+        rows = sorted(
+            rd.read_iceberg(iceberg_table, snapshot_id=100).take_all(),
+            key=lambda r: r["id"])
+        assert [r["id"] for r in rows] == [1, 2, 3]
+        # snapshot 100 predates the 'score' column entirely
+        assert all("score" not in r for r in rows)
+        by_ts = rd.read_iceberg(iceberg_table,
+                                as_of_timestamp_ms=1500).take_all()
+        assert sorted(r["id"] for r in by_ts) == [1, 2, 3]
+
+    def test_column_projection(self, iceberg_table, ray_start_regular):
+        import ray_tpu.data as rd
+
+        rows = rd.read_iceberg(iceberg_table,
+                               columns=["id", "region"]).take_all()
+        assert all(set(r) == {"id", "region"} for r in rows)
+
+    def test_missing_snapshot_errors(self, iceberg_table):
+        import ray_tpu.data as rd
+
+        with pytest.raises(ValueError, match="snapshot 999 not found"):
+            rd.read_iceberg(iceberg_table, snapshot_id=999)
+
+    def test_not_a_table_errors(self, tmp_path):
+        import ray_tpu.data as rd
+
+        with pytest.raises(FileNotFoundError, match="not an Iceberg"):
+            rd.read_iceberg(str(tmp_path))
+
+    def test_delete_manifests_honest_error(self, iceberg_table):
+        """content=1 (delete) manifests are merge-on-read state this
+        reader does not merge — it must refuse, not drop deletes."""
+        import ray_tpu.data as rd
+
+        root = iceberg_table
+        md = os.path.join(root, "metadata")
+        loc = f"file://{root}"
+        ml = os.path.join(md, "snap-300.avro")
+        write_ocf(ml, MANIFEST_FILE_SCHEMA, [
+            {"manifest_path": f"{loc}/metadata/m2.avro",
+             "manifest_length": 1, "partition_spec_id": 0,
+             "content": 1, "added_snapshot_id": 300}])
+        meta = json.load(open(os.path.join(md, "v3.metadata.json")))
+        meta["snapshots"].append(
+            {"snapshot-id": 300, "timestamp-ms": 3000, "schema-id": 1,
+             "manifest-list": f"{loc}/metadata/snap-300.avro"})
+        meta["current-snapshot-id"] = 300
+        with open(os.path.join(md, "v4.metadata.json"), "w") as f:
+            json.dump(meta, f)
+        with open(os.path.join(md, "version-hint.text"), "w") as f:
+            f.write("4")
+        with pytest.raises(NotImplementedError, match="delete"):
+            rd.read_iceberg(root)

@@ -90,6 +90,9 @@ def test_cql_learns_pendulum_from_offline_data(cluster, tmp_path):
     for i in range(5):
         result = algo.train()
         if i >= 2:  # evaluate once the warmup is nearly done
-            best = max(best, algo.evaluate(num_episodes=5))
+            # 10-episode evals (round-4 VERDICT weak #6): 5-episode
+            # Pendulum returns are noisy enough for a mediocre policy
+            # to luck past the gate; best-checkpoint selection stays
+            best = max(best, algo.evaluate(num_episodes=10))
     assert np.isfinite(result["critic_loss"])
     assert best >= -500, f"CQL best policy return {best} < -500"

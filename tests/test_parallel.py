@@ -80,3 +80,47 @@ def test_ring_attention_no_seq_axis_fallback():
     ref = np.asarray(plain_attention(q, k, v, causal=True))
     out = np.asarray(ring_attention(q, k, v, mesh, causal=True))
     np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_opt_state_shardings_factored_second_moment():
+    """adafactor's v_row/v_col drop a dimension vs the param: they must
+    fall back to replicated instead of inheriting the param's spec
+    (round-5 flagship fix — the 1.04B config trains with adafactor)."""
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.sharding import opt_state_shardings
+
+    mesh = make_mesh(MeshConfig(data=1, fsdp=2, seq=1, tensor=1))
+    params = {"w": jax.numpy.zeros((64, 32))}
+    param_sh = {"w": NamedSharding(mesh, P("fsdp", None))}
+    repl = NamedSharding(mesh, P())
+
+    # adam: moments mirror param shapes -> inherit the param sharding
+    adam_sh = opt_state_shardings(
+        optax.adam(1e-3), params, param_sh, repl)
+    mus = [s for s in jax.tree.leaves(adam_sh)
+           if s.spec == P("fsdp", None)]
+    assert len(mus) == 2  # mu + nu
+
+    # adafactor: factored v_row [64] / v_col [32] must NOT take the
+    # 2D spec (rank mismatch would fail jit outright)
+    af = optax.adafactor(learning_rate=1e-3, momentum=0.9)
+    af_sh = opt_state_shardings(af, params, param_sh, repl)
+    state = jax.eval_shape(af.init, params)
+
+    import jax.tree_util as jtu
+
+    for (path, leaf), sh in zip(
+            jtu.tree_flatten_with_path(state)[0],
+            jax.tree.leaves(af_sh)):
+        if tuple(leaf.shape) == (64, 32):
+            assert sh.spec == P("fsdp", None), path
+        else:
+            assert sh.spec == P(), (path, leaf.shape)
+
+    # and the shardings actually jit (the original bug was a pjit
+    # output-sharding rank error)
+    init = jax.jit(af.init, out_shardings=af_sh)
+    init({"w": jax.numpy.zeros((64, 32))})

@@ -1,0 +1,99 @@
+"""REAL 2-process jax.distributed bootstrap (round-4 VERDICT weak #7).
+
+test_train_multihost.py checks the coordinator *payloads* with mocks;
+this test runs the actual thing: two TrainWorker actors in separate
+worker processes call ``jax.distributed.initialize`` against a live
+coordinator (worker 0), form ONE global mesh spanning both processes'
+virtual CPU devices, and run a pjit'd computation whose collective
+crosses the process boundary (Gloo) — the single-machine analog of a
+2-host TPU pod bootstrap (reference: torch/xla/config.py process-group
+setup, SURVEY §2.3).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train.backend_executor import JaxBackend, TrainWorker
+
+
+# defined via exec so cloudpickle ships it BY VALUE into the worker
+# processes (a test-module function would pickle by reference to a
+# module workers can't import)
+_TRAIN_FN_SRC = '''
+def _train_fn():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu import train
+
+    ctx = train.get_context()
+    devs = jax.devices()
+    local = jax.local_device_count()
+    # the mesh spans BOTH processes: global devices > local devices
+    assert len(devs) == 2 * local, (len(devs), local)
+    mesh = Mesh(np.array(devs).reshape(len(devs)), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    # each process contributes its rank+1 per shard row; the jitted sum
+    # reduces ACROSS processes — 2-host collective for real
+    rank = ctx.get_world_rank()
+    x = jax.make_array_from_callback(
+        (len(devs),), sharding,
+        lambda idx: np.full((1,), rank + 1.0, np.float32))
+
+    @jax.jit
+    def total(a):
+        return jnp.sum(a)
+
+    out = float(total(x))
+    train.report({"total": out, "global_devices": len(devs),
+                  "rank": rank})
+'''
+_ns: dict = {"__name__": "__main__"}  # by-value pickling trigger
+exec(_TRAIN_FN_SRC, _ns)
+_train_fn = _ns["_train_fn"]
+
+
+def test_two_process_jax_distributed_mesh():
+    ray_tpu.init(num_cpus=2)
+    try:
+        import cloudpickle
+
+        WorkerActor = ray_tpu.remote(TrainWorker)
+        actors = [WorkerActor.options(num_cpus=1).remote(
+            2, rank, 0, 0, "exp", "/tmp/trial") for rank in range(2)]
+        metadata = ray_tpu.get([a.get_metadata.remote() for a in actors],
+                               timeout=120)
+        payloads = JaxBackend(coordinator_port=19745).on_start(metadata)
+        ray_tpu.get([a.setup.remote(p, None, None)
+                     for a, p in zip(actors, payloads)], timeout=180)
+        fn = cloudpickle.dumps(_train_fn)
+        ray_tpu.get([a.start_training.remote(fn, {}) for a in actors],
+                    timeout=60)
+        deadline = time.monotonic() + 300
+        results = [None, None]
+        while time.monotonic() < deadline:
+            polls = ray_tpu.get([a.poll.remote() for a in actors],
+                                timeout=60)
+            for i, p in enumerate(polls):
+                if p["error"]:
+                    pytest.fail(f"rank {i} failed:\n{p['error']}")
+                for metrics, _ckpt in p["reports"]:
+                    results[i] = metrics
+            if all(p["done"] for p in polls):
+                break
+            time.sleep(0.5)
+        assert all(r is not None for r in results), results
+        n_global = results[0]["global_devices"]
+        assert results[1]["global_devices"] == n_global
+        # shards: half the rows written by rank 0 (1.0), half by rank 1
+        # (2.0) -> sum = 1.5 * n_global. Both ranks must agree (the
+        # value only comes out right if the cross-process psum ran).
+        expect = 1.5 * n_global
+        assert results[0]["total"] == pytest.approx(expect)
+        assert results[1]["total"] == pytest.approx(expect)
+    finally:
+        ray_tpu.shutdown()
