@@ -103,13 +103,24 @@ class Autoscaler:
         alive_workers = [n for n in self.head.gcs.alive_nodes()
                          if n.hex != head_hex]
 
+        from ray_tpu.util import events as events_mod
+
         demand = self.head.scheduler.pending_demand()
         want = int(math.ceil(
             self._workers_for_demand(demand) * cfg.upscaling_speed))
         target = max(cfg.min_workers,
                      min(cfg.max_workers, len(alive_workers) + want))
         # ---- scale up ----
-        for _ in range(max(0, target - provider_count)):
+        launching = max(0, target - provider_count)
+        if launching:
+            events_mod.emit(
+                "INFO", events_mod.SOURCE_AUTOSCALER,
+                f"scaling up: launching {launching} node(s) "
+                f"(demand={len(demand)} asks, alive={len(alive_workers)}, "
+                f"target={target})", entity_id="autoscaler",
+                launching=launching, target=target,
+                pending_demand=len(demand))
+        for _ in range(launching):
             self.provider.create_node(dict(cfg.node_config))
             self.num_launches += 1
 
@@ -130,6 +141,12 @@ class Autoscaler:
             for h in victims:
                 pid = self._provider_id_for(h)
                 if pid is not None:
+                    idle_s = now - self._idle_since[h]
+                    events_mod.emit(
+                        "INFO", events_mod.SOURCE_AUTOSCALER,
+                        f"terminating idle node {h[:8]} "
+                        f"(idle {idle_s:.1f}s >= {cfg.idle_timeout_s}s)",
+                        entity_id=h, provider_id=pid, idle_s=idle_s)
                     self.provider.terminate_node(pid)
                     self.num_terminations += 1
                     del self._idle_since[h]

@@ -96,6 +96,12 @@ def init(
     rt = DriverRuntime(_head)
     runtime_mod.set_current_runtime(rt)
     object_ref_mod.set_runtime(rt)
+    if global_config().device_telemetry_enabled:
+        # driver-process JAX device gauges land in the head registry
+        from ray_tpu.util.device_telemetry import start_device_telemetry
+
+        _head._device_telemetry_stop = start_device_telemetry(
+            node_hex=_head.head_node.hex)
     return rt
 
 

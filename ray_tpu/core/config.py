@@ -105,6 +105,20 @@ class Config:
     task_events_max_buffered: int = 100_000
     metrics_report_interval_ms: int = 10_000
     event_log_enabled: bool = True
+    # structured cluster event log (util/events.py -> GCS ring + JSONL).
+    # emit() delivers inline; the flush cadence only governs re-delivery
+    # after a failed send, so it stays low-frequency (per-worker wakeups
+    # add jitter to latency-sensitive loops)
+    cluster_events_max_buffered: int = 10_000
+    cluster_event_flush_ms: int = 1000
+    cluster_events_log_max_bytes: int = 64 * 1024 * 1024
+    # head-side metrics time-series rings (/api/metrics/history)
+    metrics_history_enabled: bool = True
+    metrics_history_interval_ms: int = 5_000
+    metrics_history_max_samples: int = 360
+    # per-process JAX/TPU device telemetry (HBM gauges + jax.monitoring)
+    device_telemetry_enabled: bool = True
+    device_telemetry_interval_ms: int = 10_000
 
     # ---- fault injection (reference: testing_asio_delay_us :824) ----
     testing_delay_ms: str = ""  # "handler1=ms,handler2=ms" injected latency

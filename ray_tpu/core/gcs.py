@@ -123,6 +123,10 @@ class GCS:
         self.pubsub = PubSub()
         cfg = global_config()
         self.task_events: deque = deque(maxlen=cfg.task_events_max_buffered)
+        # structured cluster events (util/events.py; reference: the GCS
+        # cluster-event table behind `ray list cluster-events`)
+        self.cluster_events: deque = deque(
+            maxlen=cfg.cluster_events_max_buffered)
         self.placement_groups: Dict[PlacementGroupID, Any] = {}
 
     # ---- KV (reference: gcs_kv_manager.cc) ----
@@ -271,3 +275,12 @@ class GCS:
     def list_task_events(self, limit: int = 1000) -> List[TaskEvent]:
         with self._lock:
             return list(self.task_events)[-limit:]
+
+    # ---- cluster events (util/events.py sink; reference: the GCS
+    # cluster-event table behind `ray list cluster-events`) ----
+    def record_cluster_event(self, ev: dict) -> None:
+        self.cluster_events.append(ev)
+
+    def list_cluster_events(self, limit: int = 1000) -> List[dict]:
+        with self._lock:
+            return list(self.cluster_events)[-limit:]

@@ -451,6 +451,7 @@ class Node:
             with self._lock:
                 self._forwarded[spec.task_id] = (origin, spec, handle)
             handle.submit_direct(spec, ("node", self, origin))
+            self._emit_spillback(spec, handle.hex, depth)
             return True
         ch = self._peer_channel(peer_hex, handle)
         if ch is None:
@@ -471,7 +472,27 @@ class Node:
                 self._forwarded.pop(spec.task_id, None)
             self._drop_peer(peer_hex)
             return False
+        self._emit_spillback(spec, peer_hex, depth)
         return True
+
+    def _emit_spillback(self, spec, peer_hex: str, depth: int) -> None:
+        """Cluster event for a direct-task spillback, rate-limited to one
+        per peer per second (spill waves are bursty)."""
+        now = time.monotonic()
+        last = getattr(self, "_spill_event_last", None)
+        if last is None:
+            last = self._spill_event_last = {}
+        if now - last.get(peer_hex, 0.0) < 1.0:
+            return
+        last[peer_hex] = now
+        from ray_tpu.util import events as events_mod
+
+        events_mod.emit(
+            "INFO", events_mod.SOURCE_SCHEDULER,
+            f"spillback: node {self.hex[:8]} (queue depth {depth}) "
+            f"forwarded {spec.function_name} to peer {peer_hex[:8]}",
+            entity_id=self.hex, peer=peer_hex, queue_depth=depth,
+            function=spec.function_name)
 
     def _peer_candidates(self) -> List[tuple]:
         """[(hex, Node | addr, queue_depth)] of alive CPU peers."""
@@ -1092,6 +1113,12 @@ class Node:
             elif tag == "metrics":
                 self.head.on_worker_metrics(
                     f"{self.hex[:6]}:{w.pid}", payload[0])
+            elif tag == "cevents":
+                # worker cluster events -> head event ring (one-way)
+                try:
+                    self.head.record_cluster_events(payload[0])
+                except Exception:
+                    pass
             elif tag == "unstaged":
                 # worker handed back a staged-unstarted task: requeue it
                 tid = payload[0]

@@ -180,6 +180,9 @@ class RemoteHead:
     def on_worker_metrics(self, source_id: str, snapshot: dict) -> None:
         self._send("worker_metrics", source_id, snapshot)
 
+    def record_cluster_events(self, events: list) -> None:
+        self._send("cevents", events)
+
     def on_worker_log(self, node_hex: str, pid: int, text: str) -> None:
         self._send("worker_log", node_hex, pid, text)
 
@@ -372,6 +375,12 @@ def main(argv=None) -> int:
     set_global_config(Config.from_json(welcome["config"]))
 
     head = RemoteHead(channel, welcome, key)
+    # this process's cluster events flush over the head link (one-way)
+    from ray_tpu.util import events as events_mod
+
+    cfg = global_config()
+    events_mod.set_sink(head.record_cluster_events,
+                        cfg.cluster_event_flush_ms / 1000.0)
     session_dir = args.session_dir or tempfile.mkdtemp(prefix="raytpu_node_")
 
     node_ip = args.node_ip or os.environ.get("RAY_TPU_NODE_IP")
@@ -402,6 +411,10 @@ def main(argv=None) -> int:
     from .syncer import NodeSyncer
 
     syncer = NodeSyncer(head, node)
+    if cfg.device_telemetry_enabled:
+        from ray_tpu.util.device_telemetry import start_device_telemetry
+
+        start_device_telemetry(node_hex=node.hex)
     try:
         head.stopped.wait()
     except KeyboardInterrupt:

@@ -337,6 +337,7 @@ class LocalObjectStore:
     def _reclaim(self, need: int) -> bool:
         """Evict unreferenced sealed objects (LRU), then spill referenced ones."""
         cfg = global_config()
+        evicted = evicted_bytes = spilled = spilled_bytes = 0
         with self._lock:
             candidates = sorted(
                 (e for e in self._entries.values()
@@ -355,19 +356,62 @@ class LocalObjectStore:
                     self.arena.allocator.free(e.offset)
                     del self._entries[e.object_id]
                     freed += e.size
-            if freed >= need:
-                return True
-            if not cfg.object_spilling_enabled:
-                return freed > 0
-            for e in candidates:
-                if freed >= need:
-                    break
-                if e.object_id not in self._entries or e.mapped:
-                    # never move an object a zero-copy reader may alias
-                    continue
-                self._spill_locked(e)
-                freed += e.size
-            return freed > 0
+                    evicted += 1
+                    evicted_bytes += e.size
+            if freed < need and cfg.object_spilling_enabled:
+                for e in candidates:
+                    if freed >= need:
+                        break
+                    if e.object_id not in self._entries or e.mapped:
+                        # never move an object a zero-copy reader may alias
+                        continue
+                    self._spill_locked(e)
+                    freed += e.size
+                    spilled += 1
+                    spilled_bytes += e.size
+            ok = freed > 0 or freed >= need
+        self._emit_pressure_events(evicted, evicted_bytes, spilled,
+                                   spilled_bytes)
+        return ok
+
+    def _emit_pressure_events(self, evicted: int, evicted_bytes: int,
+                              spilled: int, spilled_bytes: int) -> None:
+        """Memory-pressure cluster events, emitted outside the store lock
+        (reference: the 'object store is spilling' autoscaler warning).
+        Rate-limited to one emit per second with counts aggregated in
+        between — _reclaim sits on the allocation retry path, and a
+        pressure wave must not turn into an event flood of blocking
+        sends (same policy as node._emit_spillback)."""
+        if not evicted and not spilled:
+            return
+        acc = getattr(self, "_pressure_acc", None)
+        if acc is None:
+            acc = self._pressure_acc = [0, 0, 0, 0]
+            self._pressure_last_emit = 0.0
+        acc[0] += evicted
+        acc[1] += evicted_bytes
+        acc[2] += spilled
+        acc[3] += spilled_bytes
+        now = time.monotonic()
+        if now - self._pressure_last_emit < 1.0:
+            return
+        self._pressure_last_emit = now
+        evicted, evicted_bytes, spilled, spilled_bytes = acc
+        self._pressure_acc = [0, 0, 0, 0]
+        from ray_tpu.util import events as events_mod
+
+        if evicted:
+            events_mod.emit(
+                "INFO", events_mod.SOURCE_OBJECT_STORE,
+                f"evicted {evicted} object(s) ({evicted_bytes} bytes) "
+                f"under memory pressure", entity_id=self.arena_path,
+                count=evicted, bytes=evicted_bytes)
+        if spilled:
+            events_mod.emit(
+                "WARNING", events_mod.SOURCE_OBJECT_STORE,
+                f"spilled {spilled} object(s) ({spilled_bytes} bytes) "
+                f"to {self.spill_dir}", entity_id=self.arena_path,
+                count=spilled, bytes=spilled_bytes)
 
     def _spill_locked(self, e: ObjectEntry):
         path = os.path.join(self.spill_dir, e.object_id.hex())

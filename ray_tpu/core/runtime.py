@@ -157,6 +157,28 @@ class Head:
             store = FileStore(os.path.join(storage, "gcs"))
         self.gcs = GCS(store=store)
         self.gcs.add_job(JobInfo(self.job_id))
+        # cluster event log: this head is the process-local sink (GCS ring
+        # + JSONL under session_dir/logs/events/); workers and daemons
+        # reach record_cluster_events over their channels ("cevents")
+        from ray_tpu.util import events as events_mod
+
+        cfg0 = global_config()
+        self._event_writer = None
+        if cfg0.event_log_enabled:
+            try:
+                self._event_writer = events_mod.EventLogWriter(
+                    self.session_dir)
+            except OSError:
+                self._event_writer = None
+        events_mod.set_sink(self.record_cluster_events,
+                            cfg0.cluster_event_flush_ms / 1000.0)
+        # metrics history: sample the merged registry into bounded rings
+        self.metrics_history = None
+        if cfg0.metrics_history_enabled:
+            from ray_tpu.util.metrics import MetricsHistory
+
+            self.metrics_history = MetricsHistory(
+                cfg0.metrics_history_max_samples)
         from .pubsub import PubsubBroker
 
         # general pubsub channels (reference: src/ray/pubsub/publisher.h)
@@ -199,6 +221,37 @@ class Head:
         if global_config().task_record_ttl_s > 0:
             threading.Thread(target=self._record_gc_loop, daemon=True,
                              name="task-record-gc").start()
+        if self.metrics_history is not None:
+            threading.Thread(target=self._metrics_history_loop, daemon=True,
+                             name="metrics-history").start()
+
+    # ------------------------------------------------------- observability
+
+    def record_cluster_events(self, events: List[dict]) -> None:
+        """Event-log sink: absorb a batch of structured cluster events
+        (local emitters, worker channels, daemon links all funnel here)."""
+        for ev in events:
+            self.gcs.record_cluster_event(ev)
+        if self._event_writer is not None:
+            self._event_writer.write(events)
+
+    def sample_metrics_history(self) -> None:
+        """Take one sample of every metric series now (the loop calls this
+        on the configured interval; tests call it directly)."""
+        if self.metrics_history is not None:
+            from ray_tpu.util.metrics import registry
+
+            self.metrics_history.sample(registry())
+
+    def _metrics_history_loop(self) -> None:
+        period = max(0.05,
+                     global_config().metrics_history_interval_ms / 1000.0)
+        while not self._stopped:
+            time.sleep(period)
+            try:
+                self.sample_metrics_history()
+            except Exception:
+                pass  # sampling must never kill the loop
 
     # ------------------------------------------------------- record GC
 
@@ -294,6 +347,11 @@ class Head:
                                         resources_total=dict(resources),
                                         labels=labels or {}))
         self.scheduler.add_node(node.hex, node.resources)
+        from ray_tpu.util import events as events_mod
+
+        events_mod.emit("INFO", events_mod.SOURCE_NODE,
+                        f"node {node.hex[:8]} alive (in-process)",
+                        entity_id=node.hex, resources=dict(resources))
         if self._node_listener is not None:
             self._broadcast_cluster_view()
         return node
@@ -527,6 +585,12 @@ class Head:
                                         resources_total=dict(ready["resources"]),
                                         labels=proxy.labels))
         self.scheduler.add_node(proxy.hex, proxy.resources)
+        from ray_tpu.util import events as events_mod
+
+        events_mod.emit("INFO", events_mod.SOURCE_NODE,
+                        f"node {proxy.hex[:8]} alive (daemon pid="
+                        f"{proxy.pid})", entity_id=proxy.hex,
+                        resources=dict(ready["resources"]))
         self._broadcast_cluster_view()
         threading.Thread(target=self._daemon_reader, args=(proxy,),
                          daemon=True, name=f"daemon-{proxy.hex[:6]}").start()
@@ -586,6 +650,8 @@ class Head:
                 self.on_node_sync(proxy, payload[0])
             elif tag == "devents":
                 self.publish_direct_events(proxy.hex, payload[0])
+            elif tag == "cevents":
+                self.record_cluster_events(payload[0])
             elif tag == "sealed_payload":
                 self.on_sealed_payload(*payload)
             elif tag == "pin_delta":
@@ -690,6 +756,10 @@ class Head:
             return
         self.scheduler.remove_node(node_hex)
         self.gcs.mark_node_dead(node_hex)
+        from ray_tpu.util import events as events_mod
+
+        events_mod.emit("WARNING", events_mod.SOURCE_NODE,
+                        f"node {node_hex[:8]} dead", entity_id=node_hex)
         with self._lock:
             self.node_loads.pop(node_hex, None)
         if self._node_listener is not None:
@@ -1306,6 +1376,8 @@ class Head:
                      "bundles": len(pg.bundles)}
                     for pid, pg in
                     list(self.scheduler._pgs.items())[:limit]]
+        if kind == "cluster_events":
+            return gcs.list_cluster_events(limit)
         raise ValueError(f"unknown state kind {kind!r}")
 
     def on_worker_metrics(self, source_id: str, snapshot: dict) -> None:
@@ -1824,6 +1896,15 @@ class Head:
 
     def shutdown(self) -> None:
         self._stopped = True
+        from ray_tpu.util import events as events_mod
+
+        events_mod.flush()
+        events_mod.clear_sink(self.record_cluster_events)
+        if self._event_writer is not None:
+            self._event_writer.close()
+        stop_telemetry = getattr(self, "_device_telemetry_stop", None)
+        if stop_telemetry is not None:
+            stop_telemetry.set()
         self.scheduler.stop()
         if self._node_listener is not None:
             try:

@@ -97,6 +97,12 @@ class ClusterScheduler:
         self._pending: deque = deque()
         self._pgs: Dict[PlacementGroupID, PlacementGroup] = {}
         self._pending_pgs: deque = deque()
+        # infeasibility memo, both cleared when the cluster shape changes:
+        # sigs already reported infeasible, and sigs known feasible (so the
+        # totals scan runs once per sig per shape, not on every rescan of
+        # the 1M-round pending-queue hot path)
+        self._infeasible_reported: set = set()
+        self._feasible_sigs: set = set()
         self._spread_rr = 0
         self._wake = threading.Condition(self._lock)
         self._stopped = False
@@ -109,6 +115,8 @@ class ClusterScheduler:
         with self._lock:
             self._nodes[node_hex] = resources
             self._node_order.append(node_hex)
+            self._infeasible_reported.clear()  # new shape: re-evaluate
+            self._feasible_sigs.clear()
             self._wake.notify_all()
 
     def remove_node(self, node_hex: str) -> None:
@@ -116,6 +124,9 @@ class ClusterScheduler:
             self._nodes.pop(node_hex, None)
             if node_hex in self._node_order:
                 self._node_order.remove(node_hex)
+            # a shrunk cluster can turn feasible sigs infeasible (already-
+            # reported ones stay infeasible: shrinking never adds capacity)
+            self._feasible_sigs.clear()
             # kill reservations on that node
             for pg in self._pgs.values():
                 for b in pg.bundles:
@@ -246,6 +257,7 @@ class ClusterScheduler:
                 # rescan per completion into O(1) for homogeneous batches
                 # (the 1M-calls-for-2k-tasks hot spot in bench_core.py).
                 failed_sigs = set()
+                infeasible: List[TaskSpec] = []
                 while self._pending:
                     spec = self._pending.popleft()
                     sig = self._request_sig(spec)
@@ -256,11 +268,21 @@ class ClusterScheduler:
                     if placed is None:
                         failed_sigs.add(sig)
                         still_pending.append(spec)
+                        if (self._nodes
+                                and sig not in self._feasible_sigs
+                                and sig not in self._infeasible_reported):
+                            if self._infeasible_locked(spec):
+                                self._infeasible_reported.add(sig)
+                                infeasible.append(spec)
+                            else:
+                                self._feasible_sigs.add(sig)
                     else:
                         ready.append(placed)
                 self._pending = still_pending
                 if not ready and not progress:
                     self._wake.wait(timeout=0.25)
+            for spec in infeasible:  # emit outside the scheduler lock
+                self._emit_infeasible(spec)
             for node_hex, spec, binding in ready:
                 try:
                     self._dispatch(node_hex, spec, binding)
@@ -269,6 +291,30 @@ class ClusterScheduler:
                         nr = self._nodes.get(node_hex)
                         if nr is not None:
                             nr.release(spec.resources, binding)
+
+    def _infeasible_locked(self, spec: TaskSpec) -> bool:
+        """True when no node's TOTAL resources can ever fit the request —
+        distinct from transient unavailability (reference: the raylet's
+        infeasible-task queue + its autoscaler warning)."""
+        ask = {k: v for k, v in spec.resources.to_dict().items() if v > 0}
+        if not ask:
+            return False
+        for nr in self._nodes.values():
+            total = nr.total.to_dict()
+            if all(total.get(k, 0) >= v for k, v in ask.items()):
+                return False
+        return True
+
+    def _emit_infeasible(self, spec: TaskSpec) -> None:
+        from ray_tpu.util import events as events_mod
+
+        events_mod.emit(
+            "WARNING", events_mod.SOURCE_SCHEDULER,
+            f"infeasible request: {spec.function_name} asks "
+            f"{spec.resources.to_dict()} but no node can ever fit it",
+            entity_id=spec.task_id.hex(),
+            resources=spec.resources.to_dict(),
+            function=spec.function_name)
 
     @staticmethod
     def _request_sig(spec: TaskSpec):

@@ -805,6 +805,17 @@ def worker_main(argv=None) -> None:
     start_report_thread(
         lambda snap: channel.send("metrics", snap),
         global_config().metrics_report_interval_ms / 1000.0)
+    # cluster events ride the worker channel one-way ("cevents"), same
+    # shape as the metrics report; the node forwards them to the head
+    from ray_tpu.util import events as events_mod
+
+    events_mod.set_sink(
+        lambda evs: channel.send("cevents", evs),
+        global_config().cluster_event_flush_ms / 1000.0)
+    if global_config().device_telemetry_enabled:
+        from ray_tpu.util.device_telemetry import start_device_telemetry
+
+        start_device_telemetry(node_hex=runtime.node_hex)
     from ray_tpu.util.sampling_profiler import start_from_env
 
     _dump_profile = start_from_env()  # RAY_TPU_SAMPLER=<prefix> to enable

@@ -16,6 +16,9 @@ control plane — with:
     POST /api/jobs/<id>/stop    stop the driver
     DELETE /api/jobs/<id>       delete a terminal job
     GET  /api/serve             Serve deployment summary
+    GET  /api/events?severity=&min_severity=&source=&limit=
+                                structured cluster event log
+    GET  /api/metrics/history?name=   sampled metric time-series rings
     GET  /api/pubsub?channel=&cursor=&timeout=   poll a pubsub channel
     GET  /api/nodes/<hex>/logs[/<name>]     per-node agent: log browse/tail
     GET  /api/nodes/<hex>/metrics           per-node agent: metrics snapshot
@@ -48,6 +51,7 @@ th{background:#f0f0f0} code{background:#eee;padding:1px 4px;border-radius:3px}
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
+<h2>Cluster events</h2><table id="events"></table>
 <script>
 function esc(v){return String(v).replace(/[&<>"']/g,
   c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
@@ -71,6 +75,11 @@ async function refresh(){
   const t = await (await fetch('/api/tasks?limit=25')).json();
   document.getElementById('tasks').innerHTML = row(['task','name','state','node'],'th')+
     t.slice(-25).map(x=>row([esc(x.task_id),esc(x.name||''),esc(x.state),esc(x.node_hex||'')],'td')).join('');
+  const ev = await (await fetch('/api/events?limit=25')).json();
+  document.getElementById('events').innerHTML = row(['time','severity','source','message'],'th')+
+    ev.slice(-25).reverse().map(x=>row([esc(new Date(x.ts*1000).toLocaleTimeString()),
+    x.severity==='ERROR'||x.severity==='WARNING'?'<span class=bad>'+esc(x.severity)+'</span>':esc(x.severity),
+    esc(x.source),esc(x.message)],'td')).join('');
  }catch(e){console.log(e)}
 }
 refresh(); setInterval(refresh, 2000);
@@ -189,6 +198,35 @@ class DashboardServer:
         elif path in ("/api/nodes", "/api/actors", "/api/tasks",
                       "/api/objects", "/api/placement_groups"):
             h._json(self.head.state_list(path.rsplit("/", 1)[1], limit))
+        elif path == "/api/events":
+            # structured cluster events with filters:
+            # /api/events?severity=&min_severity=&source=&limit=
+            from urllib.parse import unquote
+
+            from ray_tpu.util.events import filter_events
+
+            rows = self.head.state_list("cluster_events", 100_000)
+            h._json(filter_events(
+                rows,
+                severity=unquote(params["severity"])
+                if "severity" in params else None,
+                source=unquote(params["source"])
+                if "source" in params else None,
+                min_severity=unquote(params["min_severity"])
+                if "min_severity" in params else None)[-limit:])
+        elif path == "/api/metrics/history":
+            # sampled metric time-series: /api/metrics/history?name=
+            # (no name -> the list of sampled series names)
+            mh = getattr(self.head, "metrics_history", None)
+            if mh is None:
+                h._json({"error": "metrics history disabled"}, 404)
+            elif "name" in params:
+                from urllib.parse import unquote
+
+                name = unquote(params["name"])
+                h._json({"name": name, "series": mh.query(name)})
+            else:
+                h._json({"names": mh.names()})
         elif path == "/api/jobs" or path == "/api/jobs/":
             h._json([j.to_dict() for j in self._jm().list_jobs()])
         elif path == "/api/serve":

@@ -12,6 +12,13 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.util import events as _events
+
+
+def _emit(severity: str, message: str, entity_id: str = "",
+          **attrs) -> None:
+    _events.emit(severity, _events.SOURCE_SERVE, message,
+                 entity_id=entity_id, **attrs)
 
 
 @ray_tpu.remote
@@ -61,6 +68,11 @@ class ServeController:
             if auto:
                 rec["target"] = max(auto["min_replicas"], 1)
             self._version += 1; self._version_cv.notify_all()
+        _emit("INFO", f"deployment {name!r} "
+              f"{'updated' if old else 'deployed'} "
+              f"(target={rec['target']}, version={rec['version']})",
+              entity_id=name, target=rec["target"],
+              version=rec["version"])
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
@@ -71,6 +83,8 @@ class ServeController:
             self._routes = {k: v for k, v in self._routes.items()
                             if v != name}
             self._version += 1; self._version_cv.notify_all()
+        if rec:
+            _emit("INFO", f"deployment {name!r} deleted", entity_id=name)
 
     def shutdown(self) -> None:
         with self._lock:
@@ -189,11 +203,19 @@ class ServeController:
                 and now - rec["last_scale_up"] > auto["upscale_delay_s"]:
             rec["target"] = target + 1
             rec["last_scale_up"] = now
+            _emit("INFO", f"deployment {rec['name']!r} autoscaling up: "
+                  f"target {target} -> {target + 1} "
+                  f"(avg ongoing {avg:.1f})", entity_id=rec["name"],
+                  target=target + 1, avg_ongoing=avg)
         elif avg < auto["target_ongoing_requests"] / 2 \
                 and target > auto["min_replicas"] \
                 and now - rec["last_scale_down"] > auto["downscale_delay_s"]:
             rec["target"] = target - 1
             rec["last_scale_down"] = now
+            _emit("INFO", f"deployment {rec['name']!r} autoscaling down: "
+                  f"target {target} -> {target - 1} "
+                  f"(avg ongoing {avg:.1f})", entity_id=rec["name"],
+                  target=target - 1, avg_ongoing=avg)
 
     def _replica_stale(self, rec: dict, r: dict) -> bool:
         return (r.get("version") != rec["version"]
@@ -263,11 +285,19 @@ class ServeController:
                     for _ in range(diff):
                         replicas.append(self._spawn_replica(rec))
                     self._version += 1; self._version_cv.notify_all()
+                    _emit("INFO", f"deployment {rec['name']!r} scaled up: "
+                          f"+{diff} replica(s) -> {len(replicas)}",
+                          entity_id=rec["name"],
+                          num_replicas=len(replicas))
                 elif diff < 0:
                     for _ in range(-diff):
                         dead = replicas.pop()
                         self._kill_replica(dead)
                     self._version += 1; self._version_cv.notify_all()
+                    _emit("INFO", f"deployment {rec['name']!r} scaled "
+                          f"down: {-diff} replica(s) -> {len(replicas)}",
+                          entity_id=rec["name"],
+                          num_replicas=len(replicas))
 
     def _health_check(self) -> None:
         with self._lock:
@@ -294,6 +324,10 @@ class ServeController:
                             rec["replicas"].remove(r)
                             self._kill_replica(r)
                     self._version += 1; self._version_cv.notify_all()
+                _emit("WARNING",
+                      f"deployment {rec['name']!r}: {len(bad)} replica(s) "
+                      f"failed health check, restarting",
+                      entity_id=rec["name"], unhealthy=len(bad))
 
     def _reconcile_loop(self) -> None:
         last_health = 0.0

@@ -123,11 +123,19 @@ class JaxTrainer:
         attempt = 0
         error: Optional[str] = None
 
+        from ray_tpu.util import events as events_mod
+
+        run_name = self.run_config.name or "train"
+
         def on_report(rank: int, metrics: Dict[str, Any],
                       ckpt_path: Optional[str]):
             nonlocal last_metrics
             if ckpt_path:
-                ckpt_mgr.register(ckpt_path, metrics)
+                dest = ckpt_mgr.register(ckpt_path, metrics)
+                events_mod.emit(
+                    "INFO", events_mod.SOURCE_TRAIN,
+                    f"checkpoint saved by rank {rank} -> {dest}",
+                    entity_id=run_name, rank=rank, path=dest)
             if rank == 0:
                 row = dict(metrics)
                 row["_training_iteration"] = len(history)
@@ -152,7 +160,17 @@ class JaxTrainer:
                 break
             attempt += 1
             if max_failures != -1 and attempt > max_failures:
+                events_mod.emit(
+                    "ERROR", events_mod.SOURCE_TRAIN,
+                    f"run {run_name!r} failed after {attempt} attempt(s): "
+                    f"{error.splitlines()[0] if error else ''}",
+                    entity_id=run_name, attempts=attempt)
                 break
+            events_mod.emit(
+                "WARNING", events_mod.SOURCE_TRAIN,
+                f"run {run_name!r} worker failure (attempt {attempt}); "
+                f"restarting worker group from latest checkpoint",
+                entity_id=run_name, attempt=attempt)
             error = None  # retrying from latest checkpoint
 
         latest = ckpt_mgr.latest()

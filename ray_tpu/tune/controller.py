@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.util import events as _events
 
 from .schedulers import FIFOScheduler, TrialScheduler
 from .search import BasicVariantGenerator, Searcher
@@ -271,11 +272,24 @@ class TuneController:
             trial.pending_ref = trial.actor.train.remote()
         trial.restore_from = None
         trial.status = "RUNNING"
+        _events.emit("INFO", _events.SOURCE_TUNE,
+                     f"trial {trial.trial_id} -> RUNNING "
+                     f"(experiment {self.exp_name})",
+                     entity_id=trial.trial_id, state="RUNNING",
+                     experiment=self.exp_name)
         for cb in self.callbacks:
             cb.on_trial_start(trial.iteration, self.trials, trial)
 
     def _stop_trial(self, trial: Trial, status: str = "TERMINATED") -> None:
         trial.status = status
+        _events.emit("ERROR" if status == "ERROR" else "INFO",
+                     _events.SOURCE_TUNE,
+                     f"trial {trial.trial_id} -> {status} "
+                     f"(experiment {self.exp_name})",
+                     entity_id=trial.trial_id, state=status,
+                     experiment=self.exp_name,
+                     iteration=trial.last_result.get(
+                         "training_iteration", 0))
         if trial.actor is not None:
             try:
                 if not self.is_function and status == "TERMINATED":
@@ -349,6 +363,10 @@ class TuneController:
         n = self._failures.get(trial.trial_id, 0)
         if n < self.max_failures or self.max_failures < 0:
             self._failures[trial.trial_id] = n + 1
+            _events.emit("WARNING", _events.SOURCE_TUNE,
+                         f"trial {trial.trial_id} failed "
+                         f"(attempt {n + 1}), retrying from checkpoint",
+                         entity_id=trial.trial_id, attempt=n + 1)
             self._stop_trial(trial, status="PENDING")
             trial.restore_from = trial.checkpoint_path
             self._start_trial(trial)

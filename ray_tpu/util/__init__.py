@@ -7,6 +7,6 @@ from ray_tpu.core.placement_group import (  # noqa: F401
     remove_placement_group,
 )
 
-from ray_tpu.util import metrics, pubsub, state  # noqa: F401,E402
+from ray_tpu.util import events, metrics, pubsub, state  # noqa: F401,E402
 from ray_tpu.util.actor_pool import ActorPool  # noqa: F401,E402
 from ray_tpu.util.queue import Queue  # noqa: F401,E402

@@ -1,0 +1,146 @@
+"""TPU/JAX device telemetry: HBM gauges + XLA compile activity counters.
+
+The reference never had TPU-native signals; this collector publishes, per
+process (worker / node daemon / driver):
+
+- ``ray_tpu_device_bytes_in_use`` / ``ray_tpu_device_peak_bytes_in_use``
+  gauges from ``device.memory_stats()`` with node/device tags, and
+- ``ray_tpu_jax_events_total`` counters plus
+  ``ray_tpu_jax_event_duration_seconds`` histograms from ``jax.monitoring``
+  listeners (JIT compilations, compilation-cache hits/misses, ...).
+
+Everything feeds the existing worker->head metrics channel (the local
+registry flushed by ``start_report_thread``), so the head's /metrics and
+/api/metrics/history expose cluster-wide device state with zero new wires.
+
+Laziness is load-bearing: the collector never imports jax itself — it waits
+until user code has (``"jax" in sys.modules``), so CPU-only workers that
+never touch jax pay nothing.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import List, Optional
+
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+_BYTES_IN_USE = Gauge("ray_tpu_device_bytes_in_use",
+                      "accelerator memory currently allocated (bytes)")
+_PEAK_BYTES = Gauge("ray_tpu_device_peak_bytes_in_use",
+                    "peak accelerator memory allocated (bytes)")
+_JAX_EVENTS = Counter("ray_tpu_jax_events_total",
+                      "jax.monitoring events (compilations, cache misses)")
+_JAX_DURATIONS = Histogram(
+    "ray_tpu_jax_event_duration_seconds",
+    "jax.monitoring event durations (e.g. JIT compile time)",
+    boundaries=[0.01, 0.1, 1, 10, 60])
+
+_listener_lock = threading.Lock()
+_listeners_installed = False
+
+
+def _on_jax_event(event: str, *args, **kwargs) -> None:
+    try:
+        _JAX_EVENTS.inc(1.0, tags={"event": str(event)})
+    except Exception:
+        pass
+
+
+def _on_jax_event_duration(event: str, duration: float,
+                           *args, **kwargs) -> None:
+    try:
+        _JAX_DURATIONS.observe(float(duration),
+                               tags={"event": str(event)})
+    except Exception:
+        pass
+
+
+def install_jax_listeners() -> bool:
+    """Register jax.monitoring listeners once per process. Returns True if
+    listeners are (already) installed; False when jax is absent or its
+    monitoring seam moved (the API lives in jax._src.monitoring)."""
+    global _listeners_installed
+    with _listener_lock:
+        if _listeners_installed:
+            return True
+        if "jax" not in sys.modules:
+            return False
+        try:
+            from jax._src import monitoring as _mon
+
+            reg_ev = getattr(_mon, "register_event_listener", None)
+            reg_dur = getattr(_mon, "register_event_duration_secs_listener",
+                              None)
+            if reg_ev is None:
+                return False
+            reg_ev(_on_jax_event)
+            if reg_dur is not None:
+                reg_dur(_on_jax_event_duration)
+            _listeners_installed = True
+            return True
+        except Exception:
+            return False
+
+
+def collect_device_stats(devices: List, node_hex: str = "") -> int:
+    """Publish memory gauges for the given device objects; returns how many
+    devices reported stats (CPU devices typically report none)."""
+    node = node_hex[:8] or "local"
+    n = 0
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        tags = {"node": node,
+                "device": f"{getattr(d, 'platform', 'dev')}:"
+                          f"{getattr(d, 'id', n)}"}
+        in_use = stats.get("bytes_in_use")
+        if in_use is not None:
+            _BYTES_IN_USE.set(float(in_use), tags=tags)
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            _PEAK_BYTES.set(float(peak), tags=tags)
+        n += 1
+    return n
+
+
+def collect_once(node_hex: str = "") -> int:
+    """One collection tick: install listeners if jax showed up, then read
+    every visible device's memory stats. Cheap no-op before jax loads."""
+    if "jax" not in sys.modules:
+        return 0
+    install_jax_listeners()
+    jax = sys.modules["jax"]
+    try:
+        devices = jax.devices()
+    except Exception:
+        return 0
+    return collect_device_stats(devices, node_hex)
+
+
+def start_device_telemetry(node_hex: str = "",
+                           interval_s: Optional[float] = None
+                           ) -> threading.Event:
+    """Start the per-process collector thread; returns its stop event."""
+    if interval_s is None:
+        from ray_tpu.core.config import global_config
+
+        interval_s = max(
+            0.05, global_config().device_telemetry_interval_ms / 1000.0)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval_s):
+            try:
+                collect_once(node_hex)
+            except Exception:
+                pass  # telemetry must never take a worker down
+
+    threading.Thread(target=loop, daemon=True,
+                     name="device-telemetry").start()
+    return stop

@@ -1,0 +1,248 @@
+"""Structured cluster event log: what happened to the cluster, when.
+
+Analog of the reference's GCS cluster events + export-event pipeline
+(src/ray/gcs/gcs_server/gcs_ray_event_converter.cc, ray list cluster-events):
+subsystems emit severity-tagged :class:`ClusterEvent` records through a
+per-process buffer; the buffer flushes to a head-side sink that appends to
+the GCS event ring (mirroring the task-event table in ``core/gcs.py``) and
+persists JSONL under ``session_dir/logs/events/``.
+
+Transport mirrors the metrics pipeline exactly:
+
+- driver/head process: the sink is ``Head.record_cluster_events`` (direct),
+- worker process:      one-way ``("cevents", batch)`` on the worker channel,
+- node daemon process: one-way ``("cevents", batch)`` on the head link.
+
+Emission is cheap and never raises; with no sink installed (process started
+before/without a cluster) events park in a bounded deque and flush when a
+sink appears.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
+_LEVELS = {s: (i + 1) * 10 for i, s in enumerate(SEVERITIES)}
+
+# sources used by the runtime's own emitters (user code may use any string)
+SOURCE_AUTOSCALER = "AUTOSCALER"
+SOURCE_SCHEDULER = "SCHEDULER"
+SOURCE_OBJECT_STORE = "OBJECT_STORE"
+SOURCE_SERVE = "SERVE"
+SOURCE_TRAIN = "TRAIN"
+SOURCE_TUNE = "TUNE"
+SOURCE_NODE = "NODE"
+
+
+@dataclass
+class ClusterEvent:
+    ts: float
+    severity: str
+    source: str
+    entity_id: str
+    message: str
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"ts": self.ts, "severity": self.severity,
+                "source": self.source, "entity_id": self.entity_id,
+                "message": self.message, "attrs": dict(self.attrs)}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ClusterEvent":
+        return cls(ts=d.get("ts", 0.0), severity=d.get("severity", "INFO"),
+                   source=d.get("source", ""),
+                   entity_id=d.get("entity_id", ""),
+                   message=d.get("message", ""),
+                   attrs=dict(d.get("attrs") or {}))
+
+
+class _EventBuffer:
+    """Per-process buffer with a pluggable sink (one per process)."""
+
+    def __init__(self, maxlen: int = 1000):
+        self._lock = threading.Lock()
+        self._buf: deque = deque(maxlen=maxlen)
+        self._sink: Optional[Callable[[List[dict]], None]] = None
+        self._flush_interval = 0.2
+        self._flusher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def set_sink(self, sink: Callable[[List[dict]], None],
+                 flush_interval_s: float = 0.2) -> None:
+        with self._lock:
+            self._sink = sink
+            # emit() flushes inline whenever a sink is present; this
+            # cadence only governs re-delivery after a failed send and
+            # draining of pre-sink parking
+            self._flush_interval = max(0.05, flush_interval_s)
+            if self._flusher is None:
+                self._stop = threading.Event()
+                self._flusher = threading.Thread(
+                    target=self._flush_loop, daemon=True,
+                    name="event-flusher")
+                self._flusher.start()
+        self.flush()
+
+    def clear_sink(self, sink: Optional[Callable] = None) -> None:
+        """Detach the sink (only if it matches ``sink`` when given).
+        Equality, not identity: bound methods are recreated per access."""
+        with self._lock:
+            if sink is not None and self._sink != sink:
+                return
+            self._sink = None
+            self._stop.set()
+            self._flusher = None
+
+    def emit(self, ev: ClusterEvent) -> None:
+        with self._lock:
+            self._buf.append(ev.to_dict())
+            sink = self._sink
+        # WARNING+ and head-local sinks want low latency; one flush per
+        # emit is fine (events are control-plane-rare, not per-task)
+        if sink is not None:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            sink = self._sink
+            if sink is None or not self._buf:
+                return
+            batch = list(self._buf)
+            self._buf.clear()
+        try:
+            sink(batch)
+        except Exception:
+            # link down / head shutting down: re-park (bounded) and retry
+            # on the next flush tick
+            with self._lock:
+                if self._sink is not None:
+                    self._buf.extendleft(reversed(batch))
+
+    def _flush_loop(self) -> None:
+        stop = self._stop
+        while not stop.wait(self._flush_interval):
+            self.flush()
+
+
+_buffer = _EventBuffer()
+
+
+def emit(severity: str, source: str, message: str, entity_id: str = "",
+         **attrs: Any) -> None:
+    """Record a cluster event. Never raises; no-op when disabled."""
+    try:
+        from ray_tpu.core.config import global_config
+
+        if not global_config().event_log_enabled:
+            return
+    except Exception:
+        pass
+    sev = severity.upper()
+    if sev not in _LEVELS:
+        sev = "INFO"
+    _buffer.emit(ClusterEvent(ts=time.time(), severity=sev, source=source,
+                              entity_id=str(entity_id), message=message,
+                              attrs=attrs))
+
+
+def flush() -> None:
+    """Push any buffered events to the sink now (test/shutdown hook)."""
+    _buffer.flush()
+
+
+def set_sink(sink: Callable[[List[dict]], None],
+             flush_interval_s: float = 0.2) -> None:
+    _buffer.set_sink(sink, flush_interval_s)
+
+
+def clear_sink(sink: Optional[Callable] = None) -> None:
+    _buffer.clear_sink(sink)
+
+
+def filter_events(rows: List[dict], severity: Optional[str] = None,
+                  source: Optional[str] = None,
+                  min_severity: Optional[str] = None) -> List[dict]:
+    """Shared filter for the state API and the dashboard ``/api/events``.
+
+    ``severity`` matches exactly; ``min_severity`` keeps that level and
+    above (DEBUG < INFO < WARNING < ERROR). Both are case-insensitive.
+    """
+    out = rows
+    if severity:
+        want = severity.upper()
+        out = [r for r in out if r.get("severity") == want]
+    if min_severity:
+        floor = _LEVELS.get(min_severity.upper(), 0)
+        out = [r for r in out
+               if _LEVELS.get(r.get("severity", ""), 0) >= floor]
+    if source:
+        want = source.upper()
+        out = [r for r in out if (r.get("source") or "").upper() == want]
+    return out
+
+
+class EventLogWriter:
+    """Head-side JSONL persistence under ``session_dir/logs/events/``.
+
+    Size-capped with one rotation generation (``events.jsonl.1``) so a
+    long-lived cluster's routine INFO traffic cannot fill the session
+    disk — the in-memory ring is bounded for the same reason.
+    """
+
+    def __init__(self, session_dir: str, max_bytes: Optional[int] = None):
+        if max_bytes is None:
+            try:
+                from ray_tpu.core.config import global_config
+
+                max_bytes = global_config().cluster_events_log_max_bytes
+            except Exception:
+                max_bytes = 64 * 1024 * 1024
+        self.max_bytes = max(1, int(max_bytes))
+        self.dir = os.path.join(session_dir, "logs", "events")
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, "events.jsonl")
+        self._lock = threading.Lock()
+        self._f = open(self.path, "a", encoding="utf-8")
+        self._size = self._f.tell()
+
+    def write(self, events: List[dict]) -> None:
+        with self._lock:
+            if self._f.closed:
+                return
+            for ev in events:
+                line = json.dumps(ev, default=str) + "\n"
+                self._f.write(line)
+                self._size += len(line)
+            self._f.flush()
+            if self._size >= self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        try:
+            self._f.close()
+            os.replace(self.path, self.path + ".1")
+            self._f = open(self.path, "a", encoding="utf-8")
+            self._size = 0
+        except OSError:
+            # rotation failing must not kill the sink; reopen best-effort
+            if self._f.closed:
+                try:
+                    self._f = open(self.path, "a", encoding="utf-8")
+                    self._size = self._f.tell()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except Exception:
+                pass

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 _TagKey = Tuple[Tuple[str, str], ...]
@@ -156,11 +157,23 @@ class Histogram:
 # --------------------------------------------------------------------------- #
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus exposition format: label values escape backslash, quote
+    and newline (a raw quote would make the scrape unparseable)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(v: str) -> str:
+    """HELP text escapes backslash and newline."""
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_tags(tags: _TagKey, extra: Dict[str, str] = ()) -> str:
     items = list(tags) + list(dict(extra).items() if extra else [])
     if not items:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in items)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
     return "{" + inner + "}"
 
 
@@ -169,7 +182,7 @@ def render_prometheus(reg: _Registry) -> str:
     lines: List[str] = []
     with reg._lock:
         for name, m in sorted(reg.metrics.items()):
-            lines.append(f"# HELP {name} {m['help']}")
+            lines.append(f"# HELP {name} {_escape_help(m['help'])}")
             lines.append(f"# TYPE {name} {m['type']}")
             all_values: List[Tuple[str, _TagKey, object]] = []
             for tags, v in m["values"].items():
@@ -211,17 +224,116 @@ def render_prometheus(reg: _Registry) -> str:
     return "\n".join(lines) + "\n"
 
 
+# --------------------------------------------------------------------------- #
+# Metrics history: bounded per-series time-series rings (head side)
+# --------------------------------------------------------------------------- #
+
+
+def aggregate_series(reg: _Registry) -> Dict[str, List[Tuple[_TagKey, float]]]:
+    """Flatten the merged registry into scalar series, aggregated the same
+    way the Prometheus rendering does: counters sum across sources,
+    gauges stay per-source (with a ``source`` tag), histograms project to
+    ``<name>_count`` and ``<name>_sum`` series."""
+    out: Dict[str, List[Tuple[_TagKey, float]]] = {}
+    with reg._lock:
+        for name, m in reg.metrics.items():
+            all_values: List[Tuple[str, _TagKey, object]] = []
+            for tags, v in m["values"].items():
+                all_values.append(("", tags, v))
+            for src, values in (m.get("sources") or {}).items():
+                for tags, v in values.items():
+                    all_values.append((src, tags, v))
+            if m["type"] == "histogram":
+                counts: Dict[_TagKey, float] = {}
+                sums: Dict[_TagKey, float] = {}
+                for _src, tags, v in all_values:
+                    counts[tags] = counts.get(tags, 0.0) + v["count"]
+                    sums[tags] = sums.get(tags, 0.0) + v["sum"]
+                out[name + "_count"] = list(counts.items())
+                out[name + "_sum"] = list(sums.items())
+            elif m["type"] == "counter":
+                agg: Dict[_TagKey, float] = {}
+                for _src, tags, v in all_values:
+                    agg[tags] = agg.get(tags, 0.0) + v
+                out[name] = list(agg.items())
+            else:  # gauge
+                series: Dict[_TagKey, float] = {}
+                for src, tags, v in all_values:
+                    key = tags + ((("source", src),) if src else ())
+                    series[key] = v
+                out[name] = list(series.items())
+    return out
+
+
+class MetricsHistory:
+    """Bounded (ts, value) rings per metric series so rates and trends are
+    queryable instead of only instantaneous snapshots (reference: the
+    dashboard's Grafana time-series over the Prometheus scrape; here a
+    self-contained ring served at ``/api/metrics/history``)."""
+
+    def __init__(self, max_samples: int = 360):
+        self.max_samples = max(2, int(max_samples))
+        self._lock = threading.Lock()
+        # metric name -> tag key -> deque[(ts, value)]
+        self._series: Dict[str, Dict[_TagKey, "deque"]] = {}
+
+    def sample(self, reg: Optional[_Registry] = None,
+               now: Optional[float] = None) -> None:
+        """Append one sample of every series in the merged registry."""
+        flat = aggregate_series(reg or _registry)
+        ts = time.time() if now is None else now
+        with self._lock:
+            for name, series in flat.items():
+                by_tags = self._series.setdefault(name, {})
+                for tags, value in series:
+                    ring = by_tags.get(tags)
+                    if ring is None:
+                        ring = by_tags[tags] = deque(
+                            maxlen=self.max_samples)
+                    ring.append((ts, float(value)))
+
+    def query(self, name: str) -> List[Dict]:
+        """All series of one metric: [{"tags": {...}, "points": [[ts, v]]}]."""
+        with self._lock:
+            by_tags = self._series.get(name, {})
+            return [{"tags": dict(tags), "points": [list(p) for p in ring]}
+                    for tags, ring in by_tags.items()]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+
 def start_report_thread(send_fn, interval_s: float) -> threading.Event:
-    """Worker-side: periodically flush the local registry via send_fn."""
+    """Worker-side: periodically flush the local registry via send_fn.
+
+    A transient send failure (node channel blip, head mid-restart) must not
+    kill the report thread for the life of the worker: log the first
+    failure, re-mark the registry dirty, and retry on the next interval.
+    """
+    import logging
+
     stop = threading.Event()
+    log = logging.getLogger("ray_tpu.metrics")
 
     def loop():
+        warned = False
         while not stop.wait(interval_s):
-            if _registry._dirty:
-                try:
-                    send_fn(_registry.snapshot())
-                except Exception:
-                    return
+            if not _registry._dirty:
+                continue
+            snap = _registry.snapshot()
+            try:
+                send_fn(snap)
+                warned = False
+            except Exception as e:  # noqa: BLE001
+                # snapshot() cleared the dirty bit; restore it so the next
+                # interval re-reports (values are cumulative, nothing lost)
+                with _registry._lock:
+                    _registry._dirty = True
+                if not warned:
+                    warned = True
+                    log.warning("metrics report failed (will retry "
+                                "next interval): %r", e)
 
     threading.Thread(target=loop, daemon=True,
                      name="metrics-report").start()

@@ -3,7 +3,11 @@
 The analog of attaching py-spy to a worker (reference debugging flow); used
 to find hot spots in worker/daemon processes where cProfile's single-thread
 view is useless. Activate with ``RAY_TPU_SAMPLER=/path/prefix`` — each
-process dumps ``<prefix>.<pid>`` at exit with stack-sample counts.
+process dumps ``<prefix>.<pid>`` at exit in collapsed-stack format
+(root-first, ``;``-separated frames, trailing sample count), the input
+flamegraph tooling (flamegraph.pl, speedscope, inferno) consumes directly::
+
+    worker_main:worker_runtime.py;serve_forever:worker_runtime.py;... 42
 """
 
 from __future__ import annotations
@@ -24,7 +28,14 @@ def start_from_env(env_var: str = "RAY_TPU_SAMPLER",
     return start(f"{prefix}.{os.getpid()}", interval_s, depth)
 
 
+def _frame_name(f) -> str:
+    # no ';' (frame separator) or spaces (count separator) in a frame
+    name = f"{f.f_code.co_name}:{os.path.basename(f.f_code.co_filename)}"
+    return name.replace(";", ":").replace(" ", "_")
+
+
 def start(path: str, interval_s: float = 0.002, depth: int = 8):
+    # key: tuple of frames, leaf-first (the natural f_back walk order)
     samples: collections.Counter = collections.Counter()
     stop = threading.Event()
     me = threading.get_ident()
@@ -37,10 +48,9 @@ def start(path: str, interval_s: float = 0.002, depth: int = 8):
                 stack = []
                 f = frame
                 while f is not None and len(stack) < depth:
-                    stack.append(f"{f.f_code.co_name}:"
-                                 f"{os.path.basename(f.f_code.co_filename)}")
+                    stack.append(_frame_name(f))
                     f = f.f_back
-                samples["<".join(stack)] += 1
+                samples[tuple(stack)] += 1
             time.sleep(interval_s)
 
     t = threading.Thread(target=loop, daemon=True, name="sampler")
@@ -52,8 +62,12 @@ def start(path: str, interval_s: float = 0.002, depth: int = 8):
         snapshot = collections.Counter(dict(samples))
         try:
             with open(path, "w") as f:
-                for k, v in snapshot.most_common(100):
-                    f.write(f"{v}\t{k}\n")
+                # collapsed-stack format: root-first frames joined by ';',
+                # one space, the sample count. EVERY stack is written (no
+                # top-N cut) so flamegraphs keep their true total.
+                for stack, count in sorted(snapshot.items(),
+                                           key=lambda kv: -kv[1]):
+                    f.write(";".join(reversed(stack)) + f" {count}\n")
         except OSError:
             pass
 

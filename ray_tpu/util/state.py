@@ -45,6 +45,22 @@ def list_placement_groups(limit: int = 1000) -> List[Dict[str, Any]]:
     return _state_query("placement_groups", limit)
 
 
+def list_cluster_events(severity: Optional[str] = None,
+                        source: Optional[str] = None,
+                        min_severity: Optional[str] = None,
+                        limit: int = 1000) -> List[Dict[str, Any]]:
+    """Structured cluster events from the head's GCS event ring
+    (reference: ``ray list cluster-events``). ``severity`` matches one
+    level exactly, ``min_severity`` keeps that level and above, and
+    ``source`` filters the emitting subsystem (AUTOSCALER, SCHEDULER,
+    OBJECT_STORE, SERVE, TRAIN, TUNE, NODE, ...)."""
+    from ray_tpu.util.events import filter_events
+
+    rows = _state_query("cluster_events", 100_000)
+    return filter_events(rows, severity=severity, source=source,
+                         min_severity=min_severity)[-limit:]
+
+
 def summarize_tasks() -> Dict[str, Dict[str, int]]:
     """{func_name: {state: count}} (reference: ray summary tasks)."""
     out: Dict[str, Dict[str, int]] = {}

@@ -1,0 +1,119 @@
+"""Minimal Prometheus exposition-format parser (test utility).
+
+Strict enough to catch the real failure modes of a hand-rolled renderer:
+unescaped quotes/backslashes/newlines in label values, malformed label
+blocks, bad metric names, non-numeric values, and malformed comment
+lines. Returns parsed samples so tests can assert label round-trips.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+_ESCAPES = {"\\": "\\", '"': '"', "n": "\n"}
+
+
+class PromParseError(ValueError):
+    pass
+
+
+def _err(lineno: int, msg: str, line: str) -> "PromParseError":
+    return PromParseError(f"line {lineno}: {msg}: {line!r}")
+
+
+def _parse_labels(line: str, i: int, lineno: int) -> Tuple[Dict[str, str], int]:
+    """Parse a ``{k="v",...}`` block starting at ``line[i] == '{'``;
+    returns (labels, index past the closing brace)."""
+    labels: Dict[str, str] = {}
+    i += 1  # past '{'
+    try:
+        while line[i] != "}":
+            j = i
+            while line[j] not in "=,}":
+                j += 1
+            lname = line[i:j]
+            if not _LABEL.match(lname):
+                raise _err(lineno, f"bad label name {lname!r}", line)
+            if line[j] != "=":
+                raise _err(lineno, "expected '=' after label name", line)
+            j += 1
+            if line[j] != '"':
+                raise _err(lineno, "label value must be quoted", line)
+            j += 1
+            buf: List[str] = []
+            while line[j] != '"':
+                c = line[j]
+                if c == "\\":
+                    esc = line[j + 1]
+                    if esc not in _ESCAPES:
+                        raise _err(lineno, f"bad escape \\{esc}", line)
+                    buf.append(_ESCAPES[esc])
+                    j += 2
+                else:
+                    buf.append(c)
+                    j += 1
+            labels[lname] = "".join(buf)
+            j += 1  # past closing quote
+            if line[j] == ",":
+                i = j + 1
+            elif line[j] == "}":
+                i = j
+            else:
+                raise _err(lineno, "expected ',' or '}' after label", line)
+    except IndexError:
+        raise _err(lineno, "truncated label block "
+                   "(unescaped quote or newline?)", line) from None
+    return labels, i + 1
+
+
+def _parse_sample(line: str, lineno: int) -> Tuple[str, Dict[str, str], float]:
+    i = 0
+    while i < len(line) and (line[i].isalnum() or line[i] in "_:"):
+        i += 1
+    name = line[:i]
+    if not _NAME.match(name):
+        raise _err(lineno, f"bad metric name {name!r}", line)
+    labels: Dict[str, str] = {}
+    if i < len(line) and line[i] == "{":
+        labels, i = _parse_labels(line, i, lineno)
+    if i >= len(line) or line[i] != " ":
+        raise _err(lineno, "expected space before value", line)
+    rest = line[i + 1:].split()
+    if not rest or len(rest) > 2:  # value [timestamp]
+        raise _err(lineno, "expected 'value [timestamp]'", line)
+    try:
+        value = float(rest[0].replace("+Inf", "inf").replace("-Inf", "-inf"))
+    except ValueError:
+        raise _err(lineno, f"bad value {rest[0]!r}", line) from None
+    if len(rest) == 2:
+        try:
+            int(rest[1])
+        except ValueError:
+            raise _err(lineno, f"bad timestamp {rest[1]!r}", line) from None
+    return name, labels, value
+
+
+def parse_exposition(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Parse exposition text; raises :class:`PromParseError` on any
+    malformed line. Returns [(metric_name, labels, value), ...]."""
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if line == "":
+            continue
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) < 3 or parts[0] != "#" \
+                    or parts[1] not in ("HELP", "TYPE"):
+                raise _err(lineno, "bad comment line", line)
+            if not _NAME.match(parts[2]):
+                raise _err(lineno, f"bad metric name {parts[2]!r}", line)
+            if parts[1] == "TYPE":
+                if len(parts) < 4 or parts[3] not in _TYPES:
+                    raise _err(lineno, "bad TYPE", line)
+            continue
+        samples.append(_parse_sample(line, lineno))
+    return samples
