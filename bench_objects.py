@@ -1,0 +1,131 @@
+"""Object data-plane microbenchmark: put/get latency + 2-node transfer MB/s.
+
+Prints ONE JSON line (same convention as bench.py):
+
+    {"bench": "objects", "put_ms": {"1KB": .., "1MB": .., "64MB": ..},
+     "get_ms": {...}, "transfer_MBps": {"1KB": .., "1MB": .., "64MB": ..},
+     "pool": {"hits": N, "misses": N}}
+
+- put/get: driver <-> local node store (inline for 1KB, arena for the rest).
+- transfer: a REAL separate-process daemon node produces the payload; the
+  driver pulls it over the node-to-node object plane (the path rebuilt by
+  the zero-copy data-plane PR: pooled connections + arena-direct receive +
+  striped pulls). MB/s = payload bytes / wall-clock pull time.
+
+Runs under ``JAX_PLATFORMS=cpu`` (no accelerator needed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# arena headroom: the 64 MB series keeps a few payloads live at once
+os.environ.setdefault("RAY_TPU_OBJECT_STORE_MEMORY", str(1 << 30))
+
+SIZES = {"1KB": 1 << 10, "1MB": 1 << 20, "64MB": 64 << 20}
+
+
+def _median_ms(samples):
+    return round(statistics.median(samples) * 1000.0, 3)
+
+
+def bench_put_get(iters):
+    import numpy as np
+
+    import ray_tpu
+
+    put_ms, get_ms = {}, {}
+    for label, size in SIZES.items():
+        n = max(3, iters // (8 if size >= (1 << 20) else 1))
+        puts, gets = [], []
+        for _ in range(n):
+            arr = np.ones(size, dtype=np.uint8)
+            t0 = time.perf_counter()
+            ref = ray_tpu.put(arr)
+            t1 = time.perf_counter()
+            out = ray_tpu.get(ref)
+            t2 = time.perf_counter()
+            assert out.nbytes == size
+            puts.append(t1 - t0)
+            gets.append(t2 - t1)
+            del ref, out
+        put_ms[label] = _median_ms(puts)
+        get_ms[label] = _median_ms(gets)
+    return put_ms, get_ms
+
+
+def bench_transfer(iters):
+    """Daemon node -> driver pull throughput (2 OS processes, real TCP)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=1, resources={"src": 4},
+                     separate_process=True)
+
+    @ray_tpu.remote(resources={"src": 1})
+    def produce(nbytes, salt):
+        import numpy as np
+
+        a = np.empty(nbytes, dtype=np.uint8)
+        a[:] = salt & 0xFF
+        return a
+
+    out = {}
+    try:
+        # warm the worker + transfer path once
+        ray_tpu.get(produce.remote(1024, 0), timeout=120)
+        for label, size in SIZES.items():
+            n = max(2, iters // (8 if size >= (1 << 20) else 1))
+            rates = []
+            for i in range(n):
+                ref = produce.remote(size, i + 1)
+                # materialize on the producer before timing the pull
+                ray_tpu.wait([ref], timeout=120, fetch_local=False)
+                t0 = time.perf_counter()
+                arr = ray_tpu.get(ref, timeout=300)
+                dt = time.perf_counter() - t0
+                assert arr.nbytes == size and int(arr[0]) == (i + 1) & 0xFF
+                rates.append(size / dt / (1 << 20))
+                del arr, ref
+            out[label] = round(statistics.median(rates), 1)
+    finally:
+        cluster.shutdown()
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=24,
+                    help="samples for the small sizes (large sizes use /8)")
+    ap.add_argument("--skip-transfer", action="store_true")
+    args = ap.parse_args()
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+        put_ms, get_ms = bench_put_get(args.iters)
+    finally:
+        ray_tpu.shutdown()
+
+    transfer = {} if args.skip_transfer else bench_transfer(args.iters)
+
+    try:
+        from ray_tpu.core import object_transfer
+
+        pool = object_transfer.pool_stats()
+    except Exception:
+        pool = {}
+    print(json.dumps({"bench": "objects", "put_ms": put_ms,
+                      "get_ms": get_ms, "transfer_MBps": transfer,
+                      "pool": pool}))
+
+
+if __name__ == "__main__":
+    main()

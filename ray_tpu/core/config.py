@@ -35,6 +35,19 @@ class Config:
     min_spilling_size: int = 1 * 1024 * 1024
     max_io_workers: int = 4
 
+    # ---- object data plane (node-to-node transfer; object_transfer.py) ----
+    # pooled, reusable authenticated connections per peer object server
+    # (reference: ObjectManager keeps persistent gRPC channels per remote;
+    # a fresh TCP+HMAC handshake per pull was the round-5 hot-path tax)
+    object_pool_enabled: bool = True
+    object_pool_connections_per_peer: int = 4
+    object_pool_idle_timeout_s: float = 60.0
+    # striped multi-peer pulls: objects >= threshold with >=2 holders are
+    # split into per-holder stripes pulled in parallel into disjoint arena
+    # slices (reference: chunked parallel pulls, pull_manager.h)
+    object_stripe_threshold: int = 8 * 1024 * 1024
+    object_stripe_max_peers: int = 4
+
     # ---- scheduler (reference: ray_config_def.h:179,185,190) ----
     scheduler_spread_threshold: float = 0.5
     scheduler_top_k_fraction: float = 0.2

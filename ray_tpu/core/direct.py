@@ -398,7 +398,16 @@ class DirectTaskManager:
         with self._lock:
             spec = self._pending.get(task_id)
             if spec is None:
-                return  # stale (superseded attempt)
+                # Stale (superseded attempt / duplicate delivery) — but EOF
+                # delivery must stay idempotent: if a stream exists whose
+                # EOF never landed (a lost/reordered first delivery), settle
+                # it now so no consumer blocks in stream_next forever (an
+                # empty stream's ONLY signal is the EOF).
+                st = self._streams.get(task_id)
+                if st is not None and st.done is None:
+                    st.done = (st.count, err_name is not None)
+                    self._cv.notify_all()
+                return
             # cancel is a no-op on an already-finished task (Ray
             # semantics): only seal TaskCancelledError when the executor
             # reports the task errored or never produced results

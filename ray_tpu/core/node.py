@@ -1024,6 +1024,12 @@ class Node:
             "arena_path": self.store.arena_path,
             "arena_capacity": self.store.capacity,
             "config": global_config().to_json(),
+            # driver-visible import roots: functions pickled BY REFERENCE
+            # against modules the driver loaded from script-local dirs
+            # (pytest rootdir inserts, sys.path hacks) must resolve in the
+            # worker too (reference: ray ships the driver's sys.path via
+            # the runtime env's working_dir/py_modules mechanism)
+            "sys_path": [p for p in sys.path if p],
         }
         channel.send("init", init_info)
         w.reader = threading.Thread(
@@ -1446,5 +1452,12 @@ class Node:
             pass
         if getattr(self, "object_server", None) is not None:
             self.object_server.close()
+            # drop pooled transfer connections: this node's outbound conns
+            # are dead weight now, and peers' conns to it will fail health
+            # checks. Coarse (the pool is process-global; co-resident nodes
+            # re-dial on their next pull) but leak-free.
+            from .object_transfer import close_pool
+
+            close_pool()
         self.store.close()
         self._handler_pool.shutdown(wait=False)

@@ -273,7 +273,7 @@ class RemoteHead:
         ``hint`` (direct-path owner hint) short-circuits the head locate
         entirely: the daemon pulls straight from the hinted peer's object
         server found in the syncer-broadcast cluster view."""
-        from .object_transfer import pull_object
+        from .object_transfer import pull_object, pull_object_striped
 
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
@@ -313,6 +313,35 @@ class RemoteHead:
             if rep[0] == "inline":
                 return ("inline", rep[1], rep[2])
             if rep[0] == "locs":
+                if len(rep[1]) >= 2:
+                    # multi-holder: striped parallel pull with per-stripe
+                    # failover (falls back to serial pulls internally, so
+                    # a None covers every holder). Peers that failed —
+                    # even when failover succeeded — get their stale
+                    # locations dropped so locate stops handing them out.
+                    addr_to_hex = {tuple(a): h for h, a in rep[1]}
+                    failed: list = []
+                    res = pull_object_striped(
+                        [addr for _h, addr in rep[1]], self.cluster_key,
+                        oid, node.store, on_peer_failed=failed.append)
+                    for a in failed:
+                        src_hex = addr_to_hex.get(tuple(a))
+                        if src_hex is None:
+                            continue
+                        try:
+                            self.rpc.call("req", "drop_location",
+                                          (oid, src_hex), timeout=10.0)
+                        except Exception:
+                            pass
+                    if res is not None:
+                        body, is_err = res
+                        if isinstance(body, tuple):
+                            _, off, size = body
+                            self.on_object_sealed(oid, node.hex)
+                            return ("arena", off, size, is_err)
+                        return ("inline", body, is_err)
+                    time.sleep(0.05)  # all holders failed: re-locate
+                    continue
                 all_stale = True
                 for src_hex, addr in rep[1]:
                     res = pull_object(addr, self.cluster_key, oid,
@@ -421,6 +450,9 @@ def main(argv=None) -> int:
         pass
     syncer.stop()
     node.shutdown()
+    from .object_transfer import close_pool
+
+    close_pool()  # drop pooled transfer connections with the node
     return 0
 
 

@@ -9,6 +9,8 @@ with the owner via the contained-ids mechanism in serialization.py
 
 from __future__ import annotations
 
+import collections
+import threading
 from typing import Optional
 
 from . import serialization
@@ -18,14 +20,73 @@ from .ids import ObjectID
 # resolve `.get()`/release without importing the runtime module (avoids cycle).
 _runtime = None
 
+# Deferred ref-drop queue. ``ObjectRef.__del__`` runs inside the garbage
+# collector, which can fire on ANY allocation — including one made while a
+# runtime thread holds a non-reentrant lock (DirectTaskManager._lock, node
+# locks). Calling ``remove_local_ref`` synchronously from __del__ therefore
+# self-deadlocks that thread (observed: the direct-path completion thread
+# wedged inside complete(), losing a stream's EOF forever — the
+# test_stream_empty full-suite hang). __del__ only appends to this deque
+# (atomic, lock-free); a dedicated reaper thread drains it, so ref releases
+# always run on a thread that holds no runtime locks. The reference solves
+# the same problem the same way (_raylet's deferred ref-release queue).
+_drop_queue: "collections.deque" = collections.deque()
+_drop_event = threading.Event()
+_reaper_started = False
+_reaper_lock = threading.Lock()
+
+
+def _reaper_loop() -> None:
+    while True:
+        _drop_event.wait()
+        _drop_event.clear()
+        while True:
+            try:
+                oid = _drop_queue.popleft()
+            except IndexError:
+                break
+            rt = _runtime
+            if rt is None:
+                continue  # runtime torn down: nothing left to release
+            try:
+                rt.remove_local_ref(oid)
+            except Exception:
+                pass  # shutdown race / head gone
+
+
+def _ensure_reaper() -> None:
+    global _reaper_started
+    if _reaper_started:
+        return
+    with _reaper_lock:
+        if not _reaper_started:
+            threading.Thread(target=_reaper_loop, daemon=True,
+                             name="ref-reaper").start()
+            _reaper_started = True
+
 
 def set_runtime(rt) -> None:
     global _runtime
+    if rt is None:
+        # cluster shutdown: drops for the old runtime are void
+        _drop_queue.clear()
+    else:
+        _ensure_reaper()
     _runtime = rt
 
 
 def get_runtime():
     return _runtime
+
+
+def flush_pending_drops(timeout: float = 1.0) -> None:
+    """Best-effort wait for queued __del__ ref drops to apply (tests)."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _drop_queue and _time.monotonic() < deadline:
+        _drop_event.set()
+        _time.sleep(0.005)
 
 
 class ObjectRef:
@@ -83,9 +144,13 @@ class ObjectRef:
         return (_deserialize_ref, (self.id, self.owner_node))
 
     def __del__(self):
+        # NEVER release synchronously: __del__ runs inside the GC, which
+        # can fire on a thread holding runtime locks — hand the drop to
+        # the reaper thread instead (see _drop_queue above)
         if not self._weak and _runtime is not None:
             try:
-                _runtime.remove_local_ref(self.id)
+                _drop_queue.append(self.id)
+                _drop_event.set()
             except Exception:  # interpreter shutdown
                 pass
 

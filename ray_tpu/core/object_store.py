@@ -17,6 +17,7 @@ the CoreWorker in-process memory store (``store_provider/memory_store/``):
 
 from __future__ import annotations
 
+import contextlib
 import mmap
 import os
 import threading
@@ -103,6 +104,11 @@ class PlasmaArena:
         self._mm = mmap.mmap(self._fd, capacity)
         self.allocator = _make_allocator(capacity) if create else None
 
+    @property
+    def fd(self) -> int:
+        """Backing-file descriptor (os.sendfile source for zero-copy sends)."""
+        return self._fd
+
     def view(self, offset: int, size: int) -> memoryview:
         return memoryview(self._mm)[offset : offset + size]
 
@@ -129,6 +135,18 @@ class PlasmaArena:
 # --------------------------------------------------------------------------- #
 
 
+class ReadHandle:
+    """Pinned view of a sealed arena extent (see open_read): ``view`` for
+    mmap reads, (``fd``, ``offset``) for os.sendfile zero-copy sends."""
+
+    __slots__ = ("view", "fd", "offset")
+
+    def __init__(self, view: memoryview, fd: int, offset: int):
+        self.view = view
+        self.fd = fd
+        self.offset = offset
+
+
 @dataclass
 class ObjectEntry:
     object_id: ObjectID
@@ -143,6 +161,11 @@ class ObjectEntry:
     ref_count: int = 0
     last_access: float = field(default_factory=time.monotonic)
     creating: bool = False  # allocated, being written
+    # transfer readers streaming this extent to a peer (open_read): the
+    # extent must not move or free mid-send; unlike ``mapped`` the pin is
+    # scoped — delete() during a send defers the free to the last release
+    readers: int = 0
+    pending_free: bool = False  # deleted while readers > 0
 
 
 class LocalObjectStore:
@@ -200,7 +223,10 @@ class LocalObjectStore:
         with self._lock:
             stale = self._entries.get(oid)
             if stale is not None and stale.offset >= 0 and stale.spilled_path is None:
-                self.arena.allocator.free(stale.offset)  # retry overwrote entry
+                if stale.readers > 0:  # open_read sender mid-stream
+                    stale.pending_free = True
+                else:
+                    self.arena.allocator.free(stale.offset)  # retry overwrote entry
             self._entries[oid] = ObjectEntry(oid, size=size, offset=off, creating=True)
         return off, self.arena.view(off, size)
 
@@ -277,6 +303,38 @@ class LocalObjectStore:
                     return None
             return bytes(self.arena.view(e.offset, e.size)[start:start + n])
 
+    @contextlib.contextmanager
+    def open_read(self, oid: ObjectID):
+        """Zero-copy transfer read: yields a ``ReadHandle`` over the sealed
+        arena extent, pinned against move/free for the duration (the
+        node-to-node sender streams the payload straight out of the mmap —
+        or via ``os.sendfile`` from the backing tmpfs fd). Yields None for
+        inline/spilled/absent entries — caller falls back to the copying
+        ``read_chunk`` path. A concurrent delete() defers the extent free
+        to the last reader's release instead of yanking memory out from
+        under an in-flight send."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if (e is None or not e.sealed or e.inline is not None
+                    or e.spilled_path is not None or e.offset < 0):
+                e = None
+            else:
+                e.readers += 1
+                e.last_access = time.monotonic()
+                handle = ReadHandle(self.arena.view(e.offset, e.size),
+                                    self.arena.fd, e.offset)
+        try:
+            yield handle if e is not None else None
+        finally:
+            if e is not None:
+                with self._lock:
+                    e.readers -= 1
+                    if (e.readers <= 0 and e.pending_free
+                            and not e.mapped and e.offset >= 0):
+                        self.arena.allocator.free(e.offset)
+                        e.pending_free = False
+                        e.offset = -1
+
     def entry_info(self, oid: ObjectID) -> Optional[Tuple[int, int, bool]]:
         """(offset, size, is_error) for sealed arena objects, for direct worker
         mmap reads; None if inline/absent/spilled."""
@@ -316,7 +374,12 @@ class LocalObjectStore:
             # (the reference frees plasma buffers only when all client
             # references release; we track at entry granularity).
             if e.offset >= 0 and e.spilled_path is None and not e.mapped:
-                self.arena.allocator.free(e.offset)
+                if e.readers > 0:
+                    # an open_read sender is mid-stream over this extent:
+                    # the last release frees it (see open_read)
+                    e.pending_free = True
+                else:
+                    self.arena.allocator.free(e.offset)
             if e.spilled_path:
                 try:
                     os.unlink(e.spilled_path)
@@ -351,7 +414,7 @@ class LocalObjectStore:
                 # never relocate/free an entry whose zero-copy view was handed
                 # out (a reader may alias the arena range); explicit delete()
                 # via refcount-0 is the user-driven path that still frees it
-                if (e.ref_count <= 0 and not e.mapped
+                if (e.ref_count <= 0 and not e.mapped and e.readers <= 0
                         and not self._pin_check(e.object_id)):
                     self.arena.allocator.free(e.offset)
                     del self._entries[e.object_id]
@@ -362,7 +425,8 @@ class LocalObjectStore:
                 for e in candidates:
                     if freed >= need:
                         break
-                    if e.object_id not in self._entries or e.mapped:
+                    if (e.object_id not in self._entries or e.mapped
+                            or e.readers > 0):
                         # never move an object a zero-copy reader may alias
                         continue
                     self._spill_locked(e)

@@ -1,31 +1,317 @@
-"""Direct node-to-node chunked object transfer.
+"""Direct node-to-node chunked object transfer (zero-copy data plane).
 
 Analog of the reference's ObjectManager push/pull over gRPC
 (src/ray/object_manager/object_manager.h:117, chunked per
 object_manager_default_chunk_size ray_config_def.h:345): every node runs an
 ``ObjectServer``; a node needing an object asks the head only for *locations*
 (addr + key), then pulls chunks straight from the source node's store into
-its own arena — the driver's memory is never in the data path (the round-1
-weakness: whole-object copies mediated by driver memory).
+its own arena — the driver's memory is never in the data path.
 
-Wire protocol (multiprocessing.connection over TCP, HMAC-authenticated):
-    puller -> ("pull", oid_binary)
+Data-plane design (this module's three throughput pillars):
+
+1. **Pooled connections** — a per-peer pool of authenticated, reusable
+   ``multiprocessing.connection`` TCP clients (bounded size, idle timeout,
+   health check on checkout) shared by ``pull_object`` / ``push_object`` /
+   ``fan_out_push``. The reference keeps persistent gRPC channels per
+   remote node; a fresh TCP+HMAC handshake per object was this layer's
+   round-5 hot-path tax.
+2. **Arena-direct chunked transfers** — the puller allocates the
+   destination extent first (size from the transfer header) and receives
+   each chunk straight into ``memoryview`` slices of the shm mmap via
+   ``recv_bytes_into`` (zero intermediate copies, constant memory); the
+   sender streams ``send_bytes(view, offset, n)`` over the sealed extent
+   pinned by ``LocalObjectStore.open_read`` — no ``bytes`` payload is ever
+   materialized on either side.
+3. **Striped multi-peer pulls** — objects >= ``object_stripe_threshold``
+   with >=2 holders (GCS location table) are split into contiguous
+   stripes pulled in parallel from different holders into disjoint arena
+   slices, with per-stripe failover to the remaining holders when a peer
+   dies mid-transfer (reference: pull_manager.h parallel chunked pulls).
+
+Wire protocol (multiprocessing.connection over TCP, HMAC-authenticated;
+one server-side thread per connection, many requests per connection):
+    puller -> ("pull", oid_binary)                  whole object
+    puller -> ("pullr", oid_binary, start, length)  byte range (stripes)
+    puller -> ("stat", oid_binary)                  metadata only
     server -> ("meta", size, is_error) | ("missing",)
-    server -> chunk bytes x ceil(size / chunk)      (send_bytes frames)
-Connections are per-pull; the OS socket buffer provides backpressure.
+    server -> RAW byte stream of exactly the requested range
+Control messages use the connection's pickle framing; the payload body is
+a raw unframed stream (``os.sendfile`` from the tmpfs arena fd on the
+sender, ``os.readv`` straight into the destination mmap on the receiver —
+CPython's ``recv_bytes_into`` copies through an internal BytesIO, so the
+framed API cannot be zero-copy). A sender that loses the object
+mid-stream closes the connection; the receiver treats the short read as
+"unavailable" and re-locates. Aborted/err'd connections are discarded
+from the pool, clean exchanges are pooled for reuse.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+import socket as _socket
 import threading
+import time
 from multiprocessing import connection as mpc
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .config import global_config
 from .exceptions import ObjectLostError
 from .ids import ObjectID
 from .protocol import set_nodelay as _set_nodelay
+
+from ray_tpu.util.metrics import Counter
+
+# transfer metrics: merged into the head registry by the existing metrics
+# report threads, so they show up in /metrics and /api/metrics/history
+_m_pool_hits = Counter("object_transfer_pool_hits_total",
+                       "pooled connection checkouts that reused a socket")
+_m_pool_misses = Counter("object_transfer_pool_misses_total",
+                         "pooled connection checkouts that dialed fresh")
+_m_pool_evicted = Counter("object_transfer_pool_evicted_total",
+                          "pooled connections dropped (idle/unhealthy)")
+_m_bytes_pulled = Counter("object_transfer_bytes_pulled_total",
+                          "object payload bytes pulled from peers")
+_m_bytes_pushed = Counter("object_transfer_bytes_pushed_total",
+                          "object payload bytes pushed to peers")
+_m_stripe_pulls = Counter("object_transfer_stripe_pulls_total",
+                          "large pulls striped across multiple holders")
+_m_stripe_retries = Counter("object_transfer_stripe_retries_total",
+                            "stripe failovers to a surviving holder")
+
+_CONN_ERRS = (EOFError, OSError, ValueError, BufferError)
+
+# transfer sockets move multi-MB bodies: widen the kernel buffers (the
+# ~200KB defaults throttle loopback/LAN streaming) — best effort
+_SOCK_BUF = 4 << 20
+
+
+def _tune_conn(conn) -> None:
+    _set_nodelay(conn)
+    try:
+        s = _socket.socket(fileno=os.dup(conn.fileno()))
+        try:
+            s.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, _SOCK_BUF)
+            s.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, _SOCK_BUF)
+        finally:
+            s.close()
+    except OSError:
+        pass
+
+
+# ---- raw body streaming (no per-chunk framing) ---------------------------- #
+
+
+def _read_exact_into(fd: int, view: memoryview) -> None:
+    """Fill ``view`` from the socket fd — reads land directly in the
+    destination buffer (an arena mmap slice), zero intermediate copies.
+    MSG_WAITALL lets the kernel loop until the buffer fills (one syscall
+    for multi-MB bodies instead of one per socket-buffer drain)."""
+    total = view.nbytes
+    if total == 0:
+        return
+    try:
+        s = _socket.socket(fileno=os.dup(fd))
+    except OSError:
+        s = None
+    got = 0
+    try:
+        while got < total:
+            if s is not None:
+                n = s.recv_into(view[got:], 0, _socket.MSG_WAITALL)
+            else:
+                n = os.readv(fd, [view[got:]])
+            if n == 0:
+                raise EOFError("transfer stream truncated")
+            got += n
+    finally:
+        if s is not None:
+            s.close()
+
+
+def _drain_exact(fd: int, count: int) -> None:
+    """Consume exactly ``count`` raw body bytes (duplicate push)."""
+    if count <= 0:
+        return
+    buf = memoryview(bytearray(min(count, 1 << 20)))
+    left = count
+    while left > 0:
+        n = os.readv(fd, [buf[:min(left, buf.nbytes)]])
+        if n == 0:
+            raise EOFError("transfer stream truncated")
+        left -= n
+
+
+def _write_all(fd: int, view) -> None:
+    view = memoryview(view)
+    off = 0
+    total = view.nbytes
+    while off < total:
+        off += os.write(fd, view[off:])
+
+
+_sendfile_broken = False
+
+
+def _send_body(sock_fd: int, handle, start: int, length: int) -> None:
+    """Stream ``length`` bytes of a pinned arena extent: os.sendfile from
+    the tmpfs backing fd (payload never enters user space), falling back
+    to plain writes from the mmap view."""
+    global _sendfile_broken
+    if not _sendfile_broken:
+        try:
+            sent = 0
+            base = handle.offset + start
+            while sent < length:
+                n = os.sendfile(sock_fd, handle.fd, base + sent,
+                                length - sent)
+                if n == 0:
+                    raise EOFError("peer closed mid-send")
+                sent += n
+            return
+        except OSError as e:
+            import errno
+
+            if sent == 0 and e.errno in (errno.EINVAL, errno.ENOSYS,
+                                         errno.ENOTSOCK):
+                _sendfile_broken = True  # fall through to mmap writes
+            else:
+                raise
+    _write_all(sock_fd, handle.view[start:start + length])
+
+
+# --------------------------------------------------------------------------- #
+# Connection pool
+# --------------------------------------------------------------------------- #
+
+
+class ConnectionPool:
+    """Per-peer pool of authenticated, reusable transfer connections.
+
+    Checkout is exclusive (a connection is never shared between threads);
+    release returns it for reuse unless the protocol exchange ended off a
+    message boundary (``discard``). Health check on checkout: a healthy
+    idle transfer connection has no readable data — ``poll(0)`` returning
+    True means server EOF or stray bytes, either way unusable.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._idle: Dict[tuple, List[Tuple[object, float]]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evicted = 0
+
+    def acquire(self, address, authkey: bytes):
+        addr = tuple(address)
+        cfg = global_config()
+        if cfg.object_pool_enabled:
+            now = time.monotonic()
+            while True:
+                with self._lock:
+                    entries = self._idle.get(addr)
+                    entry = entries.pop() if entries else None
+                if entry is None:
+                    break
+                conn, ts = entry
+                if now - ts > cfg.object_pool_idle_timeout_s:
+                    self._drop(conn)
+                    continue
+                try:
+                    if conn.closed or conn.poll(0):
+                        self._drop(conn)
+                        continue
+                except OSError:
+                    self._drop(conn)
+                    continue
+                with self._lock:
+                    self.hits += 1
+                _m_pool_hits.inc()
+                return conn
+        conn = mpc.Client(address=addr, family="AF_INET", authkey=authkey)
+        _tune_conn(conn)
+        with self._lock:
+            self.misses += 1
+        _m_pool_misses.inc()
+        return conn
+
+    def release(self, address, conn, discard: bool = False) -> None:
+        cfg = global_config()
+        if discard or not cfg.object_pool_enabled:
+            self._drop(conn, count=discard)
+            return
+        addr = tuple(address)
+        now = time.monotonic()
+        expired: List[object] = []
+        with self._lock:
+            # global idle sweep: addresses never acquired again (removed
+            # peers) would otherwise keep their sockets forever — the
+            # lazy per-address timeout in acquire() can't reach them
+            for a in list(self._idle):
+                entries = self._idle[a]
+                keep = [(c, ts) for c, ts in entries
+                        if now - ts <= cfg.object_pool_idle_timeout_s]
+                expired.extend(c for c, ts in entries
+                               if now - ts > cfg.object_pool_idle_timeout_s)
+                if keep:
+                    self._idle[a] = keep
+                else:
+                    del self._idle[a]
+            entries = self._idle.setdefault(addr, [])
+            if len(entries) >= cfg.object_pool_connections_per_peer:
+                stale = entries.pop(0)[0]  # bound: recycle the oldest slot
+                entries.append((conn, now))
+                conn = stale
+            else:
+                entries.append((conn, now))
+                conn = None
+        for c in expired:
+            self._drop(c)
+        if conn is not None:
+            self._drop(conn)
+
+    def _drop(self, conn, count: bool = True) -> None:
+        if count:
+            with self._lock:
+                self.evicted += 1
+            _m_pool_evicted.inc()
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def close_all(self) -> None:
+        with self._lock:
+            idle, self._idle = self._idle, {}
+        for entries in idle.values():
+            for conn, _ts in entries:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evicted": self.evicted,
+                "idle": sum(len(v) for v in self._idle.values()),
+            }
+
+
+_pool = ConnectionPool()
+
+
+def pool_stats() -> Dict[str, int]:
+    """Process-wide transfer connection-pool counters (bench/tests)."""
+    return _pool.stats()
+
+
+def close_pool() -> None:
+    """Close idle pooled connections (node/daemon shutdown)."""
+    _pool.close_all()
+
 
 # Serialize concurrent pulls of the same object into the same store: two
 # racing create(oid) calls would free each other's in-flight arena offset
@@ -53,6 +339,11 @@ def _pull_guard(dest_store, oid: ObjectID):
                 _pull_locks.pop(key, None)
 
 
+# --------------------------------------------------------------------------- #
+# Server
+# --------------------------------------------------------------------------- #
+
+
 class ObjectServer:
     """Per-node chunk server reading from the node's LocalObjectStore."""
 
@@ -71,6 +362,10 @@ class ObjectServer:
             if advertise_host and bound_host in ("0.0.0.0", "::")
             else (bound_host, port))
         self._alive = True
+        # live accepted connections: close() severs them so a "dead" node
+        # really aborts its in-flight transfers (striped-pull failover)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         self._thread = threading.Thread(target=self._accept_loop, daemon=True,
                                         name="object-server")
         self._thread.start()
@@ -83,7 +378,17 @@ class ObjectServer:
                 if not self._alive:
                     return
                 continue
-            _set_nodelay(conn)
+            if not self._alive:
+                # a blocked accept() can hand out one last connection
+                # after close(); a closed server must serve nothing
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            _tune_conn(conn)
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -92,15 +397,21 @@ class ObjectServer:
         try:
             while True:
                 msg = conn.recv()
-                if msg[0] == "peer_hello" and self.node is not None:
+                tag = msg[0]
+                if tag == "peer_hello" and self.node is not None:
                     # switch to the node-to-node control session (direct-
                     # task spillback; reference: NodeManagerService peer RPC)
                     self._serve_peer(conn)
                     return
-                if msg[0] == "push":
+                if tag == "push":
                     self._serve_push(conn, msg)
                     continue
-                if msg[0] != "pull":
+                if tag == "stat":
+                    meta = self.store.read_meta(ObjectID(msg[1]))
+                    conn.send(("missing",) if meta is None
+                              else ("meta", meta[0], meta[1]))
+                    continue
+                if tag not in ("pull", "pullr"):
                     break
                 oid = ObjectID(msg[1])
                 meta = self.store.read_meta(oid)
@@ -109,60 +420,79 @@ class ObjectServer:
                     continue
                 size, is_err = meta
                 conn.send(("meta", size, is_err))
-                sent, aborted = 0, False
-                while sent < size:
-                    n = min(chunk, size - sent)
-                    data = self.store.read_chunk(oid, sent, n)
-                    if data is None or len(data) != n:
-                        # deleted mid-stream: pad out the frame count so the
-                        # puller's framing stays aligned, then it re-locates
-                        conn.send_bytes(b"")
-                        aborted = True
-                        break
-                    conn.send_bytes(data)
-                    sent += n
-                if aborted:
-                    break
+                if tag == "pull":
+                    start, length = 0, size
+                else:
+                    start = max(0, int(msg[2]))
+                    length = int(msg[3])
+                    if length < 0:
+                        length = size - start
+                    length = max(0, min(length, size - start))
+                if not self._send_range(conn, oid, start, length, chunk):
+                    break  # aborted mid-stream: close, framing must not skew
         except (EOFError, OSError):
             pass
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
                 pass
 
+    def _send_range(self, conn, oid: ObjectID, start: int, length: int,
+                    chunk: int) -> bool:
+        """Stream payload[start:start+length] as a raw byte body. Arena-
+        resident objects go zero-copy (sendfile from the tmpfs fd / writes
+        from the pinned mmap); inline/spilled entries fall back to copying
+        chunk reads. Returns False when the entry vanished mid-stream —
+        the caller closes the connection, which the puller reads as a
+        truncated body and re-locates."""
+        fd = conn.fileno()
+        with self.store.open_read(oid) as handle:
+            if handle is not None:
+                if start + length > handle.view.nbytes:
+                    # entry was deleted + re-put at a different size between
+                    # the meta reply and this pin: streaming would send the
+                    # wrong byte count (or bytes past the extent) — abort
+                    return False
+                _send_body(fd, handle, start, length)
+                return True
+        sent = 0
+        while sent < length:
+            n = min(chunk, length - sent)
+            data = self.store.read_chunk(oid, start + sent, n)
+            if data is None or len(data) != n:
+                return False
+            _write_all(fd, data)
+            sent += n
+        return True
+
     def _serve_push(self, conn, msg) -> None:
         """Receive a pushed object (reference: push_manager.h:30 — the
-        sender streams chunks without being asked) and continue the
-        broadcast tree toward the delegated targets."""
+        sender streams chunks without being asked) straight into a
+        pre-allocated arena extent, and continue the broadcast tree toward
+        the delegated targets."""
         _, oid_b, size, is_err, targets = msg
         oid = ObjectID(oid_b)
+        fd = conn.fileno()
         if self.store.contains(oid):
-            # drain the frames to keep the stream aligned, then forward
-            got = 0
-            while got < size:
-                got += len(conn.recv_bytes())
+            # drain the raw body to keep the stream aligned, then forward
+            _drain_exact(fd, size)
         else:
             with _pull_guard(self.store, oid):
                 if self.store.contains(oid):
-                    got = 0
-                    while got < size:
-                        got += len(conn.recv_bytes())
+                    _drain_exact(fd, size)
                 else:
                     cfg = global_config()
                     if size <= cfg.max_direct_call_object_size:
-                        buf = bytearray()
-                        while len(buf) < size:
-                            buf += conn.recv_bytes()
+                        buf = bytearray(size)
+                        _read_exact_into(fd, memoryview(buf))
                         self.store.put_inline(oid, bytes(buf), is_err)
                     else:
                         offset, view = self.store.create(oid, size)
                         try:
-                            got = 0
-                            while got < size:
-                                data = conn.recv_bytes()
-                                view[got:got + len(data)] = data
-                                got += len(data)
+                            _read_exact_into(fd, view)
                         except Exception:
                             # pusher died mid-stream: drop the partial,
                             # unsealed entry so the arena space reclaims
@@ -226,67 +556,167 @@ class ObjectServer:
             self._listener.close()
         except OSError:
             pass
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+# --------------------------------------------------------------------------- #
+# Pull
+# --------------------------------------------------------------------------- #
 
 
 def pull_object(address, authkey: bytes, oid: ObjectID,
                 dest_store=None) -> Optional[Tuple[object, bool]]:
-    """Pull one object from a remote ObjectServer.
+    """Pull one object from a remote ObjectServer over a pooled connection.
 
-    Small objects return (bytes, is_error). Large ones stream chunk-by-chunk
-    into ``dest_store``'s arena (never materializing the whole payload in
-    this process beyond one chunk) and return (("arena", offset, size),
-    is_error); with no dest_store large pulls assemble bytes. Returns None
-    if the remote no longer has the object (caller re-locates).
+    Small objects return (bytes, is_error). Large ones stream chunk-by-
+    chunk straight into ``dest_store``'s arena extent (zero intermediate
+    copies) and return (("arena", offset, size), is_error); with no
+    dest_store large pulls assemble bytes. Returns None if the remote no
+    longer has the object (caller re-locates).
     """
     cfg = global_config()
     if dest_store is None:
         return _pull_one(address, authkey, oid, None, cfg)
     with _pull_guard(dest_store, oid):
         # double-check: a racing pull may have landed it already
-        if dest_store.contains(oid):
-            info = dest_store.entry_info(oid)
-            if info is not None:
-                off, size, is_err = info
-                return ("arena", off, size), is_err
-            payload, is_err = dest_store.get_payload(oid)
-            return bytes(payload), is_err
+        local = _local_result(dest_store, oid)
+        if local is not None:
+            return local
         return _pull_one(address, authkey, oid, dest_store, cfg)
 
 
+def pull_object_striped(addresses: Sequence, authkey: bytes, oid: ObjectID,
+                        dest_store,
+                        on_peer_failed=None) -> Optional[Tuple[object, bool]]:
+    """Pull one large object striped across multiple holders.
+
+    ``addresses`` lists the object servers of every known holder. Objects
+    below ``object_stripe_threshold`` (or with a single reachable holder)
+    fall back to a plain pooled pull. Each stripe lands in a disjoint
+    slice of one pre-allocated arena extent; a stripe whose peer dies
+    mid-transfer retries against the remaining holders (failover emits a
+    cluster event so operators see the degraded peer). Returns None only
+    when no holder could serve the object.
+
+    ``on_peer_failed(addr)`` (optional) is invoked for every holder that
+    could not serve the object (unreachable, missing, died mid-stream) —
+    even when the pull ultimately succeeds via failover — so callers can
+    invalidate stale locations in the directory.
+    """
+    addresses = [tuple(a) for a in addresses]
+    if not addresses:
+        return None
+    failed: set = set()
+
+    def note_failed(addr) -> None:
+        failed.add(tuple(addr))
+
+    cfg = global_config()
+    try:
+        if dest_store is None or len(addresses) < 2:
+            res = pull_object(addresses[0], authkey, oid, dest_store)
+            if res is None:
+                note_failed(addresses[0])
+            return res
+        with _pull_guard(dest_store, oid):
+            local = _local_result(dest_store, oid)
+            if local is not None:
+                return local
+            meta = None
+            for a in addresses:
+                meta = _stat_one(a, authkey, oid)
+                if meta is not None:
+                    break
+                note_failed(a)
+            if meta is not None and meta[0] >= cfg.object_stripe_threshold:
+                res = _pull_striped(addresses, authkey, oid, meta[0],
+                                    meta[1], dest_store, cfg, note_failed)
+                if res is not None:
+                    return res
+            for a in addresses:
+                res = _pull_one(a, authkey, oid, dest_store, cfg)
+                if res is not None:
+                    return res
+                note_failed(a)
+            return None
+    finally:
+        if on_peer_failed is not None:
+            for a in failed:
+                try:
+                    on_peer_failed(a)
+                except Exception:
+                    pass
+
+
+def _local_result(dest_store, oid: ObjectID):
+    if not dest_store.contains(oid):
+        return None
+    info = dest_store.entry_info(oid)
+    if info is not None:
+        off, size, is_err = info
+        return ("arena", off, size), is_err
+    payload, is_err = dest_store.get_payload(oid)
+    return bytes(payload), is_err
+
+
+def _stat_one(address, authkey: bytes,
+              oid: ObjectID) -> Optional[Tuple[int, bool]]:
+    """(size, is_error) from one holder, or None if unreachable/missing."""
+    addr = tuple(address)
+    try:
+        conn = _pool.acquire(addr, authkey)
+    except Exception:
+        return None
+    reuse = False
+    try:
+        conn.send(("stat", oid.binary()))
+        msg = conn.recv()
+        reuse = msg[0] in ("meta", "missing")
+        return (msg[1], msg[2]) if msg[0] == "meta" else None
+    except _CONN_ERRS:
+        return None
+    finally:
+        _pool.release(addr, conn, discard=not reuse)
+
+
 def _pull_one(address, authkey: bytes, oid: ObjectID, dest_store, cfg):
-    conn = None
+    addr = tuple(address)
+    try:
+        conn = _pool.acquire(addr, authkey)
+    except Exception:
+        return None  # connect refused/auth failure: caller re-locates
+    reuse = False
     created = False
     try:
-        conn = mpc.Client(address=tuple(address), family="AF_INET",
-                          authkey=authkey)
-        _set_nodelay(conn)
         conn.send(("pull", oid.binary()))
         msg = conn.recv()
         if msg[0] != "meta":
+            reuse = msg[0] == "missing"  # clean miss: conn still aligned
             return None
         size, is_err = msg[1], msg[2]
-        inline = size <= cfg.max_direct_call_object_size or dest_store is None
-        if inline:
-            buf = bytearray()
-            while len(buf) < size:
-                data = conn.recv_bytes()
-                if not data:
-                    return None
-                buf += data
+        fd = conn.fileno()
+        if size <= cfg.max_direct_call_object_size or dest_store is None:
+            buf = bytearray(size)
+            _read_exact_into(fd, memoryview(buf))
+            reuse = True
+            _m_bytes_pulled.inc(size)
             return bytes(buf), is_err
         offset, view = dest_store.create(oid, size)
         created = True
-        got = 0
-        while got < size:
-            data = conn.recv_bytes()
-            if not data:
-                dest_store.delete(oid)
-                return None
-            view[got:got + len(data)] = data
-            got += len(data)
+        _read_exact_into(fd, view)
         dest_store.seal(oid, is_err)
+        created = False
+        reuse = True
+        _m_bytes_pulled.inc(size)
         return ("arena", offset, size), is_err
-    except (EOFError, OSError, ValueError):
+    except _CONN_ERRS:
         # connect refused / source died mid-stream: drop any partial,
         # unsealed arena entry so the space is reclaimable, and report
         # "unavailable" so the caller re-locates
@@ -297,49 +727,150 @@ def _pull_one(address, authkey: bytes, oid: ObjectID, dest_store, cfg):
                 pass
         return None
     finally:
-        if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
+        _pool.release(addr, conn, discard=not reuse)
+
+
+def _pull_striped(addresses, authkey: bytes, oid: ObjectID, size: int,
+                  is_err: bool, dest_store, cfg, note_failed=None):
+    peers = addresses[:max(2, cfg.object_stripe_max_peers)]
+    stripe = (size + len(peers) - 1) // len(peers)
+    ranges = [(i * stripe, min(stripe, size - i * stripe))
+              for i in range(len(peers)) if i * stripe < size]
+    offset, view = dest_store.create(oid, size)
+    ok = [False] * len(ranges)
+
+    def pull_stripe(idx: int) -> None:
+        start, length = ranges[idx]
+        # holder preference rotates so stripes spread across peers;
+        # failover walks the remaining holders
+        order = peers[idx % len(peers):] + peers[:idx % len(peers)]
+        for attempt, a in enumerate(order):
+            if attempt > 0:
+                _m_stripe_retries.inc()
+                _emit_stripe_failover(oid, order[attempt - 1], a, idx)
+            if _pull_range(a, authkey, oid, start, length, view, size):
+                ok[idx] = True
+                return
+            if note_failed is not None:
+                note_failed(a)
+
+    threads = [threading.Thread(target=pull_stripe, args=(i,), daemon=True,
+                                name=f"stripe-{oid.hex()[:6]}-{i}")
+               for i in range(len(ranges))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if all(ok):
+        dest_store.seal(oid, is_err)
+        _m_bytes_pulled.inc(size)
+        _m_stripe_pulls.inc()
+        return ("arena", offset, size), is_err
+    try:
+        dest_store.delete(oid)
+    except Exception:
+        pass
+    return None
+
+
+def _pull_range(address, authkey: bytes, oid: ObjectID, start: int,
+                length: int, view, expect_size: int) -> bool:
+    """Receive payload[start:start+length] into the matching arena slice."""
+    addr = tuple(address)
+    try:
+        conn = _pool.acquire(addr, authkey)
+    except Exception:
+        return False
+    reuse = False
+    try:
+        conn.send(("pullr", oid.binary(), start, length))
+        msg = conn.recv()
+        if msg[0] != "meta":
+            reuse = msg[0] == "missing"
+            return False
+        if msg[1] != expect_size:
+            # this holder's copy disagrees with the size the stripes were
+            # cut from (re-put under the same oid): the server clamps the
+            # range to ITS size, so MSG_WAITALL would block forever on
+            # the missing tail — fail the stripe (and discard the conn,
+            # whose stream now carries the clamped body) instead
+            return False
+        _read_exact_into(conn.fileno(), view[start:start + length])
+        reuse = True
+        return True
+    except _CONN_ERRS:
+        return False
+    finally:
+        _pool.release(addr, conn, discard=not reuse)
+
+
+def _emit_stripe_failover(oid: ObjectID, failed_addr, next_addr,
+                          stripe_idx: int) -> None:
+    try:
+        from ray_tpu.util import events as events_mod
+
+        events_mod.emit(
+            "WARNING", events_mod.SOURCE_OBJECT_STORE,
+            f"stripe {stripe_idx} failover for object {oid.hex()[:8]}: "
+            f"peer {failed_addr[0]}:{failed_addr[1]} failed mid-transfer, "
+            f"retrying on {next_addr[0]}:{next_addr[1]}",
+            entity_id=oid.hex(), stripe=stripe_idx,
+            failed_peer=f"{failed_addr[0]}:{failed_addr[1]}")
+    except Exception:
+        pass
+
+
+# --------------------------------------------------------------------------- #
+# Push
+# --------------------------------------------------------------------------- #
 
 
 def push_object(address, authkey: bytes, oid: ObjectID, src_store,
                 targets=()) -> bool:
     """Stream one object to a peer's object server, delegating onward
     delivery of ``targets`` (the binary-broadcast-tree edge; reference:
-    push_manager.h chunked push). Returns False if the source no longer
-    has the object or the target is unreachable."""
+    push_manager.h chunked push). Sends straight from the pinned arena
+    extent when resident. Returns False if the source no longer has the
+    object or the target is unreachable."""
     cfg = global_config()
     meta = src_store.read_meta(oid)
     if meta is None:
         return False
     size, is_err = meta
-    conn = None
+    addr = tuple(address)
     try:
-        conn = mpc.Client(address=tuple(address), family="AF_INET",
-                          authkey=authkey)
-        _set_nodelay(conn)
+        conn = _pool.acquire(addr, authkey)
+    except Exception:
+        return False
+    reuse = False
+    try:
         conn.send(("push", oid.binary(), size, is_err, list(targets)))
         chunk = cfg.object_transfer_chunk_size
+        fd = conn.fileno()
         sent = 0
+        with src_store.open_read(oid) as handle:
+            # nbytes check: the entry may have been deleted + re-put at a
+            # different size since read_meta above — the announced size is
+            # the contract, so a mismatched extent must not stream
+            if handle is not None and handle.view.nbytes == size:
+                _send_body(fd, handle, 0, size)
+                sent = size
         while sent < size:
             n = min(chunk, size - sent)
             data = src_store.read_chunk(oid, sent, n)
             if data is None or len(data) != n:
                 return False  # evicted mid-push; receiver re-locates
-            conn.send_bytes(data)
+            _write_all(fd, data)
             sent += n
         ack = conn.recv()
-        return ack and ack[0] == "ok"
-    except (EOFError, OSError, ValueError):
+        reuse = bool(ack) and ack[0] == "ok"
+        if reuse:
+            _m_bytes_pushed.inc(size)
+        return reuse
+    except _CONN_ERRS:
         return False
     finally:
-        if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
+        _pool.release(addr, conn, discard=not reuse)
 
 
 def fan_out_push(src_store, authkey: bytes, oid: ObjectID,

@@ -732,13 +732,28 @@ class Head:
                 self._object_cv.wait(min(remaining, 0.2))
 
     def _pull_from_proxy(self, proxy: "NodeProxy", oid: ObjectID, dest_store):
-        """Pull an object from a remote node directly into ``dest_store``
-        (chunked; driver memory holds at most one chunk). Returns
-        ("inline", bytes, is_err) or ("arena", off, size, is_err)."""
-        from .object_transfer import pull_object
+        """Pull an object from one remote node directly into ``dest_store``
+        (pooled + arena-direct; driver memory never holds the payload)."""
+        return self._pull_from_proxies([proxy], oid, dest_store)
 
-        res = pull_object(proxy.object_addr, self._cluster_key, oid,
-                          dest_store=dest_store)
+    def _pull_from_proxies(self, proxies, oid: ObjectID, dest_store):
+        """Pull from any/all of several remote holders into ``dest_store``
+        — striped across peers when the object is large and >=2 have it.
+        Holders that failed (even when failover succeeded) lose their
+        location entry so future pulls stop dialing them. Returns
+        ("inline", bytes, is_err) or ("arena", off, size, is_err)."""
+        from .object_transfer import pull_object_striped
+
+        addr_to_hex = {tuple(p.object_addr): p.hex for p in proxies}
+        failed: list = []
+        res = pull_object_striped([p.object_addr for p in proxies],
+                                  self._cluster_key, oid,
+                                  dest_store=dest_store,
+                                  on_peer_failed=failed.append)
+        for a in failed:
+            h = addr_to_hex.get(tuple(a))
+            if h is not None:
+                self.gcs.remove_object_location(oid, h)
         if res is None:
             raise ObjectLostError(oid, "remote node no longer has the object")
         body, is_err = res
@@ -1516,26 +1531,31 @@ class Head:
             with self._lock:
                 locs = self.gcs.get_object_locations(oid)
                 node = None
+                remote = []
                 for h in locs:
                     cand = self.nodes.get(h)
                     if cand is None:
                         continue
-                    if node is None or (self._is_local(cand)
-                                        and not self._is_local(node)):
+                    if self._is_local(cand):
                         node = cand  # prefer a local (zero-copy) location
-            if node is not None and self._is_local(node):
+                    else:
+                        remote.append(cand)
+            if node is not None:
                 try:
                     return node.store.get_payload(oid)
                 except ObjectLostError:
                     self.gcs.remove_object_location(oid, node.hex)
                     continue
-            if node is not None:
-                # remote daemon: chunked pull; large payloads land in the
+            if remote:
+                # remote daemon(s): pooled chunked pull — striped across
+                # holders when several have it; large payloads land in the
                 # head node's store (cached location for future reads)
                 try:
-                    rep = self._pull_from_proxy(node, oid, self.head_node.store)
+                    rep = self._pull_from_proxies(remote, oid,
+                                                  self.head_node.store)
                 except ObjectLostError:
-                    self.gcs.remove_object_location(oid, node.hex)
+                    for n in remote:
+                        self.gcs.remove_object_location(oid, n.hex)
                     continue
                 if rep[0] == "inline":
                     return rep[1], rep[2]
@@ -1897,7 +1917,9 @@ class Head:
     def shutdown(self) -> None:
         self._stopped = True
         from ray_tpu.util import events as events_mod
+        from .object_transfer import close_pool
 
+        close_pool()  # pooled transfer connections die with the cluster
         events_mod.flush()
         events_mod.clear_sink(self.record_cluster_events)
         if self._event_writer is not None:
@@ -1991,9 +2013,8 @@ class DriverRuntime:
             node.store.put_inline(oid, sobj.to_bytes(), False)
         else:
             _, view = node.store.create(oid, sobj.total_bytes)
-            buf = bytearray()
-            sobj.write_into(buf)
-            view[: len(buf)] = buf
+            # writev-style: source buffers pack straight into the arena
+            sobj.write_into_view(view)
             node.store.seal(oid, False)
         self.head.on_object_sealed(oid, node.hex)
         # registered ref: +1 now, -1 when the ObjectRef is GC'd -> deletable

@@ -50,17 +50,40 @@ class SerializedObject:
 
     def write_into(self, out) -> None:
         """Append the wire format into a bytearray / writable buffer proxy."""
+        for seg in self.iter_segments():
+            out += seg
+
+    def iter_segments(self):
+        """Writev-style iteration: yields the wire format as a short header
+        segment followed by each out-of-band buffer as its own memoryview —
+        no concatenation, no intermediate payload copy. Writers (arena
+        seals, socket sends) consume the segments directly."""
         bufs = [
             b.raw() if isinstance(b, pickle.PickleBuffer) else memoryview(b)
             for b in self.buffers
         ]
-        out += struct.pack("<I", len(self.meta))
-        out += self.meta
-        out += struct.pack("<Q", len(bufs))
+        header = bytearray()
+        header += struct.pack("<I", len(self.meta))
+        header += self.meta
+        header += struct.pack("<Q", len(bufs))
         for b in bufs:
-            out += struct.pack("<Q", b.nbytes)
+            header += struct.pack("<Q", b.nbytes)
+        yield memoryview(header)
         for b in bufs:
-            out += b
+            # flatten non-contiguous pickle-5 buffers (rare: sliced arrays)
+            yield b if b.contiguous else memoryview(bytes(b))
+
+    def write_into_view(self, view: "memoryview") -> int:
+        """Pack the wire format directly into a writable buffer (an arena
+        extent): one copy total, payload bytes go straight from the source
+        buffers into shared memory. Returns bytes written."""
+        flat = view.cast("B") if view.format != "B" else view
+        off = 0
+        for seg in self.iter_segments():
+            n = seg.nbytes
+            flat[off:off + n] = seg.cast("B") if seg.format != "B" else seg
+            off += n
+        return off
 
 
 class _ByValuePickler(pickle.Pickler):

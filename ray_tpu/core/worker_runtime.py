@@ -71,6 +71,11 @@ class WorkerRuntime:
         self.node_ip: str = init_info.get("node_ip", "127.0.0.1")
         self.job_id = JobID(init_info["job_id"])
         set_global_config(Config.from_json(init_info["config"]))
+        # adopt the node's extra import roots (driver-side sys.path inserts)
+        # so by-reference pickles of driver-loaded modules resolve here
+        for p in init_info.get("sys_path", []):
+            if p not in sys.path:
+                sys.path.append(p)
         self.arena = ArenaClient(init_info["arena_path"], init_info["arena_capacity"])
         self._fn_cache: Dict[str, Any] = {}
         self._actors: Dict[ActorID, _ActorState] = {}
@@ -358,9 +363,8 @@ class WorkerRuntime:
         else:
             offset = self.rpc.call("store", "create", oid, size)
             view = self.arena.view(offset, size)
-            buf = bytearray()
-            sobj.write_into(buf)
-            view[: len(buf)] = buf
+            # writev-style: source buffers pack straight into shared memory
+            sobj.write_into_view(view)
             self.rpc.call("store", "seal", oid, is_error)
 
     # --------------------------------------------------------------- serve
@@ -698,9 +702,7 @@ class WorkerRuntime:
             else:
                 offset = self.rpc.call("store", "create", oid, sobj.total_bytes)
                 view = self.arena.view(offset, sobj.total_bytes)
-                buf = bytearray()
-                sobj.write_into(buf)
-                view[: len(buf)] = buf
+                sobj.write_into_view(view)
                 self.rpc.call("store", "seal", oid, False)
                 results.append((oid, None, False))
         self.channel.send("done", spec.task_id, results, None)

@@ -35,9 +35,18 @@ def get_session() -> Optional[TuneSession]:
     return _session
 
 
-def report(metrics: Dict[str, Any],
-           checkpoint: Optional[Checkpoint] = None) -> None:
-    """Report metrics (+ optional checkpoint) from inside a trial fn."""
+def report(metrics: Optional[Dict[str, Any]] = None,
+           checkpoint: Optional[Checkpoint] = None,
+           **kwargs: Any) -> None:
+    """Report metrics (+ optional checkpoint) from inside a trial fn.
+
+    Accepts both styles the reference supports: the new dict form
+    ``tune.report({"loss": x})`` and the legacy kwargs form
+    ``tune.report(loss=x)`` (mixing merges, kwargs win).
+    """
+    merged: Dict[str, Any] = dict(metrics or {})
+    merged.update(kwargs)
+    metrics = merged
     s = _session
     if s is None:
         # Fall back to the Train session (JaxTrainer inside Tune)

@@ -160,6 +160,23 @@ class Tuner:
             callbacks=rc.callbacks,
         )
         trials = controller.run()
+        errored = [t for t in trials if t.error]
+        if trials and len(errored) == len(trials):
+            # every trial failed: returning a normal-looking ResultGrid
+            # buries the errors behind private state (the round-5 stain —
+            # 25/25 silently ERRORed). Raise with the first traceback so
+            # the failure is visible at the call site (reference:
+            # tune.run(raise_on_failed_trial=True) default).
+            raise RuntimeError(
+                f"all {len(trials)} trial(s) errored; first error:\n"
+                f"{errored[0].error}")
+        if errored:
+            import warnings
+
+            warnings.warn(
+                f"{len(errored)}/{len(trials)} trial(s) errored; see "
+                "ResultGrid.errors / result.error for tracebacks",
+                RuntimeWarning, stacklevel=2)
         return ResultGrid(trials, tc.metric, tc.mode)
 
     @classmethod

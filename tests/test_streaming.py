@@ -67,6 +67,60 @@ def test_stream_empty(ray_start_regular):
     assert list(empty.remote()) == []
 
 
+def test_stream_empty_stress(ray_start_regular):
+    """Regression: empty-stream EOF delivery under GC + task load.
+
+    Round-5 full-suite runs hung forever in test_stream_empty (zero CPU):
+    ``ObjectRef.__del__`` ran ``remove_local_ref`` inside the garbage
+    collector, which can fire on a thread already holding the
+    DirectTaskManager lock — self-deadlocking the completion path and
+    losing the stream's EOF (an empty stream's ONLY signal is the EOF).
+    Drops are now handed to a reaper thread; this loops empty-stream
+    creation under background load with forced GC to keep the original
+    interleaving covered.
+    """
+    import gc
+    import threading
+
+    @ray_tpu.remote(num_returns="streaming")
+    def empty():
+        return
+        yield  # pragma: no cover
+
+    @ray_tpu.remote
+    def busy(i):
+        return [i] * 64
+
+    stop = threading.Event()
+    errors = []
+
+    def load():
+        while not stop.is_set():
+            try:
+                ray_tpu.get([busy.remote(i) for i in range(4)], timeout=60)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    t = threading.Thread(target=load, daemon=True)
+    t.start()
+    try:
+        for i in range(20):
+            # churn refs so the GC has ObjectRefs to finalize mid-loop
+            assert list(empty.remote()) == []
+            if i % 5 == 0:
+                gc.collect()
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not errors, errors
+    # the queued __del__ drops must drain without wedging the runtime
+    from ray_tpu.core.object_ref import _drop_queue, flush_pending_drops
+
+    flush_pending_drops(timeout=10.0)
+    assert not _drop_queue
+
+
 def test_actor_method_stream(ray_start_regular):
     @ray_tpu.remote
     class A:
