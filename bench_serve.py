@@ -1,0 +1,187 @@
+"""Serve request-path microbenchmark: instrumentation overhead on vs off.
+
+Prints ONE JSON line (same convention as bench.py / bench_objects.py):
+
+    {"bench": "serve",
+     "on":  {"handle_p50_ms": .., "handle_p99_ms": ..,
+             "http_p50_ms": .., "http_p99_ms": ..},
+     "off": {...},
+     "overhead_handle_p50_pct": .., "overhead_http_p50_pct": ..}
+
+Each mode runs in its OWN subprocess: the config snapshot
+(serve_observability_enabled) ships to replica workers at cluster init,
+so toggling it requires a fresh cluster. "off" sets
+``RAY_TPU_SERVE_OBSERVABILITY_ENABLED=0`` — no request ids, no stage
+histograms, no access logs — the uninstrumented baseline.
+
+``--check`` exits non-zero when instrumentation regresses the handle-path
+p50 by more than the budget (default 5%, the PR acceptance bound).
+
+Runs under ``JAX_PLATFORMS=cpu`` (no accelerator needed).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _pct(samples, q):
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return round(s[idx] * 1000.0, 3)
+
+
+def run_phase(iters: int, port: int) -> dict:
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    serve.start(serve.HTTPOptions(port=port))
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, req):
+            return b"ok"
+
+        def direct(self, x):
+            return x
+
+    handle = serve.run(Echo.bind(), route_prefix="/echo")
+
+    # warmup: replica cold start, route/replica caches, jit of nothing
+    for _ in range(50):
+        handle.direct.remote(1).result()
+    url = f"http://127.0.0.1:{port}/echo"
+    for _ in range(15):
+        urllib.request.urlopen(url, timeout=30).read()
+
+    # several rounds per cluster, keep each round's p50, report the MIN:
+    # scheduling luck on a shared box swings a single round's p50 far
+    # more than the instrumentation cost being measured
+    rounds = 3
+    per = max(50, iters // rounds)
+    handle_p50s, handle_p99s, handle_means = [], [], []
+    for _ in range(rounds):
+        samples = []
+        for _ in range(per):
+            t0 = time.perf_counter()
+            handle.direct.remote(1).result()
+            samples.append(time.perf_counter() - t0)
+        handle_p50s.append(_pct(samples, 0.50))
+        handle_p99s.append(_pct(samples, 0.99))
+        handle_means.append(
+            round(statistics.mean(samples) * 1000.0, 3))
+    http_p50s, http_p99s = [], []
+    for _ in range(rounds):
+        samples = []
+        for _ in range(max(10, per // 2)):
+            t0 = time.perf_counter()
+            urllib.request.urlopen(url, timeout=30).read()
+            samples.append(time.perf_counter() - t0)
+        http_p50s.append(_pct(samples, 0.50))
+        http_p99s.append(_pct(samples, 0.99))
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return {
+        "handle_p50_ms": min(handle_p50s),
+        "handle_p99_ms": min(handle_p99s),
+        "handle_mean_ms": min(handle_means),
+        "http_p50_ms": min(http_p50s),
+        "http_p99_ms": min(http_p99s),
+    }
+
+
+def _spawn_phase(mode: str, iters: int, port: int) -> dict:
+    env = dict(os.environ)
+    env["RAY_TPU_SERVE_OBSERVABILITY_ENABLED"] = \
+        "1" if mode == "on" else "0"
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--phase", mode,
+         "--iters", str(iters), "--port", str(port)],
+        env=env, capture_output=True, text=True, timeout=600)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"phase {mode} failed:\n{out.stdout}\n{out.stderr}")
+    for line in reversed(out.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"phase {mode} printed no JSON:\n{out.stdout}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=400)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="interleaved repetitions per mode; per-metric "
+                         "minimum is reported (noise-robust)")
+    ap.add_argument("--port", type=int, default=18431)
+    ap.add_argument("--phase", choices=["on", "off"],
+                    help="internal: run one mode in-process and print it")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when handle p50 overhead > --budget-pct")
+    ap.add_argument("--budget-pct", type=float, default=5.0)
+    ap.add_argument("--out", help="also write the JSON result here")
+    args = ap.parse_args()
+
+    if args.phase:
+        print(json.dumps(run_phase(args.iters, args.port)))
+        return 0
+
+    # interleave modes across reps (alternating which goes first, so
+    # cold-start bias can't land on one mode); per-metric min is the
+    # noise-robust stat for a shared CI box
+    runs = {"on": [], "off": []}
+    port = args.port
+    for rep in range(max(1, args.reps)):
+        order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        for mode in order:
+            runs[mode].append(_spawn_phase(mode, args.iters, port))
+            port += 1
+
+    def best(mode):
+        return {k: min(r[k] for r in runs[mode]) for k in runs[mode][0]}
+
+    on, off = best("on"), best("off")
+
+    def overhead(key):
+        if not off[key]:
+            return None
+        return round((on[key] - off[key]) / off[key] * 100.0, 2)
+
+    result = {
+        "bench": "serve",
+        "iters": args.iters,
+        "on": on,
+        "off": off,
+        "overhead_handle_p50_pct": overhead("handle_p50_ms"),
+        "overhead_handle_p99_pct": overhead("handle_p99_ms"),
+        "overhead_http_p50_pct": overhead("http_p50_ms"),
+        "budget_pct": args.budget_pct,
+    }
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f)
+    if args.check:
+        oh = result["overhead_handle_p50_pct"]
+        if oh is not None and oh > args.budget_pct:
+            print(f"FAIL: instrumentation handle p50 overhead {oh}% "
+                  f"> {args.budget_pct}% budget", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -132,6 +132,17 @@ class Config:
     # per-process JAX/TPU device telemetry (HBM gauges + jax.monitoring)
     device_telemetry_enabled: bool = True
     device_telemetry_interval_ms: int = 10_000
+    # serve request-path observability: request ids + per-stage latency
+    # histograms + JSONL access logs + slow-request events (serve/
+    # observability.py). One switch for the whole layer so the bench can
+    # measure its overhead; the access log has its own gate
+    serve_observability_enabled: bool = True
+    serve_access_log_enabled: bool = True
+    serve_access_log_max_bytes: int = 64 * 1024 * 1024
+    # requests slower end-to-end than this emit a WARNING cluster event
+    # with the stage breakdown; per-deployment override via
+    # @serve.deployment(slow_request_threshold_s=...); <= 0 disables
+    serve_slow_request_threshold_s: float = 1.0
 
     # ---- fault injection (reference: testing_asio_delay_us :824) ----
     testing_delay_ms: str = ""  # "handler1=ms,handler2=ms" injected latency

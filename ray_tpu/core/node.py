@@ -1023,6 +1023,7 @@ class Node:
             "job_id": self.head.job_id.binary(),
             "arena_path": self.store.arena_path,
             "arena_capacity": self.store.capacity,
+            "session_dir": self.session_dir,
             "config": global_config().to_json(),
             # driver-visible import roots: functions pickled BY REFERENCE
             # against modules the driver loaded from script-local dirs

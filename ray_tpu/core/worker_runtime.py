@@ -70,6 +70,9 @@ class WorkerRuntime:
         self.node_hex: str = init_info["node_hex"]
         self.node_ip: str = init_info.get("node_ip", "127.0.0.1")
         self.job_id = JobID(init_info["job_id"])
+        # the node's session dir: workers hosting serve replicas write
+        # their access logs under <session_dir>/logs/serve/
+        self.session_dir: str = init_info.get("session_dir", "")
         set_global_config(Config.from_json(init_info["config"]))
         # adopt the node's extra import roots (driver-side sys.path inserts)
         # so by-reference pickles of driver-loaded modules resolve here
@@ -415,6 +418,20 @@ class WorkerRuntime:
             dump = getattr(self, "_profile_dump", None)
             if dump is not None:
                 dump()  # os._exit skips atexit
+            # buffered observability (span batches, deferred serve
+            # bookkeeping) flushes from daemon threads that os._exit
+            # kills — drain what's queued so a replica's final requests
+            # keep their spans and access-log lines. Only if the modules
+            # are already loaded; never import on the exit path.
+            try:
+                tr = sys.modules.get("ray_tpu.util.tracing")
+                if tr is not None:
+                    tr._flush_spans()
+                so = sys.modules.get("ray_tpu.serve.observability")
+                if so is not None:
+                    so.flush_all()
+            except Exception:
+                pass
             os._exit(0)
 
     def _dispatch_exec(self, spec: TaskSpec, binding: Dict[str, List[int]]) -> None:

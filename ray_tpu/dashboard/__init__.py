@@ -50,6 +50,7 @@ th{background:#f0f0f0} code{background:#eee;padding:1px 4px;border-radius:3px}
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
+<h2>Serve</h2><table id="serve"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <h2>Cluster events</h2><table id="events"></table>
 <script>
@@ -72,6 +73,13 @@ async function refresh(){
   const j = await (await fetch('/api/jobs/')).json();
   document.getElementById('jobs').innerHTML = row(['id','status','entrypoint','message'],'th')+
     j.map(x=>row([esc(x.submission_id),esc(x.status),'<code>'+esc(x.entrypoint)+'</code>',esc(x.message)],'td')).join('');
+  const sv = await (await fetch('/api/serve/latency')).json();
+  const lat = v=>v&&v.latency_ms||{};
+  document.getElementById('serve').innerHTML =
+    row(['deployment','requests','error rate','p50 ms','p95 ms','p99 ms','queue depth'],'th')+
+    Object.entries(sv).map(([k,v])=>row([esc(k),esc(v.requests||0),
+    esc(((v.error_rate||0)*100).toFixed(1))+'%',esc(lat(v).p50??''),
+    esc(lat(v).p95??''),esc(lat(v).p99??''),esc(v.queue_depth||0)],'td')).join('');
   const t = await (await fetch('/api/tasks?limit=25')).json();
   document.getElementById('tasks').innerHTML = row(['task','name','state','node'],'th')+
     t.slice(-25).map(x=>row([esc(x.task_id),esc(x.name||''),esc(x.state),esc(x.node_hex||'')],'td')).join('');
@@ -233,6 +241,13 @@ class DashboardServer:
             # Serve module (reference: dashboard/modules/serve): the
             # controller's deployment summary, or {} when Serve is down
             h._json(self._serve_summary())
+        elif path == "/api/serve/latency":
+            # per-deployment request-path aggregates (p50/p95/p99, error
+            # rate, queue depth) from the head's merged registry — the
+            # serve.status() numbers over HTTP
+            from ray_tpu.serve.observability import serve_stats
+
+            h._json(serve_stats())
         elif path == "/api/pubsub":
             # poll a pubsub channel over HTTP (tracing/event consumers):
             # /api/pubsub?channel=X&cursor=N&timeout=S

@@ -40,22 +40,44 @@ class NodeAgentCore:
         return os.path.join(self.node.session_dir, "logs")
 
     def list_logs(self) -> list:
+        """Top-level log files plus one level of subdirectories (the
+        serve access logs live under ``logs/serve/``; events under
+        ``logs/events/``) as ``sub/name`` entries."""
         d = self._log_dir()
         if not os.path.isdir(d):
             return []
         out = []
+
+        def add(display: str, path: str) -> None:
+            try:
+                # rotating writers os.replace() files away between the
+                # listdir and the stat — skip, don't 500 the listing
+                out.append({"name": display,
+                            "size": os.path.getsize(path)})
+            except OSError:
+                pass
+
         for name in sorted(os.listdir(d)):
             p = os.path.join(d, name)
             if os.path.isfile(p):
-                out.append({"name": name, "size": os.path.getsize(p)})
+                add(name, p)
+            elif os.path.isdir(p) and not name.startswith("."):
+                for sub in sorted(os.listdir(p)):
+                    sp = os.path.join(p, sub)
+                    if os.path.isfile(sp):
+                        add(f"{name}/{sub}", sp)
         return out
 
     def read_log(self, name: str, offset: int = 0,
                  limit: int = 64 * 1024) -> Tuple[str, int]:
-        """(text, next_offset). ``name`` is basename-only (no traversal)."""
-        if os.path.basename(name) != name or name.startswith("."):
+        """(text, next_offset). ``name`` is a top-level file or a single
+        ``sub/name`` path (no traversal outside the log dir)."""
+        parts = name.split("/")
+        if (len(parts) > 2 or not all(parts)
+                or any(os.path.basename(s) != s or s.startswith(".")
+                       for s in parts)):
             raise FileNotFoundError(name)
-        p = os.path.join(self._log_dir(), name)
+        p = os.path.join(self._log_dir(), *parts)
         if not os.path.isfile(p):
             raise FileNotFoundError(name)
         size = os.path.getsize(p)

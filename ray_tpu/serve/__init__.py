@@ -34,6 +34,10 @@ from ray_tpu.serve.multiplex import (  # noqa: F401
     get_multiplexed_model_id,
     multiplexed,
 )
+from ray_tpu.serve.observability import (  # noqa: F401
+    get_request_id,
+    serve_stats,
+)
 from ray_tpu.serve.proxy import Request  # noqa: F401
 
 __all__ = [
@@ -41,6 +45,7 @@ __all__ = [
     "status", "delete", "get_deployment_handle", "DeploymentHandle",
     "DeploymentResponse", "AutoscalingConfig", "HTTPOptions", "batch",
     "Request", "multiplexed", "get_multiplexed_model_id",
+    "get_request_id", "serve_stats",
     "gRPCOptions", "get_grpc_ingress", "get_proxy_addresses",
     "InputNode", "DAGNode", "DAGDriver",
 ]

@@ -325,9 +325,24 @@ def get_deployment_handle(deployment_name: str,
 
 
 def status() -> Dict[str, Any]:
+    """Per-deployment state + request-path aggregates. Beyond the
+    replica/target/version fields, each deployment carries ``latency_ms``
+    (p50/p95/p99/avg end-to-end), ``requests``/``errors``/``timeouts``
+    counts, ``error_rate``, and summed replica ``queue_depth`` — computed
+    from the head's merged metrics registry (serve/observability.py)."""
     if _controller is None:
         return {}
-    return ray_tpu.get(_controller.list_deployments.remote())
+    st = ray_tpu.get(_controller.list_deployments.remote())
+    try:
+        from .observability import serve_stats
+
+        stats = serve_stats()
+        for name, rec in st.items():
+            if name in stats:
+                rec.update(stats[name])
+    except Exception:
+        pass  # aggregates are best-effort; deployment state is not
+    return st
 
 
 def delete(name: str) -> None:

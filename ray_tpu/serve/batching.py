@@ -10,7 +10,20 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import time
 from typing import Any, Callable, List, Optional
+
+
+def _record_batch_metrics(dep: str, waits, size: int,
+                          max_size: int) -> None:
+    """Deferred batch-stage records (observability drain thread)."""
+    from ray_tpu.serve import observability as obs
+
+    key = obs.dep_key(dep)
+    for wait in waits:
+        obs.BATCH_WAIT.observe(wait, tag_key=key)
+    obs.BATCH_SIZE.set(size, tag_key=key)
+    obs.BATCH_UTILIZATION.set(size / max(1, max_size), tag_key=key)
 
 
 class _BatchQueue:
@@ -25,6 +38,30 @@ class _BatchQueue:
         if self._queue is None:
             self._queue = asyncio.Queue()
             self._task = asyncio.get_event_loop().create_task(self._loop())
+
+    def _record_batch(self, batch) -> None:
+        """Batch-assembly observability. Only the per-request context
+        stamping runs inline (it must land BEFORE each future resolves,
+        so the replica's access-log line carries the batch wait); the
+        histogram/gauge records defer to the drain thread like every
+        other stage — they'd otherwise tax the event loop between
+        assembly and the user's batch fn."""
+        from ray_tpu.serve import observability as obs
+
+        if not obs.enabled():
+            return
+        now = time.monotonic()
+        dep = next((rc.meta.get("deployment", "")
+                    for _a, _f, _t, rc in batch if rc is not None), "")
+        waits = []
+        for _arg, _fut, enq_ts, rc in batch:
+            wait = max(0.0, now - enq_ts)
+            waits.append(wait)
+            if rc is not None:
+                rc.timings["batch_wait_s"] = \
+                    rc.timings.get("batch_wait_s", 0.0) + wait
+        obs.defer(_record_batch_metrics, dep, waits, len(batch),
+                  self._max)
 
     async def _loop(self):
         while True:
@@ -41,6 +78,10 @@ class _BatchQueue:
                     batch.append(item)
                 except asyncio.TimeoutError:
                     break
+            try:
+                self._record_batch(batch)
+            except Exception:
+                pass  # observability must never fail the batch
             args = [item[0] for item in batch]
             futures = [item[1] for item in batch]
             try:
@@ -61,8 +102,11 @@ class _BatchQueue:
 
     async def submit(self, arg) -> Any:
         self._ensure()
+        from ray_tpu.serve import observability as obs
+
+        rc = obs.current_request() if obs.enabled() else None
         fut = asyncio.get_event_loop().create_future()
-        await self._queue.put((arg, fut))
+        await self._queue.put((arg, fut, time.monotonic(), rc))
         return await fut
 
 

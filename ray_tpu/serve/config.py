@@ -50,3 +50,7 @@ class DeploymentConfig:
     version: str = "1"
     user_config: Optional[Dict[str, Any]] = None
     route_prefix: Optional[str] = None
+    # e2e latency above this (seconds) emits a WARNING cluster event with
+    # the request's stage breakdown; None falls back to the global
+    # serve_slow_request_threshold_s config, <= 0 disables
+    slow_request_threshold_s: Optional[float] = None

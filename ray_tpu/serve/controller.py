@@ -109,11 +109,16 @@ class ServeController:
         with self._lock:
             rec = self._deployments.get(name)
             if rec is None:
-                return {"replicas": [], "retry_on_replica_failure": True}
+                return {"replicas": [], "retry_on_replica_failure": True,
+                        "slow_request_threshold_s": None}
             return {
                 "replicas": [r["actor"] for r in rec["replicas"]],
                 "retry_on_replica_failure": rec["config"].get(
                     "retry_on_replica_failure", True),
+                # None -> the caller falls back to the global config
+                # default (serve_slow_request_threshold_s)
+                "slow_request_threshold_s": rec["config"].get(
+                    "slow_request_threshold_s"),
             }
 
     def get_version(self) -> int:
@@ -174,16 +179,22 @@ class ServeController:
             pass
 
     def _spawn_replica(self, rec: dict) -> dict:
+        import uuid
+
         from .replica import ServeReplica
 
         opts = dict(rec["config"].get("ray_actor_options") or {})
         opts.setdefault("max_concurrency",
                         rec["config"].get("max_ongoing_requests", 100))
+        # replica tag: names the replica in queue-depth gauges, access-log
+        # file names, and slow-request events
+        tag = f"{rec['name']}#{uuid.uuid4().hex[:6]}"
         actor = ServeReplica.options(**opts).remote(
             rec["callable"], rec["init_args"], rec["init_kwargs"],
-            rec["config"].get("user_config"))
+            rec["config"].get("user_config"), rec["name"], tag)
         return {"actor": actor, "created": time.time(), "healthy": True,
-                "version": rec["version"], "callable": rec["callable"]}
+                "version": rec["version"], "callable": rec["callable"],
+                "tag": tag}
 
     def _autoscale(self, rec: dict) -> None:
         auto = rec["config"].get("autoscaling")

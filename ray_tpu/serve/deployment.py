@@ -40,7 +40,8 @@ class Deployment:
                  health_check_period_s: float = 2.0,
                  stream: bool = False,
                  request_timeout_s: float = 60.0,
-                 retry_on_replica_failure: bool = True):
+                 retry_on_replica_failure: bool = True,
+                 slow_request_threshold_s: Optional[float] = None):
         self._target = target
         self.name = name
         if isinstance(autoscaling_config, dict):
@@ -57,6 +58,7 @@ class Deployment:
             stream=stream,
             request_timeout_s=request_timeout_s,
             retry_on_replica_failure=retry_on_replica_failure,
+            slow_request_threshold_s=slow_request_threshold_s,
         )
 
     def options(self, **overrides) -> "Deployment":
@@ -97,6 +99,10 @@ class Deployment:
             # (reference: Serve gates request retries)
             "retry_on_replica_failure": self._opts.get(
                 "retry_on_replica_failure", True),
+            # e2e latency above this emits a WARNING cluster event with
+            # the stage breakdown; None -> global config default
+            "slow_request_threshold_s": self._opts.get(
+                "slow_request_threshold_s"),
         }
 
     def __repr__(self):

@@ -123,9 +123,12 @@ class GRPCIngress:
                           f"no deployment named {name!r}")
                 return b""
         model_id = ""
+        req_id = ""
         for k, v in (ctx.invocation_metadata() or ()):
             if k == "multiplexed-model-id":
                 model_id = v
+            elif k == "x-request-id":
+                req_id = v
         req = Request("GRPC", _PREFIX + target, {}, {"content-type":
                       "application/grpc"}, request_bytes)
         handle = self._get_handle(name)
@@ -133,6 +136,23 @@ class GRPCIngress:
             handle = handle.options(method_name=method)
         if model_id:
             handle = handle.options(multiplexed_model_id=model_id)
+        # ingress observability: request id + root span + meta (same
+        # shape as the HTTP proxy; the span context rides the meta)
+        from . import observability as obs
+        from ray_tpu.util import tracing
+
+        span = None
+        if obs.enabled():
+            req_id = req_id or obs.new_request_id()
+            span = tracing.child_span(f"serve.grpc {target}",
+                                      request_id=req_id)
+            handle = handle.options(_request_meta=obs.make_request_meta(
+                deployment=name, route=_PREFIX + target, ingress="grpc",
+                request_id=req_id, trace_ctx=span.context))
+            try:
+                ctx.set_trailing_metadata((("x-request-id", req_id),))
+            except Exception:
+                pass
         try:
             value = handle.remote(req).result(timeout=self._timeout)
         except TimeoutError:
@@ -142,6 +162,9 @@ class GRPCIngress:
         except Exception as e:  # noqa: BLE001
             ctx.abort(grpc.StatusCode.INTERNAL, repr(e))
             return b""
+        finally:
+            if span is not None:
+                span.finish()
         return _encode_reply(value)
 
     def shutdown(self) -> None:

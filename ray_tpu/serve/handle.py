@@ -77,15 +77,28 @@ def get_longpoll_client(controller) -> _LongPollClient:
 
 
 class DeploymentResponse:
-    """Future-like wrapper over the replica call's ObjectRef."""
+    """Future-like wrapper over the replica call's ObjectRef. With
+    observability on it also records the end-to-end latency and the
+    request/error/timeout counters on completion (once, however many
+    times result() is called). ``.ref`` always resolves to the user's
+    raw return value — stage breakdowns live replica-side (access log +
+    slow-request events), never inside the result."""
 
     def __init__(self, ref, router: "Router", replica_key: str,
-                 redispatch=None):
+                 redispatch=None, request_meta: Optional[dict] = None,
+                 deployment: str = ""):
         self._ref = ref
         self._router = router
         self._replica_key = replica_key
         self._done = False
         self._redispatch = redispatch
+        self._request_meta = request_meta
+        self._deployment = deployment
+        self._recorded = False
+        self._timeout_counted = False
+        # caller-side timings (handle queue wait + e2e); the replica-side
+        # stage breakdown lives in the access log / slow-request events
+        self.timings: Optional[Dict[str, float]] = None
 
     def result(self, timeout: Optional[float] = None) -> Any:
         # a replica killed mid-flight (rolling update, health replacement)
@@ -100,7 +113,8 @@ class DeploymentResponse:
                 remaining = (None if deadline is None
                              else max(0.0, deadline - time.time()))
                 try:
-                    return ray_tpu.get(self._ref, timeout=remaining)
+                    value = ray_tpu.get(self._ref, timeout=remaining)
+                    return self._complete_ok(value)
                 except ActorDiedError:
                     if attempt == attempts - 1 or (
                             deadline is not None
@@ -109,8 +123,57 @@ class DeploymentResponse:
                     self._router._dec(self._replica_key)
                     self._router._refresh(force=True)
                     self._ref, self._replica_key = self._redispatch()
+        except TimeoutError:
+            # a timed-out poll is NOT the request failing — result() is
+            # re-callable and a later call may succeed (then records ok).
+            # Count the timeout signal once, but leave the outcome open;
+            # marking error here would pin 100% error_rate on any caller
+            # that polls with short timeouts.
+            if not self._timeout_counted:
+                self._timeout_counted = True
+                self._count_timeout()
+            raise
+        except BaseException as e:
+            self._record_failure(e)
+            raise
         finally:
             self._finish()
+
+    def _count_timeout(self) -> None:
+        if self._request_meta is None:
+            return
+        from . import observability as obs
+
+        obs.defer(obs.record_timeout, self._deployment)
+
+    def _complete_ok(self, value):
+        meta = self._request_meta
+        if meta is None or self._recorded:
+            return value
+        self._recorded = True  # result() is re-callable; record ONCE
+        from . import observability as obs
+
+        e2e = max(0.0, time.time() - meta.get("ingress_ts", time.time()))
+        self.timings = {
+            "handle_queue_wait_s": meta.get("handle_queue_wait_s", 0.0),
+            "e2e_s": e2e,
+        }
+        obs.defer(obs.record_request_outcome, self._deployment,
+                  meta.get("ingress", "handle"), "ok", e2e,
+                  meta.get("handle_queue_wait_s"))
+        return value
+
+    def _record_failure(self, exc: BaseException) -> None:
+        meta = self._request_meta
+        if meta is None or self._recorded:
+            return
+        self._recorded = True
+        from . import observability as obs
+
+        ingress = meta.get("ingress", "handle")
+        e2e = max(0.0, time.time() - meta.get("ingress_ts", time.time()))
+        obs.defer(obs.record_request_outcome, self._deployment, ingress,
+                  "error", e2e, meta.get("handle_queue_wait_s"))
 
     def _finish(self):
         if not self._done:
@@ -149,6 +212,8 @@ class Router:
         self._last_refresh = 0.0
         self._poller_started = False
         self.retry_on_replica_failure = True  # updated on refresh
+        # None -> fall back to the global config default at emit time
+        self.slow_request_threshold_s: Optional[float] = None
 
     def _on_longpoll(self) -> None:
         self._refresh(force=True)
@@ -181,6 +246,14 @@ class Router:
                 self._version = version
                 self.retry_on_replica_failure = rset.get(
                     "retry_on_replica_failure", True)
+                # resolve the global fallback HERE so the per-request
+                # slow check compares against a concrete float
+                thr = rset.get("slow_request_threshold_s")
+                if thr is None:
+                    from ray_tpu.core.config import global_config
+
+                    thr = global_config().serve_slow_request_threshold_s
+                self.slow_request_threshold_s = thr
                 keys = {self._key(r) for r in replicas}
                 self._inflight = {k: v for k, v in self._inflight.items()
                                   if k in keys}
@@ -253,11 +326,15 @@ class DeploymentHandle:
         self._stream_item_timeout_s = stream_item_timeout_s
         self._model_id = multiplexed_model_id
         self._router = Router(controller, deployment_name)
+        # per-call ingress metadata (proxy/gRPC set it via options();
+        # never shared between handle instances, never serialized)
+        self._pending_meta: Optional[dict] = None
 
     def options(self, method_name: Optional[str] = None,
                 stream: Optional[bool] = None,
                 stream_item_timeout_s: Optional[float] = None,
-                multiplexed_model_id: Optional[str] = None
+                multiplexed_model_id: Optional[str] = None,
+                _request_meta: Optional[dict] = None
                 ) -> "DeploymentHandle":
         h = DeploymentHandle(self._controller, self._name,
                              method_name or self._method,
@@ -267,44 +344,141 @@ class DeploymentHandle:
                              self._model_id if multiplexed_model_id is None
                              else multiplexed_model_id)
         h._router = self._router  # share in-flight accounting
+        h._pending_meta = _request_meta or self._pending_meta
         return h
 
     @property
     def method(self):
         return _MethodAccessor(self)
 
+    def _build_request_meta(self) -> Optional[dict]:
+        """The per-request record carried to the replica. Ingress-created
+        meta (proxy/gRPC) arrives via options(_request_meta=); otherwise a
+        fresh one is minted here — inheriting the enclosing request's id
+        when this call composes deployments inside a replica, so one
+        user request keeps ONE id across every hop."""
+        from . import observability as obs
+
+        if not obs.enabled():
+            return None
+        meta = self._pending_meta
+        if meta is None:
+            parent = obs.current_request()
+            meta = obs.make_request_meta(
+                deployment=self._name, ingress="handle",
+                request_id=(parent.meta.get("request_id")
+                            if parent is not None else None))
+        else:
+            meta = dict(meta)
+        meta["deployment"] = self._name
+        return meta
+
     def remote(self, *args, **kwargs):
-        replica, key = self._router.choose(model_id=self._model_id)
+        from ray_tpu.util import tracing
+
+        meta = self._build_request_meta()
+        t_choose = time.perf_counter()
+        try:
+            replica, key = self._router.choose(model_id=self._model_id)
+        except Exception:
+            # routing failure (e.g. no live replicas): no response object
+            # will ever exist, so the error must count HERE — a total
+            # outage showing 0% error rate is the worst failure mode an
+            # error metric can have
+            if meta is not None:
+                from . import observability as obs
+
+                e2e = max(0.0, time.time() - meta.get("ingress_ts",
+                                                      time.time()))
+                obs.defer(obs.record_request_outcome, self._name,
+                          meta.get("ingress", "handle"), "error", e2e)
+            raise
+        span = None
+        if meta is not None:
+            wait = time.perf_counter() - t_choose
+            meta["handle_queue_wait_s"] = wait
+            meta["dispatch_ts"] = time.time()
+            # the replica emits the slow-request event (it owns the stage
+            # breakdown); the deployment's threshold rides along
+            meta["slow_threshold_s"] = \
+                self._router.slow_request_threshold_s
+            # the handle hop's span: parented under the ingress span when
+            # one rides the meta (HTTP/gRPC), else under the ambient
+            # context (a driver-side `with tracing.trace(...)` or replica
+            # composition inside a traced request). Entering it makes the
+            # replica's task span its child via spec.trace_ctx. With NO
+            # parent at all the span is skipped — an orphan single-span
+            # trace joins nothing, and span overhead off the ingress path
+            # is pure cost (metrics still record).
+            parent_ctx = meta.get("trace_ctx") or tracing.current_context()
+            if parent_ctx is not None:
+                span = tracing.child_span(
+                    f"serve.handle.{self._name}", parent=parent_ctx,
+                    request_id=meta["request_id"])
         if self._stream:
             # items stream incrementally (streaming generators); the
             # in-flight count drops when the generator is exhausted
-            gen = replica.handle_request_stream.options(
-                num_returns="streaming").remote(self._method, args, kwargs,
-                                                self._model_id)
+            try:
+                if span is not None:
+                    span.__enter__()
+                gen = replica.handle_request_stream.options(
+                    num_returns="streaming").remote(
+                    self._method, args, kwargs, self._model_id, meta)
+            finally:
+                if span is not None:
+                    span.__exit__(None, None, None)
             item_timeout = self._stream_item_timeout_s
+            stream_meta, name = meta, self._name
 
             def iterate():
+                status = "ok"
+                timed_out = False
                 try:
                     for ref in gen:
                         # bounded per-item wait: a hung replica must not
                         # pin the consumer (and its executor thread) forever
                         yield ray_tpu.get(ref, timeout=item_timeout)
+                except BaseException as e:
+                    status = "error"
+                    timed_out = isinstance(e, TimeoutError)
+                    raise
                 finally:
                     self._router._dec(key)
+                    if stream_meta is not None:
+                        from . import observability as obs
+
+                        e2e = max(0.0, time.time()
+                                  - stream_meta.get("ingress_ts",
+                                                    time.time()))
+                        obs.defer(
+                            obs.record_request_outcome, name,
+                            stream_meta.get("ingress", "handle"), status,
+                            e2e,
+                            stream_meta.get("handle_queue_wait_s"),
+                            timed_out)
 
             return iterate()
-        ref = replica.handle_request.remote(self._method, args, kwargs,
-                                            self._model_id)
+        try:
+            if span is not None:
+                span.__enter__()
+            ref = replica.handle_request.remote(
+                self._method, args, kwargs, self._model_id, meta)
+        finally:
+            if span is not None:
+                span.__exit__(None, None, None)
 
         def redispatch():
             r2, k2 = self._router.choose(model_id=self._model_id)
-            return r2.handle_request.remote(self._method, args, kwargs,
-                                            self._model_id), k2
+            if meta is not None:
+                meta["dispatch_ts"] = time.time()
+            return r2.handle_request.remote(
+                self._method, args, kwargs, self._model_id, meta), k2
 
         # flag rides the router's replica refresh — no extra RPC here
         return DeploymentResponse(
             ref, self._router, key,
-            redispatch if self._router.retry_on_replica_failure else None)
+            redispatch if self._router.retry_on_replica_failure else None,
+            request_meta=meta, deployment=self._name)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

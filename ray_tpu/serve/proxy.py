@@ -116,38 +116,71 @@ class HTTPProxy:
                       dict(request.query),
                       {k: v for k, v in request.headers.items()}, body)
         handle = self._get_handle(match["name"])
+        # ingress observability: assign (or adopt) the request id, open
+        # the root span, and build the meta that rides to the replica.
+        # The span can't use the contextvar — the request hops between
+        # the event loop and executor threads — so its (trace_id,
+        # span_id) travels inside the meta instead and finish() publishes
+        # it when the response settles.
+        from . import observability as obs
+        from ray_tpu.util import tracing
+
+        span, meta, req_id = None, None, ""
+        if obs.enabled():
+            req_id = request.headers.get("x-request-id") \
+                or obs.new_request_id()
+            span = tracing.child_span(f"serve.http {path}",
+                                      request_id=req_id)
+            meta = obs.make_request_meta(
+                deployment=match["name"], route=path, ingress="http",
+                request_id=req_id, trace_ctx=span.context)
+            handle = handle.options(_request_meta=meta)
+
+        def _respond(resp):
+            if req_id:
+                resp.headers["x-request-id"] = req_id
+            return resp
+
         if match.get("stream"):
             # dispatch BEFORE sending headers: a routing failure (e.g. no
             # replicas) must surface as a 5xx, not a truncated 200
             try:
-                it = await loop.run_in_executor(
-                    None, lambda: handle.options(
-                        stream=True,
-                        stream_item_timeout_s=match.get("timeout", 60.0),
-                    ).remote(req))
-            except Exception as e:  # noqa: BLE001
-                return web.Response(status=503, text=str(e))
-            # streaming response: chunks flow as the replica yields them
-            resp = web.StreamResponse()
-            await resp.prepare(request)
-            try:
-                while True:
-                    chunk = await loop.run_in_executor(
-                        None, lambda: next(it, _STREAM_END))
-                    if chunk is _STREAM_END:
-                        break
-                    if isinstance(chunk, str):
-                        chunk = chunk.encode()
-                    await resp.write(chunk)
-            except Exception:
-                # mid-stream failure: ABORT the connection (no clean eof)
-                # so the client can tell truncation from completion
-                resp.force_close()
-                if request.transport is not None:
-                    request.transport.close()
+                try:
+                    it = await loop.run_in_executor(
+                        None, lambda: handle.options(
+                            stream=True,
+                            stream_item_timeout_s=match.get("timeout",
+                                                            60.0),
+                        ).remote(req))
+                except Exception as e:  # noqa: BLE001
+                    return _respond(web.Response(status=503, text=str(e)))
+                # streaming response: chunks flow as the replica yields
+                resp = web.StreamResponse()
+                if req_id:
+                    resp.headers["x-request-id"] = req_id
+                await resp.prepare(request)
+                try:
+                    while True:
+                        chunk = await loop.run_in_executor(
+                            None, lambda: next(it, _STREAM_END))
+                        if chunk is _STREAM_END:
+                            break
+                        if isinstance(chunk, str):
+                            chunk = chunk.encode()
+                        await resp.write(chunk)
+                except Exception:
+                    # mid-stream failure: ABORT the connection (no clean
+                    # eof) so the client can tell truncation from
+                    # completion
+                    resp.force_close()
+                    if request.transport is not None:
+                        request.transport.close()
+                    return resp
+                await resp.write_eof()
                 return resp
-            await resp.write_eof()
-            return resp
+            finally:
+                if span is not None:
+                    span.finish()
         timeout = match.get("timeout", 60.0)
         try:
             # handle.remote() can spin in Router.choose() waiting for
@@ -157,12 +190,15 @@ class HTTPProxy:
 
             result = await loop.run_in_executor(None, _call)
         except Exception as e:  # noqa: BLE001
-            return web.Response(status=500, text=str(e))
+            return _respond(web.Response(status=500, text=str(e)))
+        finally:
+            if span is not None:
+                span.finish()
         if isinstance(result, (dict, list)):
-            return web.json_response(result)
+            return _respond(web.json_response(result))
         if isinstance(result, bytes):
-            return web.Response(body=result)
-        return web.Response(text=str(result))
+            return _respond(web.Response(body=result))
+        return _respond(web.Response(text=str(result)))
 
     def _serve(self) -> None:
         from aiohttp import web

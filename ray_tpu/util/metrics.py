@@ -110,6 +110,13 @@ def _tags_key(tags: Optional[Dict[str, str]]) -> _TagKey:
     return tuple(sorted((tags or {}).items()))
 
 
+def tags_key(tags: Optional[Dict[str, str]]) -> _TagKey:
+    """Precompute a tag key for the ``tag_key=`` fast path: hot callers
+    (the serve request path) build the sorted tuple once per tag set
+    instead of once per record."""
+    return _tags_key(tags)
+
+
 class Counter:
     """Monotonic counter (reference: ray.util.metrics.Counter)."""
 
@@ -119,9 +126,11 @@ class Counter:
         self._desc = description
 
     def inc(self, value: float = 1.0,
-            tags: Optional[Dict[str, str]] = None) -> None:
+            tags: Optional[Dict[str, str]] = None,
+            tag_key: Optional[_TagKey] = None) -> None:
         _registry.record(self._name, "counter", self._desc,
-                         _tags_key(tags), value, mode="add")
+                         tag_key if tag_key is not None
+                         else _tags_key(tags), value, mode="add")
 
 
 class Gauge:
@@ -131,9 +140,11 @@ class Gauge:
         self._desc = description
 
     def set(self, value: float,
-            tags: Optional[Dict[str, str]] = None) -> None:
+            tags: Optional[Dict[str, str]] = None,
+            tag_key: Optional[_TagKey] = None) -> None:
         _registry.record(self._name, "gauge", self._desc,
-                         _tags_key(tags), value, mode="set")
+                         tag_key if tag_key is not None
+                         else _tags_key(tags), value, mode="set")
 
 
 class Histogram:
@@ -146,10 +157,103 @@ class Histogram:
                                [0.001, 0.01, 0.1, 1, 10, 100])
 
     def observe(self, value: float,
-                tags: Optional[Dict[str, str]] = None) -> None:
+                tags: Optional[Dict[str, str]] = None,
+                tag_key: Optional[_TagKey] = None) -> None:
         _registry.record(self._name, "histogram", self._desc,
-                         _tags_key(tags), value, mode="observe",
+                         tag_key if tag_key is not None
+                         else _tags_key(tags), value, mode="observe",
                          buckets=self._buckets)
+
+    def percentile(self, q: float,
+                   tags: Optional[Dict[str, str]] = None,
+                   reg: Optional[_Registry] = None) -> Optional[float]:
+        """Estimate the q-quantile (0 < q <= 1) from the merged bucket
+        counts for one tag set (all sources folded). None when the series
+        has no observations."""
+        agg = aggregate_histogram(self._name, reg)
+        v = agg.get(_tags_key(tags))
+        if v is None:
+            return None
+        return percentile_from_buckets(v["le"], v["count"], q)
+
+    def summary(self, percentiles: Tuple[float, ...] = (0.5, 0.95, 0.99),
+                reg: Optional[_Registry] = None) -> Dict[_TagKey, dict]:
+        """Per-tag-set {count, sum, avg, p50, ...} over merged buckets
+        (the serve.status() aggregation path)."""
+        return histogram_summary(self._name, percentiles, reg)
+
+
+# --------------------------------------------------------------------------- #
+# Histogram aggregation: percentiles over bucket counts (head side)
+# --------------------------------------------------------------------------- #
+
+
+def aggregate_histogram(name: str,
+                        reg: Optional[_Registry] = None
+                        ) -> Dict[_TagKey, dict]:
+    """One histogram's {tags: {"sum", "count", "le"}} with every source
+    (local values, merged workers, the _retired accumulator) folded."""
+    reg = reg or _registry
+    with reg._lock:
+        m = reg.metrics.get(name)
+        if m is None or m["type"] != "histogram":
+            return {}
+        agg: Dict[_TagKey, dict] = {}
+
+        def fold(tags: _TagKey, v: dict) -> None:
+            acc = agg.setdefault(tags, _hist_zero(m["buckets"]))
+            acc["sum"] += v.get("sum", 0.0)
+            acc["count"] += v.get("count", 0)
+            for b, c in (v.get("le") or {}).items():
+                acc["le"][b] = acc["le"].get(b, 0) + c
+
+        for tags, v in m["values"].items():
+            fold(tags, v)
+        for values in (m.get("sources") or {}).values():
+            for tags, v in values.items():
+                fold(tags, v)
+        return agg
+
+
+def percentile_from_buckets(le: Dict[float, int], count: int,
+                            q: float) -> Optional[float]:
+    """Prometheus-style histogram_quantile over cumulative bucket counts:
+    linear interpolation inside the bucket the rank falls in, lower bound
+    0 for the first bucket, and the highest finite bound when the rank
+    lands in +Inf."""
+    if count <= 0 or not le:
+        return None
+    q = min(max(q, 0.0), 1.0)
+    rank = q * count
+    prev_bound, prev_cum = 0.0, 0
+    bounds = sorted(le)
+    for b in bounds:
+        cum = le[b]
+        if cum >= rank:
+            if cum == prev_cum:
+                return float(b)
+            return prev_bound + (float(b) - prev_bound) \
+                * (rank - prev_cum) / (cum - prev_cum)
+        prev_bound, prev_cum = float(b), cum
+    return float(bounds[-1])  # rank falls in the +Inf bucket
+
+
+def histogram_summary(name: str,
+                      percentiles: Tuple[float, ...] = (0.5, 0.95, 0.99),
+                      reg: Optional[_Registry] = None
+                      ) -> Dict[_TagKey, dict]:
+    """{tags: {"count", "sum", "avg", "p50", "p95", ...}} for one
+    histogram, merged across sources (the serve.status() /
+    /api/serve/latency aggregation helper)."""
+    out: Dict[_TagKey, dict] = {}
+    for tags, v in aggregate_histogram(name, reg).items():
+        row = {"count": v["count"], "sum": v["sum"],
+               "avg": (v["sum"] / v["count"]) if v["count"] else None}
+        for q in percentiles:
+            label = ("p%g" % (q * 100)).replace(".", "_")
+            row[label] = percentile_from_buckets(v["le"], v["count"], q)
+        out[tags] = row
+    return out
 
 
 # --------------------------------------------------------------------------- #

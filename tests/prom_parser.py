@@ -97,6 +97,91 @@ def _parse_sample(line: str, lineno: int) -> Tuple[str, Dict[str, str], float]:
     return name, labels, value
 
 
+def parse_histograms(text: str) -> Dict[str, List[dict]]:
+    """Strictly parse every histogram FAMILY in exposition text.
+
+    For each ``# TYPE <name> histogram`` family, group its
+    ``_bucket``/``_sum``/``_count`` samples by label set (minus ``le``)
+    and validate Prometheus histogram conformance:
+
+    - all three sample kinds present for every series,
+    - every ``le`` value parses as a float or ``+Inf``,
+    - a ``+Inf`` bucket exists and equals ``_count``,
+    - cumulative bucket counts are non-decreasing with increasing ``le``.
+
+    Returns {family: [{"labels", "buckets" (le->count), "sum",
+    "count"}, ...]}; raises :class:`PromParseError` on any violation.
+    """
+    hist_families = set()
+    for line in text.split("\n"):
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) >= 4 and parts[3] == "histogram":
+                hist_families.add(parts[2])
+
+    series: Dict[Tuple[str, tuple], dict] = {}
+    for name, labels, value in parse_exposition(text):
+        family = kind = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base in hist_families:
+                family, kind = base, suffix
+                break
+        if family is None:
+            continue
+        key_labels = {k: v for k, v in labels.items() if k != "le"}
+        key = (family, tuple(sorted(key_labels.items())))
+        s = series.setdefault(key, {"labels": key_labels, "buckets": {},
+                                    "sum": None, "count": None})
+        if kind == "_bucket":
+            if "le" not in labels:
+                raise PromParseError(
+                    f"{name}: _bucket sample without an 'le' label")
+            le = labels["le"]
+            if le != "+Inf":
+                try:
+                    float(le)
+                except ValueError:
+                    raise PromParseError(
+                        f"{name}: bad le value {le!r}") from None
+            if le in s["buckets"]:
+                raise PromParseError(f"{name}: duplicate le={le!r}")
+            s["buckets"][le] = value
+        elif kind == "_sum":
+            s["sum"] = value
+        else:
+            s["count"] = value
+
+    out: Dict[str, List[dict]] = {f: [] for f in hist_families}
+    for (family, _k), s in series.items():
+        ctx = f"{family}{s['labels']}"
+        if s["sum"] is None or s["count"] is None:
+            raise PromParseError(f"{ctx}: missing _sum or _count sample")
+        if "+Inf" not in s["buckets"]:
+            raise PromParseError(f"{ctx}: no le=\"+Inf\" bucket")
+        if s["buckets"]["+Inf"] != s["count"]:
+            raise PromParseError(
+                f"{ctx}: +Inf bucket {s['buckets']['+Inf']} != _count "
+                f"{s['count']}")
+        finite = sorted((float(le), c) for le, c in s["buckets"].items()
+                        if le != "+Inf")
+        prev = 0.0
+        for le, c in finite:
+            if c < prev:
+                raise PromParseError(
+                    f"{ctx}: bucket counts decrease at le={le}")
+            prev = c
+        if finite and s["buckets"]["+Inf"] < finite[-1][1]:
+            raise PromParseError(
+                f"{ctx}: +Inf bucket below the largest finite bucket")
+        out[family].append(s)
+    for family in hist_families:
+        if not out[family]:
+            raise PromParseError(
+                f"{family}: TYPE histogram declared but no samples")
+    return out
+
+
 def parse_exposition(text: str) -> List[Tuple[str, Dict[str, str], float]]:
     """Parse exposition text; raises :class:`PromParseError` on any
     malformed line. Returns [(metric_name, labels, value), ...]."""

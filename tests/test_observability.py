@@ -304,6 +304,94 @@ class TestRegistrySourceLifecycle:
             ray_tpu.shutdown()
 
 
+class TestHistogramPercentiles:
+    """Histogram.percentile()/summary() over merged bucket counts (the
+    serve.status() aggregation helper)."""
+
+    def test_percentile_interpolates_within_bucket(self):
+        from ray_tpu.util.metrics import percentile_from_buckets
+
+        # 10 observations uniform in (0, 1]: buckets 0.5 -> 5, 1.0 -> 10
+        le = {0.5: 5, 1.0: 10}
+        assert percentile_from_buckets(le, 10, 0.5) == pytest.approx(0.5)
+        # p90 -> rank 9, inside the (0.5, 1.0] bucket: 0.5 + 0.5 * 4/5
+        assert percentile_from_buckets(le, 10, 0.9) == pytest.approx(0.9)
+        # rank in the +Inf bucket returns the highest finite bound
+        assert percentile_from_buckets({0.5: 5, 1.0: 8}, 10, 0.99) == 1.0
+        assert percentile_from_buckets({}, 0, 0.5) is None
+
+    def test_histogram_percentile_merges_sources(self):
+        from ray_tpu.util.metrics import (Histogram, _Registry,
+                                          histogram_summary)
+
+        reg = _Registry()
+        reg.record("lat_s", "histogram", "h", (("d", "x"),), 0.05,
+                   mode="observe", buckets=[0.1, 1.0])
+        # a worker's snapshot of the same series merges in
+        reg.merge("w1", {"lat_s": {
+            "type": "histogram", "help": "h", "buckets": [0.1, 1.0],
+            "values": {(("d", "x"),): {"sum": 1.5, "count": 3,
+                                       "le": {0.1: 0, 1.0: 3}}}}})
+        h = Histogram("lat_s", boundaries=[0.1, 1.0])
+        # 4 total: 1 in (0, 0.1], 3 in (0.1, 1.0]
+        p = h.percentile(0.5, tags={"d": "x"}, reg=reg)
+        assert 0.1 < p <= 1.0
+        assert h.percentile(0.1, tags={"d": "x"}, reg=reg) \
+            == pytest.approx(0.04)
+        assert h.percentile(0.5, tags={"d": "zzz"}, reg=reg) is None
+        summ = histogram_summary("lat_s", reg=reg)[(("d", "x"),)]
+        assert summ["count"] == 4
+        assert summ["avg"] == pytest.approx((0.05 + 1.5) / 4)
+        assert set(summ) >= {"p50", "p95", "p99"}
+
+
+class TestStrictHistogramParsing:
+    """prom_parser.parse_histograms: conformant families parse; the real
+    renderer failure modes raise."""
+
+    GOOD = (
+        "# HELP h desc\n# TYPE h histogram\n"
+        'h_bucket{le="0.1"} 1\nh_bucket{le="1"} 3\n'
+        'h_bucket{le="+Inf"} 4\nh_sum 2.5\nh_count 4\n')
+
+    def test_rendered_histograms_conform(self):
+        from prom_parser import parse_histograms
+
+        from ray_tpu.util.metrics import _Registry
+
+        reg = _Registry()
+        reg.record("rt_h", "histogram", "h", (("k", "v"),), 0.05,
+                   mode="observe", buckets=[0.1, 1.0])
+        reg.record("rt_h", "histogram", "h", (("k", "v"),), 7.0,
+                   mode="observe", buckets=[0.1, 1.0])
+        fams = parse_histograms(render_prometheus(reg))
+        (series,), = [fams["rt_h"]]
+        assert series["labels"] == {"k": "v"}
+        assert series["count"] == 2 and series["buckets"]["+Inf"] == 2
+
+    def test_good_family_parses(self):
+        from prom_parser import parse_histograms
+
+        fams = parse_histograms(self.GOOD)
+        assert fams["h"][0]["buckets"] == {"0.1": 1, "1": 3, "+Inf": 4}
+
+    @pytest.mark.parametrize("mutation, why", [
+        (lambda t: t.replace('h_bucket{le="+Inf"} 4\n', ""), "no +Inf"),
+        (lambda t: t.replace("h_count 4", "h_count 5"),
+         "+Inf != count"),
+        (lambda t: t.replace('h_bucket{le="1"} 3', 'h_bucket{le="1"} 0'),
+         "decreasing cumulative counts"),
+        (lambda t: t.replace("h_sum 2.5\n", ""), "missing _sum"),
+        (lambda t: t.replace('le="0.1"', 'le="abc"'), "bad le value"),
+    ])
+    def test_violations_raise(self, mutation, why):
+        from prom_parser import PromParseError, parse_histograms
+
+        with pytest.raises(PromParseError):
+            parse_histograms(mutation(self.GOOD))
+        assert why  # parametrize label
+
+
 def test_sampling_profiler_collapsed_stack_format(tmp_path):
     """Dumps are collapsed-stack: root-first, ';'-separated frames, one
     'stack count' line each, full counts (no top-N cut)."""

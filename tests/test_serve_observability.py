@@ -1,0 +1,351 @@
+"""End-to-end Serve request observability: request ids, access logs,
+stage histograms, slow-request events, span trees, status aggregates.
+
+Reference model: serve's request-context + metrics tests
+(python/ray/serve/tests/test_metrics.py) over this repo's pipeline:
+proxy -> handle -> replica instrumentation (serve/observability.py)
+flowing into the standard registry, the cluster event log, and the
+tracing pubsub channel.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core import api
+from ray_tpu.util import state, tracing
+from ray_tpu.util.metrics import registry, render_prometheus
+
+PORT = 18341
+
+
+@pytest.fixture
+def serve_instance(monkeypatch):
+    from ray_tpu.core.config import global_config
+
+    # replica metrics must land on the head fast enough to assert on
+    # (the config snapshot ships to workers at init)
+    monkeypatch.setattr(global_config(), "metrics_report_interval_ms", 300)
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    serve.start(serve.HTTPOptions(port=PORT))
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _get(path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{PORT}{path}", timeout=30) as r:
+        return r.status, r.read().decode(), dict(r.headers)
+
+
+def _access_log_lines():
+    d = os.path.join(api._get_head().session_dir, "logs", "serve")
+    if not os.path.isdir(d):
+        return []
+    out = []
+    for name in os.listdir(d):
+        with open(os.path.join(d, name)) as f:
+            out.extend(json.loads(ln) for ln in f if ln.strip())
+    return out
+
+
+def _merged_latency_count(deployment):
+    from ray_tpu.serve import observability as obs
+    from ray_tpu.util.metrics import aggregate_histogram
+
+    obs.drain_deferred()  # settle the driver's queued records
+    total = 0
+    for tags, v in aggregate_histogram(
+            "ray_tpu_serve_request_latency_seconds").items():
+        if dict(tags).get("deployment") == deployment:
+            total += v["count"]
+    return total
+
+
+def test_http_requests_yield_ids_logs_histograms_and_spans(serve_instance):
+    """The acceptance path: N HTTP requests produce N unique request ids
+    (echoed in the x-request-id header), N access-log JSONL lines, e2e
+    histogram _count == N, and a joined span tree proxy -> handle ->
+    replica for any one request."""
+    @serve.deployment
+    class Greeter:
+        def __call__(self, request):
+            return {"hello": serve.get_request_id()}
+
+    serve.run(Greeter.bind(), route_prefix="/greet")
+    N = 8
+    header_ids, body_ids = [], []
+    for _ in range(N):
+        status, body, headers = _get("/greet")
+        assert status == 200
+        header_ids.append(headers.get("x-request-id"))
+        body_ids.append(json.loads(body)["hello"])
+    # ingress-assigned ids: unique, echoed in the response header, and
+    # visible to user code via serve.get_request_id()
+    assert len(set(header_ids)) == N
+    assert header_ids == body_ids
+
+    # one access-log line per request, request ids joined
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        lines = [l for l in _access_log_lines()
+                 if l["deployment"] == "Greeter"]
+        if len(lines) >= N:
+            break
+        time.sleep(0.1)
+    assert len(lines) == N
+    assert {l["request_id"] for l in lines} == set(header_ids)
+    for l in lines:
+        assert l["status"] == "ok" and l["route"] == "/greet"
+        assert l["replica"].startswith("Greeter#")
+        assert "exec_ms" in l["timings_ms"]
+        assert "replica_queue_wait_ms" in l["timings_ms"]
+
+    # e2e histogram (recorded proxy-side, head process): _count == N
+    assert _merged_latency_count("Greeter") == N
+
+    # replica-side stage histograms flush over the worker channel
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        text = render_prometheus(registry())
+        if "ray_tpu_serve_exec_seconds_count" in text \
+                and "ray_tpu_serve_replica_queue_wait_seconds" in text:
+            break
+        time.sleep(0.2)
+    from prom_parser import parse_exposition, parse_histograms
+
+    parse_exposition(text)  # every line conformant
+    hists = parse_histograms(text)  # strict histogram-family validation
+    for fam in ("ray_tpu_serve_request_latency_seconds",
+                "ray_tpu_serve_handle_queue_wait_seconds",
+                "ray_tpu_serve_replica_queue_wait_seconds",
+                "ray_tpu_serve_exec_seconds"):
+        assert fam in hists and hists[fam], fam
+
+    # span tree: the root span carries the request id; the handle span
+    # parents under it and the replica task span under the handle span
+    rid = header_ids[0]
+    spans = tracing.get_spans(timeout=10)
+    mine = [s for s in spans
+            if (s.get("attrs") or {}).get("request_id") == rid]
+    assert mine, "no spans tagged with the request id"
+    trace_id = mine[0]["trace_id"]
+    tree = [s for s in spans if s["trace_id"] == trace_id]
+    by_id = {s["span_id"]: s for s in tree}
+    root = next(s for s in tree if s["parent_id"] is None)
+    assert root["name"].startswith("serve.http")
+    handle_span = next(s for s in tree
+                       if s["name"] == "serve.handle.Greeter")
+    assert handle_span["parent_id"] == root["span_id"]
+    replica_span = next(s for s in tree
+                        if "handle_request" in s["name"])
+    assert by_id[replica_span["parent_id"]] is handle_span
+
+
+def test_slow_request_emits_warning_event_with_stages(serve_instance):
+    @serve.deployment(slow_request_threshold_s=0.05)
+    class Sleepy:
+        def __call__(self, request):
+            time.sleep(0.25)
+            return "done"
+
+    serve.run(Sleepy.bind(), route_prefix="/sleepy")
+    status, _, headers = _get("/sleepy")
+    assert status == 200
+    rid = headers.get("x-request-id")
+
+    deadline = time.monotonic() + 10
+    slow = []
+    while time.monotonic() < deadline and not slow:
+        evs = state.list_cluster_events(source="SERVE",
+                                        min_severity="WARNING")
+        slow = [e for e in evs
+                if e["attrs"].get("request_id") == rid]
+        time.sleep(0.1)
+    assert slow, "no slow-request WARNING event"
+    ev = slow[0]
+    assert ev["severity"] == "WARNING"
+    assert ev["entity_id"] == "Sleepy"
+    assert ev["attrs"]["e2e_ms"] >= 250
+    stages = ev["attrs"]["stages"]
+    assert stages["exec_ms"] >= 200
+    assert "replica_queue_wait_ms" in stages
+    assert "handle_queue_wait_ms" in stages
+
+
+def test_errors_and_status_aggregates(serve_instance):
+    @serve.deployment
+    class Flaky:
+        def __call__(self, request):
+            if request.query_params.get("boom"):
+                raise ValueError("boom")
+            return "ok"
+
+    serve.run(Flaky.bind(), route_prefix="/flaky")
+    for _ in range(4):
+        assert _get("/flaky")[0] == 200
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get("/flaky?boom=1")
+    assert ei.value.code == 500
+
+    st = serve.status()["Flaky"]
+    assert st["requests"] == 5
+    assert st["errors"] == 1
+    assert st["error_rate"] == pytest.approx(0.2)
+    assert st["latency_ms"]["p50"] is not None
+    assert st["latency_ms"]["p99"] is not None
+
+    # error requests get access-log lines with status=error too
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        lines = [l for l in _access_log_lines()
+                 if l["deployment"] == "Flaky"
+                 and l["status"] == "error"]
+        if lines:
+            break
+        time.sleep(0.1)
+    assert lines
+
+
+def test_polling_result_timeout_not_recorded_as_error(serve_instance):
+    """result() is future-like and re-callable: a caller polling with
+    short timeouts must not pin the request as an error — the timeout
+    signal counts once, and the eventual success records ok."""
+    @serve.deployment
+    class Slowish:
+        def __call__(self, x):
+            time.sleep(0.8)
+            return "done"
+
+    handle = serve.run(Slowish.bind(), route_prefix=None)
+    r = handle.remote(None)
+    timeouts = 0
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            v = r.result(timeout=0.1)
+            break
+        except TimeoutError:
+            timeouts += 1
+            assert time.monotonic() < deadline
+    assert v == "done" and timeouts >= 1
+    st = serve.status()["Slowish"]
+    assert st["errors"] == 0
+    assert st["requests"] == 1
+    assert st["timeouts"] == 1  # once, however many polls timed out
+    assert st["error_rate"] == 0.0
+
+
+def test_batching_records_wait_and_size(serve_instance):
+    @serve.deployment
+    class Batcher:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        async def go(self, xs):
+            return [x * 10 for x in xs]
+
+        async def __call__(self, x):
+            return await self.go(x)
+
+    handle = serve.run(Batcher.bind(), route_prefix=None)
+    rs = [handle.remote(i) for i in range(8)]
+    assert sorted(r.result() for r in rs) == [i * 10 for i in range(8)]
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        text = render_prometheus(registry())
+        if "ray_tpu_serve_batch_wait_seconds_count" in text:
+            break
+        time.sleep(0.2)
+    assert "ray_tpu_serve_batch_wait_seconds" in text
+    assert "ray_tpu_serve_batch_size" in text
+    assert "ray_tpu_serve_batch_utilization" in text
+    # batch wait lands in the access-log stage timings too (the
+    # replica's bookkeeping drains asynchronously)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        lines = [l for l in _access_log_lines()
+                 if l["deployment"] == "Batcher"]
+        if any("batch_wait_ms" in l["timings_ms"] for l in lines):
+            break
+        time.sleep(0.1)
+    assert any("batch_wait_ms" in l["timings_ms"] for l in lines)
+
+
+def test_latency_dashboard_endpoint(serve_instance):
+    from ray_tpu.dashboard import start_dashboard
+
+    @serve.deployment
+    def hello(x):
+        return "hi"
+
+    serve.run(hello.bind(), route_prefix="/hello")
+    dash = start_dashboard(port=0, with_jobs=False)
+    try:
+        assert _get("/hello")[0] == 200
+        base = f"http://127.0.0.1:{dash.address[1]}"
+        with urllib.request.urlopen(base + "/api/serve/latency",
+                                    timeout=10) as r:
+            stats = json.loads(r.read())
+        assert "hello" in stats
+        assert stats["hello"]["requests"] >= 1
+        assert stats["hello"]["latency_ms"]["p50"] is not None
+        # the serve access logs are browsable through the per-node
+        # dashboard agent log endpoints (one level of subdirs)
+        node_hex = ray_tpu.nodes()[0]["NodeID"]
+        # the replica's bookkeeping drains asynchronously (~50ms cadence)
+        deadline = time.monotonic() + 10
+        serve_logs, logs = [], []
+        while time.monotonic() < deadline and not serve_logs:
+            with urllib.request.urlopen(
+                    f"{base}/api/nodes/{node_hex}/logs",
+                    timeout=10) as r:
+                logs = json.loads(r.read())
+            serve_logs = [l["name"] for l in logs
+                          if l["name"].startswith("serve/")]
+            time.sleep(0.1)
+        assert serve_logs, logs
+        # the replica's access-log flusher is async (~0.2s cadence)
+        deadline = time.monotonic() + 10
+        tail = {"text": ""}
+        while time.monotonic() < deadline \
+                and "request_id" not in tail["text"]:
+            with urllib.request.urlopen(
+                    f"{base}/api/nodes/{node_hex}/logs/{serve_logs[0]}",
+                    timeout=10) as r:
+                tail = json.loads(r.read())
+            time.sleep(0.1)
+        assert "request_id" in tail["text"]
+    finally:
+        dash.stop()
+
+
+def test_observability_disabled_is_clean(monkeypatch):
+    """With RAY_TPU_SERVE_OBSERVABILITY_ENABLED=0 the request path runs
+    uninstrumented: no serve histograms, no access logs (the
+    bench_serve.py baseline mode)."""
+    from ray_tpu.core.config import global_config
+
+    monkeypatch.setattr(global_config(),
+                        "serve_observability_enabled", False)
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+        serve.start(serve.HTTPOptions(port=PORT))
+
+        @serve.deployment
+        def plain(x):
+            return {"v": x}
+
+        handle = serve.run(plain.bind(), route_prefix=None)
+        assert handle.remote(3).result() == {"v": 3}
+        d = os.path.join(api._get_head().session_dir, "logs", "serve")
+        assert not os.path.isdir(d) or not os.listdir(d)
+        assert _merged_latency_count("plain") == 0
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()

@@ -50,6 +50,44 @@ def test_actor_calls_traced(cluster):
     assert any("work" in n for n in names)
 
 
+def test_get_spans_returns_early_once_quiet(cluster):
+    """get_spans must not block for the full timeout once spans arrived
+    and the channel has gone quiet (the poll loop used to spin until the
+    hard deadline no matter what); the deadline stays the cap when
+    nothing ever arrives."""
+    import time
+
+    with tracing.trace("early-exit-root"):
+        pass
+    t0 = time.monotonic()
+    spans = tracing.get_spans(timeout=30.0)
+    elapsed = time.monotonic() - t0
+    assert any(s["name"] == "early-exit-root" for s in spans)
+    assert elapsed < 10.0, f"get_spans blocked {elapsed:.1f}s of a 30s cap"
+
+
+def test_get_spans_attrs_round_trip(cluster):
+    with tracing.trace("attr-root", request_id="req-42", route="/x") as cm:
+        pass
+    spans = tracing.get_spans(cm.trace_id, timeout=10)
+    (span,) = [s for s in spans if s["name"] == "attr-root"]
+    assert span["attrs"] == {"request_id": "req-42", "route": "/x"}
+
+
+def test_child_span_explicit_parent(cluster):
+    """child_span parents under a context handed across threads/processes
+    (the serve ingress pattern), without touching the ambient var."""
+    root = tracing.child_span("explicit-root")
+    with tracing.child_span("explicit-child", parent=root.context):
+        pass
+    root.finish()
+    assert tracing.current_context() is None  # ambient var untouched
+    spans = tracing.get_spans(root.trace_id, timeout=10)
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["explicit-child"]["parent_id"] \
+        == by_name["explicit-root"]["span_id"]
+
+
 def test_untraced_tasks_record_nothing(cluster):
     @ray_tpu.remote
     def f():
