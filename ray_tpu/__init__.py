@@ -13,6 +13,7 @@ from ray_tpu.core.api import (  # noqa: F401
     cluster_resources,
     get,
     get_actor,
+    get_object_locations,
     init,
     is_initialized,
     kill,
@@ -45,7 +46,8 @@ from ray_tpu.util.timeline import timeline  # noqa: F401
 __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "start_client_server", "timeline",
-    "kill", "cancel", "get_actor", "method", "available_resources",
+    "kill", "cancel", "get_actor", "get_object_locations", "method",
+    "available_resources",
     "cluster_resources", "nodes", "ObjectRef", "get_runtime_context",
     "RayTpuError", "TaskError", "ActorError", "ActorDiedError",
     "ObjectLostError", "ObjectStoreFullError", "TaskCancelledError",

@@ -201,6 +201,26 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
                    fetch_local=fetch_local)
 
 
+def get_object_locations(refs: Sequence[ObjectRef]) -> Dict[ObjectRef, List[str]]:
+    """Node hexes currently holding each object (may be empty for inline
+    or in-flight objects). The data plane uses this for locality-aware
+    dispatch and split dealing; works from the driver and from workers
+    (reference: ray.experimental.get_object_locations)."""
+    rt = runtime_mod.get_current_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() has not been called")
+    lst = list(refs)
+    for r in lst:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(
+                f"get_object_locations() expects ObjectRefs, got {type(r)}")
+    lookup = getattr(rt, "object_locations", None)
+    if lookup is None:  # e.g. local_mode: everything is in-process
+        return {r: [] for r in lst}
+    locs = lookup([r.id for r in lst])
+    return {r: list(ls) for r, ls in zip(lst, locs)}
+
+
 def kill(actor: ActorHandle, *, no_restart: bool = True):
     rt = runtime_mod.get_current_runtime()
     rt.kill_actor(actor._actor_id, no_restart)

@@ -797,6 +797,18 @@ class DirectTaskManager:
         with self._lock:
             return self._result_nodes.get(oid)
 
+    def fill_result_locations(self, oids, locations) -> None:
+        """Backfill empty slots of a head-directory ``object_locations``
+        answer from the owner's direct result table (direct-owned results
+        the head hasn't learned about yet). Mutates ``locations`` in
+        place; the one ownership rule both driver and worker lookups
+        share."""
+        for i, oid in enumerate(oids):
+            if not locations[i]:
+                h = self.result_node(oid)
+                if h:
+                    locations[i] = [h]
+
     def ready_subset(self, oids) -> set:
         """Non-blocking: which of ``oids`` are completed owned results."""
         with self._lock:

@@ -332,18 +332,21 @@ class Node:
         return True
 
     def _locality_target(self, spec: TaskSpec) -> Optional[str]:
-        """Peer node holding the most store-resident args, if not us."""
+        """Peer node holding the most store-resident args, if not us.
+        An explicit ``spec.locality_hex`` (caller-provided hint, e.g. the
+        data executor targeting a block holder) is the fallback when the
+        arg hints don't name a node — small blocks ride inline and leave
+        no store hint, but the caller still knows where they live."""
         hints = spec.arg_hints
-        if not hints:
-            return None
         counts: Dict[str, int] = {}
-        for h in hints.values():
+        for h in (hints or {}).values():
             if h[0] == "node":
                 counts[h[1]] = counts.get(h[1], 0) + 1
-        if not counts:
-            return None
-        best = max(counts, key=lambda k: counts[k])
-        if best == self.hex:
+        if counts:
+            best = max(counts, key=lambda k: counts[k])
+        else:
+            best = spec.locality_hex
+        if best is None or best == self.hex:
             return None
         # don't ship work to a node we can't see or that already left
         return best

@@ -64,6 +64,10 @@ class RemoteHead:
         self.ref_counts = _PinShim(self)
         self.node = None  # set after Node construction
         self.stopped = threading.Event()
+        # fetch_local prefetch kicks (timeout=0 waits): one in-flight
+        # background pull per object across concurrent waits
+        self._prefetching: set = set()
+        self._prefetch_lock = threading.Lock()
         self.cluster_view: list = []          # syncer-broadcast membership
         self.cluster_view_version: int = 0
         # handlers can block on node/store locks (e.g. store_delete vs a
@@ -244,6 +248,11 @@ class RemoteHead:
             remaining = (None if deadline is None
                          else deadline - time.monotonic())
             if remaining is not None and remaining <= 0:
+                # budget exhausted: kick one ASYNC pull round for the
+                # stragglers so a timeout=0 fetch_local wait still
+                # STARTS transfers (the head-side wait spawns pulls the
+                # same way; iterator prefetch relies on the side effect)
+                self._spawn_prefetch([o for o in oids if o not in ready])
                 return ready
             round_t = (2.0 if remaining is None
                        else max(0.05, min(remaining, 2.0)))
@@ -258,12 +267,49 @@ class RemoteHead:
                 rep = self.get_object_for_node(node, oid, round_t)
                 if rep[0] == "inline":
                     try:
-                        node.store.put_inline(oid, rep[1], rep[2])
+                        node.store.put_inline(oid, rep[1], rep[2],
+                                              transfer=True)
                     except Exception:
                         pass
                     fetched.add(oid)
                 elif rep[0] == "arena":
                     fetched.add(oid)
+
+    def _spawn_prefetch(self, oids) -> None:
+        """Background locate+pull for a timeout=0 fetch_local wait —
+        readiness was already answered; this only starts the transfers.
+        One thread PER object (the window is small — prefetch_batches+1
+        refs): a ref whose producing task hasn't finished must not
+        head-of-line-block transfer of the refs behind it, and the
+        cross-wait dedup below would otherwise pin the whole batch
+        behind the straggler. Each thread gives its object a bounded
+        locate budget, then clears its dedup entry so a later wait
+        re-kicks it; failures are silent (the consumer's real get()
+        re-locates)."""
+        node = self.node
+        if node is None or not oids:
+            return
+        with self._prefetch_lock:
+            todo = [o for o in oids if o not in self._prefetching
+                    and not node.store.contains(o)]
+            self._prefetching.update(todo)
+
+        def run(oid):
+            try:
+                if not node.store.contains(oid):
+                    rep = self.get_object_for_node(node, oid, 5.0)
+                    if rep and rep[0] == "inline":
+                        node.store.put_inline(oid, rep[1], rep[2],
+                                              transfer=True)
+            except Exception:
+                pass
+            finally:
+                with self._prefetch_lock:
+                    self._prefetching.discard(oid)
+
+        for oid in todo:
+            threading.Thread(target=run, args=(oid,), daemon=True,
+                             name="prefetch-pull").start()
 
     def get_object_for_node(self, node, oid: ObjectID, timeout,
                             hint: Optional[str] = None):

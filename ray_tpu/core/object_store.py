@@ -28,6 +28,29 @@ from typing import Dict, List, Optional, Tuple
 from .config import global_config
 from .exceptions import ObjectStoreFullError, ObjectLostError
 from .ids import ObjectID
+# Store write traffic. The data-pipeline benches assert operator fusion
+# reduces per-stage materialization through these (puts = inline + arena
+# creations, bytes = payload bytes written). Imported lazily: this module
+# loads inside the ray_tpu.core import chain, before ray_tpu.util's
+# package __init__ (which needs ray_tpu.remote) can run. Both counters
+# publish via ONE atomic global assignment — concurrent first puts must
+# never observe a half-initialized pair.
+_m_store_put = None
+
+
+def _count_put(nbytes: int) -> None:
+    global _m_store_put
+    m = _m_store_put
+    if m is None:
+        from ray_tpu.util.metrics import Counter
+
+        m = (Counter("ray_tpu_object_store_puts_total",
+                     "Objects written into a local store"),
+             Counter("ray_tpu_object_store_put_bytes_total",
+                     "Bytes written into local stores"))
+        _m_store_put = m
+    m[0].inc()
+    m[1].inc(nbytes)
 
 
 # --------------------------------------------------------------------------- #
@@ -193,7 +216,8 @@ class LocalObjectStore:
 
     # -- creation ----------------------------------------------------------
 
-    def put_inline(self, oid: ObjectID, payload: bytes, is_error: bool = False):
+    def put_inline(self, oid: ObjectID, payload: bytes, is_error: bool = False,
+                   transfer: bool = False):
         with self._lock:
             e = self._entries.get(oid)
             if e is not None and e.sealed:
@@ -203,10 +227,19 @@ class LocalObjectStore:
                 is_error=is_error,
             )
             self._sealed_cv.notify_all()
+        if not transfer:
+            _count_put(len(payload))
 
-    def create(self, oid: ObjectID, size: int) -> Tuple[int, memoryview]:
+    def create(self, oid: ObjectID, size: int,
+               transfer: bool = False) -> Tuple[int, memoryview]:
         """Allocate arena space; returns (offset, writable view). Spills/evicts
-        under pressure (reference: create_request_queue.cc backpressure)."""
+        under pressure (reference: create_request_queue.cc backpressure).
+
+        ``transfer=True`` marks a receive-side allocation (node-to-node
+        pull/push of bytes that already exist elsewhere): those are not
+        counted as puts, so the put counters measure object
+        MATERIALIZATIONS, not replication traffic (which has its own
+        metrics in object_transfer)."""
         cfg = global_config()
         deadline = time.monotonic() + 30.0
         while True:
@@ -228,6 +261,8 @@ class LocalObjectStore:
                 else:
                     self.arena.allocator.free(stale.offset)  # retry overwrote entry
             self._entries[oid] = ObjectEntry(oid, size=size, offset=off, creating=True)
+        if not transfer:
+            _count_put(size)
         return off, self.arena.view(off, size)
 
     def seal(self, oid: ObjectID, is_error: bool = False):

@@ -488,9 +488,11 @@ class ObjectServer:
                     if size <= cfg.max_direct_call_object_size:
                         buf = bytearray(size)
                         _read_exact_into(fd, memoryview(buf))
-                        self.store.put_inline(oid, bytes(buf), is_err)
+                        self.store.put_inline(oid, bytes(buf), is_err,
+                                              transfer=True)
                     else:
-                        offset, view = self.store.create(oid, size)
+                        offset, view = self.store.create(oid, size,
+                                                         transfer=True)
                         try:
                             _read_exact_into(fd, view)
                         except Exception:
@@ -708,7 +710,7 @@ def _pull_one(address, authkey: bytes, oid: ObjectID, dest_store, cfg):
             reuse = True
             _m_bytes_pulled.inc(size)
             return bytes(buf), is_err
-        offset, view = dest_store.create(oid, size)
+        offset, view = dest_store.create(oid, size, transfer=True)
         created = True
         _read_exact_into(fd, view)
         dest_store.seal(oid, is_err)
@@ -736,7 +738,7 @@ def _pull_striped(addresses, authkey: bytes, oid: ObjectID, size: int,
     stripe = (size + len(peers) - 1) // len(peers)
     ranges = [(i * stripe, min(stripe, size - i * stripe))
               for i in range(len(peers)) if i * stripe < size]
-    offset, view = dest_store.create(oid, size)
+    offset, view = dest_store.create(oid, size, transfer=True)
     ok = [False] * len(ranges)
 
     def pull_stripe(idx: int) -> None:

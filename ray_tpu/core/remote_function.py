@@ -175,6 +175,12 @@ class RemoteFunction:
             runtime_env=runtime_env,
             pinned_args=[r.id for r in keepalive],
         )
+        # explicit soft-locality hint (e.g. the data executor dispatching a
+        # map task to the node holding its input block); the head's
+        # arg-size inference only runs when this is unset
+        loc = opt.get("locality_hex")
+        if loc is not None:
+            spec.locality_hex = loc
         from ray_tpu.util.tracing import current_context
 
         spec.trace_ctx = current_context()

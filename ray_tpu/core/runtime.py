@@ -1642,7 +1642,7 @@ class Head:
                 data = bytes(payload)
                 if len(data) <= global_config().max_direct_call_object_size:
                     return ("inline", data, is_err)
-                off, view = node.store.create(oid, len(data))
+                off, view = node.store.create(oid, len(data), transfer=True)
                 view[: len(data)] = data
                 node.store.seal(oid, is_err)
                 self.on_object_sealed(oid, node.hex)
@@ -1707,7 +1707,8 @@ class Head:
             try:
                 rep = self._pull_from_proxy(proxy, oid, self.head_node.store)
                 if rep[0] == "inline":
-                    self.head_node.store.put_inline(oid, rep[1], rep[2])
+                    self.head_node.store.put_inline(oid, rep[1], rep[2],
+                                                    transfer=True)
                 self.on_object_sealed(oid, self.head_node.hex)
             except Exception:
                 pass  # source lost mid-pull: the wait loop re-locates
@@ -1754,6 +1755,17 @@ class Head:
         else:
             holder._send("push_object", oid, targets)
         return len(targets)
+
+    def object_locations(self, oids: List[ObjectID]) -> List[List[str]]:
+        """Node hexes holding each object, aligned with ``oids``.
+
+        The block-location lookup behind data-plane locality (executor
+        dispatch hints, streaming_split dealers). Unlike
+        :meth:`locate_large_object` there is no size filter — callers
+        decide whether the bytes are worth chasing."""
+        with self._lock:
+            return [[h for h in self.gcs.get_object_locations(oid)
+                     if h in self.nodes] for oid in oids]
 
     def locate_large_object(self, oid: ObjectID) -> Optional[str]:
         """Locality signal: hex of a node holding ``oid`` when the bytes
@@ -1843,6 +1855,8 @@ class Head:
             return self.stream_next(args[0], args[1], args[2])
         if op == "state_list":
             return self.state_list(args[0], args[1])
+        if op == "object_locations":
+            return self.object_locations(args[0])
         if op == "register_owned_object":
             with self._lock:
                 self.ref_counts[args[0]] += 1
@@ -2044,6 +2058,17 @@ class DriverRuntime:
         (triggered by this wait) lands locally."""
         oids = [r.id for r in refs]
         deadline = None if timeout is None else time.monotonic() + timeout
+        if fetch_local:
+            # completed direct-owned results count as ready immediately
+            # (their get() resolves from the owner table), but the bytes
+            # may still sit on the producer node. num_returns=0 returns
+            # after the pull-spawning pass, so this wait still starts
+            # the transfer — the side effect windowed iterator prefetch
+            # (data/iterator.py) relies on for direct-path task results.
+            settled = [o for o in self.direct.ready_subset(oids)
+                       if self.direct.result_node(o) is not None]
+            if settled:
+                self.head.wait_objects(settled, 0, 0.0, fetch_local=True)
         ev = threading.Event()
         self.direct.add_waiter(ev)
         self.head.add_seal_waiter(ev)
@@ -2072,6 +2097,13 @@ class DriverRuntime:
         ready_set = {r.id for r in ready}
         not_ready = [r for r in refs if r.id not in ready_set]
         return ready, not_ready
+
+    def object_locations(self, oids: List[ObjectID]) -> List[List[str]]:
+        """Per-object holder node hexes; direct-owned results the head
+        hasn't learned about yet resolve from the owner's table."""
+        out = self.head.object_locations(oids)
+        self.direct.fill_result_locations(oids, out)
+        return out
 
     # ---- tasks ----
     def submit_task(self, spec: TaskSpec) -> List[ObjectRef]:

@@ -303,6 +303,12 @@ class WorkerRuntime:
     def kv(self, op: str, *args):
         return self.rpc.call("rpc", "kv", op, *args)
 
+    def object_locations(self, oids: List[ObjectID]) -> List[List[str]]:
+        """Per-object holder node hexes (head directory + owned results)."""
+        out = self.rpc.call("rpc", "object_locations", list(oids))
+        self.direct.fill_result_locations(oids, out)
+        return out
+
     def next_task_id(self) -> TaskID:
         return TaskID.from_random()
 

@@ -62,8 +62,11 @@ class Dataset:
     def _with_op(self, op: L.LogicalOperator) -> "Dataset":
         return Dataset(L.LogicalPlan(op))
 
-    def _execute(self) -> Iterator[RefBundle]:
-        return StreamingExecutor(self._plan).execute()
+    def _execute(self, stamp_output_holders: bool = False) \
+            -> Iterator[RefBundle]:
+        return StreamingExecutor(
+            self._plan,
+            stamp_output_holders=stamp_output_holders).execute()
 
     @staticmethod
     def _compute_kwargs(compute, concurrency, num_cpus, num_tpus,
@@ -356,21 +359,31 @@ class Dataset:
 
         Reference: dataset.py:1236 + _internal/execution/operators/
         output_splitter.py — here a coordinator actor executes the plan and
-        deals output blocks round-robin to per-split queues. With
+        deals output blocks to per-split queues. ``locality_hints`` is a
+        list of n node hexes (one per consumer, e.g. each Train worker's
+        node): the dealer looks up each output block's holder in the
+        object directory and prefers the consumer living with the bytes,
+        subject to a balance bound so no split starves. With
         ``equal=True`` every block is sliced into n equal shares (per-block
         remainder rows dropped), so all splits yield IDENTICAL row counts
         per epoch — unequal splits feeding gang-scheduled SPMD Train
         workers produce different batch counts and hang collectives.
+        NOTE: ``equal=True`` IGNORES ``locality_hints`` (the dealt shares
+        are re-sliced blocks living on the coordinator, not where the
+        source blocks did) — hints are validated, then dropped.
         """
         if locality_hints is not None:
-            import warnings
-
-            warnings.warn(
-                "streaming_split(locality_hints=...) is not honored: "
-                "the single-coordinator dealer has no block-locality "
-                "tracking yet", stacklevel=2)
+            locality_hints = list(locality_hints)
+            if len(locality_hints) != n:
+                raise ValueError(
+                    f"locality_hints needs one node per split: got "
+                    f"{len(locality_hints)} hints for {n} splits")
+            if equal:
+                # equal shares are re-sliced blocks; the slices don't
+                # live where the source blocks did, so hints are moot
+                locality_hints = None
         coordinator = _SplitCoordinator.options(max_concurrency=n + 2) \
-            .remote(self, n, equal)
+            .remote(self, n, equal, locality_hints)
 
         def make_source(idx: int):
             epoch_box = [0]
@@ -573,17 +586,93 @@ class _SplitCoordinator:
     n consumer queues. A new epoch starts once every split requests it
     (gang barrier — Train workers iterate epochs in lockstep)."""
 
-    def __init__(self, ds: Dataset, n: int, equal: bool = False):
+    def __init__(self, ds: Dataset, n: int, equal: bool = False,
+                 locality_hints: Optional[List[str]] = None):
         import collections
 
         self._ds = ds
         self._n = n
         self._equal = equal
+        self._hints = locality_hints
         self._queues = [collections.deque() for _ in _range(n)]
         self._done = False
         self._epoch = -1
         self._requests: Dict[int, set] = {}
         self._lock = threading.Lock()
+        # dealer bookkeeping: per-split blocks dealt this epoch, and how
+        # often the locality preference could/could not be honored
+        self._dealt = [0] * n
+        self._locality_hits = 0
+        self._locality_misses = 0
+        # ref -> holder hexes (() = known miss); materialized datasets
+        # replay the SAME refs every epoch, so later epochs deal without
+        # directory round trips. Misses are cached too: a block is
+        # produced before it is dealt, so an absent directory entry means
+        # inline/direct-owned bytes that will never get one — retrying
+        # every epoch would pay one head RPC per block for zero locality
+        self._loc_cache: Dict[Any, tuple] = {}
+
+    # how far (in blocks) a split may run ahead of the least-fed split
+    # before locality preference yields to balance
+    _BALANCE_SLACK = 2
+
+    def _pick_split(self, bundle, rr_idx: int) -> int:
+        """Dealer choice for one output block: the consumer co-located
+        with the block's holder when that doesn't skew the deal, else the
+        least-fed split (reference: output_splitter.py locality dealing).
+        Increments ``_dealt[k]`` for the chosen split under the lock —
+        stats()/epoch reset read the same counters from other actor
+        threads. Holder resolution (a possible RPC) happens before the
+        lock is taken; ``_loc_cache`` is single-writer (only the one
+        pump thread per epoch touches it)."""
+        if self._hints is None:
+            with self._lock:
+                k = rr_idx % self._n
+                self._dealt[k] += 1
+                return k
+        from .executor import locate_block_holders, record_split_locality
+
+        ref = bundle.ref
+        holders = bundle.holders
+        if holders is None:
+            # unstamped bundle (bulk all-to-all output, locality-aware
+            # off upstream): fall back to one cached directory lookup
+            holders = self._loc_cache.get(ref.id)
+        if holders is None:
+            located = locate_block_holders(ref)
+            if located is None:
+                # lookup FAILED (transient): deal without locality this
+                # time but do not cache — a later epoch may succeed
+                holders = ()
+            else:
+                holders = tuple(located)
+                if len(self._loc_cache) > 65536:  # refs are ephemeral
+                    self._loc_cache.clear()
+                self._loc_cache[ref.id] = holders
+        with self._lock:
+            floor = min(self._dealt)
+            if holders:
+                # a replicated block is local to ANY of its holders
+                local = [i for i in _range(self._n)
+                         if self._hints[i] in holders]
+                local.sort(key=lambda i: self._dealt[i])
+                for i in local:
+                    if self._dealt[i] <= floor + self._BALANCE_SLACK:
+                        self._locality_hits += 1
+                        record_split_locality(True)
+                        self._dealt[i] += 1
+                        return i
+            self._locality_misses += 1
+            record_split_locality(False)
+            k = min(_range(self._n), key=lambda i: self._dealt[i])
+            self._dealt[k] += 1
+            return k
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"dealt": list(self._dealt),
+                    "locality_hits": self._locality_hits,
+                    "locality_misses": self._locality_misses}
 
     def _pump(self):
         def run():
@@ -627,7 +716,8 @@ class _SplitCoordinator:
 
             try:
                 i = 0
-                for bundle in self._ds._execute():
+                for bundle in self._ds._execute(
+                        stamp_output_holders=self._hints is not None):
                     if self._equal:
                         rows = bundle.num_rows
                         if rows is None:
@@ -644,8 +734,9 @@ class _SplitCoordinator:
                         if sum(buf_counts) >= self._n:
                             flush()
                     else:
+                        k = self._pick_split(bundle, i)
                         with self._lock:
-                            self._queues[i % self._n].append(bundle.ref)
+                            self._queues[k].append(bundle.ref)
                     i += 1
                 if self._equal and buf_refs:
                     flush()
@@ -666,6 +757,7 @@ class _SplitCoordinator:
             if ready:
                 self._epoch = epoch
                 self._done = False
+                self._dealt = [0] * self._n
                 self._pump()
 
     def get_next(self, idx: int, epoch: int):

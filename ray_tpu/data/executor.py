@@ -14,14 +14,16 @@ stream out — consumption pulls the loop.
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
 import ray_tpu
+from ray_tpu.util.metrics import Counter, tags_key
 
 from .block import (
     Block,
@@ -32,11 +34,52 @@ from .block import (
 )
 from . import logical as L
 
+# ---------------------------------------------------------------- metrics
+# Per-operator pipeline telemetry in the standard registry, so /metrics
+# and /api/metrics/history cover Data the way they cover Serve.
+
+_m_blocks_out = Counter("ray_tpu_data_blocks_produced_total",
+                        "Output blocks emitted per physical operator",
+                        ("operator",))
+_m_bytes_out = Counter("ray_tpu_data_bytes_produced_total",
+                       "Measured output-block bytes per physical operator",
+                       ("operator",))
+_m_fused_stages = Counter("ray_tpu_data_fused_stages_total",
+                          "Logical stages absorbed into fused operators")
+_m_fused_ops = Counter("ray_tpu_data_fused_operators_total",
+                       "Fused physical operators built")
+_m_locality = Counter("ray_tpu_data_locality_hints_total",
+                      "Dispatch locality lookups (hit = holder known)",
+                      ("result",))
+_TAG_LOC_HIT = tags_key({"result": "hit"})
+_TAG_LOC_MISS = tags_key({"result": "miss"})
+_TAG_SPLIT_HIT = tags_key({"result": "split_hit"})
+_TAG_SPLIT_MISS = tags_key({"result": "split_miss"})
+
+
+def record_split_locality(hit: bool) -> None:
+    """Split-dealer outcome into the shared locality series (this module
+    owns the metric; the dealer in dataset.py reports through here)."""
+    _m_locality.inc(tag_key=_TAG_SPLIT_HIT if hit else _TAG_SPLIT_MISS)
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off")
+
 
 @dataclass
 class RefBundle:
     ref: Any  # ObjectRef of one block
     num_rows: Optional[int] = None
+    # node hexes holding the block when the producing operator emitted it
+    # (batched directory lookup per completion drain): consumers that
+    # dispatch/deal on locality read this instead of paying their own
+    # per-block round trip. None = never looked up, () = known miss
+    # (inline / direct-owned bytes that have no directory entry).
+    holders: Optional[tuple] = None
 
 
 @dataclass
@@ -53,6 +96,17 @@ class DataContext:
     # Sizes are measured from head-local store metadata; on multi-node
     # clusters unmeasured remote blocks fall back to the running average.
     op_memory_budget: int = 512 * 1024 * 1024
+    # fuse Read->Map and Map/Filter/FlatMap/Project chains into single
+    # physical operators: one remote task + one output block per fused
+    # chain instead of a put/get round trip per stage (reference:
+    # logical/rules/operator_fusion.py). Off = one op per logical stage,
+    # for A/B benching and debugging.
+    enable_fusion: bool = field(
+        default_factory=lambda: _env_flag("RAY_TPU_DATA_FUSION", True))
+    # stamp map-task specs with the input block holder's node hex so the
+    # soft-locality scheduler runs the task where the bytes already live
+    locality_aware: bool = field(
+        default_factory=lambda: _env_flag("RAY_TPU_DATA_LOCALITY", True))
 
     _current: "DataContext" = None
 
@@ -61,6 +115,42 @@ class DataContext:
         if DataContext._current is None:
             DataContext._current = DataContext()
         return DataContext._current
+
+
+def _locate(refs: List[Any]) -> List[Optional[List[str]]]:
+    """Holder node hexes per block ref (ONE directory round trip for the
+    whole list). [] = the directory answered and has no entry (inline /
+    direct-owned bytes — a real miss, safe to cache); None = the lookup
+    itself failed (no runtime, transient RPC error — unknown, callers
+    must stay eligible to retry rather than cache a fake miss). Never
+    raises — locality is an optimization, not a correctness
+    dependency."""
+    if not refs:
+        return []
+    try:
+        from ray_tpu.core import runtime as runtime_mod
+
+        rt = runtime_mod.get_current_runtime()
+        lookup = getattr(rt, "object_locations", None)
+        if lookup is None:
+            # local_mode etc.: there IS no directory, nothing to retry
+            return [[] for _ in refs]
+        return [list(ls) for ls in lookup([r.id for r in refs])]
+    except Exception:
+        return [None for _ in refs]
+
+
+def locate_blocks(refs: List[Any]) -> List[Optional[str]]:
+    """First holder per block ref, None where unknown (dispatch wants ONE
+    target node for the soft-locality hint)."""
+    return [ls[0] if ls else None for ls in _locate(refs)]
+
+
+def locate_block_holders(ref) -> Optional[List[str]]:
+    """All holders of one block (the split dealer matches its whole hint
+    list against these — a replicated block is local to any of them).
+    None when the lookup failed (caller must not cache that as a miss)."""
+    return _locate([ref])[0]
 
 
 # ---------------------------------------------------------- remote helpers
@@ -303,6 +393,33 @@ def _read_task_exec(read_task):
     return concat_blocks(list(read_task()))
 
 
+@ray_tpu.remote
+def _fused_read_task_exec(read_task, transform):
+    """Read + downstream fused stages in ONE task: the intermediate
+    blocks never touch the object store. Concatenates the read output
+    first, exactly like the unfused ``_read_task_exec`` — batch-shape-
+    sensitive fns must see identical inputs in both modes."""
+    return transform([concat_blocks(list(read_task()))])
+
+
+class ComposedTransform:
+    """Stage functions of a fused chain, applied in-process in order.
+
+    Each stage is a ``List[Block] -> Block`` transform (the same shape
+    ``make_map_transform`` / ``make_project_transform`` build), so the
+    composition is itself a valid operator transform.
+    """
+
+    def __init__(self, transforms: List[Callable[[List[Block]], Block]]):
+        self.transforms = list(transforms)
+
+    def __call__(self, blocks: List[Block]) -> Block:
+        out = blocks
+        for t in self.transforms:
+            out = [t(out)]
+        return out[0]
+
+
 # --------------------------------------------------------------- operators
 
 
@@ -322,6 +439,14 @@ class PhysicalOperator:
         # measured output block sizes -> per-op memory budget enforcement
         self._size_samples = 0
         self._size_total = 0
+        # logical stages this physical op covers (>1 after fusion)
+        self.fused_names: List[str] = [name]
+        self._metric_tag = tags_key({"operator": name})
+        # set by the executor at plan-build time when a downstream
+        # consumer actually reads bundle.holders (locality map dispatch,
+        # the streaming_split dealer) — the per-drain directory round
+        # trip is skipped everywhere else
+        self.stamp_holders = False
 
     def _next_seq(self) -> int:
         s = self._seq_in
@@ -363,14 +488,22 @@ class PhysicalOperator:
         ready, _ = ray_tpu.wait(list(self.pending.keys()),
                                 num_returns=len(self.pending), timeout=0,
                                 fetch_local=False)
+        # ONE directory round trip for the whole drain: emitted bundles
+        # carry their holders so downstream locality consumers (map
+        # dispatch, the streaming_split dealer) never pay a per-block
+        # lookup of their own
+        holder_lists = (_locate(ready) if self.ctx.locality_aware
+                        and self.stamp_holders and ready else [])
         progress = False
-        for ref in ready:
+        for ref, hl in zip(ready, holder_lists or [None] * len(ready)):
             ctx = self.pending.pop(ref)
             # size sampling lives in the shared drain loop, not the
             # overridable completion hook, so every operator subclass
             # feeds the memory-budget estimator
             self._note_output_size(ref)
-            self._on_task_done(ref, ctx)
+            _m_blocks_out.inc(tag_key=self._metric_tag)
+            self._on_task_done(ref, ctx,
+                               holders=None if hl is None else tuple(hl))
             progress = True
         return progress
 
@@ -389,6 +522,7 @@ class PhysicalOperator:
                     if meta:
                         self._size_samples += 1
                         self._size_total += meta[0]
+                        _m_bytes_out.inc(meta[0], tag_key=self._metric_tag)
                     return
         except Exception:
             pass  # sizes are an optimization; never fail the pipeline
@@ -412,8 +546,8 @@ class PhysicalOperator:
                        + len(self._ready_bufs))
         return outstanding * avg > budget
 
-    def _on_task_done(self, ref, task_ctx) -> None:
-        self._emit(task_ctx, RefBundle(ref))
+    def _on_task_done(self, ref, task_ctx, holders=None) -> None:
+        self._emit(task_ctx, RefBundle(ref, holders=holders))
 
     def dispatch(self, out_backpressure: bool) -> bool:
         return False
@@ -436,14 +570,33 @@ class InputDataBuffer(PhysicalOperator):
         self.output_queue.extend(bundles)
         self.inputs_complete = True
 
+    def stamp_input_holders(self) -> None:
+        """Materialized blocks already exist: stamp holders with ONE
+        directory round trip for the whole input set. Called by the
+        executor only when a downstream consumer reads them."""
+        bundles = list(self.output_queue)
+        for b, hl in zip(bundles, _locate([b.ref for b in bundles])):
+            if hl is not None:
+                b.holders = tuple(hl)
+
 
 class ReadOperator(PhysicalOperator):
-    """Executes ReadTasks as remote tasks (reference fuses Read into Map)."""
+    """Executes ReadTasks as remote tasks. With ``transform`` set (fusion),
+    the downstream map chain runs inside the same read task — one task and
+    one output block per chain (reference fuses Read into Map)."""
 
-    def __init__(self, ctx, read_tasks, max_tasks: int):
-        super().__init__("Read", ctx)
+    def __init__(self, ctx, read_tasks, max_tasks: int,
+                 name: str = "Read", transform=None,
+                 num_cpus: float = 1.0, num_tpus: float = 0.0):
+        super().__init__(name, ctx)
         self._read_tasks = deque(read_tasks)
         self._max_tasks = max_tasks
+        self._transform = transform
+        self._opts = {}
+        if num_cpus != 1.0:
+            self._opts["num_cpus"] = num_cpus
+        if num_tpus:
+            self._opts["num_tpus"] = num_tpus
         self.inputs_complete = True
 
     def dispatch(self, out_backpressure: bool) -> bool:
@@ -454,7 +607,12 @@ class ReadOperator(PhysicalOperator):
                and len(self.output_queue) + len(self.pending)
                < self.ctx.op_output_queue_cap):
             rt = self._read_tasks.popleft()
-            ref = _read_task_exec.remote(rt)
+            if self._transform is not None:
+                fn = (_fused_read_task_exec.options(**self._opts)
+                      if self._opts else _fused_read_task_exec)
+                ref = fn.remote(rt, self._transform)
+            else:
+                ref = _read_task_exec.remote(rt)
             self.pending[ref] = self._next_seq()
             progress = True
         return progress
@@ -481,15 +639,43 @@ class TaskPoolMapOperator(PhysicalOperator):
         if num_tpus:
             self._opts["num_tpus"] = num_tpus
 
+    def _dispatchable(self, out_backpressure: bool) -> bool:
+        return (bool(self.input_queue)
+                and len(self.pending) < self._max_tasks
+                and not out_backpressure
+                and not self.memory_backpressure()
+                and len(self.output_queue) + len(self.pending)
+                < self.ctx.op_output_queue_cap)
+
     def dispatch(self, out_backpressure: bool) -> bool:
+        holders: Dict[Any, Optional[str]] = {}
+        if self.ctx.locality_aware and self._dispatchable(out_backpressure):
+            # bundles stamped by the producing operator carry their
+            # holders already; one directory round trip covers the rest
+            # of everything dispatchable this call, not one per block
+            # (the lookup is an RPC on workers); gated on dispatchability
+            # so a backpressured op doesn't repeat the lookup every
+            # executor tick and throw it away
+            slots = max(0, min(len(self.input_queue),
+                               self._max_tasks - len(self.pending),
+                               self.ctx.op_output_queue_cap
+                               - len(self.output_queue) - len(self.pending)))
+            head = [b for b in list(self.input_queue)[:slots]
+                    if b.holders is None]
+            for b, h in zip(head, locate_blocks([b.ref for b in head])):
+                holders[b.ref.id] = h
         progress = False
-        while (self.input_queue and len(self.pending) < self._max_tasks
-               and not out_backpressure
-               and not self.memory_backpressure()
-               and len(self.output_queue) + len(self.pending)
-               < self.ctx.op_output_queue_cap):
+        while self._dispatchable(out_backpressure):
             bundle = self.input_queue.popleft()
-            fn = _map_task.options(**self._opts) if self._opts else _map_task
+            opts = dict(self._opts)
+            if self.ctx.locality_aware:
+                holder = (bundle.holders[0] if bundle.holders
+                          else holders.get(bundle.ref.id))
+                _m_locality.inc(tag_key=_TAG_LOC_HIT if holder
+                                else _TAG_LOC_MISS)
+                if holder:
+                    opts["locality_hex"] = holder
+            fn = _map_task.options(**opts) if opts else _map_task
             ref = fn.remote(self._transform, bundle.ref)
             self.pending[ref] = self._next_seq()
             progress = True
@@ -546,9 +732,9 @@ class ActorPoolMapOperator(PhysicalOperator):
             progress = True
         return progress
 
-    def _on_task_done(self, ref, ctx) -> None:
+    def _on_task_done(self, ref, ctx, holders=None) -> None:
         seq, actor = ctx
-        self._emit(seq, RefBundle(ref))
+        self._emit(seq, RefBundle(ref, holders=holders))
         self._idle.append(actor)
 
     def shutdown(self) -> None:
@@ -784,40 +970,110 @@ def _default_max_tasks(ctx: DataContext) -> int:
         return 4
 
 
+def _plan_fusion_chains(topo: List[L.LogicalOperator]
+                        ) -> Dict[int, List[L.LogicalOperator]]:
+    """Group the topo into linear fusable chains (reference:
+    logical/rules/operator_fusion.py). Returns id(lop) -> chain list;
+    ops in the same list lower onto ONE physical operator. A chain grows
+    while each link is the sole consumer of a fusable (or Read) producer."""
+    n_consumers: Dict[int, int] = {}
+    for lop in topo:
+        for p in lop.inputs:
+            n_consumers[id(p)] = n_consumers.get(id(p), 0) + 1
+    chain_of: Dict[int, List[L.LogicalOperator]] = {}
+    for lop in topo:
+        if lop.fusable():
+            inp = lop.inputs[0]
+            ch = chain_of.get(id(inp))
+            if (ch is not None and ch[-1] is inp
+                    and n_consumers.get(id(inp), 0) == 1
+                    and (isinstance(inp, L.Read) or inp.fusable())):
+                ch.append(lop)
+                chain_of[id(lop)] = ch
+                continue
+        chain_of[id(lop)] = [lop]
+    return chain_of
+
+
+def _stage_transform(lop: L.LogicalOperator):
+    """The ``List[Block] -> Block`` transform for one fusable stage."""
+    if isinstance(lop, L.MapBatches):
+        return make_map_transform(
+            "map_batches", lop.fn, lop.batch_size, lop.batch_format,
+            lop.fn_constructor_args, lop.fn_constructor_kwargs)
+    if isinstance(lop, L.MapRows):
+        return make_map_transform("map", lop.fn)
+    if isinstance(lop, L.Filter):
+        return make_map_transform("filter", lop.fn)
+    if isinstance(lop, L.FlatMap):
+        return make_map_transform("flat_map", lop.fn)
+    if isinstance(lop, L.Project):
+        return make_project_transform(lop.select, lop.drop, lop.rename)
+    raise ValueError(f"not a fusable stage: {lop}")
+
+
+def _lower_fused_chain(ctx: DataContext, chain: List[L.LogicalOperator],
+                       max_tasks: int) -> PhysicalOperator:
+    name = "->".join(o.name for o in chain)
+    stages = [o for o in chain if not isinstance(o, L.Read)]
+    composed = ComposedTransform([_stage_transform(o) for o in stages])
+    # the fused task inherits the most demanding stage's resources and
+    # the most restrictive concurrency cap — fusing must not drop a
+    # stage's TPU reservation or its parallelism bound
+    maps = [o for o in stages if isinstance(o, L.AbstractMap)]
+    num_cpus = max((o.num_cpus for o in maps), default=1.0)
+    if isinstance(chain[0], L.Read):
+        # the unfused read task reserves 1 CPU; a lighter map stage
+        # (num_cpus < 1) must not shrink the fused read+map reservation
+        num_cpus = max(1.0, num_cpus)
+    num_tpus = max((o.num_tpus for o in maps), default=0.0)
+    caps = [o.concurrency for o in maps if o.concurrency]
+    cap = min(caps) if caps else max_tasks
+    if isinstance(chain[0], L.Read):
+        tasks = chain[0].datasource.get_read_tasks(chain[0].parallelism)
+        phys = ReadOperator(ctx, tasks, cap, name=name, transform=composed,
+                            num_cpus=num_cpus, num_tpus=num_tpus)
+    else:
+        phys = TaskPoolMapOperator(ctx, name, composed, cap,
+                                   num_cpus, num_tpus)
+    phys.fused_names = [o.name for o in chain]
+    _m_fused_stages.inc(len(chain))
+    _m_fused_ops.inc()
+    return phys
+
+
 def build_physical_plan(plan: L.LogicalPlan, ctx: DataContext):
     """Lower the logical DAG to physical operators; returns (ops_topo,
-    edges: op -> consumer)."""
+    edges: op -> consumer). With ``ctx.enable_fusion``, linear Read->Map
+    and Map/Filter/FlatMap/Project chains collapse onto one operator."""
     ops: Dict[int, PhysicalOperator] = {}
     consumers: Dict[int, List[PhysicalOperator]] = {}
     topo = plan.ops_topo()
     max_tasks = _default_max_tasks(ctx)
+    chain_of = (_plan_fusion_chains(topo) if ctx.enable_fusion
+                else {id(lop): [lop] for lop in topo})
+    built: Dict[int, PhysicalOperator] = {}  # id(chain list) -> phys
 
     for lop in topo:
-        if isinstance(lop, L.Read):
+        chain = chain_of[id(lop)]
+        if id(chain) in built:
+            # interior/tail stage of an already-lowered fused chain
+            ops[id(lop)] = built[id(chain)]
+            continue
+        if len(chain) > 1:
+            phys = _lower_fused_chain(ctx, chain, max_tasks)
+        elif isinstance(lop, L.Read):
             tasks = lop.datasource.get_read_tasks(lop.parallelism)
             phys = ReadOperator(ctx, tasks, max_tasks)
         elif isinstance(lop, L.InputData):
             phys = InputDataBuffer(ctx, [
                 RefBundle(r, m.num_rows if m else None)
                 for r, m in zip(lop.block_refs, lop.metadata)])
-        elif isinstance(lop, L.MapBatches):
-            transform = make_map_transform(
-                "map_batches", lop.fn, lop.batch_size, lop.batch_format,
-                lop.fn_constructor_args, lop.fn_constructor_kwargs)
-            phys = _make_map_phys(ctx, lop, transform, max_tasks)
-        elif isinstance(lop, L.MapRows):
-            phys = _make_map_phys(ctx, lop, make_map_transform(
-                "map", lop.fn), max_tasks)
-        elif isinstance(lop, L.Filter):
-            phys = _make_map_phys(ctx, lop, make_map_transform(
-                "filter", lop.fn), max_tasks)
-        elif isinstance(lop, L.FlatMap):
-            phys = _make_map_phys(ctx, lop, make_map_transform(
-                "flat_map", lop.fn), max_tasks)
+        elif isinstance(lop, (L.MapBatches, L.MapRows, L.Filter, L.FlatMap)):
+            phys = _make_map_phys(ctx, lop, _stage_transform(lop), max_tasks)
         elif isinstance(lop, L.Project):
             phys = TaskPoolMapOperator(
-                ctx, "Project", make_project_transform(
-                    lop.select, lop.drop, lop.rename), max_tasks)
+                ctx, "Project", _stage_transform(lop), max_tasks)
         elif isinstance(lop, L.Repartition):
             phys = AllToAllOperator(
                 ctx, "Repartition",
@@ -855,12 +1111,23 @@ def build_physical_plan(plan: L.LogicalPlan, ctx: DataContext):
             phys = _WriteOperator(ctx, lop.datasink, max_tasks)
         else:
             raise ValueError(f"cannot lower {lop}")
+        built[id(chain)] = phys
         ops[id(lop)] = phys
+        # edges connect DISTINCT physical ops; a fused chain's interior
+        # links never get here (they continue above), so only real
+        # cross-operator edges are recorded
         for parent in lop.inputs:
             consumers.setdefault(id(parent), []).append(phys)
 
-    ordered = [ops[id(lop)] for lop in topo]
-    edges = {id(ops[k]): v for k, v in consumers.items()}
+    ordered, seen_phys = [], set()
+    for lop in topo:
+        phys = ops[id(lop)]
+        if id(phys) not in seen_phys:
+            seen_phys.add(id(phys))
+            ordered.append(phys)
+    edges: Dict[int, List[PhysicalOperator]] = {}
+    for k, v in consumers.items():
+        edges.setdefault(id(ops[k]), []).extend(v)
     # Zip needs to know which input is left vs right
     for lop in topo:
         if isinstance(lop, L.Zip):
@@ -947,10 +1214,25 @@ class StreamingExecutor:
     streaming_executor.py:272 _scheduling_loop_step)."""
 
     def __init__(self, plan: L.LogicalPlan,
-                 ctx: Optional[DataContext] = None):
+                 ctx: Optional[DataContext] = None,
+                 stamp_output_holders: bool = False):
         self.ctx = ctx or DataContext.get_current()
         self.ops, self.edges, self.final_op = build_physical_plan(
             plan, self.ctx)
+        if self.ctx.locality_aware:
+            # only operators whose output feeds a locality consumer pay
+            # the per-drain holder lookup: task-pool dispatch reads
+            # bundle.holders, as does the streaming_split dealer
+            # (stamp_output_holders) on the final op's output
+            for op in self.ops:
+                if any(isinstance(c, TaskPoolMapOperator)
+                       for c in self.edges.get(id(op), [])):
+                    op.stamp_holders = True
+            if stamp_output_holders:
+                self.final_op.stamp_holders = True
+            for op in self.ops:
+                if op.stamp_holders and isinstance(op, InputDataBuffer):
+                    op.stamp_input_holders()
         self._producers_done: Dict[int, int] = {}
         self._num_producers: Dict[int, int] = {}
         self._done_markers: set = set()

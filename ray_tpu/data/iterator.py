@@ -1,16 +1,21 @@
 """DataIterator — batched iteration over streams of block refs.
 
 Reference: python/ray/data/iterator.py + _internal/block_batching/.
-``iter_batches`` re-chunks the block stream to exact batch sizes, with
-background prefetch (thread) and optional local shuffle buffer; ``to_jax``
-adds device placement (``jax.device_put`` with an optional NamedSharding) —
-the TPU-native replacement for iter_torch_batches' pin_memory path.
+``iter_batches`` re-chunks the block stream to exact batch sizes with a
+row-offset cursor over the block queue (no carry re-concat — per-batch
+work is O(batch), flat in stream length), windowed ref prefetch via
+``ray_tpu.wait`` (pulls overlap consumption), background batch prefetch
+(thread), and an optional local shuffle buffer; ``to_jax`` adds
+double-buffered device placement (``jax.device_put`` with an optional
+NamedSharding) — the TPU-native replacement for iter_torch_batches'
+pin_memory path.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+from collections import deque
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
 
 import numpy as np
@@ -18,6 +23,96 @@ import numpy as np
 import ray_tpu
 
 from .block import Block, BlockAccessor, concat_blocks
+
+
+class BlockBuffer:
+    """Row-cursor rechunk queue: blocks enter whole, batches leave as
+    zero-copy slices (or a concat of the few slices spanning a block
+    boundary). The remainder is never re-concatenated — ``take(n)``
+    touches exactly n rows, so per-batch cost does not grow with how
+    many blocks have already streamed through.
+    """
+
+    def __init__(self):
+        self._q: deque = deque()  # [accessor, row_offset]
+        self._rows = 0
+        # work accounting (regression tests assert O(total rows), not
+        # O(rows x batches) like the old carry re-concat)
+        self.rows_sliced = 0
+        self.concat_ops = 0
+
+    def add_block(self, block: Block) -> None:
+        acc = BlockAccessor.for_block(block)
+        n = acc.num_rows()
+        if n:
+            self._q.append([acc, 0])
+            self._rows += n
+
+    def num_rows(self) -> int:
+        return self._rows
+
+    def take(self, n: int) -> Block:
+        """Pop the next ``n`` rows (fewer if the buffer runs dry)."""
+        parts: List[Block] = []
+        need = n
+        while need > 0 and self._q:
+            acc, off = self._q[0]
+            avail = acc.num_rows() - off
+            step = min(avail, need)
+            parts.append(acc.slice(off, off + step))
+            self.rows_sliced += step
+            if step == avail:
+                self._q.popleft()
+            else:
+                self._q[0][1] = off + step
+            need -= step
+        self._rows -= n - need
+        if len(parts) == 1:
+            return parts[0]
+        self.concat_ops += 1
+        return concat_blocks(parts)
+
+    def take_all(self) -> Block:
+        return self.take(self._rows)
+
+
+def _windowed_blocks(refs: Iterator[Any], window: int) -> Iterator[Block]:
+    """Yield blocks in order while keeping ``window`` refs in flight:
+    ``ray_tpu.wait(timeout=0, fetch_local=True)`` kicks background pulls
+    for buffered refs, so remote block transfer overlaps consumption
+    instead of serializing one blocking get per block. Pulling refs
+    ahead also drives the streaming executor ahead. A ref leaves the
+    prefetch set once a wait confirms it ready (its pull is in flight or
+    done — no re-checking); refs still PENDING at window entry (live
+    streaming pipelines) are re-waited each step so their pull starts
+    as soon as the producing task completes."""
+    window = max(1, window)
+    buf: deque = deque()
+    unconfirmed: set = set()  # buffered refs not yet confirmed by a wait
+    exhausted = False
+    while True:
+        while not exhausted and len(buf) < window:
+            try:
+                ref = next(refs)
+            except StopIteration:
+                exhausted = True
+                break
+            buf.append(ref)
+            if window > 1:
+                unconfirmed.add(ref)
+        if unconfirmed:
+            try:
+                pending = [r for r in buf if r in unconfirmed]
+                ready, _ = ray_tpu.wait(pending, num_returns=len(pending),
+                                        timeout=0, fetch_local=True)
+                unconfirmed.difference_update(ready)
+            except Exception:
+                unconfirmed.clear()  # best-effort; get() below is the truth
+        if not buf:
+            return
+        head = buf.popleft()
+        unconfirmed.discard(head)
+        yield ray_tpu.get(head)
 
 
 class DataIterator:
@@ -31,9 +126,8 @@ class DataIterator:
     def iter_block_refs(self) -> Iterator[Any]:
         return self._source_fn()
 
-    def iter_blocks(self) -> Iterator[Block]:
-        for ref in self._source_fn():
-            yield ray_tpu.get(ref)
+    def iter_blocks(self, *, prefetch_blocks: int = 2) -> Iterator[Block]:
+        return _windowed_blocks(self._source_fn(), 1 + max(0, prefetch_blocks))
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self.iter_blocks():
@@ -51,46 +145,43 @@ class DataIterator:
         prefetch_batches: int = 1,
     ) -> Iterator[Any]:
         def gen():
-            carry: List[Block] = []
-            carry_rows = 0
+            buf = BlockBuffer()
             shuffle_rng = (np.random.RandomState(local_shuffle_seed)
                            if local_shuffle_buffer_size else None)
             min_buf = local_shuffle_buffer_size or 0
-            for block in self.iter_blocks():
-                n = BlockAccessor.for_block(block).num_rows()
-                if n == 0:
-                    continue
-                carry.append(block)
-                carry_rows += n
-                threshold = max(batch_size or 1, min_buf)
-                while carry_rows >= threshold and (batch_size or carry_rows):
-                    merged = concat_blocks(carry)
-                    acc = BlockAccessor.for_block(merged)
-                    if shuffle_rng is not None:
-                        merged = acc.take_indices(
-                            shuffle_rng.permutation(
-                                acc.num_rows()).tolist())
-                        acc = BlockAccessor.for_block(merged)
-                    bs = batch_size or acc.num_rows()
-                    out = acc.slice(0, bs)
-                    rest = acc.slice(bs, acc.num_rows())
-                    carry = [rest]
-                    carry_rows = BlockAccessor.for_block(rest).num_rows()
-                    yield BlockAccessor.for_block(out).to_batch(batch_format)
-            if carry_rows:
-                merged = concat_blocks(carry)
+            threshold = max(batch_size or 1, min_buf)
+
+            def reshuffle():
+                """Merge + permute the buffered rows; called once per
+                REFILL (new blocks since the last permute), not once per
+                batch, so per-batch cost stays bounded by the buffer
+                size, never the stream length."""
+                merged = buf.take_all()
                 acc = BlockAccessor.for_block(merged)
-                if shuffle_rng is not None:
-                    merged = acc.take_indices(
-                        shuffle_rng.permutation(acc.num_rows()).tolist())
-                    acc = BlockAccessor.for_block(merged)
-                bs = batch_size or acc.num_rows()
-                for start in range(0, acc.num_rows(), bs):
-                    end = min(start + bs, acc.num_rows())
-                    if drop_last and end - start < bs:
-                        break
-                    yield BlockAccessor.for_block(
-                        acc.slice(start, end)).to_batch(batch_format)
+                buf.add_block(acc.take_indices(
+                    shuffle_rng.permutation(acc.num_rows()).tolist()))
+
+            window = 1 + max(0, prefetch_batches)
+            unshuffled = False
+            for block in _windowed_blocks(self._source_fn(), window):
+                buf.add_block(block)
+                unshuffled = True
+                while buf.num_rows() >= threshold:
+                    bs = batch_size or buf.num_rows()
+                    if shuffle_rng is not None and unshuffled:
+                        reshuffle()
+                        unshuffled = False
+                    out = buf.take(bs)
+                    yield BlockAccessor.for_block(out).to_batch(batch_format)
+            # stream end: drain the remainder
+            if shuffle_rng is not None and buf.num_rows() and unshuffled:
+                reshuffle()
+            bs = batch_size or buf.num_rows()
+            while buf.num_rows():
+                if buf.num_rows() < bs and drop_last:
+                    break
+                out = buf.take(min(bs, buf.num_rows()))
+                yield BlockAccessor.for_block(out).to_batch(batch_format)
 
         if prefetch_batches and prefetch_batches > 0:
             return _prefetch(gen(), prefetch_batches)
@@ -109,8 +200,10 @@ class DataIterator:
     ) -> Iterator[Dict[str, Any]]:
         """Yield dict-of-jax.Array batches placed on device.
 
-        Double-buffered H2D: the prefetch thread materializes numpy batches
-        while the device consumes the current one (SURVEY.md §7.6).
+        Double-buffered H2D: batch N+1's ``jax.device_put`` is issued
+        before batch N is handed to the consumer (dispatch is async), so
+        host-side rechunk/transfer overlaps device compute on the
+        current batch (SURVEY.md §7.6 / tf.data prefetch-to-device).
         """
         import jax
 
@@ -125,11 +218,17 @@ class DataIterator:
                         for k, v in batch.items()}
             return {k: jax.device_put(v) for k, v in batch.items()}
 
+        pending = None
         for batch in self.iter_batches(
                 batch_size=batch_size, batch_format="numpy",
                 drop_last=drop_last, prefetch_batches=prefetch_batches,
                 local_shuffle_buffer_size=local_shuffle_buffer_size):
-            yield place(batch)
+            placed = place(batch)
+            if pending is not None:
+                yield pending
+            pending = placed
+        if pending is not None:
+            yield pending
 
     def iter_torch_batches(
         self,

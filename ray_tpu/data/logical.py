@@ -20,6 +20,12 @@ class LogicalOperator:
         self.name = name
         self.inputs = inputs
 
+    def fusable(self) -> bool:
+        """Can this op run as one stage of a fused task chain? (The
+        planner's rewrite pass — executor._plan_fusion_chains — also
+        requires the chain to be linear: sole consumer per link.)"""
+        return False
+
     def __repr__(self):
         return f"{self.name}({', '.join(i.name for i in self.inputs)})"
 
@@ -73,6 +79,11 @@ class AbstractMap(LogicalOperator):
         self.num_tpus = num_tpus
         self.concurrency = concurrency
 
+    def fusable(self) -> bool:
+        # actor-pool compute keeps its own operator: the pool IS the
+        # execution resource, fusing would strand it
+        return self.compute.kind == "tasks"
+
 
 class MapBatches(AbstractMap):
     def __init__(self, input_op, fn, *, batch_size: Optional[int] = None,
@@ -108,6 +119,9 @@ class Project(LogicalOperator):
         self.select = select
         self.drop = drop
         self.rename = rename
+
+    def fusable(self) -> bool:
+        return True
 
 
 class Repartition(LogicalOperator):

@@ -1,5 +1,6 @@
 """Regression tests for the round-1 advisor findings (ADVICE.md)."""
 
+import builtins
 import json
 import os
 
@@ -28,12 +29,25 @@ def test_streaming_split_equal_rows(ray_start_regular):
     assert counts[0] > 0
 
 
-def test_streaming_split_locality_hints_warns(ray_start_regular):
+def test_streaming_split_locality_hints_honored_quietly(ray_start_regular):
+    """locality_hints is a real knob now (PR 4): accepted without warning
+    and all rows still arrive exactly once."""
+    import warnings
+
     import ray_tpu.data as rdata
 
     ds = rdata.from_items([{"x": i} for i in range(10)])
-    with pytest.warns(UserWarning, match="locality_hints"):
-        ds.streaming_split(2, locality_hints=["a", "b"])
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        splits = ds.streaming_split(2, locality_hints=["a", "b"])
+    got = []
+    for it in splits:
+        for batch in it.iter_batches(batch_size=4):
+            got.extend(batch["x"])
+    assert sorted(got) == list(builtins.range(10))
+
+    with pytest.raises(ValueError, match="locality_hints"):
+        ds.streaming_split(2, locality_hints=["a"])
 
 
 def test_random_sample_deterministic_across_processes(ray_start_regular):
