@@ -9,6 +9,7 @@ stop/list``, ``ray list tasks|actors|nodes``). Commands:
     submit  submit a job entrypoint to a head's dashboard
     job     status|logs|stop|list against a dashboard address
     list    tasks|actors|nodes|objects|placement_groups via dashboard
+    memory  cluster memory/object ownership table (`ray memory` analog)
 """
 
 from __future__ import annotations
@@ -104,6 +105,42 @@ def _cmd_list(args) -> int:
     return 0
 
 
+def _cmd_memory(args) -> int:
+    """Render the cluster memory table from the dashboard /api/memory —
+    the same grouped numbers util.state.memory_summary returns."""
+    import urllib.request
+
+    base = args.address
+    if not base.startswith("http"):
+        base = "http://" + base
+    url = f"{base}/api/memory?group_by={args.group_by}&limit={args.limit}"
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        data = json.loads(resp.read().decode())
+    if "error" in data:
+        print(data["error"], file=sys.stderr)
+        return 1
+    groups = data.get("groups", [])
+    totals = data.get("totals", {})
+    col = {"callsite": "CALLSITE", "node": "NODE",
+           "task": "TASK"}.get(args.group_by, args.group_by.upper())
+    widths = max([len(col)] + [len(str(g["group"])) for g in groups])
+    header = (f"{col:<{widths}}  {'OBJECTS':>8}  {'BYTES':>14}  "
+              f"{'LOCAL':>6}  {'BORROW':>6}  {'PINNED':>6}  {'SPILLED':>7}")
+    print(header)
+    print("-" * len(header))
+    for g in groups:
+        print(f"{str(g['group']):<{widths}}  {g['objects']:>8}  "
+              f"{g['bytes']:>14}  {g['local_refs']:>6}  {g['borrows']:>6}  "
+              f"{g['pinned']:>6}  {g['spilled_objects']:>7}")
+    print("-" * len(header))
+    print(f"total: {totals.get('objects', 0)} objects, "
+          f"{totals.get('bytes', 0)} bytes "
+          f"(inline {totals.get('inline_bytes', 0)}, "
+          f"arena {totals.get('arena_bytes', 0)}, "
+          f"spilled {totals.get('spilled_bytes', 0)})")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m ray_tpu")
     sub = p.add_subparsers(dest="cmd")
@@ -143,6 +180,13 @@ def main(argv=None) -> int:
     ls.add_argument("--address", default="http://127.0.0.1:8265")
     ls.add_argument("--limit", type=int, default=100)
 
+    mem = sub.add_parser("memory",
+                         help="cluster memory/object ownership table")
+    mem.add_argument("--address", default="http://127.0.0.1:8265")
+    mem.add_argument("--group-by", choices=["callsite", "node", "task"],
+                     default="callsite", dest="group_by")
+    mem.add_argument("--limit", type=int, default=50)
+
     up = sub.add_parser("up", help="launch a cluster from a YAML spec")
     up.add_argument("config", help="cluster YAML path")
     dn = sub.add_parser("down", help="tear down a launched cluster")
@@ -177,6 +221,8 @@ def main(argv=None) -> int:
         if args.kind == "jobs":
             args.kind = "jobs/"
         return _cmd_list(args)
+    if args.cmd == "memory":
+        return _cmd_memory(args)
     if args.cmd == "up":
         from ray_tpu.cluster_launcher import up as _up
 

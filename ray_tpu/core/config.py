@@ -34,6 +34,9 @@ class Config:
     object_spilling_dir: str = ""  # defaults to session dir /spill
     min_spilling_size: int = 1 * 1024 * 1024
     max_io_workers: int = 4
+    # arena usage fraction past which the store emits a WARNING cluster
+    # event naming the top consumers by creation callsite (<= 0 disables)
+    object_store_high_watermark: float = 0.8
 
     # ---- object data plane (node-to-node transfer; object_transfer.py) ----
     # pooled, reusable authenticated connections per peer object server
@@ -132,6 +135,18 @@ class Config:
     # per-process JAX/TPU device telemetry (HBM gauges + jax.monitoring)
     device_telemetry_enabled: bool = True
     device_telemetry_interval_ms: int = 10_000
+    # object/memory observability (core/ref_tracker.py): per-process
+    # ObjectRef accounting joined head-side into the `ray memory` analog
+    # (util/state.memory_summary, /api/memory). The kill switch exists so
+    # bench_objects.py --check can measure the accounting's own cost.
+    ref_accounting_enabled: bool = True
+    # capture creator callsites (file:line:function) at ref creation —
+    # a sys._getframe walk per put/submit, so opt-in (the `ray memory`
+    # RAY_record_ref_creation_sites analog)
+    record_ref_creation_sites: bool = False
+    # worker -> head ref-table report cadence (rides the worker channel
+    # one-way, same shape as the metrics report)
+    ref_report_interval_ms: int = 1000
     # serve request-path observability: request ids + per-stage latency
     # histograms + JSONL access logs + slow-request events (serve/
     # observability.py). One switch for the whole layer so the bench can

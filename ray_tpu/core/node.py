@@ -1129,6 +1129,14 @@ class Node:
                     self.head.record_cluster_events(payload[0])
                 except Exception:
                     pass
+            elif tag == "refs":
+                # worker ref-table report -> head ownership table; the
+                # node stamps the source id (same keying as metrics)
+                try:
+                    self.head.on_ref_report(f"{self.hex[:6]}:{w.pid}",
+                                            payload[0])
+                except Exception:
+                    pass
             elif tag == "unstaged":
                 # worker handed back a staged-unstarted task: requeue it
                 tid = payload[0]

@@ -132,6 +132,11 @@ class RemoteHead:
                 threading.Thread(
                     target=self.node.push_object_to, args=(oid, targets),
                     daemon=True, name="bcast-root").start()
+            elif tag == "store_info":
+                # head asks for this node's store dump (memory_table):
+                # bounded, read-only, replied one-way
+                self._send("store_info_rep", payload[0],
+                           self.node.store.object_infos())
             elif tag == "ping":
                 # health probe (reference: gcs_health_check_manager.h) —
                 # answered from the handler pool, so a wedged daemon
@@ -186,6 +191,9 @@ class RemoteHead:
 
     def record_cluster_events(self, events: list) -> None:
         self._send("cevents", events)
+
+    def on_ref_report(self, source_id: str, table: dict) -> None:
+        self._send("refs", source_id, table)
 
     def on_worker_log(self, node_hex: str, pid: int, text: str) -> None:
         self._send("worker_log", node_hex, pid, text)

@@ -13,7 +13,7 @@ import collections
 import threading
 from typing import Optional
 
-from . import serialization
+from . import ref_tracker, serialization
 from .ids import ObjectID
 
 # Installed by the runtime (driver api or worker runtime) so that refs can
@@ -156,7 +156,11 @@ class ObjectRef:
 
 
 def _deserialize_ref(oid: ObjectID, owner_node):
-    return ObjectRef(oid, owner_node)
+    ref = ObjectRef(oid, owner_node)
+    # a deserialized handle is a BORROW: this process holds but does not
+    # own it (reference: reference_count.h borrower bookkeeping)
+    ref_tracker.note_borrow(oid)
+    return ref
 
 
 class ObjectRefGenerator:
@@ -185,7 +189,9 @@ class ObjectRefGenerator:
             kind = rep[0]
             if kind == "item":
                 self._i += 1
-                return ObjectRef(rep[1])
+                ref = ObjectRef(rep[1])
+                ref_tracker.annotate(rep[1], ref_tracker.KIND_STREAM_ITEM)
+                return ref
             if kind == "end":
                 raise StopIteration
             if kind == "error":

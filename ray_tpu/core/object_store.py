@@ -53,6 +53,48 @@ def _count_put(nbytes: int) -> None:
     m[1].inc(nbytes)
 
 
+# Store-pressure telemetry (the `ray memory` store half): lazily-created
+# counter bundle shared by every store in the process, tagged {node}.
+# Same one-shot atomic publish discipline as _m_store_put above.
+_m_store_tel = None
+
+
+def _telemetry():
+    global _m_store_tel
+    m = _m_store_tel
+    if m is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        m = {
+            "spilled": Counter("ray_tpu_object_store_spilled_objects_total",
+                               "Objects spilled to disk under pressure"),
+            "spilled_bytes": Counter(
+                "ray_tpu_object_store_spilled_bytes_total",
+                "Bytes spilled to disk under pressure"),
+            "restored": Counter(
+                "ray_tpu_object_store_restored_objects_total",
+                "Spilled objects restored into the arena"),
+            "restored_bytes": Counter(
+                "ray_tpu_object_store_restored_bytes_total",
+                "Bytes restored from spill files"),
+            "evicted": Counter("ray_tpu_object_store_evicted_objects_total",
+                               "Unreferenced objects evicted under pressure"),
+            "evicted_bytes": Counter(
+                "ray_tpu_object_store_evicted_bytes_total",
+                "Bytes evicted under pressure"),
+            "used": Gauge("ray_tpu_object_store_bytes_used",
+                          "Arena bytes allocated"),
+            "free": Gauge("ray_tpu_object_store_bytes_free",
+                          "Arena bytes free"),
+            "inline": Gauge("ray_tpu_object_store_inline_bytes",
+                            "Bytes held inline in the memory store"),
+            "frag": Gauge("ray_tpu_object_store_fragmentation_ratio",
+                          "1 - largest free extent / total free bytes"),
+        }
+        _m_store_tel = m
+    return m
+
+
 # --------------------------------------------------------------------------- #
 # Allocator
 # --------------------------------------------------------------------------- #
@@ -98,6 +140,15 @@ class FreeListAllocator:
     def bytes_allocated(self) -> int:
         with self._lock:
             return sum(self._allocated.values())
+
+    def free_stats(self) -> Tuple[int, int, int]:
+        """(free_bytes, free_extents, largest_free_extent) — the
+        fragmentation signal behind the store gauges."""
+        with self._lock:
+            if not self._free:
+                return (0, 0, 0)
+            sizes = [sz for _off, sz in self._free]
+            return (sum(sizes), len(sizes), max(sizes))
 
 
 def _make_allocator(capacity: int):
@@ -189,6 +240,7 @@ class ObjectEntry:
     # scoped — delete() during a send defers the free to the last release
     readers: int = 0
     pending_free: bool = False  # deleted while readers > 0
+    created_ts: float = field(default_factory=time.time)  # wall-clock age
 
 
 class LocalObjectStore:
@@ -213,6 +265,100 @@ class LocalObjectStore:
         self._entries: Dict[ObjectID, ObjectEntry] = {}
         self._lock = threading.RLock()
         self._sealed_cv = threading.Condition(self._lock)
+        # telemetry state: one tag set per node, gauges rate-limited (the
+        # put hot path must not pay a registry write per call)
+        self._tag_key = (("node", node_hex[:12]),)
+        self._inline_bytes = 0
+        self._counters = {"spilled": 0, "spilled_bytes": 0, "restored": 0,
+                          "restored_bytes": 0, "evicted": 0,
+                          "evicted_bytes": 0}
+        self._gauges_last = 0.0
+        # high-watermark edge detector (+ periodic re-emit while above)
+        self._above_watermark = False
+        self._watermark_last_emit = 0.0
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _publish_gauges(self, force: bool = False) -> None:
+        """Refresh the store gauges, at most every 0.5 s (mutation sites
+        call this opportunistically; the metrics sampler reads gauges on
+        its own cadence, so sub-second staleness is invisible)."""
+        now = time.monotonic()
+        if not force and now - self._gauges_last < 0.5:
+            return
+        self._gauges_last = now
+        try:
+            m = _telemetry()
+            alloc = self.arena.allocator
+            used = alloc.bytes_allocated() if alloc is not None else 0
+            tk = self._tag_key
+            m["used"].set(float(used), tag_key=tk)
+            m["free"].set(float(self.capacity - used), tag_key=tk)
+            m["inline"].set(float(self._inline_bytes), tag_key=tk)
+            free_stats = getattr(alloc, "free_stats", None)
+            if free_stats is not None:
+                total, _n, largest = free_stats()
+                frag = (1.0 - largest / total) if total else 0.0
+                m["frag"].set(frag, tag_key=tk)
+        except Exception:
+            pass  # metrics must never break the store
+
+    def _count(self, key: str, n: int, nbytes: int) -> None:
+        """Record a spill/restore/evict increment (rare path)."""
+        with self._lock:  # RLock: callers may already hold it
+            self._counters[key] += n
+            self._counters[key + "_bytes"] += nbytes
+        try:
+            m = _telemetry()
+            m[key].inc(float(n), tag_key=self._tag_key)
+            m[key + "_bytes"].inc(float(nbytes), tag_key=self._tag_key)
+        except Exception:
+            pass
+
+    def _check_watermark(self) -> None:
+        """Emit a WARNING cluster event when arena usage crosses the high
+        watermark, naming the top consumers by creation callsite (the
+        owner-side ref tracker knows who minted each object). Edge-
+        triggered, with a 30 s re-emit while the store stays above."""
+        cfg = global_config()
+        wm = cfg.object_store_high_watermark
+        if wm <= 0 or self.arena.allocator is None:
+            return
+        used = self.arena.allocator.bytes_allocated()
+        above = used >= wm * self.capacity
+        now = time.monotonic()
+        if not above:
+            self._above_watermark = False
+            return
+        if self._above_watermark and now - self._watermark_last_emit < 30.0:
+            return
+        self._above_watermark = True
+        self._watermark_last_emit = now
+        with self._lock:
+            top = sorted((e for e in self._entries.values()
+                          if e.offset >= 0 and e.spilled_path is None),
+                         key=lambda e: -e.size)[:5]
+            top = [(e.object_id, e.size) for e in top]
+        from ray_tpu.core import ref_tracker
+        from ray_tpu.util import events as events_mod
+
+        consumers = []
+        for oid, size in top:
+            info = ref_tracker.lookup(oid)
+            consumers.append({
+                "object_id": oid.hex(), "bytes": size,
+                "callsite": (info[3] if info and info[3] else "<unknown>"),
+                "kind": (info[1] if info else None),
+            })
+        names = ", ".join(f"{c['callsite']}={c['bytes']}B"
+                          for c in consumers) or "none"
+        events_mod.emit(
+            "WARNING", events_mod.SOURCE_OBJECT_STORE,
+            f"object store at {100.0 * used / self.capacity:.0f}% of "
+            f"capacity ({used}/{self.capacity} bytes); top consumers: "
+            f"{names}", entity_id=self.arena_path,
+            used=used, capacity=self.capacity, watermark=wm,
+            top_consumers=consumers)
 
     # -- creation ----------------------------------------------------------
 
@@ -222,13 +368,17 @@ class LocalObjectStore:
             e = self._entries.get(oid)
             if e is not None and e.sealed:
                 return  # idempotent re-put (retries)
+            if e is not None and e.inline is not None:
+                self._inline_bytes -= len(e.inline)
             self._entries[oid] = ObjectEntry(
                 oid, size=len(payload), inline=bytes(payload), sealed=True,
                 is_error=is_error,
             )
+            self._inline_bytes += len(payload)
             self._sealed_cv.notify_all()
         if not transfer:
             _count_put(len(payload))
+        self._publish_gauges()
 
     def create(self, oid: ObjectID, size: int,
                transfer: bool = False) -> Tuple[int, memoryview]:
@@ -263,6 +413,8 @@ class LocalObjectStore:
             self._entries[oid] = ObjectEntry(oid, size=size, offset=off, creating=True)
         if not transfer:
             _count_put(size)
+        self._publish_gauges()
+        self._check_watermark()
         return off, self.arena.view(off, size)
 
     def seal(self, oid: ObjectID, is_error: bool = False):
@@ -402,6 +554,8 @@ class LocalObjectStore:
             e = self._entries.pop(oid, None)
             if e is None:
                 return
+            if e.inline is not None:
+                self._inline_bytes -= len(e.inline)
             # plasma lifetime contract: an extent whose zero-copy view was
             # handed out (mapped) is NEVER returned to the allocator — a
             # reader's array may still alias it, and reuse would silently
@@ -420,15 +574,31 @@ class LocalObjectStore:
                     os.unlink(e.spilled_path)
                 except OSError:
                     pass
+        self._publish_gauges()
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
-            return {
+            spilled = [e for e in self._entries.values() if e.spilled_path]
+            out = {
                 "num_objects": len(self._entries),
                 "bytes_allocated": self.arena.allocator.bytes_allocated(),
                 "capacity": self.capacity,
-                "num_spilled": sum(1 for e in self._entries.values() if e.spilled_path),
+                "num_spilled": len(spilled),
+                "bytes_inline": self._inline_bytes,
+                "bytes_spilled": sum(e.size for e in spilled),
             }
+            out.update(self._counters)
+        return out
+
+    def object_infos(self) -> List[Tuple[ObjectID, int, bool, bool, float,
+                                         int]]:
+        """Per-object store dump for the cluster memory table
+        (``Head.memory_table``): (oid, size, inline?, spilled?,
+        created_ts, store_ref_count) for every sealed entry."""
+        with self._lock:
+            return [(e.object_id, e.size, e.inline is not None,
+                     e.spilled_path is not None, e.created_ts, e.ref_count)
+                    for e in self._entries.values() if e.sealed]
 
     # -- spilling / eviction ----------------------------------------------
 
@@ -469,6 +639,11 @@ class LocalObjectStore:
                     spilled += 1
                     spilled_bytes += e.size
             ok = freed > 0 or freed >= need
+        if evicted:
+            self._count("evicted", evicted, evicted_bytes)
+        if spilled:
+            self._count("spilled", spilled, spilled_bytes)
+        self._publish_gauges(force=True)
         self._emit_pressure_events(evicted, evicted_bytes, spilled,
                                    spilled_bytes)
         return ok
@@ -536,6 +711,10 @@ class LocalObjectStore:
             pass
         e.spilled_path = None
         e.offset = off
+        self._count("restored", 1, e.size)
+        # a restore allocates like a create does — a read-heavy workload
+        # can cross the watermark with no create() in sight
+        self._check_watermark()
 
     def close(self):
         self.arena.close(unlink=True)

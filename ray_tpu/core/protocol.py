@@ -35,10 +35,11 @@ from typing import Any, Callable, Dict, Optional, Tuple
 # tags/payload shapes — mixed-version clusters fail fast with a clear
 # error instead of unpickling garbage (the pickle-schema analog of the
 # reference's versioned protobuf wire format, src/ray/protobuf/).
-PROTOCOL_VERSION = 4  # v4: pooled multi-request object-transfer
-# connections with stat/pullr (range) ops + arena-direct framing.
-# (v3: ddone/pdone carry exec_hex; dpin/pin_delta; owner-resolved
-# ref args — arg_hints in TaskSpec)
+PROTOCOL_VERSION = 5  # v5: memory observability — worker/daemon "refs"
+# ref-table reports + head->daemon store_info/store_info_rep round-trip.
+# (v4: pooled multi-request object-transfer connections with stat/pullr
+# (range) ops + arena-direct framing. v3: ddone/pdone carry exec_hex;
+# dpin/pin_delta; owner-resolved ref args — arg_hints in TaskSpec)
 
 
 class ProtocolVersionError(ConnectionError):

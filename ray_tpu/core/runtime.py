@@ -27,7 +27,7 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
-from . import serialization
+from . import ref_tracker, serialization
 from .config import global_config
 from .exceptions import (
     ActorDiedError,
@@ -216,6 +216,17 @@ class Head:
         self.direct_recover: Optional[Callable[[ObjectID], bool]] = None
         # fetch_local pulls in flight (dedup across concurrent waits)
         self._active_pulls: Set[ObjectID] = set()
+        # memory observability: per-source worker ref-table reports
+        # (source id = "<node6>:<pid>", same keying as worker metrics)
+        # and pending head->daemon store_info requests
+        self._ref_reports: Dict[str, dict] = {}
+        self._store_info_seq = 0
+        self._store_info_pending: Dict[int, list] = {}
+        # (monotonic_ts, rows) — memory_table joins are cached briefly so
+        # a dashboard polling /api/objects doesn't pay a store_info
+        # round-trip to every daemon per request
+        self._memory_table_cache: Tuple[float, Optional[List[dict]]] = \
+            (0.0, None)
         # head node (the driver's node)
         self.head_node = self.add_node(resources, labels=labels)
         if global_config().task_record_ttl_s > 0:
@@ -234,6 +245,125 @@ class Head:
             self.gcs.record_cluster_event(ev)
         if self._event_writer is not None:
             self._event_writer.write(events)
+
+    def on_ref_report(self, source_id: str, table: dict) -> None:
+        """Absorb one process's ref-table export (full state per source,
+        so re-reports overwrite — mirror of on_worker_metrics)."""
+        with self._lock:
+            self._ref_reports[source_id] = table
+
+    def collect_store_infos(self, timeout: float = 1.0) -> Dict[str, list]:
+        """Per-node store dumps: local nodes by direct call, daemons via
+        a bounded ``store_info`` round-trip over the control channel.
+        Returns {node_hex: [(oid, size, inline, spilled, created_ts,
+        store_ref_count)]}; unreachable/slow daemons are simply absent."""
+        out: Dict[str, list] = {}
+        waiters = []
+        with self._lock:
+            nodes = list(self.nodes.items())
+        for h, n in nodes:
+            if self._is_local(n):
+                out[h] = n.store.object_infos()
+            elif getattr(n, "alive", False):
+                with self._lock:
+                    self._store_info_seq += 1
+                    req_id = self._store_info_seq
+                    slot = [threading.Event(), None]
+                    self._store_info_pending[req_id] = slot
+                if n._send("store_info", req_id):
+                    waiters.append((h, req_id, slot))
+                else:
+                    self._store_info_pending.pop(req_id, None)
+        deadline = time.monotonic() + timeout
+        for h, req_id, slot in waiters:
+            slot[0].wait(max(0.0, deadline - time.monotonic()))
+            self._store_info_pending.pop(req_id, None)
+            if slot[1] is not None:
+                out[h] = slot[1]
+        return out
+
+    def memory_table(self, limit: int = 100_000,
+                     timeout: float = 1.0) -> List[dict]:
+        """The cluster ownership table (the ``ray memory`` backend): joins
+        the object directory + per-node store dumps (bytes, spill state)
+        with the owner-side ref tables (creator callsite/kind, local-ref
+        and borrow counts) — driver's table read in-process, workers' from
+        their periodic ``refs`` reports. Joins are cached for 1 s (rows
+        are copied out, so callers may mutate them)."""
+        cache_ts, cached = self._memory_table_cache
+        if cached is not None and time.monotonic() - cache_ts < 1.0:
+            return [dict(r) for r in cached[:limit]]
+        store_infos = self.collect_store_infos(timeout)
+        tables = [ref_tracker.export()]  # this (driver) process
+        with self._lock:
+            tables.extend(self._ref_reports.values())
+            pins = {oid: n for oid, n in self.ref_counts.items() if n > 0}
+        now = time.time()
+        rows: Dict[ObjectID, dict] = {}
+
+        def row(oid: ObjectID) -> dict:
+            r = rows.get(oid)
+            if r is None:
+                r = rows[oid] = {
+                    "object_id": oid.hex(), "size": None, "locations": [],
+                    "inline": False, "spilled": False,
+                    "pinned": pins.get(oid, 0),
+                    "local_refs": 0, "borrows": 0,
+                    # set from the owner-side kind below (the id's index
+                    # bits are random garbage for from_random puts, so
+                    # they can't be trusted as a stream marker)
+                    "stream": False,
+                    "kind": None, "callsite": None, "creator": None,
+                    "age_s": None,
+                }
+            return r
+
+        for node_hex, infos in store_infos.items():
+            for oid, size, inline, spilled, created_ts, _rc in infos:
+                r = row(oid)
+                r["locations"].append(node_hex)
+                r["size"] = max(r["size"] or 0, size)
+                r["inline"] = r["inline"] or inline
+                r["spilled"] = r["spilled"] or spilled
+                if r["age_s"] is None:
+                    r["age_s"] = round(max(0.0, now - created_ts), 3)
+        for table in tables:
+            for oid, entry in table.items():
+                count, kind, size, callsite, creator, created_at = entry
+                r = row(oid)
+                if kind == ref_tracker.KIND_BORROW:
+                    r["borrows"] += count
+                else:
+                    r["local_refs"] += count
+                    if r["kind"] is None:
+                        r["kind"] = kind
+                    if kind == ref_tracker.KIND_STREAM_ITEM:
+                        r["stream"] = True
+                    if r["callsite"] is None and callsite:
+                        r["callsite"] = callsite
+                    if r["creator"] is None and creator:
+                        r["creator"] = creator
+                if r["size"] is None and size:
+                    r["size"] = int(size)
+                if r["age_s"] is None and created_at:
+                    r["age_s"] = round(max(0.0, now - created_at), 3)
+        # directory-known objects the store dumps missed (e.g. a daemon
+        # that timed out, or an object whose handles were all dropped):
+        # every directory entry gets a row, so the table never under-
+        # reports just because a node was slow to answer store_info
+        with self._lock:
+            node_set = set(self.nodes)
+        with self.gcs._lock:
+            dir_snap = {oid: set(locs)
+                        for oid, locs in self.gcs.object_dir.items()}
+        for oid, locs in dir_snap.items():
+            r = row(oid)
+            for h in locs:
+                if h in node_set and h not in r["locations"]:
+                    r["locations"].append(h)
+        out = list(rows.values())
+        self._memory_table_cache = (time.monotonic(), out)
+        return [dict(r) for r in out[:limit]]
 
     def sample_metrics_history(self) -> None:
         """Take one sample of every metric series now (the loop calls this
@@ -652,6 +782,14 @@ class Head:
                 self.publish_direct_events(proxy.hex, payload[0])
             elif tag == "cevents":
                 self.record_cluster_events(payload[0])
+            elif tag == "refs":
+                self.on_ref_report(payload[0], payload[1])
+            elif tag == "store_info_rep":
+                req_id, infos = payload
+                slot = self._store_info_pending.get(req_id)
+                if slot is not None:
+                    slot[1] = infos
+                    slot[0].set()
             elif tag == "sealed_payload":
                 self.on_sealed_payload(*payload)
             elif tag == "pin_delta":
@@ -1288,6 +1426,8 @@ class Head:
         from ray_tpu.util.metrics import registry
 
         registry().retire(f"{node.hex[:6]}:{w.pid}")
+        with self._lock:
+            self._ref_reports.pop(f"{node.hex[:6]}:{w.pid}", None)
 
     def on_worker_exit(self, node: Node, w: WorkerHandle) -> None:
         """Graceful actor termination (__ray_terminate__)."""
@@ -1381,11 +1521,26 @@ class Head:
                 "load": self.node_loads.get(n.hex),
             } for n in list(gcs.nodes.values())[:limit]]
         if kind == "objects":
-            with self._lock:
-                items = list(gcs.object_dir.items())[:limit]
-            return [{"object_id": oid.hex(), "locations": sorted(locs),
-                     "ref_count": self.ref_counts.get(oid, 0)}
-                    for oid, locs in items]
+            # rewritten rows (the `ray list objects` analog): size, owner,
+            # age, ref-type counts, spilled flag — from the joined
+            # ownership table, with the legacy ref_count field kept
+            rows = self.memory_table(limit=limit, timeout=0.5)
+            for r in rows:
+                r["locations"] = sorted(r["locations"])
+                r["owner"] = r.pop("creator", None) or "driver"
+                r["ref_count"] = r["pinned"]
+            return rows
+        if kind == "memory":
+            return self.memory_table(limit=limit, timeout=1.5)
+        if kind == "task_events":
+            # FULL event log (not latest-state-only): worker/client
+            # drivers rebuild real durations from RUNNING->terminal pairs
+            # (util/timeline.py)
+            return [{
+                "task_id": ev.task_id.hex(), "name": ev.name,
+                "state": ev.state, "node_hex": ev.node_hex, "ts": ev.ts,
+                "attempt": ev.attempt, "error": ev.error,
+            } for ev in list(gcs.task_events)[-limit:]]
         if kind == "placement_groups":
             return [{"pg_id": pid.hex(), "state": pg.state,
                      "bundles": len(pg.bundles)}
@@ -1930,6 +2085,7 @@ class Head:
 
     def shutdown(self) -> None:
         self._stopped = True
+        ref_tracker.reset()  # driver-process entries die with the cluster
         from ray_tpu.util import events as events_mod
         from .object_transfer import close_pool
 
@@ -2032,7 +2188,10 @@ class DriverRuntime:
             node.store.seal(oid, False)
         self.head.on_object_sealed(oid, node.hex)
         # registered ref: +1 now, -1 when the ObjectRef is GC'd -> deletable
-        return ObjectRef(oid)
+        ref = ObjectRef(oid)
+        ref_tracker.annotate(oid, ref_tracker.KIND_PUT,
+                             size=sobj.total_bytes, creator="driver")
+        return ref
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -2115,7 +2274,13 @@ class DriverRuntime:
                 self._direct_submit(ready)
         else:
             self.head.submit_spec(spec)
-        return [ObjectRef(oid) for oid in spec.return_ids()]
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        ref_tracker.annotate_many(
+            spec.return_ids(),
+            ref_tracker.KIND_ACTOR_RETURN if spec.actor_id is not None
+            else ref_tracker.KIND_TASK_RETURN,
+            creator=spec.function_name)
+        return refs
 
     def register_function(self, function_id: str, payload: bytes) -> None:
         self.head.gcs.register_function(function_id, payload)
@@ -2167,10 +2332,12 @@ class DriverRuntime:
 
     # ---- refs ----
     def add_local_ref(self, oid: ObjectID) -> None:
+        ref_tracker.incref(oid)
         with self.head._lock:
             self.head.ref_counts[oid] += 1
 
     def remove_local_ref(self, oid: ObjectID) -> None:
+        ref_tracker.decref(oid)
         self.direct.drop(oid)
         with self.head._lock:
             self.head.ref_counts[oid] -= 1
@@ -2211,7 +2378,11 @@ class DriverRuntime:
         cfg = global_config()
         if (cfg.direct_task_enabled and cfg.direct_actor_enabled
                 and self.direct_actors.try_submit(spec)):
-            return [ObjectRef(oid) for oid in spec.return_ids()]
+            refs = [ObjectRef(oid) for oid in spec.return_ids()]
+            ref_tracker.annotate_many(spec.return_ids(),
+                                      ref_tracker.KIND_ACTOR_RETURN,
+                                      creator=spec.function_name)
+            return refs
         # direct path disabled by config (a whole-session toggle, so
         # every call to every actor takes the same path and per-caller
         # ordering is structural): head path

@@ -26,7 +26,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import object_ref as object_ref_mod
-from . import serialization
+from . import ref_tracker, serialization
 from .config import Config, set_global_config, global_config
 from .exceptions import ObjectLostError, TaskCancelledError, TaskError, GetTimeoutError
 from .ids import ActorID, JobID, ObjectID, TaskID
@@ -146,9 +146,14 @@ class WorkerRuntime:
             oid = ObjectID.for_put(tid, idx)
         else:
             oid = ObjectID.from_random()  # put outside a task context
-        self._store_object(oid, serialization.serialize(value), is_error=False)
+        sobj = serialization.serialize(value)
+        self._store_object(oid, sobj, is_error=False)
         self.rpc.call("rpc", "register_owned_object", oid)
-        return ObjectRef(oid)
+        ref = ObjectRef(oid)
+        ref_tracker.annotate(
+            oid, ref_tracker.KIND_PUT, size=sobj.total_bytes,
+            creator=getattr(self._current_task, "name", None) or "worker")
+        return ref
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -274,7 +279,13 @@ class WorkerRuntime:
                 self._direct_submit(ready)
         else:
             self.rpc.call("rpc", "submit_task", pickle.dumps(spec))
-        return [ObjectRef(oid) for oid in spec.return_ids()]
+        refs = [ObjectRef(oid) for oid in spec.return_ids()]
+        ref_tracker.annotate_many(
+            spec.return_ids(),
+            ref_tracker.KIND_ACTOR_RETURN if spec.actor_id is not None
+            else ref_tracker.KIND_TASK_RETURN,
+            creator=spec.function_name)
+        return refs
 
     def register_function(self, function_id: str, payload: bytes) -> None:
         self.rpc.call("rpc", "register_function", function_id, payload)
@@ -312,11 +323,14 @@ class WorkerRuntime:
     def next_task_id(self) -> TaskID:
         return TaskID.from_random()
 
-    # reference counting: workers batch releases to the owner (head)
+    # reference counting: workers batch releases to the owner (head);
+    # the local ref tracker still counts live handles so this process's
+    # local/borrow table exports to the cluster memory view
     def add_local_ref(self, oid: ObjectID) -> None:
-        pass  # head-side counting covers worker borrows conservatively
+        ref_tracker.incref(oid)
 
     def remove_local_ref(self, oid: ObjectID) -> None:
+        ref_tracker.decref(oid)
         self.direct.drop(oid)
 
     def add_borrow_ref(self, oid: ObjectID) -> None:
@@ -349,7 +363,11 @@ class WorkerRuntime:
         cfg = global_config()
         if (cfg.direct_task_enabled and cfg.direct_actor_enabled
                 and self.direct_actors.try_submit(spec)):
-            return [ObjectRef(oid) for oid in spec.return_ids()]
+            refs = [ObjectRef(oid) for oid in spec.return_ids()]
+            ref_tracker.annotate_many(spec.return_ids(),
+                                      ref_tracker.KIND_ACTOR_RETURN,
+                                      creator=spec.function_name)
+            return refs
         # direct path disabled by config (a whole-session toggle, so
         # every call to every actor takes the same path and per-caller
         # ordering is structural): head path
@@ -477,6 +495,7 @@ class WorkerRuntime:
             method = getattr(st.instance, fn_name)
             self._current_task.task_id = spec.task_id
             self._current_task.actor_id = spec.actor_id
+            self._current_task.name = spec.function_name
             result = await method(*args, **kwargs)
             self._finish(spec, result)
         except Exception as e:  # noqa: BLE001
@@ -486,6 +505,7 @@ class WorkerRuntime:
                 span_cm.__exit__(None, None, None)
             self._current_task.task_id = None
             self._current_task.actor_id = None
+            self._current_task.name = None
 
     def _start_compiled_exec(self, st: _ActorState, desc: dict) -> None:
         from ray_tpu.experimental.channel import (
@@ -634,6 +654,7 @@ class WorkerRuntime:
             args, kwargs = self._resolve_args(spec)
             self._current_task.task_id = spec.task_id
             self._current_task.actor_id = spec.actor_id
+            self._current_task.name = spec.function_name
             if spec.is_actor_creation:
                 cls = self.get_function(spec.function_id)
                 instance = cls(*args, **kwargs)
@@ -681,6 +702,7 @@ class WorkerRuntime:
             restore_env()
             self._current_task.task_id = None
             self._current_task.actor_id = None
+            self._current_task.name = None
 
     def _apply_accelerator_binding(self, binding: Dict[str, List[int]]) -> None:
         """Set accelerator visibility env vars before user code imports jax.
@@ -830,6 +852,11 @@ def worker_main(argv=None) -> None:
     start_report_thread(
         lambda snap: channel.send("metrics", snap),
         global_config().metrics_report_interval_ms / 1000.0)
+    # ref-table reports ride the same worker channel one-way ("refs");
+    # the node stamps this worker's source id and forwards to the head
+    ref_tracker.start_report(
+        lambda table: channel.send("refs", table),
+        global_config().ref_report_interval_ms / 1000.0)
     # cluster events ride the worker channel one-way ("cevents"), same
     # shape as the metrics report; the node forwards them to the head
     from ray_tpu.util import events as events_mod

@@ -18,6 +18,8 @@ control plane — with:
     GET  /api/serve             Serve deployment summary
     GET  /api/events?severity=&min_severity=&source=&limit=
                                 structured cluster event log
+    GET  /api/memory?group_by=callsite|node|task
+                                cluster memory/object ownership summary
     GET  /api/metrics/history?name=   sampled metric time-series rings
     GET  /api/pubsub?channel=&cursor=&timeout=   poll a pubsub channel
     GET  /api/nodes/<hex>/logs[/<name>]     per-node agent: log browse/tail
@@ -222,6 +224,25 @@ class DashboardServer:
                 if "source" in params else None,
                 min_severity=unquote(params["min_severity"])
                 if "min_severity" in params else None)[-limit:])
+        elif path == "/api/memory":
+            # cluster memory observability (`ray memory` analog): grouped
+            # ownership summary + totals + the raw top rows. Uses the
+            # same helpers as util.state.memory_summary, so the HTTP, CLI
+            # and Python surfaces all render identical numbers.
+            from ray_tpu.util.state import (group_memory_rows,
+                                            memory_totals)
+
+            gb = params.get("group_by", "callsite")
+            rows = self.head.memory_table()
+            try:
+                groups = group_memory_rows(rows, gb)
+            except ValueError as e:
+                h._json({"error": str(e)}, 400)
+                return
+            rows.sort(key=lambda r: -(r.get("size") or 0))
+            h._json({"group_by": gb, "groups": groups[:limit],
+                     "totals": memory_totals(rows),
+                     "objects": rows[:min(limit, 100)]})
         elif path == "/api/metrics/history":
             # sampled metric time-series: /api/metrics/history?name=
             # (no name -> the list of sampled series names)

@@ -38,6 +38,9 @@ def list_nodes(limit: int = 1000) -> List[Dict[str, Any]]:
 
 
 def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Per-object rows from the cluster ownership table (the ``ray list
+    objects`` analog): object_id, size, owner, age_s, locations,
+    local_refs / borrows / pinned counts, inline and spilled flags."""
     return _state_query("objects", limit)
 
 
@@ -78,9 +81,103 @@ def summarize_actors() -> Dict[str, Dict[str, int]]:
     return out
 
 
-def summarize_objects() -> Dict[str, Any]:
-    rows = list_objects(limit=1_000_000)
+GROUP_BYS = ("callsite", "node", "task")
+
+
+def group_memory_rows(rows: List[Dict[str, Any]],
+                      group_by: str = "callsite") -> List[Dict[str, Any]]:
+    """Aggregate ownership-table rows per callsite / node / creator task:
+    object count, total bytes, ref-type breakdown, spill count. Shared by
+    ``memory_summary``, the dashboard ``/api/memory``, and the CLI so all
+    three render identical numbers."""
+    if group_by not in GROUP_BYS:
+        raise ValueError(f"group_by must be one of {GROUP_BYS}, "
+                         f"got {group_by!r}")
+    groups: Dict[str, Dict[str, Any]] = {}
+    for r in rows:
+        if group_by == "callsite":
+            keys = [r.get("callsite") or "<unknown>"]
+        elif group_by == "task":
+            keys = [r.get("creator") or r.get("owner") or "<unknown>"]
+        else:  # node: one contribution per resident location
+            keys = list(r.get("locations") or ()) or ["<no-location>"]
+        for k in keys:
+            g = groups.setdefault(k, {
+                "group": k, "objects": 0, "bytes": 0, "local_refs": 0,
+                "borrows": 0, "pinned": 0, "spilled_objects": 0})
+            g["objects"] += 1
+            g["bytes"] += int(r.get("size") or 0)
+            g["local_refs"] += int(r.get("local_refs") or 0)
+            g["borrows"] += int(r.get("borrows") or 0)
+            g["pinned"] += int(r.get("pinned") or 0)
+            g["spilled_objects"] += 1 if r.get("spilled") else 0
+    return sorted(groups.values(), key=lambda g: (-g["bytes"],
+                                                  g["group"]))
+
+
+def memory_totals(rows: List[Dict[str, Any]]) -> Dict[str, int]:
+    """Whole-cluster totals over ownership-table rows (each object counted
+    once, regardless of replica count)."""
+    totals = {"objects": 0, "bytes": 0, "inline_bytes": 0, "arena_bytes": 0,
+              "spilled_objects": 0, "spilled_bytes": 0, "local_refs": 0,
+              "borrows": 0}
+    for r in rows:
+        size = int(r.get("size") or 0)
+        totals["objects"] += 1
+        totals["bytes"] += size
+        if r.get("inline"):
+            totals["inline_bytes"] += size
+        elif r.get("spilled"):
+            # spilled bytes live on disk, not in the arena — the three
+            # byte classes partition `bytes`
+            totals["spilled_objects"] += 1
+            totals["spilled_bytes"] += size
+        elif r.get("size") is not None:
+            totals["arena_bytes"] += size
+        totals["local_refs"] += int(r.get("local_refs") or 0)
+        totals["borrows"] += int(r.get("borrows") or 0)
+    return totals
+
+
+def memory_summary(group_by: str = "callsite",
+                   limit: int = 1000) -> Dict[str, Any]:
+    """Cluster memory/object-lifetime summary (the ``ray memory`` /
+    ``memory_summary()`` analog): per-group object count, total bytes and
+    ref-type breakdown over the head's ownership table — the join of the
+    object directory, per-node store dumps (sizes, spill state) and every
+    process's callsite-tagged ref table.
+
+    ``group_by``: ``"callsite"`` (creation site — file:line:function,
+    populated when ``RAY_TPU_RECORD_REF_CREATION_SITES=1``), ``"node"``
+    (resident bytes per node), or ``"task"`` (creator task/actor name).
+    """
+    rows = _state_query("memory", 1_000_000)
     return {
-        "total_objects": len(rows),
-        "total_locations": sum(len(r["locations"]) for r in rows),
+        "group_by": group_by,
+        "groups": group_memory_rows(rows, group_by)[:limit],
+        "totals": memory_totals(rows),
+    }
+
+
+def summarize_objects() -> Dict[str, Any]:
+    """Object-store summary: totals, per-node bytes, inline/arena/spilled
+    breakdown, and the top consumers (by creation callsite) — a small
+    wrapper over the ownership table behind :func:`memory_summary`."""
+    rows = _state_query("memory", 1_000_000)
+    by_node: Dict[str, Dict[str, int]] = {}
+    for g in group_memory_rows(rows, "node"):
+        by_node[g["group"]] = {"objects": g["objects"], "bytes": g["bytes"]}
+    totals = memory_totals(rows)
+    return {
+        # legacy fields (pre-ownership-table shape), kept stable
+        "total_objects": totals["objects"],
+        "total_locations": sum(len(r.get("locations") or ()) for r in rows),
+        # per-node + byte-class breakdown
+        "total_bytes": totals["bytes"],
+        "by_node": by_node,
+        "inline_bytes": totals["inline_bytes"],
+        "arena_bytes": totals["arena_bytes"],
+        "spilled_objects": totals["spilled_objects"],
+        "spilled_bytes": totals["spilled_bytes"],
+        "top_consumers": group_memory_rows(rows, "callsite")[:10],
     }

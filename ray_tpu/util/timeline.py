@@ -31,12 +31,12 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         raise RuntimeError("ray_tpu.init() has not been called")
     if hasattr(rt, "head"):
         raw = raw_events_for_head(rt.head)
-    else:  # worker / client driver: go through the state API
+    else:  # worker / client driver: the "task_events" state kind returns
+        # the FULL event log (RUNNING + terminal pairs), so durations here
+        # match the head path exactly
         from ray_tpu.util.state import _state_query
 
-        raw = _state_query("tasks", 100000)
-        # state rows are latest-only; durations need the full event log —
-        # the head path above is the precise one
+        raw = _state_query("task_events", 100000)
     events = _build_chrome_trace(raw)
     if filename:
         with open(filename, "w") as f:

@@ -1,0 +1,366 @@
+"""Cluster memory & object-lifetime observability (`ray memory` analog).
+
+Reference: reference_count.h creator-callsite tables + memory_summary /
+`ray memory`, plus local_object_manager.h spill/restore accounting. The
+PR acceptance scenarios live here:
+
+- 2-daemon cluster: memory_summary(group_by="callsite") attributes the
+  non-inline arena bytes to the put/task-return callsites that created
+  them; borrow counts drop when a daemon-side holder releases its ref.
+- /api/memory and the `python -m ray_tpu memory` CLI render the same
+  totals as memory_summary.
+- spill -> restore under arena pressure: counters advance, restored
+  payloads are byte-identical, and the high-watermark WARNING cluster
+  event carries callsite attribution.
+"""
+
+import gc
+import io
+import contextlib
+import json
+import re
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core import api, ref_tracker
+from ray_tpu.core.config import global_config
+from ray_tpu.util import state
+
+
+@pytest.fixture
+def record_sites(monkeypatch):
+    """Enable callsite capture + fast ref reports for the test, restoring
+    the cached tracker flags after the config attrs roll back."""
+    cfg = global_config()
+    monkeypatch.setattr(cfg, "record_ref_creation_sites", True)
+    monkeypatch.setattr(cfg, "ref_report_interval_ms", 200)
+    ref_tracker.refresh_flags()
+    yield
+    monkeypatch.undo()
+    ref_tracker.refresh_flags()
+
+
+def _poll(fn, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(0.2)
+    pytest.fail(f"timed out waiting for {msg}")
+
+
+def _row_for(ref):
+    rows = state._state_query("memory", 1_000_000)
+    for r in rows:
+        if r["object_id"] == ref.hex():
+            return r
+    return None
+
+
+class TestTwoDaemonAttribution:
+    """The acceptance scenario: separate-process daemons produce arena
+    objects; the head's ownership table attributes their bytes to the
+    driver-side creation callsites and tracks cross-node borrows."""
+
+    @pytest.fixture
+    def two_daemon_cluster(self, record_sites):
+        from ray_tpu.cluster_utils import Cluster
+
+        c = Cluster(head_node_args={"num_cpus": 1})
+        c.add_node(num_cpus=1, resources={"a": 4}, separate_process=True)
+        c.add_node(num_cpus=1, resources={"b": 4}, separate_process=True)
+        yield c
+        c.shutdown()
+
+    def test_callsite_attribution_and_borrow_counts(self,
+                                                    two_daemon_cluster):
+        n = 600_000  # > max_direct_call_object_size: arena-resident
+
+        @ray_tpu.remote(resources={"a": 1})
+        def produce_a(sz):
+            return np.full(sz, 1, dtype=np.uint8)
+
+        @ray_tpu.remote(resources={"b": 1})
+        def produce_b(sz):
+            return np.full(sz, 2, dtype=np.uint8)
+
+        refs_a = [produce_a.remote(n) for _ in range(2)]
+        refs_b = [produce_b.remote(n) for _ in range(2)]
+        put_ref = ray_tpu.put(np.full(n, 3, dtype=np.uint8))
+        ready, _ = ray_tpu.wait(refs_a + refs_b, num_returns=4, timeout=90,
+                                fetch_local=False)
+        assert len(ready) == 4
+
+        summary = state.memory_summary(group_by="callsite")
+        rows = state._state_query("memory", 1_000_000)
+        arena = [r for r in rows if not r["inline"] and (r["size"] or 0) > 0]
+        arena_bytes = sum(r["size"] for r in arena)
+        attributed = sum(r["size"] for r in arena
+                         if r.get("callsite")
+                         and "test_memory_observability" in r["callsite"])
+        assert arena_bytes >= 5 * n
+        # >= 95% of non-inline arena bytes attributed to their creating
+        # put/task-return callsites
+        assert attributed / arena_bytes >= 0.95, (attributed, arena_bytes)
+        # distinct creation lines -> distinct groups (2 task submits + put)
+        sites = {g["group"] for g in summary["groups"]
+                 if "test_memory_observability" in g["group"]}
+        assert len(sites) >= 3, sites
+        kinds = {r["kind"] for r in arena if r.get("kind")}
+        assert "put" in kinds and "task_return" in kinds
+        # bytes live on all three nodes (head put + one per daemon)
+        by_node = state.memory_summary(group_by="node")["groups"]
+        assert len([g for g in by_node if g["bytes"] >= n]) >= 3
+
+        # ---- borrows: a daemon-side actor holds, then drops, a ref ----
+        @ray_tpu.remote(resources={"b": 1})
+        class Holder:
+            def __init__(self):
+                self.held = None
+
+            def hold(self, boxed):
+                self.held = boxed[0]
+                return True
+
+            def drop(self):
+                self.held = None
+                gc.collect()
+                from ray_tpu.core.object_ref import flush_pending_drops
+
+                flush_pending_drops()
+                return True
+
+        h = Holder.remote()
+        assert ray_tpu.get(h.hold.remote([put_ref]), timeout=60)
+        row = _poll(
+            lambda: (lambda r: r if r and r["borrows"] >= 1 else None)(
+                _row_for(put_ref)),
+            msg="borrow count >= 1 after daemon actor holds the ref")
+        assert row["local_refs"] >= 1  # the driver's own handle
+        assert ray_tpu.get(h.drop.remote(), timeout=60)
+        _poll(
+            lambda: (lambda r: r is not None and r["borrows"] == 0)(
+                _row_for(put_ref)),
+            msg="borrow count back to 0 after the ref is dropped")
+        row = _row_for(put_ref)
+        assert row["local_refs"] >= 1  # driver still holds it
+
+        # keep refs alive through the asserts
+        del refs_a, refs_b, put_ref
+
+
+def test_api_and_cli_render_memory_summary_totals(record_sites):
+    """GET /api/memory and `python -m ray_tpu memory` must show the same
+    totals as util.state.memory_summary."""
+    from ray_tpu.dashboard import start_dashboard
+
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    dash = None
+    try:
+        refs = [ray_tpu.put(np.full(400_000, i, dtype=np.uint8))
+                for i in range(3)]
+        small = ray_tpu.put({"k": 1})  # inline
+        summary = state.memory_summary(group_by="callsite")
+        totals = summary["totals"]
+        assert totals["objects"] >= 4 and totals["arena_bytes"] >= 1_200_000
+        assert totals["inline_bytes"] > 0
+
+        dash = start_dashboard(port=0, with_jobs=False)
+        base = f"http://127.0.0.1:{dash.address[1]}"
+        body = json.loads(urllib.request.urlopen(
+            base + "/api/memory?group_by=callsite", timeout=30).read())
+        assert body["totals"] == totals
+        assert body["groups"][0]["group"] == summary["groups"][0]["group"]
+
+        from ray_tpu.__main__ import main as cli_main
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert cli_main(["memory", "--address", base]) == 0
+        out = buf.getvalue()
+        m = re.search(r"total: (\d+) objects, (\d+) bytes "
+                      r"\(inline (\d+), arena (\d+), spilled (\d+)\)", out)
+        assert m, out
+        assert int(m.group(1)) == totals["objects"]
+        assert int(m.group(2)) == totals["bytes"]
+        assert int(m.group(3)) == totals["inline_bytes"]
+        assert int(m.group(4)) == totals["arena_bytes"]
+        # the grouped table names this test's callsite
+        assert "test_memory_observability" in out
+        del refs, small
+    finally:
+        if dash is not None:
+            dash.stop()
+        ray_tpu.shutdown()
+
+
+def test_spill_restore_counters_and_watermark_event(record_sites,
+                                                    monkeypatch):
+    """Fill a small-capacity store: spill counters advance, restored
+    payloads are byte-identical, and the high-watermark WARNING fires
+    with callsite attribution."""
+    cfg = global_config()
+    monkeypatch.setattr(cfg, "object_store_memory", 8 << 20)
+    ray_tpu.init(num_cpus=1, num_tpus=0)
+    try:
+        sz = 3 * (1 << 20) // 2  # 1.5 MB each
+        refs = []
+        for i in range(6):  # 6 x 1.5 MB = 9 MB > 8 MB arena: spills
+            refs.append(ray_tpu.put(np.full(sz, i + 1, dtype=np.uint8)))
+        store = api._get_head().head_node.store
+        stats = store.stats()
+        assert stats["spilled"] > 0 and stats["spilled_bytes"] > 0
+        assert stats["num_spilled"] > 0
+
+        # restored payloads byte-identical. Zero-copy get() pins the
+        # extent forever (plasma lifetime contract: mapped extents never
+        # move), so only the first 4 go through the restore-into-arena
+        # path; the rest are verified via the copying read_chunk path,
+        # which serves spill files directly.
+        for i, r in enumerate(refs[:4]):
+            arr = ray_tpu.get(r, timeout=60)
+            assert arr.nbytes == sz
+            assert np.all(arr == i + 1)
+            del arr
+        from ray_tpu.core import serialization
+
+        for i, r in enumerate(refs[4:], start=4):
+            payload = store.read_chunk(r.id, 0, 1 << 30)
+            assert payload is not None and len(payload) > sz
+            arr = serialization.deserialize(payload)
+            assert arr.nbytes == sz and np.all(arr == i + 1)
+        stats = store.stats()
+        assert stats["restored"] > 0
+        assert stats["restored_bytes"] >= stats["restored"] * sz
+
+        # counters flow to the standard registry
+        from ray_tpu.util.metrics import registry, render_prometheus
+
+        text = render_prometheus(registry())
+        assert "ray_tpu_object_store_spilled_objects_total" in text
+        assert "ray_tpu_object_store_restored_bytes_total" in text
+        assert "ray_tpu_object_store_bytes_used" in text
+
+        # high-watermark WARNING with callsite attribution
+        from ray_tpu.util import events as events_mod
+
+        events_mod.flush()
+        evs = state.list_cluster_events(source="OBJECT_STORE",
+                                        min_severity="WARNING")
+        wm = [e for e in evs if e.get("attrs", {}).get("top_consumers")]
+        assert wm, evs
+        tops = wm[-1]["attrs"]["top_consumers"]
+        assert any("test_memory_observability" in (c.get("callsite") or "")
+                   for c in tops), tops
+        assert wm[-1]["attrs"]["used"] > 0
+        del refs
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_eviction_counters_store_unit(tmp_path):
+    """Unreferenced sealed objects are evicted (LRU) under pressure and
+    the eviction counters advance — store-level, no cluster."""
+    from ray_tpu.core.ids import NodeID, ObjectID
+    from ray_tpu.core.object_store import LocalObjectStore
+
+    store = LocalObjectStore(str(tmp_path), NodeID.from_random().hex(),
+                             capacity=4 << 20)
+    try:
+        for i in range(8):  # 8 x 1 MB through a 4 MB arena
+            oid = ObjectID.from_random()
+            off, view = store.create(oid, 1 << 20)
+            view[:4] = b"%04d" % i
+            store.seal(oid)
+        stats = store.stats()
+        assert stats["evicted"] > 0 and stats["evicted_bytes"] > 0
+        infos = store.object_infos()
+        assert all(len(t) == 6 for t in infos)
+        assert sum(t[1] for t in infos) <= 4 << 20
+    finally:
+        store.close()
+
+
+def test_memory_summary_from_worker(ray_start_regular, record_sites):
+    """Workers reach the memory table via the state-RPC passthrough."""
+    big = ray_tpu.put(np.ones(300_000, dtype=np.uint8))
+
+    @ray_tpu.remote
+    def query():
+        from ray_tpu.util import state as s
+
+        return s.memory_summary(group_by="node")
+
+    summary = ray_tpu.get(query.remote(), timeout=60)
+    assert summary["totals"]["arena_bytes"] >= 300_000
+    assert summary["groups"]
+    del big
+
+
+def test_group_memory_rows_pure():
+    rows = [
+        {"object_id": "a", "size": 10, "locations": ["n1"], "inline": False,
+         "spilled": False, "pinned": 1, "local_refs": 1, "borrows": 0,
+         "callsite": "f.py:1:f", "creator": "t1"},
+        {"object_id": "b", "size": 20, "locations": ["n1", "n2"],
+         "inline": False, "spilled": True, "pinned": 0, "local_refs": 0,
+         "borrows": 2, "callsite": "f.py:1:f", "creator": "t2"},
+        {"object_id": "c", "size": None, "locations": [], "inline": True,
+         "spilled": False, "pinned": 0, "local_refs": 1, "borrows": 0,
+         "callsite": None, "creator": None},
+    ]
+    by_site = state.group_memory_rows(rows, "callsite")
+    assert by_site[0]["group"] == "f.py:1:f"
+    assert by_site[0]["bytes"] == 30 and by_site[0]["objects"] == 2
+    assert by_site[0]["borrows"] == 2 and by_site[0]["spilled_objects"] == 1
+    assert {g["group"] for g in by_site} == {"f.py:1:f", "<unknown>"}
+    by_node = state.group_memory_rows(rows, "node")
+    n1 = next(g for g in by_node if g["group"] == "n1")
+    assert n1["bytes"] == 30  # object b counts on both nodes
+    n2 = next(g for g in by_node if g["group"] == "n2")
+    assert n2["bytes"] == 20
+    by_task = state.group_memory_rows(rows, "task")
+    assert {g["group"] for g in by_task} == {"t1", "t2", "<unknown>"}
+    totals = state.memory_totals(rows)
+    assert totals["bytes"] == 30 and totals["objects"] == 3
+    assert totals["spilled_bytes"] == 20
+    with pytest.raises(ValueError):
+        state.group_memory_rows(rows, "bogus")
+
+
+def test_ref_accounting_kill_switch(monkeypatch):
+    """RAY_TPU_REF_ACCOUNTING_ENABLED=0: every hook is a no-op (the bench
+    baseline mode)."""
+    cfg = global_config()
+    monkeypatch.setattr(cfg, "ref_accounting_enabled", False)
+    ref_tracker.refresh_flags()
+    try:
+        from ray_tpu.core.ids import ObjectID
+
+        oid = ObjectID.from_random()
+        ref_tracker.incref(oid)
+        ref_tracker.annotate(oid, ref_tracker.KIND_PUT, size=5)
+        assert ref_tracker.export() == {}
+        assert ref_tracker.live_count(oid) == 0
+    finally:
+        monkeypatch.undo()
+        ref_tracker.refresh_flags()
+
+
+def test_summarize_objects_breakdown(ray_start_regular, record_sites):
+    big = ray_tpu.put(np.ones(250_000, dtype=np.uint8))
+    small = ray_tpu.put([1, 2, 3])
+    s = state.summarize_objects()
+    assert s["total_objects"] >= 2  # legacy keys survive
+    assert s["total_bytes"] >= 250_000
+    assert s["arena_bytes"] >= 250_000 and s["inline_bytes"] > 0
+    assert s["by_node"] and sum(v["bytes"] for v in s["by_node"].values()) \
+        >= 250_000
+    assert any("test_memory_observability" in g["group"]
+               for g in s["top_consumers"])
+    del big, small

@@ -24,6 +24,34 @@ def test_timeline_events(tmp_path, ray_start_regular):
         assert json.load(f) == events
 
 
+def test_timeline_from_worker_has_real_durations(ray_start_regular):
+    """Non-head drivers (workers / clients) get the FULL event log via the
+    `task_events` state kind, so X-phase slices carry real durations — the
+    latest-state-only `tasks` rows used to yield no slices at all."""
+    import time
+
+    @ray_tpu.remote
+    def work(x):
+        time.sleep(0.05)
+        return x
+
+    ray_tpu.get([work.remote(i) for i in range(2)])
+
+    @ray_tpu.remote
+    def timeline_from_worker():
+        from ray_tpu.util.timeline import timeline
+
+        return timeline()
+
+    events = ray_tpu.get(timeline_from_worker.remote(), timeout=60)
+    slices = [e for e in events if e.get("ph") == "X"
+              and e.get("name") == "work"]
+    assert len(slices) == 2, events
+    for s in slices:
+        assert s["dur"] >= 0.05 * 1e6 * 0.5  # real, not latest-state-only
+        assert s["args"]["task_id"]
+
+
 def test_timeline_marks_failures(ray_start_regular):
     @ray_tpu.remote(max_retries=0)
     def boom():
