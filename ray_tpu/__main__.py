@@ -10,6 +10,7 @@ stop/list``, ``ray list tasks|actors|nodes``). Commands:
     job     status|logs|stop|list against a dashboard address
     list    tasks|actors|nodes|objects|placement_groups via dashboard
     memory  cluster memory/object ownership table (`ray memory` analog)
+    lint    graftlint static analyzer (tools/lint; docs/static-analysis.md)
 """
 
 from __future__ import annotations
@@ -154,10 +155,13 @@ def main(argv=None) -> int:
     h.add_argument("--num-cpus", type=int, default=None)
     h.add_argument("--num-tpus", type=int, default=None)
 
-    # NOTE: `start` is dispatched before argparse (see main()); this stub
-    # exists only so it shows in --help
+    # NOTE: `start` and `lint` are dispatched before argparse (see
+    # main()); these stubs exist only so they show in --help
     sub.add_parser("start", help="join a head as a node daemon "
                                  "(--address <host:port> --key <hex> ...)")
+    sub.add_parser("lint", help="run graftlint, the runtime's static "
+                                "analyzer (--no-baseline, --check <id>, "
+                                "--update-baseline ...)")
 
     sb = sub.add_parser("submit", help="submit a job")
     sb.add_argument("--address", default="http://127.0.0.1:8265")
@@ -201,6 +205,11 @@ def main(argv=None) -> int:
         from ray_tpu.core.node_daemon import main as daemon_main
 
         return daemon_main(argv[1:])
+    # `lint` likewise owns its argument surface (tools/lint/cli.py)
+    if argv and argv[0] == "lint":
+        from ray_tpu.tools.lint.cli import main as lint_main
+
+        return lint_main(argv[1:])
     # split off trailing "-- entrypoint..." for submit
     rest = []
     if "--" in argv:

@@ -23,6 +23,25 @@ def _env(name: str, default, typ):
     return typ(raw)
 
 
+# Bootstrap-time environment variables read OUTSIDE the Config snapshot.
+# These are consulted before a cluster (and therefore a Config) exists —
+# connect addresses, credentials, per-process identity — so they cannot be
+# Config fields: a daemon adopts the head's Config at registration, which
+# would clobber per-node values like the advertised IP.  graftlint's
+# config-hygiene check requires every direct RAY_TPU_* read in the tree to
+# appear here (and in docs/configuration.md); everything else must go
+# through a Config field + global_config().
+BOOTSTRAP_ENV_VARS = {
+    "RAY_TPU_ADDRESS": "head address ray_tpu.init() connects to",
+    "RAY_TPU_CLUSTER_KEY": "cluster auth key (hex) for client connects",
+    "RAY_TPU_NODE_IP": "routable IP this node advertises to peers",
+    "RAY_TPU_JOB_TOKEN": "dashboard job-submission auth token",
+    "RAY_TPU_USAGE_STATS_ENABLED": "opt-in usage-stats reporting",
+    "RAY_TPU_WORKFLOW_STORAGE": "workflow checkpoint storage URI",
+    "RAY_TPU_RUNTIME_ENV_PLUGINS": "entry points for runtime_env plugins",
+}
+
+
 @dataclass
 class Config:
     # ---- object store / plasma (reference: ray_config_def.h:199,345,398,614) ----
@@ -161,6 +180,15 @@ class Config:
 
     # ---- fault injection (reference: testing_asio_delay_us :824) ----
     testing_delay_ms: str = ""  # "handler1=ms,handler2=ms" injected latency
+
+    # ---- debug assertions ----
+    # dynamic lock-order checking (core/lock_debug.py): runtime locks
+    # created through lock_debug.tracked_* keep a thread-local acquisition
+    # stack and a global order graph, raising LockOrderViolation the
+    # moment two locks are ever taken in both orders — the runtime
+    # counterpart of graftlint's static lock-order check. Test-only: adds
+    # a graph probe per acquire, so off by default.
+    debug_lock_order: bool = False
 
     # ---- TPU (reference: custom_unit_instance_resources :735) ----
     # Resources tracked per unit instance (index-assignable like CUDA devices).

@@ -156,7 +156,9 @@ class DirectTaskManager:
         self._actor_failed_cb: Optional[Callable] = None
         self._actor_done_cb: Optional[Callable] = None
         self._actor_cancel_cb: Optional[Callable] = None
-        self._lock = threading.Lock()
+        from .lock_debug import tracked_lock
+
+        self._lock = tracked_lock("DirectTaskManager._lock")
         self._cv = threading.Condition(self._lock)
         self._pending: Dict[TaskID, TaskSpec] = {}
         self._cancelled: set = set()

@@ -1,0 +1,224 @@
+"""Dynamic lock-order assertions (``RAY_TPU_DEBUG_LOCK_ORDER=1``).
+
+The runtime counterpart of graftlint's static ``lock-order`` check: the
+static pass derives the lock-acquisition graph from ``with self._lock``
+nesting and flags cycles; this module *validates that order while the
+code actually runs*.  Every lock created through :func:`tracked_lock` /
+:func:`tracked_rlock` maintains
+
+- a **thread-local acquisition stack** (which tracked locks this thread
+  currently holds, in order), and
+- a **process-global order graph**: an edge ``A -> B`` is recorded the
+  first time any thread acquires ``B`` while holding ``A``.
+
+Acquiring ``B`` while holding ``A`` when a path ``B ->* A`` already
+exists in the graph is an inversion — two lock sites disagree about the
+global order, which is a deadlock waiting for the right interleaving —
+and raises :class:`LockOrderViolation` *immediately, on the acquiring
+thread*, instead of wedging a production cluster days later.  Unlike an
+actual deadlock, a single thread exercising both orders is enough to
+trip the assertion, which is what makes it usable from unit tests.
+
+Off by default: with ``debug_lock_order`` false the factories return
+plain ``threading`` primitives with zero overhead.  The flag rides the
+Config snapshot, so enabling it on the head enables it cluster-wide.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Set
+
+__all__ = [
+    "LockOrderViolation",
+    "tracked_lock",
+    "tracked_rlock",
+    "reset_order_graph",
+    "held_locks",
+]
+
+
+class LockOrderViolation(RuntimeError):
+    """Two tracked locks were acquired in both orders (potential deadlock)."""
+
+
+# first-observed acquisition order: edges outer -> inner
+_edges: Dict[str, Set[str]] = {}
+_edges_lock = threading.Lock()
+# where each edge was first recorded, for the violation message
+_edge_origin: Dict[tuple, str] = {}
+_tls = threading.local()
+
+
+def _stack() -> List[str]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def held_locks() -> List[str]:
+    """Names of tracked locks the calling thread currently holds."""
+    return list(_stack())
+
+
+def reset_order_graph() -> None:
+    """Forget every recorded edge (test isolation)."""
+    with _edges_lock:
+        _edges.clear()
+        _edge_origin.clear()
+
+
+def _reaches(src: str, dst: str) -> List[str]:
+    """Path src ->* dst in the order graph, [] if none.  Caller holds
+    ``_edges_lock``."""
+    parent = {src: None}
+    queue = [src]
+    while queue:
+        cur = queue.pop(0)
+        for nxt in _edges.get(cur, ()):
+            if nxt in parent:
+                continue
+            parent[nxt] = cur
+            if nxt == dst:
+                path = [nxt]
+                while parent[path[-1]] is not None:
+                    path.append(parent[path[-1]])
+                return list(reversed(path))
+            queue.append(nxt)
+    return []
+
+
+def _note_acquire(name: str) -> None:
+    st = _stack()
+    for outer in st:
+        if outer == name:
+            continue  # reentrant acquire: no ordering information
+        with _edges_lock:
+            if name in _edges.get(outer, ()):  # noqa: SIM108
+                continue  # edge already known
+            inv = _reaches(name, outer)
+            if inv:
+                origin = _edge_origin.get((inv[0], inv[1]), "?")
+                raise LockOrderViolation(
+                    f"lock order inversion: acquiring {name!r} while "
+                    f"holding {outer!r}, but the opposite order "
+                    f"{' -> '.join(inv)} was already observed "
+                    f"(first at {origin}); pick one global order for "
+                    "these locks")
+            _edges.setdefault(outer, set()).add(name)
+            import traceback
+
+            frame = traceback.extract_stack(limit=4)[0]
+            _edge_origin[(outer, name)] = \
+                f"{frame.filename}:{frame.lineno}"
+    st.append(name)
+
+
+def _note_release(name: str) -> None:
+    st = _stack()
+    for i in range(len(st) - 1, -1, -1):
+        if st[i] == name:
+            del st[i]
+            return
+
+
+class _TrackedLock:
+    """Order-asserting wrapper around a threading lock.  Exposes the
+    subset of the lock protocol the runtime uses (``with``, explicit
+    acquire/release, and enough surface for ``threading.Condition`` to
+    fall back to its acquire/release-based wait implementation)."""
+
+    __slots__ = ("_name", "_inner")
+
+    def __init__(self, name: str, inner):
+        self._name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            try:
+                _note_acquire(self._name)
+            except LockOrderViolation:
+                self._inner.release()
+                raise
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        _note_release(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        inner = self._inner
+        return inner.locked() if hasattr(inner, "locked") else False
+
+    # --- threading.Condition integration -------------------------------
+    # Condition(lock) probes these; without them its acquire(False)-based
+    # fallbacks misbehave on a wrapped RLock (a reentrant acquire(False)
+    # succeeds, so the fallback _is_owned would report "not owned" for a
+    # lock this thread holds).
+
+    def _is_owned(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        # Condition.wait parks: ALL recursion levels drop at once, so
+        # scrub every instance of this lock from the acquisition stack
+        # and remember how many to restore.
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            inner_state = inner._release_save()
+        else:
+            inner.release()
+            inner_state = None
+        st = _stack()
+        count = st.count(self._name)
+        while self._name in st:
+            st.remove(self._name)
+        return (inner_state, count)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, count = state
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(inner_state)
+        else:
+            inner.acquire()
+        _stack().extend([self._name] * max(count, 1))
+
+    def __repr__(self):
+        return f"<TrackedLock {self._name} wrapping {self._inner!r}>"
+
+
+def _enabled() -> bool:
+    from .config import global_config
+
+    return bool(global_config().debug_lock_order)
+
+
+def tracked_lock(name: str):
+    """``threading.Lock()`` — order-tracked under RAY_TPU_DEBUG_LOCK_ORDER."""
+    if not _enabled():
+        return threading.Lock()
+    return _TrackedLock(name, threading.Lock())
+
+
+def tracked_rlock(name: str):
+    """``threading.RLock()`` — order-tracked under RAY_TPU_DEBUG_LOCK_ORDER."""
+    if not _enabled():
+        return threading.RLock()
+    return _TrackedLock(name, threading.RLock())

@@ -72,7 +72,9 @@ class Node:
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle: deque = deque()
         self._local_queue: deque = deque()  # (spec, binding) waiting for a worker
-        self._lock = threading.RLock()
+        from .lock_debug import tracked_rlock
+
+        self._lock = tracked_rlock("Node._lock")
         self._handler_pool = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix=f"node-{self.hex[:6]}"
         )
@@ -1114,9 +1116,6 @@ class Node:
                     self.head.publish_stream_eof(*payload)
                 except Exception:
                     pass
-            elif tag == "release":
-                for oid in payload[0]:
-                    self.store.remove_ref(oid)
             elif tag == "stream":
                 task_id, index, data = payload
                 self._on_worker_stream_item(task_id, index, data)

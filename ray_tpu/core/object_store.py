@@ -263,7 +263,9 @@ class LocalObjectStore:
         self.spill_dir = cfg.object_spilling_dir or os.path.join(session_dir, "spill")
         os.makedirs(self.spill_dir, exist_ok=True)
         self._entries: Dict[ObjectID, ObjectEntry] = {}
-        self._lock = threading.RLock()
+        from .lock_debug import tracked_rlock
+
+        self._lock = tracked_rlock("LocalObjectStore._lock")
         self._sealed_cv = threading.Condition(self._lock)
         # telemetry state: one tag set per node, gauges rate-limited (the
         # put hot path must not pay a registry write per call)

@@ -13,7 +13,6 @@ Tags (worker -> node):
     done(task_id, results, err)     -- task finished; results inline or sealed
     store(req_id, op, *args)        -- blocking store ops (get/create/seal/..)
     rpc(req_id, op, *args)          -- control-plane ops (submit, actors, kv)
-    release(object_ids)             -- batched ref releases
 
 Tags (node -> worker):
     exec(task_payload)              -- run a task
@@ -35,11 +34,15 @@ from typing import Any, Callable, Dict, Optional, Tuple
 # tags/payload shapes — mixed-version clusters fail fast with a clear
 # error instead of unpickling garbage (the pickle-schema analog of the
 # reference's versioned protobuf wire format, src/ray/protobuf/).
-PROTOCOL_VERSION = 5  # v5: memory observability — worker/daemon "refs"
-# ref-table reports + head->daemon store_info/store_info_rep round-trip.
-# (v4: pooled multi-request object-transfer connections with stat/pullr
-# (range) ops + arena-direct framing. v3: ddone/pdone carry exec_hex;
-# dpin/pin_delta; owner-resolved ref args — arg_hints in TaskSpec)
+PROTOCOL_VERSION = 6  # v6: drop the dead worker->node "release" tag —
+# batched ref releases were replaced by owner-side ref accounting
+# (register/unregister_owned_object rpc ops + ref_tracker reports) in the
+# memory-observability rework; the handler outlived its last sender.
+# (v5: memory observability — worker/daemon "refs" ref-table reports +
+# head->daemon store_info/store_info_rep round-trip. v4: pooled
+# multi-request object-transfer connections with stat/pullr (range) ops +
+# arena-direct framing. v3: ddone/pdone carry exec_hex; dpin/pin_delta;
+# owner-resolved ref args — arg_hints in TaskSpec)
 
 
 class ProtocolVersionError(ConnectionError):

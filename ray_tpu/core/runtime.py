@@ -185,7 +185,9 @@ class Head:
         self.pubsub = PubsubBroker()
         self.scheduler = ClusterScheduler(self._dispatch_to_node)
         self.nodes: Dict[str, Node] = {}
-        self._lock = threading.RLock()
+        from .lock_debug import tracked_rlock
+
+        self._lock = tracked_rlock("Head._lock")
         self._object_cv = threading.Condition(self._lock)
         self.tasks: Dict[TaskID, TaskRecord] = {}
         self.actors: Dict[ActorID, ActorRecord] = {}

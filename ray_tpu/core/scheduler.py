@@ -93,7 +93,9 @@ class ClusterScheduler:
         self._dispatch = dispatch_fn
         self._nodes: Dict[str, NodeResources] = {}
         self._node_order: List[str] = []
-        self._lock = threading.RLock()
+        from .lock_debug import tracked_rlock
+
+        self._lock = tracked_rlock("ClusterScheduler._lock")
         self._pending: deque = deque()
         self._pgs: Dict[PlacementGroupID, PlacementGroup] = {}
         self._pending_pgs: deque = deque()

@@ -32,6 +32,7 @@ channel STATS expose the accounting (serialized vs tensor bytes).
 
 from __future__ import annotations
 
+import collections
 import threading
 import uuid
 from typing import Any, Dict, List, Optional
@@ -91,6 +92,46 @@ def _bind(actor_method, *args):
     return ClassMethodNode(actor_method._handle, actor_method._name, args)
 
 
+# Deferred teardown queue. ``CompiledDAG.__del__`` runs inside the garbage
+# collector, which can fire on ANY allocation — including on a thread that
+# holds runtime locks — and ``teardown()`` both acquires ``_submit_lock``
+# and performs bounded channel round-trips (seconds of work).  Tearing
+# down synchronously from __del__ is therefore the exact GC-reentrant
+# deadlock shape fixed for ObjectRef in PR 2 (graftlint: gc-reentrancy).
+# __del__ only enqueues; this reaper thread — started at compile time,
+# never from within the GC — drains the queue on a stack of its own.
+_teardown_queue: "collections.deque" = collections.deque()
+_teardown_event = threading.Event()
+_reaper_started = False
+_reaper_lock = threading.Lock()
+
+
+def _teardown_reaper_loop() -> None:
+    while True:
+        _teardown_event.wait()
+        _teardown_event.clear()
+        while True:
+            try:
+                fn = _teardown_queue.popleft()
+            except IndexError:
+                break
+            try:
+                fn()
+            except Exception:
+                pass  # channels already closed / interpreter shutdown
+
+
+def _ensure_teardown_reaper() -> None:
+    global _reaper_started
+    if _reaper_started:
+        return
+    with _reaper_lock:
+        if not _reaper_started:
+            threading.Thread(target=_teardown_reaper_loop, daemon=True,
+                             name="dag-teardown-reaper").start()
+            _reaper_started = True
+
+
 class CompiledDAGRef:
     """Result handle for one execute(); results must be consumed in
     submission order (single output channel — reference semantics)."""
@@ -106,6 +147,10 @@ class CompiledDAGRef:
 class CompiledDAG:
     def __init__(self, output_node: ClassMethodNode, buffer_size: int,
                  device_channels: bool = False):
+        # reaper first: __del__ can fire on a HALF-built DAG (executor
+        # install below may raise after channels exist), and starting
+        # threads from inside the garbage collector is not safe
+        _ensure_teardown_reaper()
         # topological order: DFS post-order from the output (dedup by id)
         nodes: List[ClassMethodNode] = []
         seen: set = set()
@@ -256,7 +301,13 @@ class CompiledDAG:
             ch.close(unlink=True)
 
     def __del__(self):
+        # NEVER tear down synchronously: __del__ runs inside the GC, which
+        # can fire on a thread holding runtime locks, and teardown() takes
+        # _submit_lock + does channel round-trips — hand the work to the
+        # reaper thread instead (see _teardown_queue above).
         try:
-            self.teardown()
-        except Exception:
+            if not self._torn_down:
+                _teardown_queue.append(self.teardown)
+                _teardown_event.set()
+        except Exception:  # interpreter shutdown
             pass

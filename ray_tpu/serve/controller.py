@@ -196,17 +196,12 @@ class ServeController:
                 "version": rec["version"], "callable": rec["callable"],
                 "tag": tag}
 
-    def _autoscale(self, rec: dict) -> None:
+    def _autoscale(self, rec: dict, avg: Optional[float]) -> None:
+        """Pure decision step: ``avg`` (ongoing requests per replica) was
+        collected by _poll_replicas OUTSIDE the controller lock."""
         auto = rec["config"].get("autoscaling")
-        if not auto or not rec["replicas"]:
+        if not auto or avg is None:
             return
-        try:
-            stats = ray_tpu.get(
-                [r["actor"].get_num_ongoing_requests.remote()
-                 for r in rec["replicas"]], timeout=2)
-        except Exception:
-            return
-        avg = sum(stats) / max(len(stats), 1)
         target = rec["target"]
         now = time.time()
         if avg > auto["target_ongoing_requests"] \
@@ -254,12 +249,47 @@ class ServeController:
                 if not r["ready"]:
                     r["ping_ref"] = r["actor"].check_health.remote()
 
+    def _poll_replicas(self) -> dict:
+        """Phase 1 of reconcile: every cluster round-trip (autoscale load
+        stats, readiness pings) runs WITHOUT the controller lock held —
+        holding it across ray_tpu.get/wait blocks deploy()/status() and
+        the long-poll broadcast for seconds (graftlint:
+        blocking-under-lock).  Replica dicts are mutated lock-free the
+        same way _health_check already does; the worst race is probing a
+        replica the reconcile phase is about to retire."""
+        with self._lock:
+            if self._shutdown:
+                return {}
+            work = []
+            for name, rec in self._deployments.items():
+                replicas = list(rec["replicas"])
+                fresh = [r for r in replicas
+                         if not self._replica_stale(rec, r)]
+                wants_stats = bool(rec["config"].get("autoscaling")
+                                   and replicas)
+                has_stale = len(fresh) < len(replicas)
+                work.append((name, replicas, fresh, wants_stats, has_stale))
+        stats: dict = {}
+        for name, replicas, fresh, wants_stats, has_stale in work:
+            if wants_stats:
+                try:
+                    vals = ray_tpu.get(
+                        [r["actor"].get_num_ongoing_requests.remote()
+                         for r in replicas], timeout=2)
+                    stats[name] = sum(vals) / max(len(vals), 1)
+                except Exception:
+                    pass
+            if has_stale:
+                self._probe_ready(fresh)
+        return stats
+
     def _reconcile_once(self) -> None:
+        stats = self._poll_replicas()
         with self._lock:
             if self._shutdown:
                 return
-            for rec in self._deployments.values():
-                self._autoscale(rec)
+            for name, rec in self._deployments.items():
+                self._autoscale(rec, stats.get(name))
                 replicas = rec["replicas"]
                 stale = [r for r in replicas if self._replica_stale(rec, r)]
                 fresh = [r for r in replicas if r not in stale]
@@ -268,8 +298,8 @@ class ServeController:
                     # rolling update (maxSurge=1): spawn a fresh replica
                     # up to target+1 total; retire one stale per cycle
                     # only when enough fresh replicas are READY to keep
-                    # the serving set covered
-                    self._probe_ready(fresh)
+                    # the serving set covered (readiness was refreshed by
+                    # _poll_replicas, outside this lock)
                     ready = [r for r in fresh if r.get("ready")]
                     if target == 0:
                         # scaled to zero mid-roll: nothing to cover, just

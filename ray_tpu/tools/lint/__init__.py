@@ -1,0 +1,52 @@
+"""graftlint — concurrency- and protocol-invariant static analyzer.
+
+The runtime core is a pile of threads, locks, and string-dispatched wire
+ops; its worst production bug so far (the PR-2 GC-reentrant
+``ObjectRef.__del__`` deadlock) was exactly the class of defect a static
+pass catches before it ships.  graftlint walks the ``ray_tpu/`` tree and
+enforces machine-checked invariants instead of reviewer vigilance:
+
+=====================  ====================================================
+check id               invariant
+=====================  ====================================================
+lock-order             the per-class lock-acquisition graph (``with
+                       self._lock`` nesting, propagated across the
+                       intraprocedural call graph) is acyclic
+blocking-under-lock    no socket/channel round-trip, ``Queue.get``,
+                       ``Event.wait`` or ``time.sleep`` while a runtime
+                       lock is held
+gc-reentrancy          no ``__del__``/weakref-callback call graph reaches
+                       a lock acquire or a channel send (the PR-2 shape)
+protocol-completeness  every op string sent by clients/workers has a
+                       handler chain, and every handler has a sender
+protocol-version       the wire-op set may only change together with a
+                       ``PROTOCOL_VERSION`` bump (hash baseline)
+config-hygiene         every ``RAY_TPU_*`` env read is declared in
+                       ``core/config.py`` and mentioned in docs
+metrics-hygiene        metric names are registered once, with one type
+                       and one tag set
+=====================  ====================================================
+
+Run it with ``python -m ray_tpu.tools.lint`` (or ``python -m ray_tpu
+lint``).  Findings are suppressed inline with ``# graftlint:
+ignore[check-id]`` (same line or the line above) or grandfathered in the
+checked-in baseline (``baseline.json``, one justification per entry).
+The tree-wide run is a tier-1 test, so every PR is gated on a clean
+report.  See ``docs/static-analysis.md``.
+"""
+
+from .analysis import TreeIndex, collect_tree
+from .baseline import Baseline, default_baseline_path
+from .checks import ALL_CHECKS, run_checks
+from .cli import LintReport, run_lint
+
+__all__ = [
+    "ALL_CHECKS",
+    "Baseline",
+    "LintReport",
+    "TreeIndex",
+    "collect_tree",
+    "default_baseline_path",
+    "run_checks",
+    "run_lint",
+]

@@ -1,0 +1,725 @@
+"""AST collection layer: one pass over the tree, shared by every check.
+
+Parses each ``*.py`` file once and extracts the facts the checks consume:
+
+- per-function lock acquisitions (``with self._lock:`` nesting, with the
+  lexically-held lock set at every interesting site),
+- blocking-call sites (sleep / wait / recv / rpc round-trips / queue
+  gets) classified by kind,
+- the intraprocedural call graph (``self.method()`` within a class,
+  bare ``name()`` to module-level functions),
+- ``__del__`` methods and weakref callback registrations,
+- wire-protocol send sites (``x.call("rpc", "op", ...)``,
+  ``channel.send("tag", ...)``, one-hop forwarder functions) and handler
+  chains (``if op == "...":`` ladders over a function parameter),
+- ``RAY_TPU_*`` environment reads and the declarations in
+  ``core/config.py``,
+- metric registrations (``Counter/Gauge/Histogram("name", ...)``),
+- ``# graftlint: ignore[check-id]`` suppression comments.
+
+Everything here is heuristic in the way useful linters are: receiver
+*names* stand in for types (an attribute called ``_lock`` is a lock, a
+receiver called ``channel`` is a channel).  The codebase enforces those
+naming conventions already; the checks inherit them as ground truth.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# Attribute names that denote locks.  Condition variables count: acquiring
+# one nests like a lock (aliases collapse `Condition(self._lock)` onto the
+# underlying lock).
+LOCK_NAME_RE = re.compile(r"(?:^|_)(lock|locks|mutex|cv|cond)\d*$")
+
+# Receivers that denote duplex channels / sockets for `.send(...)` sites.
+CHANNEL_RECV_RE = re.compile(r"(channel|chan$|conn|sock)")
+
+# Queue-ish receivers for `.get(...)` (plain dict.get is everywhere).
+QUEUE_RECV_RE = re.compile(r"(?:^|_)(q|queue|inbox|mailbox)s?$")
+
+# Condition-variable receivers: `.wait()` on these *releases* the lock
+# while parked, so it is not a blocking-under-lock defect.
+CV_RECV_RE = re.compile(r"(?:^|_)(cv|cond|condition)\d*$")
+
+# Factory callables whose result is a lock (marks `self.x = <factory>()`).
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore", "tracked_lock", "tracked_rlock"}
+
+SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*ignore\[([a-zA-Z0-9_,\- ]+)\]")
+
+# Handler-chain parameters: only ladders over these names are protocol
+# dispatch (an arbitrary `mode == "add"` ladder is not a wire surface).
+HANDLER_PARAMS = {"op", "tag"}
+
+METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
+
+
+def _expr_name(node: ast.AST) -> str:
+    """Best-effort dotted name for a receiver expression."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{_expr_name(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return f"{_expr_name(node.func)}()"
+    if isinstance(node, ast.Subscript):
+        return f"{_expr_name(node.value)}[]"
+    return "<expr>"
+
+
+@dataclass
+class LockAcquire:
+    lock: str              # canonical key, e.g. "Head._lock"
+    line: int
+    held: Tuple[str, ...]  # locks lexically held when this one is taken
+
+
+@dataclass
+class BlockingSite:
+    kind: str              # sleep | wait | recv | rpc | send | queue-get | result | accept
+    desc: str              # e.g. "time.sleep", "self.rpc.call"
+    line: int
+    held: Tuple[str, ...]
+
+
+@dataclass
+class CallSite:
+    callee: str            # method or local function name
+    is_self: bool          # True for self.m(...), False for bare name(...)
+    line: int
+    held: Tuple[str, ...]
+
+
+@dataclass
+class SendSite:
+    op: str                # literal op/tag, or prefix for prefix=True
+    line: int
+    channel: Optional[str]  # "rpc"/"store"/"req" for .call sites, None for .send
+    prefix: bool = False   # op is a `"pg_" + x` style prefix
+    # dispatcher-originated sends (literal arg into a function that
+    # string-dispatches on the param) resolve dead handlers but are not
+    # themselves required to have a handler: dispatch is polymorphic
+    # across runtime implementations (local mode vs head vs client)
+    via_dispatcher: bool = False
+
+
+@dataclass
+class HandlerChain:
+    func: str              # qualname of the dispatch function
+    param: str
+    ops: List[Tuple[str, int]]  # (literal, line)
+
+
+@dataclass
+class EnvRead:
+    var: str
+    line: int
+
+
+@dataclass
+class MetricReg:
+    name: str
+    mtype: str             # counter | gauge | histogram
+    tag_keys: Optional[Tuple[str, ...]]  # None when not statically known
+    line: int
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str          # "Class.method" | "func" | "Class.method.<nested>"
+    cls: Optional[str]
+    name: str
+    line: int
+    params: List[str] = field(default_factory=list)
+    acquires: List[LockAcquire] = field(default_factory=list)
+    blocking: List[BlockingSite] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+    # forwarder: this function relays a parameter into a send slot.
+    # (param_name, channel_literal_or_None)
+    forwards: Optional[Tuple[str, Optional[str]]] = None
+    weakref_callbacks: List[Tuple[str, int]] = field(default_factory=list)
+
+
+@dataclass
+class ModuleInfo:
+    path: str              # path relative to the scan root
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: Dict[str, List[str]] = field(default_factory=dict)  # cls -> methods
+    lock_attrs: Dict[str, Set[str]] = field(default_factory=dict)  # cls -> attrs
+    lock_aliases: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    # attrs assigned from threading.Condition(...): `.wait()` on these
+    # RELEASES the lock while parked, so it is not blocking-under-lock
+    cond_attrs: Dict[str, Set[str]] = field(default_factory=dict)
+    sends: List[SendSite] = field(default_factory=list)
+    handlers: List[HandlerChain] = field(default_factory=list)
+    # every call with string-literal args: (callee leaf name,
+    # ((arg_idx, literal), ...), line) — lets the protocol check treat a
+    # call into a dispatcher function (`self.kv("del", …)`) as a send
+    lit_calls: List[Tuple[str, Tuple[Tuple[int, str], ...], int]] = \
+        field(default_factory=list)
+    env_reads: List[EnvRead] = field(default_factory=list)
+    metrics: List[MetricReg] = field(default_factory=list)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    protocol_version: Optional[int] = None
+    config_fields: List[str] = field(default_factory=list)
+    bootstrap_env: List[str] = field(default_factory=list)
+
+
+@dataclass
+class TreeIndex:
+    root: str
+    modules: Dict[str, ModuleInfo] = field(default_factory=dict)
+    parse_errors: List[Tuple[str, str]] = field(default_factory=list)
+    doc_text: str = ""     # concatenated docs/README text for mention checks
+
+    def suppressed(self, path: str, line: int, check: str) -> bool:
+        mod = self.modules.get(path)
+        if mod is None:
+            return False
+        for probe in (line, line - 1):
+            ids = mod.suppressions.get(probe)
+            if ids and (check in ids or "all" in ids):
+                return True
+        return False
+
+
+# --------------------------------------------------------------- collection
+
+
+def _collect_suppressions(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = SUPPRESS_RE.search(text)
+        if m:
+            out[i] = {part.strip() for part in m.group(1).split(",")}
+    return out
+
+
+def _lock_key(expr: ast.AST, cls: Optional[str],
+              mod: ModuleInfo) -> Optional[str]:
+    """Canonical lock key for a `with <expr>:` item, or None if not a lock."""
+    if isinstance(expr, ast.Attribute):
+        attr = expr.attr
+        recv = _expr_name(expr.value)
+        is_lock = bool(LOCK_NAME_RE.search(attr))
+        if recv == "self" and cls is not None:
+            if attr in mod.lock_attrs.get(cls, ()):
+                is_lock = True
+            if not is_lock:
+                return None
+            attr = mod.lock_aliases.get((cls, attr), attr)
+            return f"{cls}.{attr}"
+        if not is_lock:
+            return None
+        return f"{recv}.{attr}"
+    if isinstance(expr, ast.Name) and LOCK_NAME_RE.search(expr.id):
+        return expr.id
+    return None
+
+
+class _ClassPrescan(ast.NodeVisitor):
+    """First pass over a class body: which `self.X` attrs are locks, and
+    which are Condition aliases of another lock attr."""
+
+    def __init__(self, cls: str, mod: ModuleInfo):
+        self.cls = cls
+        self.mod = mod
+        mod.lock_attrs.setdefault(cls, set())
+
+    def visit_Assign(self, node: ast.Assign):
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Attribute)
+                and isinstance(node.targets[0].value, ast.Name)
+                and node.targets[0].value.id == "self"
+                and isinstance(node.value, ast.Call)):
+            attr = node.targets[0].attr
+            fn = node.value.func
+            fname = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if fname in LOCK_FACTORIES:
+                self.mod.lock_attrs[self.cls].add(attr)
+                if fname == "Condition":
+                    self.mod.cond_attrs.setdefault(self.cls,
+                                                   set()).add(attr)
+                    if node.value.args:
+                        arg = node.value.args[0]
+                        if (isinstance(arg, ast.Attribute)
+                                and isinstance(arg.value, ast.Name)
+                                and arg.value.id == "self"):
+                            self.mod.lock_aliases[(self.cls, attr)] = \
+                                arg.attr
+        self.generic_visit(node)
+
+
+def _classify_blocking(call: ast.Call, cls: Optional[str],
+                       mod: ModuleInfo) -> Optional[Tuple[str, str]]:
+    """(kind, desc) when the call matches a known blocking shape."""
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    meth = fn.attr
+    recv = _expr_name(fn.value)
+    leaf = recv.rsplit(".", 1)[-1]
+    if meth == "sleep" and leaf.lstrip("_") in ("time", "_time"):
+        return ("sleep", f"{recv}.sleep")
+    if leaf == "ray_tpu" and meth in ("get", "wait"):
+        # public driver API: a head round-trip (and possibly a transfer)
+        return ("rpc", f"ray_tpu.{meth}")
+    if meth == "wait":
+        if CV_RECV_RE.search(leaf):
+            return None
+        if (recv.startswith("self.") and cls is not None
+                and recv.count(".") == 1
+                and leaf in mod.cond_attrs.get(cls, ())):
+            return None  # Condition.wait releases the lock while parked
+        return ("wait", f"{recv}.wait")
+    if meth in ("recv", "recv_bytes"):
+        return ("recv", f"{recv}.{meth}")
+    if meth == "accept":
+        return ("accept", f"{recv}.accept")
+    if meth == "call":
+        return ("rpc", f"{recv}.call")
+    if meth == "result":
+        return ("result", f"{recv}.result")
+    if meth == "get" and QUEUE_RECV_RE.search(leaf):
+        return ("queue-get", f"{recv}.get")
+    if meth == "send" and CHANNEL_RECV_RE.search(leaf):
+        return ("send", f"{recv}.send")
+    return None
+
+
+def _op_literal(arg: ast.AST) -> Tuple[Optional[str], bool]:
+    """(op, is_prefix) for a send-slot argument, (None, False) if dynamic."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, False
+    if (isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add)
+            and isinstance(arg.left, ast.Constant)
+            and isinstance(arg.left.value, str)):
+        return arg.left.value, True
+    # framed-tuple idiom: conn.send(("pull", oid, ...))
+    if isinstance(arg, (ast.Tuple, ast.List)) and arg.elts:
+        return _op_literal(arg.elts[0])
+    return None, False
+
+
+class _ModuleCollector:
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.mod = ModuleInfo(path=path)
+        self.mod.suppressions = _collect_suppressions(source)
+        self.tree = tree
+        self._forwarder_names: Dict[str, Tuple[int, Optional[str]]] = {}
+
+    # -------------------------------------------------------------- driver
+
+    def collect(self) -> ModuleInfo:
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                _ClassPrescan(node.name, self.mod).visit(node)
+        # forwarders first: calls to a forwarder may precede its def
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                params = [a.arg for a in node.args.args if a.arg != "self"]
+                self._detect_forwarder(node, FunctionInfo(
+                    qualname=node.name, cls=None, name=node.name,
+                    line=node.lineno, params=params))
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._function(node, cls=None, prefix="")
+            elif isinstance(node, ast.ClassDef):
+                self.mod.classes[node.name] = []
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.mod.classes[node.name].append(item.name)
+                        self._function(item, cls=node.name, prefix="")
+            else:
+                self._scan_stmt_calls(node, held=(), fi=None, cls=None)
+        self._module_level_facts()
+        return self.mod
+
+    def _module_level_facts(self):
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    if (tgt.id == "PROTOCOL_VERSION"
+                            and isinstance(node.value, ast.Constant)
+                            and isinstance(node.value.value, int)):
+                        self.mod.protocol_version = node.value.value
+                    if tgt.id in ("BOOTSTRAP_ENV_VARS", "DECLARED_ENV_VARS"):
+                        self.mod.bootstrap_env.extend(
+                            self._str_keys(node.value))
+            if isinstance(node, ast.ClassDef) and node.name == "Config":
+                for item in node.body:
+                    if (isinstance(item, ast.AnnAssign)
+                            and isinstance(item.target, ast.Name)):
+                        self.mod.config_fields.append(item.target.id)
+
+    @staticmethod
+    def _str_keys(node: ast.AST) -> List[str]:
+        out = []
+        elts: List[ast.AST] = []
+        if isinstance(node, ast.Dict):
+            elts = list(node.keys)
+        elif isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+            elts = list(node.elts)
+        for e in elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append(e.value)
+        return out
+
+    # ----------------------------------------------------------- functions
+
+    def _function(self, node, cls: Optional[str], prefix: str):
+        qual = (f"{cls}." if cls else "") + prefix + node.name
+        fi = FunctionInfo(qualname=qual, cls=cls, name=node.name,
+                          line=node.lineno,
+                          params=[a.arg for a in node.args.args
+                                  if a.arg != "self"])
+        self.mod.functions[qual] = fi
+        self._handler_chain(node, fi)
+        self._walk_block(node.body, held=(), fi=fi, cls=cls,
+                         prefix=prefix + node.name + ".")
+
+    def _walk_block(self, stmts, held, fi, cls, prefix):
+        for stmt in stmts:
+            self._walk_stmt(stmt, held, fi, cls, prefix)
+
+    def _walk_stmt(self, stmt, held, fi, cls, prefix):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later, on its own stack — empty held set
+            self._function(stmt, cls=cls, prefix=prefix)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in stmt.items:
+                self._scan_expr_calls(item.context_expr, tuple(inner), fi,
+                                      cls)
+                key = _lock_key(item.context_expr, cls, self.mod)
+                if key is not None:
+                    fi.acquires.append(LockAcquire(
+                        lock=key, line=item.context_expr.lineno,
+                        held=tuple(inner)))
+                    inner.append(key)
+            self._walk_block(stmt.body, tuple(inner), fi, cls, prefix)
+            return
+        # compound statements: recurse into child blocks with same held set
+        for name in ("body", "orelse", "finalbody", "handlers"):
+            block = getattr(stmt, name, None)
+            if block:
+                for child in block:
+                    if isinstance(child, ast.ExceptHandler):
+                        self._walk_block(child.body, held, fi, cls, prefix)
+                    else:
+                        self._walk_stmt(child, held, fi, cls, prefix)
+        if not hasattr(stmt, "body"):
+            self._scan_stmt_calls(stmt, held, fi, cls)
+        else:
+            # scan non-block expressions of the compound stmt (test, items…)
+            for fname, value in ast.iter_fields(stmt):
+                if fname in ("body", "orelse", "finalbody", "handlers"):
+                    continue
+                if isinstance(value, ast.AST):
+                    self._scan_expr_calls(value, held, fi, cls)
+                elif isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.AST):
+                            self._scan_expr_calls(v, held, fi, cls)
+
+    # ------------------------------------------------------------- call scan
+
+    def _scan_stmt_calls(self, stmt, held, fi, cls):
+        self._scan_expr_calls(stmt, held, fi, cls)
+
+    def _scan_expr_calls(self, node, held, fi, cls):
+        """Scan an expression tree for interesting Call nodes.  Calls under
+        a lambda/nested def execute later: collected with held=()."""
+        for child, in_lambda in _walk_marking_lambdas(node):
+            if not isinstance(child, ast.Call):
+                continue
+            eff_held = () if in_lambda else held
+            self._classify_call(child, eff_held, fi, cls)
+
+    def _classify_call(self, call: ast.Call, held, fi, cls):
+        fn = call.func
+        # env reads -------------------------------------------------------
+        self._maybe_env_read(call)
+        # metric registrations -------------------------------------------
+        self._maybe_metric(call)
+        # weakref callbacks ----------------------------------------------
+        self._maybe_weakref(call, fi)
+        # wire sends ------------------------------------------------------
+        self._maybe_send(call)
+        # literal-arg call record (dispatcher-send resolution) -----------
+        leaf_name = None
+        if isinstance(fn, ast.Attribute):
+            leaf_name = fn.attr
+        elif isinstance(fn, ast.Name):
+            leaf_name = fn.id
+        if leaf_name is not None:
+            lits = tuple((i, a.value) for i, a in enumerate(call.args[:4])
+                         if isinstance(a, ast.Constant)
+                         and isinstance(a.value, str))
+            if lits:
+                self.mod.lit_calls.append((leaf_name, lits, call.lineno))
+        if fi is None:
+            return
+        # blocking sites --------------------------------------------------
+        blk = _classify_blocking(call, cls, self.mod)
+        if blk is not None:
+            fi.blocking.append(BlockingSite(kind=blk[0], desc=blk[1],
+                                            line=call.lineno, held=held))
+        # intraprocedural call graph -------------------------------------
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "self":
+            fi.calls.append(CallSite(callee=fn.attr, is_self=True,
+                                     line=call.lineno, held=held))
+        elif isinstance(fn, ast.Name):
+            fi.calls.append(CallSite(callee=fn.id, is_self=False,
+                                     line=call.lineno, held=held))
+
+    # ------------------------------------------------------------ fact taps
+
+    def _maybe_env_read(self, call: ast.Call):
+        fn = call.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        recv = _expr_name(fn.value)
+        is_environ_get = fn.attr == "get" and recv.endswith("environ")
+        is_getenv = fn.attr == "getenv" and recv.rsplit(".", 1)[-1] == "os"
+        if not (is_environ_get or is_getenv):
+            return
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, str) \
+                and call.args[0].value.startswith("RAY_TPU_"):
+            self.mod.env_reads.append(EnvRead(var=call.args[0].value,
+                                              line=call.lineno))
+
+    def _maybe_metric(self, call: ast.Call):
+        fn = call.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if name not in METRIC_CTORS:
+            return
+        if not (call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            return
+        tag_keys: Optional[Tuple[str, ...]] = ()
+        for kw in call.keywords:
+            if kw.arg == "tag_keys":
+                if isinstance(kw.value, (ast.Tuple, ast.List)) and all(
+                        isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                        for e in kw.value.elts):
+                    tag_keys = tuple(e.value for e in kw.value.elts)
+                else:
+                    tag_keys = None
+        self.mod.metrics.append(MetricReg(
+            name=call.args[0].value, mtype=name.lower(), tag_keys=tag_keys,
+            line=call.lineno))
+
+    def _maybe_weakref(self, call: ast.Call, fi: Optional[FunctionInfo]):
+        if fi is None:
+            return
+        fn = call.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if name not in ("ref", "finalize", "WeakValueDictionary"):
+            return
+        recv = _expr_name(fn.value) if isinstance(fn, ast.Attribute) else ""
+        if name in ("ref", "finalize") and (recv == "weakref" or not recv):
+            cb_idx = 1
+            if len(call.args) > cb_idx:
+                cb = call.args[cb_idx]
+                cb_name = None
+                if isinstance(cb, ast.Attribute) and \
+                        isinstance(cb.value, ast.Name) and \
+                        cb.value.id == "self":
+                    cb_name = cb.attr
+                elif isinstance(cb, ast.Name):
+                    cb_name = cb.id
+                if cb_name:
+                    fi.weakref_callbacks.append((cb_name, call.lineno))
+
+    def _maybe_send(self, call: ast.Call):
+        fn = call.func
+        if not isinstance(fn, ast.Attribute):
+            # bare forwarder call: f("op", ...)
+            if isinstance(fn, ast.Name):
+                self._maybe_forwarder_call(fn.id, call)
+            return
+        meth = fn.attr
+        recv = _expr_name(fn.value)
+        leaf = recv.rsplit(".", 1)[-1]
+        if meth == "call" and len(call.args) >= 2:
+            chan = call.args[0]
+            if isinstance(chan, ast.Constant) and isinstance(chan.value, str):
+                # the channel literal IS the wire tag the rpc layer sends
+                # (RpcClient.call -> channel.send(tag, req_id, op, ...))
+                self.mod.sends.append(SendSite(
+                    op=chan.value, line=call.lineno, channel=None))
+                op, prefix = _op_literal(call.args[1])
+                if op is not None:
+                    self.mod.sends.append(SendSite(
+                        op=op, line=call.lineno, channel=chan.value,
+                        prefix=prefix))
+            return
+        if meth in ("send", "_send", "_notify") and call.args:
+            op, prefix = _op_literal(call.args[0])
+            if op is not None:
+                self.mod.sends.append(SendSite(op=op, line=call.lineno,
+                                               channel=None, prefix=prefix))
+            return
+        # method-style forwarder call: self._call("op", ...)
+        self._maybe_forwarder_call(meth, call)
+
+    def _maybe_forwarder_call(self, name: str, call: ast.Call):
+        entry = self._forwarder_names.get(name)
+        if entry is None:
+            return
+        idx, chan = entry
+        if len(call.args) > idx:
+            op, prefix = _op_literal(call.args[idx])
+            if op is not None:
+                self.mod.sends.append(SendSite(op=op, line=call.lineno,
+                                               channel=chan, prefix=prefix))
+
+    # --------------------------------------------------------- handler scan
+
+    def _handler_chain(self, node, fi: FunctionInfo):
+        """Collect dispatch ladders over a variable named ``op``/``tag``.
+
+        Parameters *and* locals count: read loops unpack the tag from
+        ``channel.recv()`` into a local before dispatching on it.  ``==``,
+        ``!=`` (handshake guards) and ``in (…)`` all mark the literal as a
+        known wire op."""
+        ops: List[Tuple[str, int]] = []
+        param_used = None
+        for child in ast.walk(node):
+            if not isinstance(child, ast.Compare) or len(child.ops) != 1:
+                continue
+            left, op, right = child.left, child.ops[0], child.comparators[0]
+            name = None
+            if isinstance(left, ast.Name) and left.id in HANDLER_PARAMS:
+                name = left.id
+            elif (isinstance(left, ast.Subscript)
+                  and isinstance(left.value, ast.Name)
+                  and isinstance(left.slice, ast.Constant)
+                  and left.slice.value == 0
+                  and left.value.id in ("msg", "rep", "reply", "resp",
+                                        "ack")):
+                # reply-tag dispatch: `msg[0] == "meta"` on a framed tuple
+                name = left.value.id
+            if name is None:
+                continue
+            if isinstance(op, (ast.Eq, ast.NotEq)) \
+                    and isinstance(right, ast.Constant) \
+                    and isinstance(right.value, str):
+                ops.append((right.value, child.lineno))
+                param_used = name
+            elif isinstance(op, (ast.In, ast.NotIn)) and \
+                    isinstance(right, (ast.Tuple, ast.List, ast.Set)):
+                for e in right.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, str):
+                        ops.append((e.value, e.lineno))
+                        param_used = name
+        if ops and param_used:
+            self.mod.handlers.append(HandlerChain(
+                func=fi.qualname, param=param_used, ops=ops))
+
+    # ----------------------------------------------------------- forwarders
+
+    def _detect_forwarder(self, node, fi: FunctionInfo):
+        """A function that relays one of its params into a send slot; calls
+        to it with a literal at that position count as protocol sends."""
+        for child in ast.walk(node):
+            if not isinstance(child, ast.Call):
+                continue
+            fn = child.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr == "call" and len(child.args) >= 2:
+                chan = child.args[0]
+                tgt = child.args[1]
+                if isinstance(chan, ast.Constant) \
+                        and isinstance(chan.value, str) \
+                        and isinstance(tgt, ast.Name) \
+                        and tgt.id in fi.params:
+                    self._forwarder_names[fi.name] = (
+                        fi.params.index(tgt.id), chan.value)
+                    fi.forwards = (tgt.id, chan.value)
+                    return
+            if fn.attr == "send" and child.args:
+                tgt = child.args[0]
+                if isinstance(tgt, ast.Name) and tgt.id in fi.params:
+                    self._forwarder_names[fi.name] = (
+                        fi.params.index(tgt.id), None)
+                    fi.forwards = (tgt.id, None)
+                    return
+
+
+def _walk_marking_lambdas(node: ast.AST):
+    """ast.walk that reports whether each node sits under a Lambda or a
+    nested function definition (deferred execution)."""
+    stack = [(node, False)]
+    while stack:
+        cur, in_lambda = stack.pop()
+        yield cur, in_lambda
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # handled as separate functions by the walker
+            stack.append(
+                (child, in_lambda or isinstance(cur, ast.Lambda)))
+
+
+# ------------------------------------------------------------------ tree API
+
+
+def iter_py_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def collect_tree(root: str, doc_roots: Optional[List[str]] = None) -> TreeIndex:
+    """Parse every module under ``root`` into a TreeIndex.
+
+    ``doc_roots`` are directories/files of markdown scanned only as text
+    (for the config-hygiene "mentioned in docs" requirement)."""
+    root = os.path.abspath(root)
+    idx = TreeIndex(root=root)
+    for path in iter_py_files(root):
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            idx.parse_errors.append((rel, str(e)))
+            continue
+        idx.modules[rel] = _ModuleCollector(rel, tree, source).collect()
+    texts = []
+    for droot in doc_roots or []:
+        if os.path.isfile(droot):
+            files = [droot]
+        else:
+            files = [os.path.join(dp, fn)
+                     for dp, _dn, fns in os.walk(droot) for fn in fns
+                     if fn.endswith((".md", ".rst"))]
+        for fpath in files:
+            try:
+                with open(fpath, "r", encoding="utf-8") as f:
+                    texts.append(f.read())
+            except OSError:
+                pass
+    idx.doc_text = "\n".join(texts)
+    return idx

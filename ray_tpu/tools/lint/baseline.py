@@ -1,0 +1,101 @@
+"""Checked-in baseline: grandfathered findings + the wire-op hash.
+
+Format (``baseline.json``, kept next to this module)::
+
+    {
+      "protocol": {"version": 5, "ops_hash": "abcd1234..."},
+      "findings": {
+        "<finding key>": "justification — why this one is intentional",
+        ...
+      }
+    }
+
+Workflow: a finding you cannot (or should not) fix gets an entry with a
+*justification string* — ``--update-baseline`` refuses to invent one, it
+writes ``TODO: justify`` so the reviewer sees exactly what was accepted.
+Entries whose finding disappears become *stale* and are reported so the
+baseline only ever shrinks by being cleaned, never silently.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .checks import Finding
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+@dataclass
+class Baseline:
+    path: Optional[str] = None
+    protocol: Dict = field(default_factory=dict)
+    findings: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Baseline":
+        if path is None or not os.path.exists(path):
+            return cls(path=path)
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(path=path,
+                   protocol=data.get("protocol", {}) or {},
+                   findings=data.get("findings", {}) or {})
+
+    def save(self) -> None:
+        assert self.path is not None
+        data = {"protocol": self.protocol,
+                "findings": dict(sorted(self.findings.items()))}
+        with open(self.path, "w", encoding="utf-8") as f:
+            json.dump(data, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    # ------------------------------------------------------------ matching
+
+    def split(self, findings: List[Finding]):
+        """(unbaselined, baselined, stale_keys).  Duplicate keys within a
+        run are disambiguated with a ``#n`` suffix in first-seen order so
+        two same-shaped findings need two baseline entries."""
+        seen: Dict[str, int] = {}
+        unbaselined: List[Finding] = []
+        baselined: List[Finding] = []
+        used: set = set()
+        for f in findings:
+            n = seen.get(f.key, 0)
+            seen[f.key] = n + 1
+            key = f.key if n == 0 else f"{f.key}#{n}"
+            if key in self.findings:
+                baselined.append(f)
+                used.add(key)
+            else:
+                unbaselined.append(f)
+        stale = [k for k in self.findings if k not in used]
+        return unbaselined, baselined, stale
+
+    def absorb(self, findings: List[Finding], protocol: Dict,
+               ran_checks: Optional[List[str]] = None) -> None:
+        """--update-baseline: record current findings + op hash, keeping
+        existing justifications, dropping stale entries.  With a check
+        filter (``ran_checks``), entries for checks that did NOT run are
+        preserved untouched — a filtered update must never delete another
+        check's justified entries."""
+        seen: Dict[str, int] = {}
+        new: Dict[str, str] = {}
+        if ran_checks is not None:
+            ran = set(ran_checks)
+            for key, justification in self.findings.items():
+                if key.split(":", 1)[0] not in ran:
+                    new[key] = justification
+        for f in findings:
+            n = seen.get(f.key, 0)
+            seen[f.key] = n + 1
+            key = f.key if n == 0 else f"{f.key}#{n}"
+            new[key] = self.findings.get(key, "TODO: justify")
+        self.findings = new
+        self.protocol = protocol

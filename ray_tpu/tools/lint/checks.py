@@ -1,0 +1,536 @@
+"""The graftlint checks.  Each consumes the shared :class:`TreeIndex`.
+
+Check ids are stable API: they appear in suppression comments, baseline
+keys, and docs.  Never rename one; add a new id instead.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import defaultdict, deque
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .analysis import FunctionInfo, ModuleInfo, TreeIndex
+
+CHECK_LOCK_ORDER = "lock-order"
+CHECK_BLOCKING = "blocking-under-lock"
+CHECK_GC = "gc-reentrancy"
+CHECK_PROTOCOL = "protocol-completeness"
+CHECK_PROTOCOL_VERSION = "protocol-version"
+CHECK_CONFIG = "config-hygiene"
+CHECK_METRICS = "metrics-hygiene"
+
+ALL_CHECKS = (
+    CHECK_LOCK_ORDER,
+    CHECK_BLOCKING,
+    CHECK_GC,
+    CHECK_PROTOCOL,
+    CHECK_PROTOCOL_VERSION,
+    CHECK_CONFIG,
+    CHECK_METRICS,
+)
+
+# Blocking kinds that also count as "channel send" for gc-reentrancy.
+GC_BLOCKING_KINDS = {"send", "rpc", "recv"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    check: str
+    path: str       # relative to the scanned root
+    line: int
+    message: str
+    context: str    # enclosing function qualname (or "-")
+    detail: str     # short symbolic token for the baseline key
+
+    @property
+    def key(self) -> str:
+        """Line-number-independent identity used by baseline/suppression
+        bookkeeping — survives unrelated edits to the same file."""
+        return f"{self.check}:{self.path}:{self.context}:{self.detail}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.check}] {self.message}")
+
+
+# ------------------------------------------------------------- call graph
+
+
+class _CallGraph:
+    """Per-module intraprocedural call graph with transitive closures for
+    'locks this function may acquire' and 'ways it may block'."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self._resolved: Dict[str, List[str]] = {}
+        for qual, fi in mod.functions.items():
+            targets = []
+            for cs in fi.calls:
+                tgt = self._resolve(fi, cs.callee, cs.is_self)
+                if tgt is not None:
+                    targets.append(tgt)
+            self._resolved[qual] = targets
+        self.acq_star = self._closure(
+            {q: {a.lock for a in fi.acquires}
+             for q, fi in mod.functions.items()})
+        self.blk_star = self._closure(
+            {q: {(b.kind, b.desc) for b in fi.blocking}
+             for q, fi in mod.functions.items()})
+
+    def _resolve(self, fi: FunctionInfo, callee: str,
+                 is_self: bool) -> Optional[str]:
+        if is_self and fi.cls is not None:
+            qual = f"{fi.cls}.{callee}"
+            if qual in self.mod.functions:
+                return qual
+            return None
+        if callee in self.mod.functions:
+            return callee
+        return None
+
+    def callees(self, qual: str) -> List[str]:
+        return self._resolved.get(qual, [])
+
+    def _closure(self, direct: Dict[str, set]) -> Dict[str, set]:
+        out = {q: set(v) for q, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q in out:
+                for tgt in self._resolved.get(q, ()):
+                    extra = out.get(tgt, set()) - out[q]
+                    if extra:
+                        out[q] |= extra
+                        changed = True
+        return out
+
+    def first_blocking_path(self, root: str) -> Optional[Tuple[List[str], Tuple[str, str]]]:
+        """BFS from ``root``: shortest call path to any blocking site or
+        lock acquire.  Returns (path_of_quals, (kind, desc))."""
+        seen = {root}
+        queue = deque([(root, [root])])
+        while queue:
+            cur, path = queue.popleft()
+            fi = self.mod.functions.get(cur)
+            if fi is None:
+                continue
+            if fi.acquires:
+                a = fi.acquires[0]
+                return path, ("lock-acquire", a.lock)
+            hazards = [b for b in fi.blocking if b.kind in GC_BLOCKING_KINDS]
+            if hazards:
+                return path, (hazards[0].kind, hazards[0].desc)
+            for tgt in self.callees(cur):
+                if tgt not in seen:
+                    seen.add(tgt)
+                    queue.append((tgt, path + [tgt]))
+        return None
+
+
+# ---------------------------------------------------------------- lock-order
+
+
+def check_lock_order(idx: TreeIndex) -> List[Finding]:
+    # edge (outer -> inner) -> representative (path, line, via)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for path, mod in idx.modules.items():
+        cg = _CallGraph(mod)
+        for qual, fi in mod.functions.items():
+            for acq in fi.acquires:
+                for outer in acq.held:
+                    if outer != acq.lock:
+                        edges.setdefault((outer, acq.lock),
+                                         (path, acq.line, qual))
+            for cs in fi.calls:
+                if not cs.held:
+                    continue
+                tgt = cg._resolve(fi, cs.callee, cs.is_self)
+                if tgt is None:
+                    continue
+                for inner in cg.acq_star.get(tgt, ()):
+                    for outer in cs.held:
+                        if outer != inner:
+                            edges.setdefault(
+                                (outer, inner),
+                                (path, cs.line, f"{qual} via {cs.callee}()"))
+    # cycle detection over the lock graph
+    graph: Dict[str, Set[str]] = defaultdict(set)
+    for (a, b) in edges:
+        graph[a].add(b)
+    findings: List[Finding] = []
+    for cycle in _find_cycles(graph):
+        locs = []
+        for i, node in enumerate(cycle):
+            nxt = cycle[(i + 1) % len(cycle)]
+            rep = edges.get((node, nxt))
+            if rep:
+                locs.append(f"{node}->{nxt} at {rep[0]}:{rep[1]} ({rep[2]})")
+        first = edges.get((cycle[0], cycle[1 % len(cycle)]),
+                          ("<unknown>", 0, ""))
+        findings.append(Finding(
+            check=CHECK_LOCK_ORDER, path=first[0], line=first[1],
+            context=first[2].split(" via ")[0],
+            detail="<->".join(cycle),
+            message=("potential deadlock: lock acquisition cycle "
+                     + " -> ".join(cycle + [cycle[0]])
+                     + "; " + "; ".join(locs))))
+    return findings
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Canonical elementary cycles via SCC; one cycle reported per SCC."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str):
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(sorted(comp))
+
+    all_nodes = set(graph) | {w for vs in graph.values() for w in vs}
+    for v in sorted(all_nodes):
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+# ------------------------------------------------------- blocking-under-lock
+
+
+def check_blocking_under_lock(idx: TreeIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, mod in idx.modules.items():
+        cg = _CallGraph(mod)
+        for qual, fi in mod.functions.items():
+            seen_direct: Set[Tuple[str, int]] = set()
+            for b in fi.blocking:
+                if not b.held:
+                    continue
+                if (b.desc, b.line) in seen_direct:
+                    continue
+                seen_direct.add((b.desc, b.line))
+                findings.append(Finding(
+                    check=CHECK_BLOCKING, path=path, line=b.line,
+                    context=qual, detail=f"{b.desc}@{b.kind}",
+                    message=(f"{b.desc}() ({b.kind}) called while holding "
+                             f"{', '.join(b.held)}")))
+            seen_calls: Set[Tuple[str, str]] = set()
+            for cs in fi.calls:
+                if not cs.held:
+                    continue
+                tgt = cg._resolve(fi, cs.callee, cs.is_self)
+                if tgt is None or tgt == qual:
+                    continue
+                blocked = cg.blk_star.get(tgt, ())
+                if not blocked:
+                    continue
+                key = (tgt, ",".join(cs.held))
+                if key in seen_calls:
+                    continue
+                seen_calls.add(key)
+                kinds = sorted({f"{d} ({k})" for k, d in blocked})
+                findings.append(Finding(
+                    check=CHECK_BLOCKING, path=path, line=cs.line,
+                    context=qual, detail=f"call:{tgt}",
+                    message=(f"calls {cs.callee}() while holding "
+                             f"{', '.join(cs.held)}; it may block via "
+                             + ", ".join(kinds[:3]))))
+    return findings
+
+
+# ----------------------------------------------------------- gc-reentrancy
+
+
+def check_gc_reentrancy(idx: TreeIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, mod in idx.modules.items():
+        cg = _CallGraph(mod)
+        roots: List[Tuple[str, int, str]] = []  # (qual, line, why)
+        for qual, fi in mod.functions.items():
+            if fi.name == "__del__":
+                roots.append((qual, fi.line, "__del__"))
+            for cb_name, line in fi.weakref_callbacks:
+                for cand in (f"{fi.cls}.{cb_name}" if fi.cls else None,
+                             cb_name):
+                    if cand and cand in mod.functions:
+                        roots.append((cand, line,
+                                      f"weakref callback ({qual})"))
+                        break
+        for qual, line, why in roots:
+            hit = cg.first_blocking_path(qual)
+            if hit is None:
+                continue
+            call_path, (kind, desc) = hit
+            verb = ("acquires lock " + desc if kind == "lock-acquire"
+                    else f"performs a channel round-trip via {desc} ({kind})")
+            findings.append(Finding(
+                check=CHECK_GC, path=path, line=line, context=qual,
+                detail=f"{why}:{desc}",
+                message=(f"{why} runs inside the garbage collector but its "
+                         f"call graph ({' -> '.join(call_path)}) {verb}; "
+                         "GC can fire on a thread already holding runtime "
+                         "locks — defer to a reaper thread instead "
+                         "(see ObjectRef._drop_queue)")))
+    return findings
+
+
+# ---------------------------------------------------- protocol completeness
+
+
+def _gather_protocol(idx: TreeIndex):
+    handled: Dict[str, List[Tuple[str, str, int]]] = defaultdict(list)
+    chains: List[Tuple[str, "HandlerChain"]] = []  # noqa: F821
+    sent: Dict[str, List[Tuple[str, int]]] = defaultdict(list)
+    prefixes: Dict[str, List[Tuple[str, int]]] = defaultdict(list)
+    # dispatcher functions: chains whose dispatch variable is an actual
+    # parameter — a call `obj.kv("del", …)` with a literal in that slot
+    # is a send even though no channel is visibly involved
+    dispatchers: Dict[str, Set[int]] = defaultdict(set)
+    for path, mod in idx.modules.items():
+        for chain in mod.handlers:
+            chains.append((path, chain))
+            for op, line in chain.ops:
+                handled[op].append((path, chain.func, line))
+            fi = mod.functions.get(chain.func)
+            if fi is not None and chain.param in fi.params:
+                dispatchers[fi.name].add(fi.params.index(chain.param))
+        for s in mod.sends:
+            if s.prefix:
+                prefixes[s.op].append((path, s.line))
+            else:
+                sent[s.op].append((path, s.line))
+    dispatcher_sent: Set[str] = set()
+    for path, mod in idx.modules.items():
+        for leaf, lits, line in mod.lit_calls:
+            for idx_ in dispatchers.get(leaf, ()):
+                for argi, lit in lits:
+                    if argi == idx_:
+                        dispatcher_sent.add(lit)
+    return handled, chains, sent, prefixes, dispatcher_sent
+
+
+def check_protocol_completeness(idx: TreeIndex) -> List[Finding]:
+    handled, chains, sent, prefixes, dispatcher_sent = _gather_protocol(idx)
+    findings: List[Finding] = []
+    for op, sites in sorted(sent.items()):
+        if op in handled:
+            continue
+        path, line = sites[0]
+        findings.append(Finding(
+            check=CHECK_PROTOCOL, path=path, line=line, context="-",
+            detail=f"unhandled:{op}",
+            message=(f"op {op!r} is sent here but no handler chain "
+                     "dispatches on it — a receiver will raise "
+                     "'unknown op' at runtime")))
+    for pfx, sites in sorted(prefixes.items()):
+        if any(op.startswith(pfx) for op in handled):
+            continue
+        path, line = sites[0]
+        findings.append(Finding(
+            check=CHECK_PROTOCOL, path=path, line=line, context="-",
+            detail=f"unhandled-prefix:{pfx}",
+            message=(f"dynamic op prefix {pfx!r}* is sent here but no "
+                     "handler dispatches on any matching op")))
+    # dead handlers: only meaningful in real dispatch ladders (>= 3 ops)
+    for path, chain in chains:
+        if len(chain.ops) < 3:
+            continue
+        for op, line in chain.ops:
+            if op in sent or op in dispatcher_sent:
+                continue
+            if any(op.startswith(p) for p in prefixes):
+                continue
+            findings.append(Finding(
+                check=CHECK_PROTOCOL, path=path, line=line,
+                context=chain.func, detail=f"dead:{op}",
+                message=(f"handler for op {op!r} in {chain.func} has no "
+                         "send site anywhere in the tree — dead wire "
+                         "code or a sender the analyzer cannot see")))
+    return findings
+
+
+def protocol_ops_hash(idx: TreeIndex) -> Tuple[str, Optional[int]]:
+    """Stable digest of the wire-op surface + current PROTOCOL_VERSION."""
+    handled, _chains, sent, prefixes, _disp = _gather_protocol(idx)
+    ops = sorted(set(handled) | set(sent) | {p + "*" for p in prefixes})
+    digest = hashlib.sha256("\n".join(ops).encode()).hexdigest()[:16]
+    version = None
+    for mod in idx.modules.values():
+        if mod.protocol_version is not None:
+            version = (mod.protocol_version if version is None
+                       else max(version, mod.protocol_version))
+    return digest, version
+
+
+def check_protocol_version(idx: TreeIndex,
+                           baseline_protocol: Optional[dict]) -> List[Finding]:
+    digest, version = protocol_ops_hash(idx)
+    if not baseline_protocol:
+        return []
+    base_hash = baseline_protocol.get("ops_hash")
+    base_version = baseline_protocol.get("version")
+    if digest == base_hash:
+        return []
+    where, line = "<tree>", 0
+    for path, mod in idx.modules.items():
+        if mod.protocol_version is not None:
+            where, line = path, 1
+            break
+    if version == base_version:
+        msg = (f"wire-op set changed (hash {base_hash} -> {digest}) but "
+               f"PROTOCOL_VERSION is still {version}: bump it in "
+               "core/protocol.py, then refresh the baseline with "
+               "--update-baseline")
+    else:
+        msg = (f"wire-op set changed (hash {base_hash} -> {digest}) and "
+               f"PROTOCOL_VERSION moved {base_version} -> {version}: "
+               "refresh the recorded op-set baseline with --update-baseline")
+    return [Finding(check=CHECK_PROTOCOL_VERSION, path=where, line=line,
+                    context="-", detail=f"ops-hash:{digest}", message=msg)]
+
+
+# ------------------------------------------------------------ config-hygiene
+
+
+def check_config_hygiene(idx: TreeIndex) -> List[Finding]:
+    config_paths: Set[str] = set()
+    field_vars: Set[str] = set()
+    bootstrap_vars: Set[str] = set()
+    for path, mod in idx.modules.items():
+        if mod.config_fields or mod.bootstrap_env:
+            config_paths.add(path)
+        for f in mod.config_fields:
+            field_vars.add(f"RAY_TPU_{f.upper()}")
+        bootstrap_vars.update(mod.bootstrap_env)
+    findings: List[Finding] = []
+    for path, mod in idx.modules.items():
+        if path in config_paths:
+            continue
+        for read in mod.env_reads:
+            if read.var in bootstrap_vars:
+                if idx.doc_text and read.var not in idx.doc_text:
+                    findings.append(Finding(
+                        check=CHECK_CONFIG, path=path, line=read.line,
+                        context="-", detail=f"undocumented:{read.var}",
+                        message=(f"{read.var} is declared in core/config.py "
+                                 "but not mentioned anywhere under docs/ "
+                                 "or README.md")))
+                continue
+            if read.var in field_vars:
+                findings.append(Finding(
+                    check=CHECK_CONFIG, path=path, line=read.line,
+                    context="-", detail=f"bypass:{read.var}",
+                    message=(f"{read.var} maps to a Config field but is "
+                             "read directly from the environment here — "
+                             "route it through global_config() so cluster-"
+                             "wide config snapshots stay authoritative")))
+                continue
+            findings.append(Finding(
+                check=CHECK_CONFIG, path=path, line=read.line,
+                context="-", detail=f"undeclared:{read.var}",
+                message=(f"{read.var} is read from the environment but "
+                         "declared neither as a Config field nor in "
+                         "BOOTSTRAP_ENV_VARS in core/config.py — every "
+                         "knob must have one discoverable declaration")))
+    return findings
+
+
+# ----------------------------------------------------------- metrics-hygiene
+
+
+def check_metrics_hygiene(idx: TreeIndex) -> List[Finding]:
+    regs: Dict[str, List[Tuple[str, "MetricReg"]]] = defaultdict(list)  # noqa: F821
+    for path, mod in idx.modules.items():
+        for m in mod.metrics:
+            regs[m.name].append((path, m))
+    findings: List[Finding] = []
+    for name, sites in sorted(regs.items()):
+        if len(sites) < 2:
+            continue
+        first_path, first = sites[0]
+        types = {m.mtype for _p, m in sites}
+        tagsets = {m.tag_keys for _p, m in sites if m.tag_keys is not None}
+        for path, m in sites[1:]:
+            if len(types) > 1:
+                msg = (f"metric {name!r} is registered with conflicting "
+                       f"types ({', '.join(sorted(types))}); first "
+                       f"registration at {first_path}:{first.line}")
+                detail = f"type-conflict:{name}"
+            elif len(tagsets) > 1:
+                msg = (f"metric {name!r} is registered with inconsistent "
+                       f"tag sets {sorted(tagsets)}; first registration "
+                       f"at {first_path}:{first.line}")
+                detail = f"tag-conflict:{name}"
+            else:
+                msg = (f"metric {name!r} is registered more than once "
+                       f"(also at {first_path}:{first.line}); register "
+                       "each name exactly once and share the instance")
+                detail = f"duplicate:{name}"
+            findings.append(Finding(
+                check=CHECK_METRICS, path=path, line=m.line,
+                context="-", detail=detail, message=msg))
+    return findings
+
+
+# ------------------------------------------------------------------- driver
+
+
+def run_checks(idx: TreeIndex,
+               baseline_protocol: Optional[dict] = None,
+               checks: Optional[Iterable[str]] = None) -> List[Finding]:
+    wanted = set(checks) if checks else set(ALL_CHECKS)
+    findings: List[Finding] = []
+    if CHECK_LOCK_ORDER in wanted:
+        findings += check_lock_order(idx)
+    if CHECK_BLOCKING in wanted:
+        findings += check_blocking_under_lock(idx)
+    if CHECK_GC in wanted:
+        findings += check_gc_reentrancy(idx)
+    if CHECK_PROTOCOL in wanted:
+        findings += check_protocol_completeness(idx)
+    if CHECK_PROTOCOL_VERSION in wanted:
+        findings += check_protocol_version(idx, baseline_protocol)
+    if CHECK_CONFIG in wanted:
+        findings += check_config_hygiene(idx)
+    if CHECK_METRICS in wanted:
+        findings += check_metrics_hygiene(idx)
+    findings = [f for f in findings
+                if not idx.suppressed(f.path, f.line, f.check)]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.check, f.detail))

@@ -1,0 +1,171 @@
+"""graftlint CLI: ``python -m ray_tpu.tools.lint`` (or ``python -m
+ray_tpu lint``).
+
+Exit codes: 0 clean (all findings baselined/suppressed), 1 unbaselined
+findings, 2 usage or parse failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .analysis import collect_tree
+from .baseline import Baseline, default_baseline_path
+from .checks import ALL_CHECKS, Finding, protocol_ops_hash, run_checks
+
+
+def default_root() -> str:
+    """The installed ray_tpu package directory."""
+    here = os.path.dirname(os.path.abspath(__file__))   # .../ray_tpu/tools/lint
+    return os.path.dirname(os.path.dirname(here))
+
+
+def default_doc_roots(root: str) -> List[str]:
+    repo = os.path.dirname(root)
+    out = []
+    for cand in (os.path.join(repo, "docs"),
+                 os.path.join(repo, "README.md")):
+        if os.path.exists(cand):
+            out.append(cand)
+    return out
+
+
+@dataclass
+class LintReport:
+    findings: List[Finding] = field(default_factory=list)
+    unbaselined: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline_keys: List[str] = field(default_factory=list)
+    parse_errors: List = field(default_factory=list)
+    ops_hash: str = ""
+    protocol_version: Optional[int] = None
+    duration_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.unbaselined and not self.parse_errors
+
+
+def run_lint(root: Optional[str] = None,
+             baseline_path: Optional[str] = None,
+             doc_roots: Optional[List[str]] = None,
+             checks: Optional[List[str]] = None,
+             update_baseline: bool = False,
+             use_baseline: bool = True) -> LintReport:
+    """Programmatic entry point (the tier-1 test calls this)."""
+    t0 = time.monotonic()
+    root = root or default_root()
+    if use_baseline and baseline_path is None:
+        baseline_path = default_baseline_path()
+    if doc_roots is None:
+        doc_roots = default_doc_roots(root)
+    idx = collect_tree(root, doc_roots=doc_roots)
+    baseline = Baseline.load(baseline_path if use_baseline else None)
+    findings = run_checks(idx, baseline_protocol=baseline.protocol,
+                          checks=checks)
+    digest, version = protocol_ops_hash(idx)
+    if update_baseline:
+        baseline.absorb(findings,
+                        {"version": version, "ops_hash": digest},
+                        ran_checks=checks)
+        baseline.path = baseline.path or default_baseline_path()
+        baseline.save()
+        unbaselined, baselined, stale = [], findings, []
+    else:
+        unbaselined, baselined, stale = baseline.split(findings)
+        if checks:
+            # a filtered run cannot judge entries for checks it didn't run
+            wanted = set(checks)
+            stale = [k for k in stale if k.split(":", 1)[0] in wanted]
+    return LintReport(findings=findings, unbaselined=unbaselined,
+                      baselined=baselined, stale_baseline_keys=stale,
+                      parse_errors=idx.parse_errors,
+                      ops_hash=digest, protocol_version=version,
+                      duration_s=time.monotonic() - t0)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m ray_tpu.tools.lint",
+        description=("graftlint: concurrency- and protocol-invariant "
+                     "static analyzer for the ray_tpu runtime"))
+    p.add_argument("--root", default=None,
+                   help="tree to scan (default: the ray_tpu package)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON path (default: the checked-in "
+                        "ray_tpu/tools/lint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to the current findings and "
+                        "wire-op hash (new entries get 'TODO: justify')")
+    p.add_argument("--check", action="append", dest="checks",
+                   metavar="ID", choices=list(ALL_CHECKS),
+                   help="run only this check id (repeatable)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--list-checks", action="store_true",
+                   help="print the stable check ids and exit")
+    args = p.parse_args(argv)
+
+    if args.list_checks:
+        for c in ALL_CHECKS:
+            print(c)
+        return 0
+
+    report = run_lint(root=args.root,
+                      baseline_path=args.baseline,
+                      checks=args.checks,
+                      update_baseline=args.update_baseline,
+                      use_baseline=not args.no_baseline)
+
+    if args.as_json:
+        try:  # noqa: SIM105 — `| head` closing the pipe is not an error
+            _print_json(report)
+        except BrokenPipeError:
+            pass
+        return 0 if report.ok else 1
+
+    for path, err in report.parse_errors:
+        print(f"{path}: PARSE ERROR: {err}", file=sys.stderr)
+    for f in report.unbaselined:
+        print(f.render())
+    if args.update_baseline:
+        print(f"baseline updated: {len(report.findings)} finding(s) "
+              f"recorded, ops hash {report.ops_hash} "
+              f"(PROTOCOL_VERSION {report.protocol_version})")
+        return 0
+    for key in report.stale_baseline_keys:
+        print(f"stale baseline entry (finding no longer fires): {key}",
+              file=sys.stderr)
+    n_sup = len(report.baselined)
+    summary = (f"graftlint: {len(report.unbaselined)} finding(s), "
+               f"{n_sup} baselined, "
+               f"{len(report.stale_baseline_keys)} stale baseline "
+               f"entr(ies), ops hash {report.ops_hash}, "
+               f"{report.duration_s:.2f}s")
+    print(summary)
+    return 0 if report.ok else 1
+
+
+def _print_json(report: LintReport) -> None:
+    print(json.dumps({
+        "ok": report.ok,
+        "ops_hash": report.ops_hash,
+        "protocol_version": report.protocol_version,
+        "duration_s": round(report.duration_s, 3),
+        "unbaselined": [f.__dict__ for f in report.unbaselined],
+        "baselined": [f.key for f in report.baselined],
+        "stale_baseline_keys": report.stale_baseline_keys,
+        "parse_errors": report.parse_errors,
+    }, indent=2))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,43 @@
+"""Planted bugs for ``blocking-under-lock``: a sleep, an Event.wait, and
+an rpc round-trip made while a runtime lock is held — directly and
+through an intraprocedural call.  A Condition.wait is planted as the
+NEGATIVE case (it releases the lock while parked and must NOT be
+flagged).
+
+Never imported or executed; parsed by tests/test_static_analysis.py.
+"""
+
+import threading
+import time
+
+
+class Dispatcher:
+    def __init__(self, rpc):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._ready = threading.Event()
+        self.rpc = rpc
+
+    def drain(self):
+        with self._lock:
+            time.sleep(0.1)  # BUG: sleeping with the dispatch lock held
+
+    def settle(self):
+        with self._lock:
+            self._ready.wait(1.0)  # BUG: Event.wait under the lock
+
+    def fetch(self):
+        with self._lock:
+            return self.rpc.call("rpc", "locate", b"oid")  # BUG: round-trip
+
+    def _slow_probe(self):
+        time.sleep(0.5)
+
+    def probe(self):
+        with self._lock:
+            self._slow_probe()  # BUG: blocks via the callee
+
+    def park_ok(self):
+        # NEGATIVE: Condition.wait releases the lock — not a finding
+        with self._lock:
+            self._cv.wait(timeout=0.1)

@@ -1,0 +1,13 @@
+"""Planted bug for ``config-hygiene``: an environment knob read directly
+with no declaration anywhere (no Config field, no BOOTSTRAP_ENV_VARS
+entry — this fixture tree has no config.py at all).
+
+Never imported or executed; parsed by tests/test_static_analysis.py.
+"""
+
+import os
+
+
+def load():
+    # BUG: undeclared, undocumented knob
+    return os.environ.get("RAY_TPU_BOGUS_KNOB", "0")

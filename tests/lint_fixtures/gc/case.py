@@ -1,0 +1,53 @@
+"""Minimal reconstruction of the PR-2 GC-reentrant ``__del__`` deadlock
+(the bug that motivated graftlint).  PR 2's data plane shipped with
+``ObjectRef.__del__`` synchronously calling ``remove_local_ref``, which
+takes the direct-task manager's lock; the GC can fire on ANY allocation,
+including one made by the completion thread while it already holds that
+very lock — the thread then deadlocks against itself and a stream's EOF
+is lost forever.  Check ``gc-reentrancy`` must flag MiniObjectRef.__del__
+(and the weakref-callback variant below).
+
+Never imported or executed; parsed by tests/test_static_analysis.py.
+"""
+
+import threading
+import weakref
+
+
+class _DirectTaskManager:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.refs = {}
+
+
+_manager = _DirectTaskManager()
+
+
+def remove_local_ref(oid):
+    mgr = _manager
+    with mgr._lock:  # held by the completion thread when GC interrupts it
+        mgr.refs.pop(oid, None)
+
+
+class MiniObjectRef:
+    """The PR-2 shape: release the ref synchronously from __del__."""
+
+    def __init__(self, oid):
+        self.id = oid
+
+    def __del__(self):
+        # BUG: __del__ runs inside the GC; remove_local_ref acquires
+        # _DirectTaskManager's lock -> self-deadlock when the GC fires on
+        # the thread already holding it.  (The shipped fix: append to a
+        # lock-free drop queue drained by a reaper thread.)
+        remove_local_ref(self.id)
+
+
+class WatchedSession:
+    """Same defect via a weakref callback instead of __del__."""
+
+    def _on_collect(self, _ref):
+        remove_local_ref(self)
+
+    def watch(self, obj):
+        return weakref.ref(obj, self._on_collect)

@@ -1,0 +1,53 @@
+"""Planted bug: two methods acquire the same pair of locks in opposite
+orders — the classic ABBA deadlock.  graftlint's ``lock-order`` check
+must report a cycle between Ledger._balance_lock and Ledger._audit_lock.
+
+Never imported or executed; parsed by tests/test_static_analysis.py.
+"""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self._balance_lock = threading.Lock()
+        self._audit_lock = threading.Lock()
+        self.balance = 0
+        self.audit = []
+
+    def credit(self, n):
+        # order: balance -> audit
+        with self._balance_lock:
+            self.balance += n
+            with self._audit_lock:
+                self.audit.append(("credit", n))
+
+    def reconcile(self):
+        # BUG: opposite order, audit -> balance
+        with self._audit_lock:
+            total = sum(n for _, n in self.audit)
+            with self._balance_lock:
+                self.balance = total
+
+
+class CallGraphLedger:
+    """Same inversion, but one side hides behind an intraprocedural call:
+    report() holds _audit_lock and calls _snapshot(), which acquires
+    _balance_lock."""
+
+    def __init__(self):
+        self._balance_lock = threading.Lock()
+        self._audit_lock = threading.Lock()
+
+    def _snapshot(self):
+        with self._balance_lock:
+            return 0
+
+    def transfer(self):
+        with self._balance_lock:
+            with self._audit_lock:
+                pass
+
+    def report(self):
+        with self._audit_lock:
+            return self._snapshot()

@@ -1,0 +1,25 @@
+"""Planted bug for ``metrics-hygiene``: one metric name registered twice
+with different tag sets (silently shards the time series), another
+re-registered with a different type (corrupts the Prometheus export).
+
+Never imported or executed; parsed by tests/test_static_analysis.py.
+"""
+
+
+def Counter(name, description="", tag_keys=()):  # noqa: N802 (AST stub)
+    pass
+
+
+def Gauge(name, description="", tag_keys=()):  # noqa: N802 (AST stub)
+    pass
+
+
+m1 = Counter("fixture_requests_total", "requests", tag_keys=("route",))
+# BUG: same name, different tag set
+m2 = Counter("fixture_requests_total", "requests", tag_keys=("deployment",))
+
+g1 = Gauge("fixture_depth", "queue depth", tag_keys=("q",))
+# BUG: same name re-registered as a different metric type
+g2 = Counter("fixture_depth", "queue depth", tag_keys=("q",))
+
+ok = Counter("fixture_healthy_total", "healthy singleton")

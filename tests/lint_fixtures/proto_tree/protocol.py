@@ -1,0 +1,6 @@
+"""Mini wire-protocol module for the ``protocol-version`` fixture tree.
+The test records this tree's op-set hash in a baseline, then adds an op
+WITHOUT bumping PROTOCOL_VERSION and asserts graftlint objects.
+"""
+
+PROTOCOL_VERSION = 1

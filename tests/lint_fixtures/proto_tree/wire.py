@@ -1,0 +1,27 @@
+"""Healthy mini wire surface: every op sent and handled.  The
+protocol-version test appends a new op pair to a COPY of this file."""
+
+
+class Server:
+    def handle_rpc(self, op, args):
+        if op == "ping":
+            return "pong"
+        if op == "put":
+            return args[0]
+        if op == "get":
+            return args[0]
+        raise ValueError(f"unknown rpc op {op!r}")
+
+
+class Client:
+    def __init__(self, rpc):
+        self.rpc = rpc
+
+    def ping(self):
+        return self.rpc.call("rpc", "ping")
+
+    def put(self, v):
+        return self.rpc.call("rpc", "put", v)
+
+    def get(self, k):
+        return self.rpc.call("rpc", "get", k)

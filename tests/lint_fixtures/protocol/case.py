@@ -1,0 +1,42 @@
+"""Planted bugs for ``protocol-completeness``:
+
+- ``frobnicate`` is sent but no handler chain dispatches on it (the
+  receiver would raise "unknown rpc op" at runtime);
+- ``defragment`` has a handler in a real dispatch ladder but no send
+  site anywhere (dead wire code);
+- ``ping``/``put``/``get`` are the healthy ops (sent AND handled) that
+  must NOT be flagged.
+
+Never imported or executed; parsed by tests/test_static_analysis.py.
+"""
+
+
+class Server:
+    def handle_rpc(self, op, args):
+        if op == "ping":
+            return "pong"
+        if op == "put":
+            return args[0]
+        if op == "get":
+            return args[0]
+        if op == "defragment":  # BUG: dead handler — nothing sends this
+            return None
+        raise ValueError(f"unknown rpc op {op!r}")
+
+
+class Client:
+    def __init__(self, rpc):
+        self.rpc = rpc
+
+    def ping(self):
+        return self.rpc.call("rpc", "ping")
+
+    def put(self, v):
+        return self.rpc.call("rpc", "put", v)
+
+    def get(self, k):
+        return self.rpc.call("rpc", "get", k)
+
+    def frobnicate(self):
+        # BUG: no handler chain anywhere dispatches on "frobnicate"
+        return self.rpc.call("rpc", "frobnicate")

@@ -1,0 +1,27 @@
+"""Suppression fixture: the same planted blocking-under-lock bug twice —
+once suppressed with ``# graftlint: ignore[...]`` (same line and
+line-above forms), once not.  Only the unsuppressed one may fire.
+
+Never imported or executed; parsed by tests/test_static_analysis.py.
+"""
+
+import threading
+import time
+
+
+class Pacer:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def suppressed_inline(self):
+        with self._lock:
+            time.sleep(0.1)  # graftlint: ignore[blocking-under-lock]
+
+    def suppressed_above(self):
+        with self._lock:
+            # graftlint: ignore[blocking-under-lock]
+            time.sleep(0.1)
+
+    def unsuppressed(self):
+        with self._lock:
+            time.sleep(0.1)  # this one MUST still be reported
