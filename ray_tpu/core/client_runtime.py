@@ -160,8 +160,10 @@ class ClientRuntime:
     def kv(self, op: str, *args):
         return self._call("kv", op, args)
 
-    def stream_next(self, task_id, index: int, timeout=None):
-        return self._call("stream_next", task_id, index, timeout)
+    def stream_next(self, task_id, index: int, timeout=None, owner=None):
+        # the owner route rides along: the server (head process) resolves
+        # owner-published streams via its node's stream_sub routing
+        return self._call("stream_next", task_id, index, timeout, owner)
 
     def state_list(self, kind: str, limit: int = 1000):
         return self._call("state_list", kind, limit)

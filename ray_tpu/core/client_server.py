@@ -125,6 +125,12 @@ class _ClientSession:
         if op == "kv":
             return getattr(head.gcs, "kv_" + args[0])(*args[1])
         if op == "stream_next":
+            owner = args[3] if len(args) > 3 else None
+            if owner is not None:
+                # owner-published stream: subscribe via the head node's
+                # routing (worker/peer channels), not head records
+                return head.head_node.serve_stream_sub(
+                    owner, args[0], args[1], args[2] or 2.0)
             return head.stream_next(args[0], args[1], args[2])
         if op == "avail":
             return head.scheduler.available_resources()

@@ -111,6 +111,11 @@ class Config:
     direct_steal_enabled: bool = True
     direct_steal_min_queue: int = 2  # only steal from peers at least this deep
     direct_steal_interval_ms: int = 50
+    # published (cross-process) streams that reached EOF with the local
+    # handle dropped are retained for remote subscribers — bounded FIFO:
+    # past this many, the oldest purge and stragglers see owner-gone
+    # (the owner-side analog of the old head stream-record TTL)
+    published_stream_retain_max: int = 256
 
     # ---- tasks / fault tolerance (reference: ray_config_def.h:138,414,835) ----
     task_retry_delay_ms: int = 0
@@ -180,6 +185,10 @@ class Config:
 
     # ---- fault injection (reference: testing_asio_delay_us :824) ----
     testing_delay_ms: str = ""  # "handler1=ms,handler2=ms" injected latency
+    # artificially slow EVERY control RPC the head serves (ms/op). The
+    # head-freeness proof: with this at >=50, direct actor-call p50 and
+    # cross-process stream items/s must not move (bench_core --actor-bench)
+    test_head_delay_ms: int = 0
 
     # ---- debug assertions ----
     # dynamic lock-order checking (core/lock_debug.py): runtime locks

@@ -61,6 +61,27 @@ _ACTOR_LOC_ERRS = ("ActorMissingError", "NodeDiedError")
 _ACTOR_SYS_ERRS = _ACTOR_LOC_ERRS + ("ActorDiedError", "WorkerCrashedError")
 
 
+def bounded_sub_rounds(call_round: Callable[[float], tuple],
+                       timeout: Optional[float]):
+    """Consumer-side subscription loop: re-issue one bounded (<=2 s)
+    stream_sub round via ``call_round(round_timeout)`` until a non-wait
+    reply or the deadline passes — rounds stay short so parked
+    subscriptions never pin node/peer threads forever. Shared by the
+    worker (rpc round) and driver (head-node route) consumers."""
+    import time as _time
+
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    while True:
+        remaining = (None if deadline is None
+                     else deadline - _time.monotonic())
+        round_t = (2.0 if remaining is None
+                   else max(0.0, min(remaining, 2.0)))
+        rep = call_round(round_t)
+        if rep[0] != "wait" or (remaining is not None
+                                and remaining <= round_t):
+            return rep
+
+
 def actor_call_eligible(spec: TaskSpec) -> bool:
     """Direct-path test for actor method calls. Streaming generator calls
     are eligible too: their item announcements ride the direct reply
@@ -105,12 +126,13 @@ class _StreamState:
         self.handed: set = set()       # item oids returned by stream_next
         self.done: Optional[Tuple[int, bool]] = None  # (total, is_error)
         self.dropped = False           # generator ref released
-        # generator handle serialized out of this process: items + EOF are
-        # mirrored to the head so any consumer can read the stream
+        # generator handle serialized out of this process: the owner keeps
+        # the stream state alive and serves remote subscribers directly
+        # (stream_next_remote) — nothing is mirrored to the head
         self.published = False
         # node that executes the generator (every item announcement
-        # carries it): the location fallback when mirroring an item whose
-        # inline payload was already consumed+dropped locally
+        # carries it): the location hint remote subscribers use to pull
+        # store-resident item payloads peer-to-peer
         self.exec_hex: Optional[str] = None
 
 
@@ -128,25 +150,18 @@ class DirectTaskManager:
       - ``ext_wait(oids, timeout) -> ready_list``: one bounded round of
         availability-checking external (non-owned) objects against the
         cluster object directory.
-      - ``pin(oids)`` / ``unpin(oids)``: keep ``spec.pinned_args`` alive
-        while the task is in flight (reference: submitter arg pinning).
+      - ``on_unpin(oids)``: called (outside the lock) when the last
+        in-flight pin on each oid is released at task settle — the
+        driver wires deferred head-side deletes through it.
     """
 
     def __init__(self, submit: Callable[[TaskSpec], None],
                  ext_wait: Optional[Callable] = None,
-                 pin: Optional[Callable] = None,
-                 unpin: Optional[Callable] = None,
                  locate: Optional[Callable] = None,
-                 publish_stream_item: Optional[Callable] = None,
-                 publish_stream_eof: Optional[Callable] = None):
+                 on_unpin: Optional[Callable] = None):
         self._submit = submit
         self._ext_wait = ext_wait
-        self._pin = pin
-        self._unpin = unpin
-        # one-way mirrors to the head for published streams (a generator
-        # handle that leaves this process); must not block on a reply
-        self._pub_item = publish_stream_item
-        self._pub_eof = publish_stream_eof
+        self._on_unpin = on_unpin
         # optional: hex of the node holding a LARGE external object (the
         # locality signal for args this owner didn't produce)
         self._locate = locate
@@ -162,6 +177,13 @@ class DirectTaskManager:
         self._cv = threading.Condition(self._lock)
         self._pending: Dict[TaskID, TaskSpec] = {}
         self._cancelled: set = set()
+        # ---- owner-side arg pins (reference: reference_count.h submitter
+        # pinning). An in-flight task's ref args stay alive on the OWNER'S
+        # say-so: _pin_counts is consulted by the owner's delete decisions
+        # (holds_pin), holder nodes additionally take a per-task lease
+        # from spec.pinned_args (node.py _arg_leases). No head RPCs.
+        self._task_pins: Dict[TaskID, tuple] = {}
+        self._pin_counts: Dict[ObjectID, int] = {}
         # oids whose ObjectRef died before the task completed: their
         # results are discarded on arrival instead of retained forever
         self._dropped: set = set()
@@ -189,6 +211,13 @@ class DirectTaskManager:
         # final completion), the consumer reads via stream_next — the
         # owner-side replacement for the head's stream records
         self._streams: Dict[TaskID, _StreamState] = {}
+        # published streams that reached EOF with their local handle
+        # dropped: remote subscribers may still read them, so they are
+        # retained — but BOUNDED (FIFO, published_stream_retain_max):
+        # eviction purges the oldest, and a straggling subscriber of an
+        # evicted stream sees ("gone",). The owner-side analog of the
+        # head's old stream-record TTL GC.
+        self._published_done: "OrderedDict[TaskID, bool]" = OrderedDict()
         # ---- dependency resolver state ---------------------------------
         # task_id -> set of oids still unavailable; submit fires when empty
         self._deferred: Dict[TaskID, Set[ObjectID]] = {}
@@ -214,14 +243,13 @@ class DirectTaskManager:
         """Record ownership; resolve dependencies. Returns the spec when it
         is ready to submit now, or None if it was deferred (the resolver
         submits it when its deps become available)."""
-        if self._pin is not None and spec.pinned_args:
-            try:
-                self._pin(list(spec.pinned_args))
-            except Exception:
-                pass
         arg_ids = spec.arg_object_ids()
         with self._lock:
             self._pending[spec.task_id] = spec
+            if spec.pinned_args and spec.task_id not in self._task_pins:
+                self._task_pins[spec.task_id] = tuple(spec.pinned_args)
+                for oid in spec.pinned_args:
+                    self._pin_counts[oid] = self._pin_counts.get(oid, 0) + 1
             if not arg_ids:
                 return spec
             owned: List[ObjectID] = []
@@ -342,7 +370,6 @@ class DirectTaskManager:
         TaskCancelledError on arrival; a still-deferred task is cancelled
         entirely owner-side. Returns True if it was pending."""
         sealed_spec = None
-        pub_eof = None
         with self._lock:
             tid = oid.task_id()
             if tid not in self._pending:
@@ -360,11 +387,9 @@ class DirectTaskManager:
                 for roid in sealed_spec.return_ids():
                     self._results[roid] = (payload, True)
                 if sealed_spec.streaming:
-                    pub_eof = self._settle_stream_locked(sealed_spec, True)
+                    self._settle_stream_locked(sealed_spec, True)
                 self._cv.notify_all()
         if sealed_spec is not None:
-            if pub_eof is not None:
-                self._safe_pub_eof(*pub_eof)
             self._wake_waiters()
             self._release_pins(sealed_spec)
             if (sealed_spec.actor_id is not None
@@ -378,11 +403,36 @@ class DirectTaskManager:
         return True
 
     def _release_pins(self, spec: TaskSpec) -> None:
-        if self._unpin is not None and spec.pinned_args:
+        """Release this task's arg pins (settle path); fires ``on_unpin``
+        for oids whose last pin dropped so the owner can apply any
+        deferred delete."""
+        released: List[ObjectID] = []
+        with self._lock:
+            oids = self._task_pins.pop(spec.task_id, None)
+            if oids:
+                for oid in oids:
+                    n = self._pin_counts.get(oid, 0) - 1
+                    if n <= 0:
+                        self._pin_counts.pop(oid, None)
+                        released.append(oid)
+                    else:
+                        self._pin_counts[oid] = n
+        if released and self._on_unpin is not None:
             try:
-                self._unpin(list(spec.pinned_args))
+                self._on_unpin(released)
             except Exception:
                 pass
+
+    def holds_pin(self, oid: ObjectID) -> bool:
+        """True while an in-flight task owned here pins ``oid`` (the
+        owner's delete decisions consult this instead of head pins)."""
+        with self._lock:
+            return oid in self._pin_counts
+
+    def pin_counts(self) -> Dict[ObjectID, int]:
+        """Snapshot of live in-flight arg pins (memory observability)."""
+        with self._lock:
+            return dict(self._pin_counts)
 
     # ------------------------------------------------------------ complete
 
@@ -395,7 +445,6 @@ class DirectTaskManager:
         resubmit = None
         settled_spec = None
         actor_handoff = None
-        pub_eof = None
         sealed_oids: List[ObjectID] = []
         with self._lock:
             spec = self._pending.get(task_id)
@@ -468,12 +517,10 @@ class DirectTaskManager:
                         # items have replay semantics of their own)
                         self._record_lineage_locked(spec, store_resident)
                 if spec.streaming:
-                    pub_eof = self._settle_stream_locked(
+                    self._settle_stream_locked(
                         spec, err_name is not None or cancelled
                         or any(e for _o, _p, e in results))
                 self._cv.notify_all()
-        if pub_eof is not None:
-            self._safe_pub_eof(*pub_eof)
         if settled_spec is not None or sealed_oids:
             self._wake_waiters()
         if actor_handoff is not None:
@@ -591,32 +638,49 @@ class DirectTaskManager:
             self._deferred.pop(spec.task_id, None)
             for oid in spec.return_ids():
                 self._results[oid] = (payload, True)
-            pub_eof = (self._settle_stream_locked(spec, True)
-                       if spec.streaming else None)
+            if spec.streaming:
+                self._settle_stream_locked(spec, True)
             self._cv.notify_all()
-        if pub_eof is not None:
-            self._safe_pub_eof(*pub_eof)
         self._wake_waiters()
         self._release_pins(spec)
         self.deps_available(spec.return_ids())
 
     # ------------------------------------------------------------ streams
 
-    def _settle_stream_locked(self, spec: TaskSpec, is_err: bool):
-        """Record stream EOF. Returns (tid, total, is_err) when the EOF
-        must also be mirrored to the head (published stream) — the caller
-        pushes it AFTER releasing the lock (the mirror may be a channel
-        send or head call)."""
+    def _settle_stream_locked(self, spec: TaskSpec, is_err: bool) -> None:
+        """Record stream EOF. Published streams keep their state and
+        retained payloads for remote subscribers (bounded retention —
+        see _retire_published_locked)."""
         tid = spec.task_id
         st = self._streams.get(tid)
         if st is None:
             st = self._streams[tid] = _StreamState()
         st.done = (st.count, is_err)
         if st.dropped:
-            self._purge_stream_locked(tid, st)
-        if st.published and self._pub_eof is not None:
-            return (tid, st.count, is_err)
-        return None
+            if st.published:
+                self._retire_published_locked(tid)
+            else:
+                self._purge_stream_locked(tid, st)
+
+    def _retire_published_locked(self, tid: TaskID) -> None:
+        """A published stream is done and its local handle is gone: move
+        it to the bounded retention FIFO; evict the oldest past the cap
+        so a stream-heavy owner's memory stays bounded."""
+        from .config import global_config
+
+        cap = max(1, global_config().published_stream_retain_max)
+        self._published_done[tid] = True
+        self._published_done.move_to_end(tid)
+        while len(self._published_done) > cap:
+            old_tid, _ = self._published_done.popitem(last=False)
+            st = self._streams.get(old_tid)
+            if st is not None:
+                st.handed.clear()  # retention over: free everything
+                self._purge_stream_locked(old_tid, st)
+                # the primary return's retained payload goes too
+                prim = ObjectID.for_task_return(old_tid, 0)
+                self._results.pop(prim, None)
+                self._result_nodes.pop(prim, None)
 
     def _purge_stream_locked(self, tid: TaskID, st: _StreamState) -> None:
         """Free retained item payloads the consumer never read; items that
@@ -627,32 +691,16 @@ class DirectTaskManager:
                 self._results.pop(soid, None)
                 self._result_nodes.pop(soid, None)
         self._streams.pop(tid, None)
-
-    def _safe_pub_item(self, tid, index, payload, node_hex) -> None:
-        try:
-            self._pub_item(tid, index, payload, node_hex)
-        except Exception:
-            pass  # head link gone: local consumers still work
-
-    def _safe_pub_eof(self, tid, total, is_err) -> None:
-        try:
-            self._pub_eof(tid, total, is_err)
-        except Exception:
-            pass
+        self._published_done.pop(tid, None)
 
     def publish_stream(self, task_id: TaskID) -> bool:
         """A generator handle for ``task_id`` is leaving this process
-        (serialization): mirror already-announced items (+ EOF if settled)
-        to the head so ANY consumer can read the stream, and keep
-        mirroring future items as they arrive. FIFO of the owner's
-        channels guarantees the mirror reaches the head before the
-        serialized handle can reach any consumer. Returns False when this
-        manager does not own the stream (borrowed handle re-serialized —
-        the head already has it)."""
-        if self._pub_item is None:
-            return False
-        to_push: List[tuple] = []
-        eof = None
+        (serialization): mark the stream published so its state (item
+        table + EOF) is retained for remote subscribers, which read it
+        straight from this owner over the ``stream_sub`` reply chain —
+        nothing is mirrored to the head. Returns False when this manager
+        does not own the stream (borrowed handle re-serialized — the
+        subscriber keeps the original owner route)."""
         with self._lock:
             st = self._streams.get(task_id)
             spec = self._pending.get(task_id)
@@ -660,27 +708,8 @@ class DirectTaskManager:
                 return False
             if st is None:
                 st = self._streams[task_id] = _StreamState()
-            if st.published:
-                return True
             st.published = True
-            for i in range(st.count):
-                soid = ObjectID.for_stream(task_id, i)
-                res = self._results.get(soid)
-                # payload gone (already consumed + ref dropped): fall back
-                # to the executor node's store copy as the location
-                to_push.append((i, res[0] if res else None,
-                                self._result_nodes.get(soid)
-                                or (None if res else st.exec_hex)))
-            eof = st.done
-        if not to_push and eof is None:
-            # zero items so far: an "open" marker (index -1) so the head
-            # knows the stream exists and consumers wait instead of erroring
-            self._safe_pub_item(task_id, -1, None, None)
-        for i, payload, node_hex in to_push:
-            self._safe_pub_item(task_id, i, payload, node_hex)
-        if eof is not None and self._pub_eof is not None:
-            self._safe_pub_eof(task_id, eof[0], eof[1])
-        return True
+            return True
 
     def on_stream_item(self, task_id: TaskID, index: int,
                        payload: Optional[bytes],
@@ -692,7 +721,6 @@ class DirectTaskManager:
         oid, so reads, hints for dependent tasks, and ref drops all reuse
         the normal owned-result machinery."""
         oid = ObjectID.for_stream(task_id, index)
-        mirror = False
         with self._lock:
             spec = self._pending.get(task_id)
             st = self._streams.get(task_id)
@@ -706,18 +734,12 @@ class DirectTaskManager:
                 st.count = index + 1  # EOF total counts published items too
             if exec_hex:
                 st.exec_hex = exec_hex
-            mirror = st.published and self._pub_item is not None
-            if st.dropped:
-                # local handle gone but a serialized copy lives elsewhere:
-                # mirror without retaining the payload here
-                pass
-            else:
-                self._results[oid] = (payload, False)
-                if payload is None and exec_hex:
-                    self._result_nodes[oid] = exec_hex
+            # retained even when the LOCAL handle is gone, as long as the
+            # stream is published: remote subscribers read items from here
+            self._results[oid] = (payload, False)
+            if payload is None and exec_hex:
+                self._result_nodes[oid] = exec_hex
             self._cv.notify_all()
-        if mirror:
-            self._safe_pub_item(task_id, index, payload, exec_hex)
         self._wake_waiters()
         # downstream tasks may be deferred on this item ref
         self.deps_available([oid])
@@ -744,6 +766,53 @@ class DirectTaskManager:
                         return None  # not direct-owned: head path
                     total, is_err = st.done
                     return ("error",) if is_err else ("end", total)
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return ("wait",)
+                self._cv.wait(remaining if remaining is not None else 0.2)
+
+    def stream_next_remote(self, task_id: TaskID, index: int,
+                           timeout: Optional[float]):
+        """Serve one bounded ``stream_sub`` round for a REMOTE subscriber
+        (a consumer in another process reading a published stream straight
+        from this owner). Replies:
+
+          ("item", oid, payload | None, hint | None)  — inline payloads
+              ship in the reply; store-resident items carry the executor
+              node hex, and the subscriber pulls the bytes peer-to-peer.
+          ("end", total) | ("wait",)
+          ("error", primary_payload | None) — the primary return's error
+              bytes ride along so owner-sealed failures (never executed)
+              are resolvable without a store location.
+          None — this manager does not own the stream (the caller reports
+              the owner gone)."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._lock:
+            while True:
+                st = self._streams.get(task_id)
+                if st is not None and index < st.count:
+                    oid = ObjectID.for_stream(task_id, index)
+                    res = self._results.get(oid)
+                    payload = res[0] if res else None
+                    # hint always rides along: inline items ALSO have a
+                    # store copy at the executor node (sealed before the
+                    # announcement), the consumer's fallback when its own
+                    # store can't hold the shipped payload
+                    hint = self._result_nodes.get(oid) or st.exec_hex
+                    return ("item", oid, payload, hint)
+                pending = task_id in self._pending
+                if not pending:
+                    if st is None or st.done is None:
+                        return None  # not owned here: owner route is stale
+                    total, is_err = st.done
+                    if is_err:
+                        prim = self._results.get(
+                            ObjectID.for_task_return(task_id, 0))
+                        return ("error", prim[0] if prim else None)
+                    return ("end", total)
                 remaining = (None if deadline is None
                              else deadline - _time.monotonic())
                 if remaining is not None and remaining <= 0:
@@ -843,8 +912,15 @@ class DirectTaskManager:
                 st.handed.discard(oid)
                 if oid == ObjectID.for_task_return(tid, 0):
                     st.dropped = True
+                    # published streams keep their state (serialized
+                    # handles elsewhere still subscribe here) under the
+                    # bounded retention FIFO; unpublished ones purge now
                     if tid not in self._pending:
-                        self._purge_stream_locked(tid, st)
+                        if st.published:
+                            if st.done is not None:
+                                self._retire_published_locked(tid)
+                        else:
+                            self._purge_stream_locked(tid, st)
 
 
 class _ActorRoute:

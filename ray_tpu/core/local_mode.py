@@ -201,7 +201,7 @@ class LocalModeRuntime:
                 self._objects[oid] = _StoredError(err)
         return [ObjectRef(oid) for oid in spec.return_ids()]
 
-    def stream_next(self, task_id, index: int, timeout=None):
+    def stream_next(self, task_id, index: int, timeout=None, owner=None):
         with self._lock:
             rec = self._streams.get(task_id)
             if rec is None:

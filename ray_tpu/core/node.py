@@ -67,7 +67,10 @@ class Node:
         self.resources.labels = self.labels
         self.store = LocalObjectStore(
             session_dir, self.hex,
-            pin_check=lambda oid: head.ref_counts.get(oid, 0) > 0)
+            pin_check=self._store_pin_check,
+            # daemons only see the local holder lease (no head pin view):
+            # their stores must spill — never evict — primary copies
+            pin_check_authoritative=hasattr(head, "nodes"))
         self.max_workers = max(1, int(resources.get("CPU", 1)))
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle: deque = deque()
@@ -94,6 +97,16 @@ class Node:
         # happens first
         self._starting_pids: set = set()
         # ---- direct (head-bypass) task path state -----------------------
+        # Holder-side owner leases: while a direct task is in flight
+        # through this node (queued locally or forwarded to a peer), its
+        # pinned ref args may not be evicted from — or deleted out of —
+        # the local store. The lease is the holder's half of the OWNER'S
+        # arg pin (DirectTaskManager._pin_counts) and releases on the
+        # same reply chain that settles the task; no head RPC is involved
+        # (replaces the old per-task pin_delta / is_pinned head ops).
+        self._arg_leases: Dict[ObjectID, int] = {}
+        self._leased_tasks: Dict[object, tuple] = {}
+        self._deferred_deletes: set = set()
         # locally-executing direct tasks: task_id -> (origin, spec)
         self._direct: Dict[object, Tuple[tuple, TaskSpec]] = {}
         # stream-item oids sealed locally for a direct streaming task;
@@ -119,6 +132,12 @@ class Node:
         self._gossip_version = 0
         self._peer_lock = threading.Lock()
         self._peer_key: Optional[bytes] = None    # set by start_object_server
+        # stream_sub round-trips in flight: req_id -> [Event, reply,
+        # owner_worker_id | None] (replies arrive as "srep" from a local
+        # owner worker or "psubrep" from a peer node)
+        self._ssub_pending: Dict[int, list] = {}
+        self._ssub_seq = 0
+        self._ssub_lock = threading.Lock()
         self._devents: List[tuple] = []           # batched head event reports
         self._dev_lock = threading.Lock()
         self._dev_first: float = 0.0
@@ -191,12 +210,14 @@ class Node:
             return
         with self._lock:
             self._direct[spec.task_id] = (origin, spec, time.time())
+            self._lease_args_locked(spec)
         self._ensure_direct_flusher()
         try:
             self.dispatch(spec, {})
         except RuntimeError:
             with self._lock:
                 self._direct.pop(spec.task_id, None)
+            self._task_departed(spec.task_id)
             self._reply_direct(origin, spec.task_id, "NodeDiedError", [])
 
     def _finish_direct(self, origin: tuple, spec: TaskSpec, task_id,
@@ -267,6 +288,7 @@ class Node:
                 peer = origin[1]
                 with peer._lock:
                     peer._forwarded.pop(task_id, None)
+                peer._task_departed(task_id)
                 peer._reply_direct(origin[2], task_id, err_name, results,
                                    exec_hex)
         except (OSError, EOFError):
@@ -282,10 +304,12 @@ class Node:
         if wid is not None:
             with self._lock:
                 self._direct[spec.task_id] = (origin, spec, time.time())
+                self._lease_args_locked(spec)
             self._ensure_direct_flusher()
             if not self.dispatch_to_worker(wid, spec):
                 with self._lock:
                     self._direct.pop(spec.task_id, None)
+                self._task_departed(spec.task_id)
                 # delivery provably failed (worker gone or send raised
                 # before the call hit the wire): a location error — the
                 # owner re-resolves and resubmits without consuming the
@@ -315,6 +339,7 @@ class Node:
             # in-process peer Node
             with self._lock:
                 self._forwarded[spec.task_id] = (origin, spec, handle)
+                self._lease_args_locked(spec)
             handle.submit_direct(spec, ("node", self, origin))
             return True
         ch = self._peer_channel(target, handle)
@@ -323,11 +348,13 @@ class Node:
             return False
         with self._lock:
             self._forwarded[spec.task_id] = (origin, spec, target)
+            self._lease_args_locked(spec)
         try:
             ch.send("psubmit", pickle.dumps(spec))
         except (OSError, EOFError):
             with self._lock:
                 self._forwarded.pop(spec.task_id, None)
+            self._task_departed(spec.task_id)
             self._drop_peer(target)
             spec.direct_hops = 0
             return False
@@ -411,6 +438,7 @@ class Node:
                     pass
             return
         if origin is not None:  # was still queued: never ran
+            self._task_departed(task_id)
             self._reply_direct(origin, task_id, "TaskCancelledError", [])
             return
         # running (or staged) on a worker: interrupt it. Actor calls are
@@ -425,6 +453,314 @@ class Node:
             self.cancel_task(task_id, awid, False)
         else:
             self.cancel_task(task_id, None, force)
+
+    # ---- holder-side owner leases ---------------------------------------
+    # (the node-local half of owner-side arg pinning: no head traffic)
+
+    def _lease_args_locked(self, spec: TaskSpec) -> None:
+        """Take a store lease on the task's pinned ref args (idempotent
+        per task). Caller holds self._lock."""
+        if not spec.pinned_args or spec.task_id in self._leased_tasks:
+            return
+        self._leased_tasks[spec.task_id] = tuple(spec.pinned_args)
+        for oid in spec.pinned_args:
+            self._arg_leases[oid] = self._arg_leases.get(oid, 0) + 1
+
+    def _task_departed(self, task_id) -> None:
+        """A direct task left this node (settled, forwarded away and
+        replied, or failed): release its arg leases, apply any store
+        deletes that were deferred while the lease was held, and let an
+        in-process head retry a cluster-wide delete it deferred behind
+        this lease."""
+        to_delete = []
+        released = []
+        with self._lock:
+            if task_id in self._direct or task_id in self._forwarded:
+                return  # still tracked under the other map
+            oids = self._leased_tasks.pop(task_id, None)
+            if not oids:
+                return
+            for oid in oids:
+                n = self._arg_leases.get(oid, 0) - 1
+                if n > 0:
+                    self._arg_leases[oid] = n
+                else:
+                    self._arg_leases.pop(oid, None)
+                    released.append(oid)
+                    if oid in self._deferred_deletes:
+                        self._deferred_deletes.discard(oid)
+                        to_delete.append(oid)
+        for oid in to_delete:
+            try:
+                self.store.delete(oid)
+            except Exception:
+                pass
+        if released and hasattr(self.head, "release_holder_lease"):
+            # in-process head: retry cluster-wide deletes deferred behind
+            # this node's lease (daemon-side leases only guard their own
+            # store; the daemon's copy is the one the lease protects)
+            try:
+                self.head.release_holder_lease(released)
+            except Exception:
+                pass
+
+    def has_lease(self, oid: ObjectID) -> bool:
+        """Lock-free: an in-flight direct task through this node leases
+        ``oid`` (consulted by the in-process head's delete decisions)."""
+        return self._arg_leases.get(oid, 0) > 0
+
+    def lease_snapshot(self) -> list:
+        """Current leased arg oids (piggybacked on the daemon's periodic
+        sync snapshot so the HEAD's delete decisions can defer behind a
+        daemon-held lease without any per-task wire traffic; staleness is
+        one sync period — the same window the old one-way pin_delta
+        messages had in flight). Never truncated: a dropped lease would
+        silently disable delete protection, so an abnormally large set
+        only costs a bigger sync message (and warns once per minute)."""
+        with self._lock:
+            leases = list(self._arg_leases.keys())
+        if len(leases) > 4096:
+            now = time.monotonic()
+            if now - getattr(self, "_lease_warn_ts", 0.0) > 60.0:
+                self._lease_warn_ts = now
+                from ray_tpu.util import events as events_mod
+
+                events_mod.emit(
+                    "WARNING", events_mod.SOURCE_NODE,
+                    f"node {self.hex[:8]} holds {len(leases)} in-flight "
+                    "arg leases; sync snapshots are growing large",
+                    entity_id=self.hex, leases=len(leases))
+        return leases
+
+    def _store_pin_check(self, oid: ObjectID) -> bool:
+        """Store eviction guard: leased args, head-path pins, and the
+        driver's owner-side pins all protect an object. Lock-free dict
+        reads (same benign-race contract the head ref_counts check had);
+        daemons have no head tables and rely on the local lease alone."""
+        if self._arg_leases.get(oid, 0) > 0:
+            return True
+        rc = getattr(self.head, "ref_counts", None)
+        if rc is not None and rc.get(oid, 0) > 0:
+            return True
+        epc = getattr(self.head, "extra_pin_check", None)
+        if epc is not None:
+            try:
+                return bool(epc(oid))
+            except Exception:
+                return True  # fail pinned: never evict on a glitch
+        return False
+
+    def delete_from_store(self, oid: ObjectID) -> None:
+        """Store deletion that honors holder leases: while an in-flight
+        direct task leases ``oid``, the delete is deferred until the
+        lease releases (owner-release-then-delete ordering)."""
+        with self._lock:
+            if self._arg_leases.get(oid, 0) > 0:
+                self._deferred_deletes.add(oid)
+                return
+        self.store.delete(oid)
+
+    # ---- stream subscriptions (owner-side published streams) -------------
+    # A consumer holding a serialized generator handle subscribes to the
+    # OWNER along the worker<->node<->peer reply channels; the head is
+    # never involved (reference: streaming generator reports are
+    # submitter-side, core_worker.h TryReadObjectRefStream).
+
+    def _ssub_slot(self, worker_id=None):
+        with self._ssub_lock:
+            self._ssub_seq += 1
+            req_id = self._ssub_seq
+            slot = [threading.Event(), None, worker_id]
+            self._ssub_pending[req_id] = slot
+        return req_id, slot
+
+    def _ssub_reply(self, req_id: int, rep) -> None:
+        with self._ssub_lock:
+            slot = self._ssub_pending.pop(req_id, None)
+        if slot is not None:
+            slot[1] = rep
+            slot[0].set()
+
+    def _fail_worker_ssubs(self, worker_id) -> None:
+        """The owner worker died: its parked subscribers learn now."""
+        with self._ssub_lock:
+            gone = [(rid, s) for rid, s in self._ssub_pending.items()
+                    if s[2] == worker_id]
+            for rid, _s in gone:
+                self._ssub_pending.pop(rid, None)
+        for _rid, slot in gone:
+            slot[1] = ("gone", "stream owner worker died")
+            slot[0].set()
+
+    def serve_stream_sub(self, owner, task_id, index: int,
+                         timeout: float):
+        """One bounded subscription round against the stream's owner.
+        Routes: driver-owned -> the driver's manager (in-process hook or
+        peer hop to the head node); worker-owned -> the owner worker via
+        its node (local ``ssub`` round-trip or peer ``psub`` hop).
+        Inline item payloads are sealed into THIS node's store before the
+        reply so the consumer's get resolves locally."""
+        rep = self._route_stream_sub(owner, task_id, index, timeout)
+        if rep is None:
+            rep = ("gone", "stream owner no longer holds the stream")
+        # not a wire-op ladder: rep is stream_next_remote's RETURN tuple
+        # (in-process call or already-framed psubrep payload)
+        # graftlint: ignore[protocol-completeness]
+        if rep[0] == "item" and len(rep) > 2 and rep[2] is not None:
+            oid, payload = rep[1], rep[2]
+            sealed = False
+            try:
+                if not self.store.contains(oid):
+                    self.store.put_inline(oid, payload, False,
+                                          transfer=True)
+                    if hasattr(self.head, "nodes"):
+                        # in-process: registering the cache copy is a
+                        # method call (daemons skip — no per-item sends)
+                        self.head.on_object_sealed(oid, self.hex)
+                sealed = True
+            except Exception:
+                pass  # store full: fall back to the executor-node hint
+            # keep the owner's location hint when the local seal failed —
+            # inline items also have a store copy at the executor node
+            return ("item", oid,
+                    None if sealed else (rep[3] if len(rep) > 3 else None))
+        # graftlint: ignore[protocol-completeness]
+        if rep[0] == "item":
+            return ("item", rep[1], rep[3] if len(rep) > 3 else None)
+        # graftlint: ignore[protocol-completeness]
+        if rep[0] == "error" and len(rep) > 1 and rep[1] is not None:
+            # owner-sealed failure: seal the primary's error payload
+            # locally so the consumer's follow-up get can raise it
+            try:
+                prim = ObjectID.for_task_return(task_id, 0)
+                if not self.store.contains(prim):
+                    self.store.put_inline(prim, rep[1], True,
+                                          transfer=True)
+            except Exception:
+                pass
+            return ("error",)
+        return rep
+
+    def _route_stream_sub(self, owner, task_id, index, timeout):
+        kind = owner[0] if owner else None
+        head = self.head
+        in_process = hasattr(head, "nodes")
+        if kind == "d":
+            if in_process:
+                hook = getattr(head, "owner_stream_next", None)
+                if hook is None:
+                    return ("gone", "driver stream owner gone")
+                return hook(task_id, index, timeout)
+            return self._stream_sub_via_peer(owner, owner[1], task_id,
+                                             index, timeout)
+        if kind == "w":
+            node_hex, wid = owner[1], owner[2]
+            if node_hex == self.hex:
+                return self._stream_sub_local(wid, task_id, index, timeout)
+            if in_process:
+                peer = head.nodes.get(node_hex)
+                if peer is not None and hasattr(peer, "store"):
+                    # in-process peer node: ask its worker directly
+                    return peer._stream_sub_local(wid, task_id, index,
+                                                  timeout)
+            return self._stream_sub_via_peer(owner, node_hex, task_id,
+                                             index, timeout)
+        return ("gone", "unroutable stream owner")
+
+    def serve_stream_sub_local(self, owner, task_id, index, timeout):
+        """Peer-facing entry: serve a subscription whose owner lives in
+        THIS process (the terminal hop of a psub)."""
+        kind = owner[0] if owner else None
+        if kind == "d" and hasattr(self.head, "nodes"):
+            hook = getattr(self.head, "owner_stream_next", None)
+            if hook is None:
+                return ("gone", "driver stream owner gone")
+            return hook(task_id, index, timeout)
+        if kind == "w" and owner[1] == self.hex:
+            return self._stream_sub_local(owner[2], task_id, index, timeout)
+        return ("gone", "stream owner not on this node")
+
+    def _stream_sub_local(self, worker_id, task_id, index, timeout):
+        """Round-trip to the owner worker on THIS node over its channel."""
+        if isinstance(worker_id, bytes):
+            worker_id = WorkerID(worker_id)  # routes carry raw id bytes
+        with self._lock:
+            w = self._workers.get(worker_id)
+        if w is None or w.state == "dead":
+            return ("gone", "stream owner worker died")
+        req_id, slot = self._ssub_slot(worker_id)
+        try:
+            w.channel.send("ssub", req_id, task_id, index, timeout)
+        except OSError:
+            self._ssub_reply(req_id, None)
+            return ("gone", "stream owner worker died")
+        if not slot[0].wait((timeout or 0) + 5.0):
+            with self._ssub_lock:
+                self._ssub_pending.pop(req_id, None)
+            return ("wait",)
+        return slot[1]
+
+    def _stream_sub_via_peer(self, owner, target_hex, task_id, index,
+                             timeout):
+        """Forward the subscription one hop to the owner's node."""
+        handle = self._peer_handle_for(target_hex)
+        if handle is None:
+            return ("gone", "stream owner node gone")
+        if not isinstance(handle, (tuple, list)):
+            return handle.serve_stream_sub_local(owner, task_id, index,
+                                                 timeout)
+        ch = self._peer_channel(target_hex, tuple(handle))
+        if ch is None:
+            return ("gone", "stream owner node unreachable")
+        req_id, slot = self._ssub_slot()
+        try:
+            ch.send("psub", req_id, owner, task_id, index, timeout)
+        except (OSError, EOFError):
+            self._ssub_reply(req_id, None)
+            self._drop_peer(target_hex)
+            return ("gone", "stream owner node unreachable")
+        if not slot[0].wait((timeout or 0) + 5.0):
+            with self._ssub_lock:
+                self._ssub_pending.pop(req_id, None)
+            return ("wait",)
+        rep = slot[1]
+        return rep if rep is not None else (
+            "gone", "stream owner node unreachable")
+
+    def _serve_peer_stream_sub(self, ch: Channel, req_id, owner, task_id,
+                               index, timeout) -> None:
+        """Server side of a peer 'psub'. Driver-owned streams probe
+        inline first (steady state: the item is already in the owner
+        table — no thread spawn per item); worker-owned streams and
+        parking rounds go off-thread (the ssub round-trip / wait must
+        not block the peer reader)."""
+        hook = getattr(self.head, "owner_stream_next", None)
+        if (owner and owner[0] == "d" and hook is not None
+                and hasattr(self.head, "nodes")):
+            try:
+                rep = hook(task_id, index, 0)
+            except Exception:
+                rep = None
+            if rep is not None and rep[0] != "wait":
+                try:
+                    ch.send("psubrep", req_id, rep)
+                except (OSError, EOFError):
+                    pass
+                return
+
+        def run():
+            try:
+                rep = self.serve_stream_sub_local(owner, task_id, index,
+                                                  timeout)
+            except Exception:
+                rep = ("gone", "stream owner errored")
+            try:
+                ch.send("psubrep", req_id, rep)
+            except (OSError, EOFError):
+                pass  # subscriber's node gone
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"psub-{self.hex[:6]}").start()
 
     # ---- spillback -------------------------------------------------------
 
@@ -455,6 +791,7 @@ class Node:
             spec.direct_hops += 1
             with self._lock:
                 self._forwarded[spec.task_id] = (origin, spec, handle)
+                self._lease_args_locked(spec)
             handle.submit_direct(spec, ("node", self, origin))
             self._emit_spillback(spec, handle.hex, depth)
             return True
@@ -466,6 +803,7 @@ class Node:
         spec.direct_hops += 1
         with self._lock:
             self._forwarded[spec.task_id] = (origin, spec, peer_hex)
+            self._lease_args_locked(spec)
         with self._peer_lock:
             self._peer_inflight[peer_hex] = \
                 self._peer_inflight.get(peer_hex, 0) + 1
@@ -475,6 +813,7 @@ class Node:
             spec.direct_hops -= 1
             with self._lock:
                 self._forwarded.pop(spec.task_id, None)
+            self._task_departed(spec.task_id)
             self._drop_peer(peer_hex)
             return False
         self._emit_spillback(spec, peer_hex, depth)
@@ -584,6 +923,13 @@ class Node:
             if tag == "pstream":
                 self.on_peer_stream_item(*payload)
                 continue
+            if tag == "psub":
+                # stream subscription for an owner living in this process
+                self._serve_peer_stream_sub(ch, *payload)
+                continue
+            if tag == "psubrep":
+                self._ssub_reply(*payload)
+                continue
             if tag == "pdone":
                 try:
                     task_id, err_name, results, exec_hex = payload
@@ -591,6 +937,7 @@ class Node:
                     break  # malformed/mixed-version peer: drop it
                 with self._lock:
                     entry = self._forwarded.pop(task_id, None)
+                self._task_departed(task_id)
                 with self._peer_lock:
                     n = self._peer_inflight.get(peer_hex, 0)
                     if n > 0:
@@ -613,6 +960,7 @@ class Node:
             for tid, _ in lost:
                 self._forwarded.pop(tid, None)
         for tid, (origin, spec, _) in lost:
+            self._task_departed(tid)
             self._reply_direct(origin, tid, "NodeDiedError", [])
 
     # ---- batched head events --------------------------------------------
@@ -864,6 +1212,10 @@ class Node:
                         or spec.direct_hops >= 2):
                     keep.appendleft((spec, binding))
                     continue
+                # NOTE: the arg lease (_leased_tasks) intentionally stays:
+                # every caller immediately re-tracks the task in
+                # _forwarded (reply still routes through this victim), so
+                # the lease releases on the normal pdone/depart path
                 del self._direct[spec.task_id]
                 spec.direct_hops += 1
                 out.append((spec, entry[0]))
@@ -902,6 +1254,7 @@ class Node:
             for tid, _e in lost:
                 self._forwarded.pop(tid, None)
         for tid, (origin, spec, _m) in lost:
+            self._task_departed(tid)
             self._reply_direct(origin, tid, "NodeDiedError", [])
 
     def on_peer_done(self, task_id, err_name, results, exec_hex) -> None:
@@ -909,6 +1262,7 @@ class Node:
         spilled) arriving over either peer-session direction."""
         with self._lock:
             entry = self._forwarded.pop(task_id, None)
+        self._task_departed(task_id)
         if entry is not None:
             self._reply_direct(entry[0], task_id, err_name, results,
                                exec_hex)
@@ -1077,9 +1431,10 @@ class Node:
                     self._handle_store(w, req_id, op, args)
             elif tag == "rpc":
                 req_id, op, *args = payload
-                if op == "pub_poll":
-                    # long-parking subscriber polls get their own thread —
-                    # they must not starve the bounded shared pool
+                if op in ("pub_poll", "stream_sub"):
+                    # long-parking rounds (pubsub polls, stream
+                    # subscriptions) get their own thread — they must not
+                    # starve the bounded shared pool
                     threading.Thread(
                         target=self._handle_rpc, args=(w, req_id, op, args),
                         daemon=True, name="pub-poll").start()
@@ -1098,24 +1453,9 @@ class Node:
                 self.submit_direct(spec, ("worker", w.worker_id))
             elif tag == "dcancel":
                 self.cancel_direct(payload[0], payload[1])
-            elif tag == "dpin":
-                # one-way arg pin/unpin for this worker's direct tasks
-                try:
-                    self.head.apply_pin_delta(payload[0], payload[1])
-                except Exception:
-                    pass
-            elif tag == "dspub":
-                # one-way stream-item mirror (published direct stream)
-                try:
-                    self.head.publish_stream_item(*payload)
-                except Exception:
-                    pass
-            elif tag == "dseof":
-                # one-way stream-EOF mirror (published direct stream)
-                try:
-                    self.head.publish_stream_eof(*payload)
-                except Exception:
-                    pass
+            elif tag == "srep":
+                # owner worker's reply to a stream_sub round ("ssub")
+                self._ssub_reply(*payload)
             elif tag == "stream":
                 task_id, index, data = payload
                 self._on_worker_stream_item(task_id, index, data)
@@ -1226,7 +1566,13 @@ class Node:
 
     def _handle_rpc(self, w: WorkerHandle, req_id: int, op: str, args) -> None:
         try:
-            result = self.head.handle_worker_rpc(self, w, op, args)
+            if op == "stream_sub":
+                # owner-routed stream subscription: served by this node's
+                # routing (worker/peer/driver channels) — the head never
+                # sees it
+                result = self.serve_stream_sub(*args)
+            else:
+                result = self.head.handle_worker_rpc(self, w, op, args)
             self._reply(w, req_id, True, result)
         except Exception as e:  # noqa: BLE001
             self._reply(w, req_id, False, e)
@@ -1256,6 +1602,7 @@ class Node:
             # head-bypass path: owner settles (retries live there)
             self._finish_direct(direct[0], direct[1], task_id, results,
                                 err_name, t_start=direct[2])
+            self._task_departed(task_id)
         else:
             # The head decides whether to seal results (it may retry).
             self.head.on_task_finished(self, task_id, err_name, spec, binding,
@@ -1268,7 +1615,9 @@ class Node:
             w.state = "dead"
             self._workers.pop(w.worker_id, None)
             lost = self._drop_actor_direct_locked(w)
+        self._fail_worker_ssubs(w.worker_id)
         for origin, spec, err in lost:
+            self._task_departed(spec.task_id)
             self._reply_direct(origin, spec.task_id, err, [])
         self.head.on_worker_exit(self, w)
 
@@ -1312,11 +1661,14 @@ class Node:
                 self._direct_stream_oids.pop(tid, None)
             lost_actor = self._drop_actor_direct_locked(w)
         w.channel.close()
+        self._fail_worker_ssubs(w.worker_id)
         head_assigned = [e for e in assigned if e[0].task_id not in direct_ids]
         # direct tasks: the OWNER retries — report the crash straight back
         for origin, spec, _t0 in direct:
+            self._task_departed(spec.task_id)
             self._reply_direct(origin, spec.task_id, "WorkerCrashedError", [])
         for origin, spec, err in lost_actor:
+            self._task_departed(spec.task_id)
             self._reply_direct(origin, spec.task_id, err, [])
         if head_assigned:
             for spec, binding, _attempt in head_assigned:

@@ -31,25 +31,6 @@ from .ids import JobID, NodeID, ObjectID
 from .protocol import Channel, RpcClient, connect, parse_address
 
 
-class _PinShim:
-    """dict-like ref_counts view backed by a head RPC (pin_check path).
-
-    Only consulted by the store's reclaim loop under memory pressure, so a
-    sync round-trip is acceptable; fails open to "pinned" so eviction never
-    drops an object the head still references just because the link blipped.
-    """
-
-    def __init__(self, rh: "RemoteHead"):
-        self._rh = rh
-
-    def get(self, oid, default=0):
-        try:
-            return 1 if self._rh.rpc.call("req", "is_pinned", (oid,),
-                                          timeout=5.0) else 0
-        except Exception:
-            return 1
-
-
 class RemoteHead:
     """Daemon-side proxy implementing the Head interface a Node calls."""
 
@@ -61,7 +42,10 @@ class RemoteHead:
         self.job_id = JobID(welcome["job_id"])
         self.node_hex: str = welcome["node_hex"]
         self.cluster_key = cluster_key
-        self.ref_counts = _PinShim(self)
+        # no head-backed pin view: store eviction/delete protection on a
+        # daemon is the node-local holder lease (Node._arg_leases) — the
+        # old per-object is_pinned head RPC is gone from the wire
+        self.ref_counts = None
         self.node = None  # set after Node construction
         self.stopped = threading.Event()
         # fetch_local prefetch kicks (timeout=0 waits): one in-flight
@@ -125,7 +109,8 @@ class RemoteHead:
             elif tag == "cancel":
                 self.node.cancel_task(*payload)
             elif tag == "store_delete":
-                self.node.store.delete(payload[0])
+                # honors in-flight holder leases (deferred until release)
+                self.node.delete_from_store(payload[0])
             elif tag == "push_object":
                 # broadcast-tree root op from the head
                 oid, targets = payload
@@ -172,16 +157,6 @@ class RemoteHead:
 
     def on_stream_item(self, task_id, index: int) -> None:
         self._send("stream_item", task_id, index)
-
-    def publish_stream_item(self, task_id, index: int, payload,
-                            node_hex) -> None:
-        self._send("stream_pub_item", task_id, index, payload, node_hex)
-
-    def publish_stream_eof(self, task_id, total: int, is_err: bool) -> None:
-        self._send("stream_pub_eof", task_id, total, is_err)
-
-    def apply_pin_delta(self, oids, delta: int) -> None:
-        self._send("pin_delta", oids, delta)
 
     def publish_oneway(self, channel: str, message) -> None:
         self._send("pub1", channel, message)

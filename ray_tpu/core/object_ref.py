@@ -172,11 +172,20 @@ class ObjectRefGenerator:
     seals it; StopIteration once the producer finished and all items were
     consumed; a failed producer raises its error (stored on the primary
     return) at the point of failure.
+
+    ``owner`` is the stream's owner route, stamped when the handle leaves
+    the owning process: ``("d", head_node_hex)`` for a driver-owned
+    stream, ``("w", node_hex, worker_id)`` for a worker-owned one, None
+    for head-path streams (the head keeps their records). Consumers in
+    other processes subscribe to the OWNER over this route
+    (``stream_sub``) and pull item payloads peer-to-peer — the head never
+    sees steady-state stream traffic.
     """
 
-    def __init__(self, task_id, primary_ref: ObjectRef):
+    def __init__(self, task_id, primary_ref: ObjectRef, owner=None):
         self._task_id = task_id
         self._primary = primary_ref
+        self._owner = tuple(owner) if owner else None
         self._i = 0
 
     def __iter__(self):
@@ -185,11 +194,16 @@ class ObjectRefGenerator:
     def __next__(self) -> ObjectRef:
         rt = get_runtime()
         while True:
-            rep = rt.stream_next(self._task_id, self._i, timeout=2.0)
+            rep = rt.stream_next(self._task_id, self._i, timeout=2.0,
+                                 owner=self._owner)
             kind = rep[0]
             if kind == "item":
                 self._i += 1
-                ref = ObjectRef(rep[1])
+                # rep[2] (when present) is a location hint: the node whose
+                # store holds the item's bytes — the consumer's get pulls
+                # peer-to-peer instead of asking the directory
+                hint = rep[2] if len(rep) > 2 else None
+                ref = ObjectRef(rep[1], owner_node=hint)
                 ref_tracker.annotate(rep[1], ref_tracker.KIND_STREAM_ITEM)
                 return ref
             if kind == "end":
@@ -198,6 +212,13 @@ class ObjectRefGenerator:
                 # the error payload is sealed on the primary return
                 rt.get([self._primary], timeout=30)
                 raise RuntimeError("streaming task failed")  # unreachable
+            if kind == "gone":
+                from .exceptions import ActorDiedError
+
+                raise ActorDiedError(
+                    None, "stream owner died: "
+                    + (rep[1] if len(rep) > 1 and rep[1]
+                       else "owner process unreachable"))
             # "wait": producer still running
 
     def __len__(self):
@@ -208,13 +229,18 @@ class ObjectRefGenerator:
         return self._primary
 
     def __reduce__(self):
-        # The handle is leaving this process: a direct-path stream lives
-        # only in its owner's buffer, so mirror it to the head first
-        # (publish_stream is a no-op for head-path/borrowed streams).
+        # The handle is leaving this process. If WE own the stream
+        # (direct path), mark it published — the owner retains the item
+        # table and serves subscribers directly — and stamp our owner
+        # route into the pickled handle. A borrowed handle re-serialized
+        # keeps the original route; head-path streams stay route-less
+        # (their consumers use the head's stream records).
+        owner = self._owner
         rt = get_runtime()
-        if rt is not None:
+        if rt is not None and owner is None:
             try:
-                rt.publish_stream(self._task_id)
+                if rt.publish_stream(self._task_id):
+                    owner = rt.stream_owner_route()
             except Exception:
                 pass
-        return (ObjectRefGenerator, (self._task_id, self._primary))
+        return (ObjectRefGenerator, (self._task_id, self._primary, owner))

@@ -241,6 +241,9 @@ class ObjectEntry:
     readers: int = 0
     pending_free: bool = False  # deleted while readers > 0
     created_ts: float = field(default_factory=time.time)  # wall-clock age
+    # receive-side replica (node-to-node pull/push/subscription cache):
+    # the bytes exist elsewhere, so eviction never destroys the only copy
+    transfer: bool = False
 
 
 class LocalObjectStore:
@@ -250,11 +253,17 @@ class LocalObjectStore:
     """
 
     def __init__(self, session_dir: str, node_hex: str, capacity: Optional[int] = None,
-                 pin_check=None):
+                 pin_check=None, pin_check_authoritative: bool = True):
         # pin_check(oid) -> bool: owner-side liveness (head ref counts). Read
         # lock-free by design: called under the store lock, and the head may
         # call into the store while holding its own lock (ABBA otherwise).
+        # pin_check_authoritative=False (daemon stores, which only see the
+        # node-local holder lease — the old per-object is_pinned head RPC
+        # is gone): eviction is then restricted to TRANSFER copies; primary
+        # copies spill to disk instead of being destroyed, since a remote
+        # owner may still reference them.
         self._pin_check = pin_check or (lambda oid: False)
+        self._pin_authoritative = pin_check_authoritative
         cfg = global_config()
         self.capacity = capacity or cfg.object_store_memory
         shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else session_dir
@@ -374,7 +383,7 @@ class LocalObjectStore:
                 self._inline_bytes -= len(e.inline)
             self._entries[oid] = ObjectEntry(
                 oid, size=len(payload), inline=bytes(payload), sealed=True,
-                is_error=is_error,
+                is_error=is_error, transfer=transfer,
             )
             self._inline_bytes += len(payload)
             self._sealed_cv.notify_all()
@@ -412,7 +421,8 @@ class LocalObjectStore:
                     stale.pending_free = True
                 else:
                     self.arena.allocator.free(stale.offset)  # retry overwrote entry
-            self._entries[oid] = ObjectEntry(oid, size=size, offset=off, creating=True)
+            self._entries[oid] = ObjectEntry(oid, size=size, offset=off,
+                                             creating=True, transfer=transfer)
         if not transfer:
             _count_put(size)
         self._publish_gauges()
@@ -622,7 +632,8 @@ class LocalObjectStore:
                 # out (a reader may alias the arena range); explicit delete()
                 # via refcount-0 is the user-driven path that still frees it
                 if (e.ref_count <= 0 and not e.mapped and e.readers <= 0
-                        and not self._pin_check(e.object_id)):
+                        and not self._pin_check(e.object_id)
+                        and (self._pin_authoritative or e.transfer)):
                     self.arena.allocator.free(e.offset)
                     del self._entries[e.object_id]
                     freed += e.size

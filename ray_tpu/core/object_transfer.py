@@ -549,6 +549,12 @@ class ObjectServer:
                 elif tag == "pstream":
                     # stream item of a task this node handed to the peer
                     self.node.on_peer_stream_item(*payload)
+                elif tag == "psub":
+                    # stream subscription for an owner in this process
+                    self.node._serve_peer_stream_sub(ch, *payload)
+                elif tag == "psubrep":
+                    # reply to a subscription this node forwarded out
+                    self.node._ssub_reply(*payload)
         finally:
             self.node.on_peer_session_closed(ch)
 

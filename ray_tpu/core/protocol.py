@@ -34,15 +34,17 @@ from typing import Any, Callable, Dict, Optional, Tuple
 # tags/payload shapes — mixed-version clusters fail fast with a clear
 # error instead of unpickling garbage (the pickle-schema analog of the
 # reference's versioned protobuf wire format, src/ray/protobuf/).
-PROTOCOL_VERSION = 6  # v6: drop the dead worker->node "release" tag —
-# batched ref releases were replaced by owner-side ref accounting
-# (register/unregister_owned_object rpc ops + ref_tracker reports) in the
-# memory-observability rework; the handler outlived its last sender.
-# (v5: memory observability — worker/daemon "refs" ref-table reports +
-# head->daemon store_info/store_info_rep round-trip. v4: pooled
-# multi-request object-transfer connections with stat/pullr (range) ops +
-# arena-direct framing. v3: ddone/pdone carry exec_hex; dpin/pin_delta;
-# owner-resolved ref args — arg_hints in TaskSpec)
+PROTOCOL_VERSION = 7  # v7: head-free actor plane — owner-side ref
+# accounting and stream publication. DELETED head hot-path ops: dpin +
+# pin_delta (arg pins are now the owner's pin table + holder-node
+# leases), is_pinned (daemon store reclaim consults the local lease),
+# dspub/dseof + stream_pub_item/stream_pub_eof (published streams are
+# served BY THE OWNER, never mirrored into the head store). ADDED
+# owner-subscription reply-chain ops: worker->node rpc "stream_sub",
+# node->worker "ssub"/worker->node "srep", peer<->peer "psub"/"psubrep".
+# (v6: dropped dead worker->node "release" tag. v5: memory observability
+# — "refs" reports + store_info/store_info_rep. v4: pooled object
+# transfer, stat/pullr. v3: ddone/pdone exec_hex; arg_hints)
 
 
 class ProtocolVersionError(ConnectionError):

@@ -193,15 +193,24 @@ class Head:
         self.actors: Dict[ActorID, ActorRecord] = {}
         self._waiting_on: Dict[ObjectID, Set[TaskID]] = defaultdict(set)
         self.ref_counts: Dict[ObjectID, int] = defaultdict(int)
-        self.streams: Dict[TaskID, int] = {}  # task_id -> items streamed
-        # published direct-path streams: task_id -> (total, is_err) EOF
-        # (direct tasks have no head task record to signal termination)
-        self.stream_eof: Dict[TaskID, Tuple[int, bool]] = {}
-        self._stream_eof_ts: Dict[TaskID, float] = {}  # for GC
-        # first time an UNKNOWN stream was queried: a cross-channel grace
-        # window for publish mirrors still in flight (stream_next)
-        self._stream_unknown_ts: Dict[TaskID, float] = {}
+        self.streams: Dict[TaskID, int] = {}  # HEAD-PATH task_id -> items
+        # Owner hooks installed by DriverRuntime: the driver process's
+        # direct manager IS an owner like any worker — its in-flight arg
+        # pins guard deletes (extra_pin_check), its pin table joins the
+        # memory view (owner_pin_counts), and its published streams serve
+        # subscribers (owner_stream_next). These terminate at the OWNER
+        # table, not head records: direct-path streams and pins never
+        # create head state.
+        self.extra_pin_check: Optional[Callable[[ObjectID], bool]] = None
+        self.owner_pin_counts: Optional[Callable[[], dict]] = None
+        self.owner_stream_next: Optional[Callable] = None
+        # deletes deferred while an owner pin was live (released via
+        # release_owner_pins on the task-settle reply chain)
+        self._deferred_deletes: Set[ObjectID] = set()
         self.node_loads: Dict[str, dict] = {}  # node hex -> syncer snapshot
+        # daemon-held arg leases, piggybacked on the sync cadence
+        # (kept apart from node_loads, which must stay JSON-safe)
+        self._daemon_leases: Dict[str, set] = {}
         self._view_version = 0
         self._stopped = False
         self._node_listener = None
@@ -300,6 +309,11 @@ class Head:
         with self._lock:
             tables.extend(self._ref_reports.values())
             pins = {oid: n for oid, n in self.ref_counts.items() if n > 0}
+        if self.owner_pin_counts is not None:
+            # the driver's owner-side in-flight arg pins (these replaced
+            # head pin_delta on the direct path) join the pinned column
+            for oid, n in self.owner_pin_counts().items():
+                pins[oid] = pins.get(oid, 0) + n
         now = time.time()
         rows: Dict[ObjectID, dict] = {}
 
@@ -431,26 +445,6 @@ class Head:
                         ObjectID.for_stream(tid, i) for i in range(count))
                 del self.tasks[tid]
                 dropped += 1
-            # published direct-path streams have no task record: GC them
-            # off their own EOF timestamp once consumers released the items
-            for tid, (_total, _e) in list(self.stream_eof.items()):
-                ts = self._stream_eof_ts.get(tid)
-                if ts is not None and now - ts < ttl_s:
-                    continue
-                count = self.streams.get(tid, 0)
-                if any(self.ref_counts.get(ObjectID.for_stream(tid, i), 0)
-                       > 1 for i in range(count)):
-                    continue  # a consumer still holds item refs
-                self.streams.pop(tid, None)
-                self.stream_eof.pop(tid, None)
-                self._stream_eof_ts.pop(tid, None)
-                stream_pins.extend(
-                    ObjectID.for_stream(tid, i) for i in range(count))
-                dropped += 1
-            # stale unknown-stream grace markers (consumer stopped asking)
-            for tid, ts in list(self._stream_unknown_ts.items()):
-                if now - ts > 60.0:
-                    del self._stream_unknown_ts[tid]
             # dead-actor records past the TTL fold away too
             for aid, arec in list(self.actors.items()):
                 if arec.state != "DEAD":
@@ -533,13 +527,27 @@ class Head:
     def on_node_sync(self, proxy, snap: dict) -> None:
         """Merge a daemon's load report (reference: RaySyncer RESOURCE_VIEW
         consumption in the GCS). A sync also counts as liveness."""
+        # leases travel on the sync but live in their own table —
+        # node_loads stays JSON-safe for the state API / dashboard
+        leases = set(snap.pop("leases", None) or ())
+        retry_deletes = []
         with self._lock:
             cur = self.node_loads.get(proxy.hex)
             if cur is not None and cur.get("version", 0) >= snap.get(
                     "version", 0):
                 return  # stale out-of-order update
             self.node_loads[proxy.hex] = snap
+            self._daemon_leases[proxy.hex] = leases
+            if self._deferred_deletes:
+                # a daemon lease releasing shows up as the oid vanishing
+                # from its sync view: retry deletes parked behind it
+                retry_deletes = [oid for oid in self._deferred_deletes
+                                 if oid not in leases
+                                 and self.ref_counts.get(oid, 0) <= 0]
         proxy.last_pong = time.monotonic()
+        for oid in retry_deletes:
+            if not self._stopped:
+                self.delete_object(oid)  # rechecks every pin/lease guard
         info = self.gcs.nodes.get(proxy.hex)
         if info is not None:
             info.last_heartbeat = time.monotonic()
@@ -751,10 +759,6 @@ class Head:
                 self.on_object_sealed(payload[0], proxy.hex)
             elif tag == "stream_item":
                 self.on_stream_item(payload[0], payload[1])
-            elif tag == "stream_pub_item":
-                self.publish_stream_item(*payload)
-            elif tag == "stream_pub_eof":
-                self.publish_stream_eof(*payload)
             elif tag == "worker_metrics":
                 self.on_worker_metrics(payload[0], payload[1])
             elif tag == "worker_log":
@@ -794,8 +798,6 @@ class Head:
                     slot[0].set()
             elif tag == "sealed_payload":
                 self.on_sealed_payload(*payload)
-            elif tag == "pin_delta":
-                self.apply_pin_delta(*payload)
             elif tag == "pub1":
                 self.publish_oneway(*payload)
             elif tag == "req":
@@ -812,6 +814,8 @@ class Head:
                                              req_id, op, args)
 
     def _handle_daemon_req(self, proxy, req_id: int, op: str, args) -> None:
+        if op != "worker_rpc":  # worker_rpc counts inside its handler
+            self._count_head_rpc(op)
         try:
             if op == "locate":
                 result = self._locate_for_daemon(*args)
@@ -819,8 +823,6 @@ class Head:
                 result = self.wait_objects(*args)
             elif op == "worker_rpc":
                 result = self.handle_worker_rpc(None, None, args[0], args[1])
-            elif op == "is_pinned":
-                result = self.ref_counts.get(args[0], 0) > 0
             elif op == "drop_location":
                 oid, node_hex = args
                 self.gcs.remove_object_location(oid, node_hex)
@@ -915,8 +917,18 @@ class Head:
 
         events_mod.emit("WARNING", events_mod.SOURCE_NODE,
                         f"node {node_hex[:8]} dead", entity_id=node_hex)
+        retry_deletes = []
         with self._lock:
             self.node_loads.pop(node_hex, None)
+            # deletes parked behind this daemon's leases must not leak:
+            # the lease died with the node — retry them (delete_object
+            # rechecks every remaining pin/lease guard)
+            if self._daemon_leases.pop(node_hex, None):
+                retry_deletes = [oid for oid in self._deferred_deletes
+                                 if self.ref_counts.get(oid, 0) <= 0]
+        for oid in retry_deletes:
+            if not self._stopped:
+                self.delete_object(oid)
         if self._node_listener is not None:
             self._broadcast_cluster_view()
         node.shutdown()
@@ -1041,6 +1053,22 @@ class Head:
         d = global_config().delay_for(handler)
         if d:
             time.sleep(d)
+
+    def _count_head_rpc(self, op: str) -> None:
+        """Every control RPC the head serves increments
+        ``ray_tpu_head_rpcs_total{op=}`` — the head-freeness gate:
+        steady-state direct actor calls and stream consumption must keep
+        this counter flat. Doubles as the ``RAY_TPU_TEST_HEAD_DELAY_MS``
+        injection point: slowing the head's control loop here must not
+        move direct-path latency/throughput (bench_core --actor-bench)."""
+        from ray_tpu.util.metrics import registry
+
+        registry().record("ray_tpu_head_rpcs_total", "counter",
+                          "control RPCs served by the head process",
+                          (("op", op),), 1.0, mode="add")
+        d = global_config().test_head_delay_ms
+        if d:
+            time.sleep(d / 1000.0)
 
     def _begin_settle(self, rec: TaskRecord) -> bool:
         """Claim the right to settle this attempt; False if another path
@@ -1594,35 +1622,6 @@ class Head:
                          name="metrics-http").start()
         return self._metrics_address
 
-    def publish_stream_item(self, task_id: TaskID, index: int,
-                            payload, node_hex) -> None:
-        """A direct-path stream owner is mirroring item ``index`` here
-        because its generator handle was serialized out of the owning
-        process: seal inline payloads in the head store (store-resident
-        items just register their location) and record the item so ANY
-        consumer's stream_next can read the stream. ``index == -1`` is the
-        stream-open marker (no items yet — consumers wait, not error)."""
-        if index < 0:
-            with self._object_cv:
-                self.streams.setdefault(task_id, 0)
-                self._object_cv.notify_all()
-            return
-        oid = ObjectID.for_stream(task_id, index)
-        if payload is not None:
-            self.on_sealed_payload(oid, payload, False)
-        elif node_hex:
-            self.on_object_sealed(oid, node_hex)
-        self.on_stream_item(task_id, index)
-
-    def publish_stream_eof(self, task_id: TaskID, total: int,
-                           is_err: bool) -> None:
-        """EOF marker for a published direct-path stream (the task has no
-        head task record, so stream_next needs this to terminate)."""
-        with self._object_cv:
-            self.stream_eof[task_id] = (int(total), bool(is_err))
-            self._stream_eof_ts[task_id] = time.monotonic()
-            self._object_cv.notify_all()
-
     def on_stream_item(self, task_id: TaskID, index: int) -> None:
         """A streaming task sealed item ``index`` (reference: streaming
         generator item report). The item gets an owner pin (same semantics
@@ -1638,8 +1637,11 @@ class Head:
 
     def stream_next(self, task_id: TaskID, index: int,
                     timeout: Optional[float]):
-        """Next-item protocol for ObjectRefGenerator: ("item", oid) |
-        ("end", total) | ("error",) | ("wait",) after ``timeout``."""
+        """Next-item protocol for HEAD-PATH streams (tasks the head
+        scheduled and records): ("item", oid) | ("end", total) |
+        ("error",) | ("wait",) after ``timeout``. Direct-path streams
+        never come here — their consumers subscribe to the owner over
+        the stream_sub reply chain."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             with self._object_cv:
@@ -1647,28 +1649,14 @@ class Head:
                 rec = self.tasks.get(task_id)
                 if index < count:
                     return ("item", ObjectID.for_stream(task_id, index))
-                eof = self.stream_eof.get(task_id)
-                if eof is not None:
-                    # published direct-path stream: EOF marker replaces
-                    # the task record
-                    self._stream_unknown_ts.pop(task_id, None)
-                    return ("error",) if eof[1] else ("end", eof[0])
                 if rec is None:
                     if task_id not in self.streams:
-                        # Unknown here — but a publish mirror may still be
-                        # in flight on ANOTHER node->head channel (the
-                        # FIFO guarantee only covers the owner's own
-                        # channel). Grace-wait before declaring it dead.
-                        now = time.monotonic()
-                        first = self._stream_unknown_ts.setdefault(
-                            task_id, now)
-                        if now - first > 10.0:
-                            self._stream_unknown_ts.pop(task_id, None)
-                            return ("error",)
-                    else:
-                        self._stream_unknown_ts.pop(task_id, None)
-                    # published direct stream mid-flight (or mirror in
-                    # flight): wait
+                        # no record and no items: the stream is not (or
+                        # no longer) known here — GC'd or never head-path
+                        return ("error",)
+                    # record folded but items remain (GC kept the pins):
+                    # everything announced was already consumed
+                    return ("end", count)
                 elif rec.state == "FAILED" or rec.cancelled:
                     return ("error",)
                 elif rec.state == "FINISHED":
@@ -1951,20 +1939,64 @@ class Head:
         self._seal_events.discard(event)
 
     def delete_object(self, oid: ObjectID) -> None:
+        # owner-side pin guard: an in-flight direct task owned by the
+        # driver still needs this object — defer; release_owner_pins
+        # (fired on the task-settle reply chain) applies it then
+        epc = self.extra_pin_check
+        if epc is not None and epc(oid):
+            with self._lock:
+                self._deferred_deletes.add(oid)
+            return
+        # holder-lease guard: an in-flight WORKER-owned direct task leases
+        # its args on the node it flows through — that lease must defer
+        # the cluster-wide delete too (the bytes may live on a THIRD node
+        # the executor hasn't pulled from yet); release_holder_lease
+        # retries when the lease drops at task settle
         with self._lock:
+            leased = any(self._is_local(n) and n.has_lease(oid)
+                         for n in self.nodes.values())
+            if not leased:
+                # daemon-held leases arrive on the sync cadence;
+                # on_node_sync retries deferred deletes when a lease
+                # view drops the oid, remove_node when the daemon dies
+                leased = any(oid in ls
+                             for ls in self._daemon_leases.values())
+            if leased:
+                self._deferred_deletes.add(oid)
+                return
+        local_nodes = []
+        with self._lock:
+            self._deferred_deletes.discard(oid)
             locs = self.gcs.get_object_locations(oid)
             for h in locs:
                 node = self.nodes.get(h)
                 if node is not None:
                     if self._is_local(node):
-                        node.store.delete(oid)
+                        local_nodes.append(node)
                     else:
                         node.store_delete(oid)
                 self.gcs.remove_object_location(oid, h)
+        for node in local_nodes:
+            # outside the head lock; holder leases may defer the bytes
+            node.delete_from_store(oid)
+
+    def release_owner_pins(self, oids) -> None:
+        """The driver's direct manager released the last in-flight pin on
+        these oids: apply any delete that was deferred behind the pin."""
+        for oid in oids:
+            with self._lock:
+                pending = oid in self._deferred_deletes
+                refs = self.ref_counts.get(oid, 0)
+            if pending and refs <= 0 and not self._stopped:
+                self.delete_object(oid)
+
+    # a node's holder lease releasing retries the same deferred deletes
+    release_holder_lease = release_owner_pins
 
     # ------------------------------------------------------------ worker RPC
 
     def handle_worker_rpc(self, node: Node, w: WorkerHandle, op: str, args):
+        self._count_head_rpc(op)
         if op == "submit_task":
             spec = pickle.loads(args[0])
             self.submit_spec(spec)
@@ -2138,19 +2170,25 @@ class DriverRuntime:
         self._lock = threading.Lock()
         self._fn_cache: Dict[str, Any] = {}
         # direct (head-bypass) path: the driver owns its eligible plain
-        # tasks, submitted straight to the in-process head node
+        # tasks, submitted straight to the in-process head node. Arg pins
+        # are owner-side (the manager's pin table); the head's delete
+        # decisions consult them via extra_pin_check and retry deferred
+        # deletes when the pin releases at task settle.
         self.direct = DirectTaskManager(
             self._direct_submit,
             ext_wait=lambda oids, t: head.wait_objects(
                 list(oids), len(oids), t),
-            pin=lambda oids: head.apply_pin_delta(oids, 1),
-            unpin=lambda oids: head.apply_pin_delta(oids, -1),
             locate=head.locate_large_object,
-            publish_stream_item=head.publish_stream_item,
-            publish_stream_eof=head.publish_stream_eof)
+            on_unpin=head.release_owner_pins)
         # lost direct results resubmit from this owner's lineage when the
         # head's get loops find no live location
         head.direct_recover = self.direct.recover
+        head.extra_pin_check = self.direct.holds_pin
+        head.owner_pin_counts = self.direct.pin_counts
+        # published driver-owned streams serve remote subscribers straight
+        # from the owner table (stream_sub terminates here, not in head
+        # records)
+        head.owner_stream_next = self.direct.stream_next_remote
 
         # direct actor calls: ordered caller->actor-node submission; the
         # head only resolves locations and keeps the lifecycle FSM
@@ -2320,17 +2358,31 @@ class DriverRuntime:
     def kv(self, op: str, *args):
         return getattr(self.head.gcs, "kv_" + op)(*args)
 
-    def stream_next(self, task_id, index: int, timeout=None):
-        # owner-side stream buffer first (direct-path streams); head path
-        # for streams this driver does not own
+    def stream_next(self, task_id, index: int, timeout=None, owner=None):
+        # owner-side stream buffer first (direct-path streams this driver
+        # owns); borrowed handles with an owner route subscribe to the
+        # OWNER via the head node's peer mesh; only head-path streams
+        # fall through to the head's stream records
         rep = self.direct.stream_next(task_id, index, timeout)
         if rep is not None:
             return rep
+        if owner is not None:
+            from .direct import bounded_sub_rounds
+
+            return bounded_sub_rounds(
+                lambda t: self.head.head_node.serve_stream_sub(
+                    owner, task_id, index, t), timeout)
         return self.head.stream_next(task_id, index, timeout)
 
-    def publish_stream(self, task_id) -> None:
-        # generator handle serialized out of this process (object_ref)
-        self.direct.publish_stream(task_id)
+    def stream_owner_route(self):
+        """This driver's stream-owner address: subscriptions terminate at
+        the driver's direct manager (head.owner_stream_next hook)."""
+        return ("d", self.head.head_node.hex)
+
+    def publish_stream(self, task_id) -> bool:
+        # generator handle serialized out of this process (object_ref):
+        # True = this driver owns it and will serve subscribers
+        return self.direct.publish_stream(task_id)
 
     # ---- refs ----
     def add_local_ref(self, oid: ObjectID) -> None:

@@ -31,6 +31,10 @@ def collect_load(node) -> Dict[str, Any]:
     return {
         "ts": time.time(),
         "queue_depth": len(getattr(node, "_local_queue", ()) or ()),
+        # in-flight direct-task arg leases: the head defers cluster-wide
+        # deletes behind these (owner-side pinning's daemon-visible half)
+        "leases": node.lease_snapshot() if hasattr(node, "lease_snapshot")
+        else [],
         "store_capacity": store.capacity,
         "store_used": int(getattr(store.arena.allocator, "bytes_allocated",
                                   lambda: 0)())

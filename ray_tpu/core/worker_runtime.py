@@ -95,24 +95,15 @@ class WorkerRuntime:
         self._shutdown = threading.Event()
         self.accelerator_binding: Dict[str, List[int]] = {}
         # direct (head-bypass) path: this worker OWNS its eligible nested
-        # submissions (reference: submitter-side TaskManager + memory store)
+        # submissions (reference: submitter-side TaskManager + memory
+        # store). Arg pins are owner-side (the manager's pin table) plus
+        # holder leases the executing node takes from spec.pinned_args —
+        # no pin traffic leaves this process.
         from .direct import DirectTaskManager
 
-        # pin/unpin are ONE-WAY sends: complete() (and with it unpin) runs
-        # on the serve_forever channel-reader thread — a blocking RPC there
-        # would deadlock on its own reply. One-way messages are also FIFO
-        # with dsubmit on the same channel, so a pin always lands first.
         self.direct = DirectTaskManager(
             self._direct_submit,
-            ext_wait=self._ext_wait_objects,
-            pin=lambda oids: self.channel.send("dpin", oids, 1),
-            unpin=lambda oids: self.channel.send("dpin", oids, -1),
-            # stream mirrors are one-way for the same reason as pin/unpin
-            # (EOF publishes can run on the channel-reader thread)
-            publish_stream_item=lambda tid, i, p, nh: self.channel.send(
-                "dspub", tid, i, p, nh),
-            publish_stream_eof=lambda tid, n, e: self.channel.send(
-                "dseof", tid, n, e))
+            ext_wait=self._ext_wait_objects)
         # direct actor calls (resolve runs on the submitter's own resolver
         # thread, so a blocking RPC there is safe)
         from .direct import DirectActorSubmitter
@@ -160,7 +151,10 @@ class WorkerRuntime:
         out = []
         for r in refs:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
-            out.append(self._get_one(r.id, remaining))
+            # owner_node doubles as a location hint (stream items carry
+            # the executor node so the pull goes peer-to-peer)
+            hint = r.owner_node if isinstance(r.owner_node, str) else None
+            out.append(self._get_one(r.id, remaining, hint))
         return out
 
     def _get_one(self, oid: ObjectID, timeout: Optional[float],
@@ -416,6 +410,28 @@ class WorkerRuntime:
                     task_id, index, data, exec_hex = payload
                     self.direct.on_stream_item(task_id, index, data,
                                                exec_hex)
+                elif tag == "ssub":
+                    # a remote consumer subscribed to a stream this worker
+                    # owns. Steady state (item already buffered) answers
+                    # INLINE — a zero-timeout probe off the reader thread
+                    # costs one lock hop; only a round that would PARK
+                    # (next item not produced yet) gets its own thread.
+                    req_id, task_id, index, sub_t = payload
+                    try:
+                        rep = self.direct.stream_next_remote(
+                            task_id, index, 0)
+                    except Exception:
+                        rep = None
+                    if rep is not None and rep[0] != "wait":
+                        self.channel.send("srep", req_id, rep)
+                    elif rep is None:
+                        self.channel.send(
+                            "srep", req_id,
+                            ("gone", "not the stream owner"))
+                    else:
+                        threading.Thread(
+                            target=self._serve_stream_sub, args=payload,
+                            daemon=True, name="ssub").start()
                 elif tag == "exec":
                     spec: TaskSpec = pickle.loads(payload[0])
                     binding = payload[1]
@@ -784,17 +800,50 @@ class WorkerRuntime:
         spec.streaming = False  # primary return is a normal value now
         self._finish(spec, count)
 
-    def stream_next(self, task_id, index: int, timeout=None):
-        # owner-side stream buffer first (direct-path streams); head path
-        # for streams this worker does not own
+    def stream_next(self, task_id, index: int, timeout=None, owner=None):
+        # owner-side stream buffer first (direct-path streams this worker
+        # owns); then the stream's owner route (subscribe straight to the
+        # owning process over the node/peer reply channels); the head
+        # only serves streams it actually records (head-path tasks)
         rep = self.direct.stream_next(task_id, index, timeout)
         if rep is not None:
             return rep
+        if owner is not None:
+            return self._stream_sub_rounds(owner, task_id, index, timeout)
         return self.rpc.call("rpc", "stream_next", task_id, index, timeout)
 
-    def publish_stream(self, task_id) -> None:
-        # generator handle serialized out of this process (object_ref)
-        self.direct.publish_stream(task_id)
+    def _stream_sub_rounds(self, owner, task_id, index: int,
+                           timeout: Optional[float]):
+        from .direct import bounded_sub_rounds
+
+        return bounded_sub_rounds(
+            lambda t: self.rpc.call("rpc", "stream_sub", owner, task_id,
+                                    index, t, timeout=None), timeout)
+
+    def stream_owner_route(self):
+        """This process's stream-owner address, stamped into serialized
+        generator handles so consumers subscribe here directly."""
+        return ("w", self.node_hex, self.worker_id)
+
+    def publish_stream(self, task_id) -> bool:
+        # generator handle serialized out of this process (object_ref):
+        # True = we own it and will serve subscribers
+        return self.direct.publish_stream(task_id)
+
+    def _serve_stream_sub(self, req_id: int, task_id, index: int,
+                          timeout) -> None:
+        """Owner side of one stream_sub round: read from this worker's
+        own stream table and reply over the node channel ("srep")."""
+        try:
+            rep = self.direct.stream_next_remote(task_id, index, timeout)
+        except Exception:
+            rep = None
+        if rep is None:
+            rep = ("gone", "not the stream owner")
+        try:
+            self.channel.send("srep", req_id, rep)
+        except (OSError, EOFError):
+            pass  # node gone: the subscriber's round times out
 
     def _send_error(self, spec: TaskSpec, exc: Exception) -> None:
         if isinstance(exc, TaskError):

@@ -76,30 +76,52 @@ def test_compiled_error_propagates(ray_start_regular):
 
 def test_compiled_beats_eager(ray_start_regular):
     """The point of compiling: >=5x over eager actor calls on a 3-actor
-    pipeline (round-1 review gate). Asserted at 4x for CI noise headroom;
-    measured ~12x on the 1-core box."""
+    pipeline (round-1 review gate). Measured ~12x on an idle 1-core box,
+    but single-shot timing on the shared CI box swung +-20% and failed
+    ~1/3 runs at a 4x threshold. Per ADVICE.md: interleave eager and
+    compiled reps (so load spikes hit both modes) and compare
+    min-of-rounds — the best round of each mode is the least
+    noise-contaminated estimate — with the gate at 4x."""
     a, b, c = Stage.remote(1), Stage.remote(10), Stage.remote(100)
     ray_tpu.get([a.step.remote(0), b.step.remote(0), c.step.remote(0)])
-    N = 150
-    t0 = time.perf_counter()
-    for i in range(N):
-        ray_tpu.get(c.step.remote(
-            ray_tpu.get(b.step.remote(ray_tpu.get(a.step.remote(i))))))
-    eager_dt = time.perf_counter() - t0
+    N = 60
+    ROUNDS = 3
+
+    def eager_round():
+        t0 = time.perf_counter()
+        for i in range(N):
+            ray_tpu.get(c.step.remote(
+                ray_tpu.get(b.step.remote(ray_tpu.get(a.step.remote(i))))))
+        return time.perf_counter() - t0
 
     with InputNode() as inp:
         out = c.step.bind(b.step.bind(a.step.bind(inp)))
     compiled = out.experimental_compile()
-    try:
-        compiled.execute(0).get()  # warm the loops
+
+    def compiled_round():
         t0 = time.perf_counter()
         for i in range(N):
             assert compiled.execute(i).get() == i + 111
-        comp_dt = time.perf_counter() - t0
+        return time.perf_counter() - t0
+
+    eager_dts, comp_dts = [], []
+    try:
+        compiled.execute(0).get()  # warm the resident loops
+        eager_round()              # warm the eager path symmetrically
+        for r in range(ROUNDS):
+            # alternate order so systematic load drift hits both modes
+            if r % 2 == 0:
+                eager_dts.append(eager_round())
+                comp_dts.append(compiled_round())
+            else:
+                comp_dts.append(compiled_round())
+                eager_dts.append(eager_round())
     finally:
         compiled.teardown()
-    speedup = eager_dt / comp_dt
-    assert speedup >= 4.0, f"compiled only {speedup:.1f}x faster than eager"
+    speedup = min(eager_dts) / min(comp_dts)
+    assert speedup >= 4.0, (
+        f"compiled only {speedup:.1f}x faster than eager "
+        f"(eager rounds {eager_dts}, compiled rounds {comp_dts})")
 
 
 def test_channel_direct():

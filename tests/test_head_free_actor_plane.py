@@ -1,0 +1,239 @@
+"""Head-free actor plane invariants (the ownership model, arXiv:1712.05889).
+
+After placement, steady-state direct actor calls and cross-process
+stream consumption must not touch the head: no control RPCs
+(ray_tpu_head_rpcs_total flat), no item payloads mirrored into the head
+store (the pre-v7 publish path uploaded every item there), and in-flight
+arg pins live owner-side instead of as head pin_delta RPCs.
+"""
+
+import gc
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.core import runtime as runtime_mod
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_ref import flush_pending_drops
+
+
+def _head():
+    return runtime_mod.get_current_runtime().head
+
+
+def _head_rpcs():
+    from ray_tpu.util.metrics import registry
+
+    m = registry().snapshot().get("ray_tpu_head_rpcs_total")
+    return dict(m["values"]) if m else {}
+
+
+def _store_puts():
+    from ray_tpu.util.metrics import registry
+
+    m = registry().snapshot().get("ray_tpu_object_store_puts_total")
+    return sum(m["values"].values()) if m else 0.0
+
+
+def test_steady_state_actor_calls_make_zero_head_rpcs(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def m(self, x):
+            return x
+
+        def stream(self, n):
+            for i in range(n):
+                yield i
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote(0))  # create + resolve (head ops expected)
+    assert [ray_tpu.get(r) for r in a.stream.options(
+        num_returns="streaming").remote(2)] == [0, 1]
+
+    before = _head_rpcs()
+    for i in range(50):
+        assert ray_tpu.get(a.m.remote(i)) == i
+    assert sum(1 for _ in a.stream.options(
+        num_returns="streaming").remote(20)) == 20
+    after = _head_rpcs()
+    diff = {k: after.get(k, 0) - before.get(k, 0)
+            for k in set(before) | set(after)
+            if after.get(k, 0) != before.get(k, 0)}
+    assert not diff, f"steady-state actor traffic hit the head: {diff}"
+
+
+def test_cross_process_stream_payloads_never_touch_head_store():
+    """Acceptance gate: a stream produced on one daemon and consumed on
+    another moves its item payloads peer-to-peer — the head process's
+    store telemetry must not see them (pre-v7, publish_stream mirrored
+    every payload into the head store)."""
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=2, resources={"prod": 2},
+                     separate_process=True)
+    cluster.add_node(num_cpus=2, resources={"cons": 2},
+                     separate_process=True)
+    try:
+        @ray_tpu.remote(resources={"prod": 1})
+        class Producer:
+            def stream(self, n):
+                for i in range(n):
+                    yield ("item", i, b"x" * 256)
+
+        @ray_tpu.remote(resources={"cons": 1})
+        def consume(g):
+            return [ray_tpu.get(r)[1] for r in g]
+
+        p = Producer.remote()
+        # warm function caches / channels (cold-start head ops OK here)
+        g0 = p.stream.options(num_returns="streaming").remote(2)
+        assert ray_tpu.get(consume.remote(g0)) == [0, 1]
+
+        head = _head()
+        puts0 = _store_puts()
+        n = 40
+        g = p.stream.options(num_returns="streaming").remote(n)
+        tid = g._task_id
+        assert ray_tpu.get(consume.remote(g)) == list(range(n))
+        # 1) no stream records or EOF mirrors head-side
+        assert not head.streams
+        # 2) no item payload landed in the head store
+        head_oids = {row[0] for row in head.head_node.store.object_infos()}
+        item_oids = {ObjectID.for_stream(tid, i) for i in range(n)}
+        assert not (head_oids & item_oids), \
+            "stream item payloads were written into the head store"
+        # 3) store telemetry: the head process's put counter moved by at
+        # most the consume task's own (inline-result seal) writes — far
+        # below one put per item, which is what the old mirror did
+        assert _store_puts() - puts0 <= 3
+    finally:
+        cluster.shutdown()
+
+
+def test_worker_owned_stream_consumed_by_driver_across_daemons():
+    """The reverse route: a WORKER-owned stream (nested streaming task
+    submitted from inside an actor) whose handle returns to the driver —
+    the driver subscribes to the owner worker over the peer mesh."""
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=2, resources={"far": 2},
+                     separate_process=True)
+    try:
+        @ray_tpu.remote(resources={"far": 1})
+        class Maker:
+            def make_stream(self, n):
+                @ray_tpu.remote
+                def gen(k):
+                    for i in range(k):
+                        yield i * 3
+
+                # the worker owns this stream; the handle leaves via the
+                # method's return value
+                return gen.options(num_returns="streaming").remote(n)
+
+        m = Maker.remote()
+        g = ray_tpu.get(m.make_stream.remote(5))
+        assert [ray_tpu.get(r) for r in g] == [0, 3, 6, 9, 12]
+        assert not _head().streams
+    finally:
+        cluster.shutdown()
+
+
+def test_inflight_arg_pin_is_owner_side(ray_start_regular):
+    """Dropping the last ObjectRef to an in-flight task's arg must not
+    delete the object under the task (this protection used to be head
+    pin_delta RPCs; now it's the owner's pin table + holder leases), and
+    the deferred delete must apply after the task settles."""
+    @ray_tpu.remote
+    class Gate:
+        def __init__(self):
+            self.opened = False
+
+        def open(self):
+            self.opened = True
+
+        def wait_open(self):
+            while not self.opened:
+                time.sleep(0.01)
+            return True
+
+    # concurrency 2: wait_open parks one actor thread while open() lands
+    gate = Gate.options(max_concurrency=2).remote()
+
+    @ray_tpu.remote
+    def task(x, _gate):
+        ray_tpu.get(_gate.wait_open.remote())
+        return len(x)
+
+    payload = b"p" * 300_000  # store-resident (above inline threshold)
+    ref = payload_ref = ray_tpu.put(payload)
+    oid = ref.id
+    head = _head()
+    rt = runtime_mod.get_current_runtime()
+    r = task.remote(payload_ref, gate)
+    # drop the only user handle while the task is still blocked
+    del ref, payload_ref
+    gc.collect()
+    flush_pending_drops(timeout=5.0)
+    assert rt.direct.holds_pin(oid), "owner-side pin missing"
+    gate.open.remote()
+    assert ray_tpu.get(r, timeout=60) == 300_000
+    # after settle the pin releases and the deferred delete applies
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        gc.collect()
+        flush_pending_drops(timeout=1.0)
+        if (not rt.direct.holds_pin(oid)
+                and not head.head_node.store.contains(oid)):
+            break
+        time.sleep(0.05)
+    assert not rt.direct.holds_pin(oid)
+    assert not head.head_node.store.contains(oid), \
+        "deferred delete never applied after the pin released"
+
+
+def test_holder_lease_defers_cluster_delete(ray_start_regular):
+    """A node's holder lease (a worker-owned in-flight task's pinned
+    arg) must defer the HEAD's cluster-wide delete — not just the local
+    store bytes — and the delete must apply when the lease releases."""
+    import types
+
+    head = _head()
+    node = head.head_node
+    ref = ray_tpu.put(b"q" * 200_000)  # store-resident
+    oid = ref.id
+    spec = types.SimpleNamespace(pinned_args=[oid], task_id="fake-tid")
+    with node._lock:
+        node._direct["fake-tid"] = ((None,), spec, 0.0)
+        node._lease_args_locked(spec)
+    del ref
+    gc.collect()
+    flush_pending_drops(timeout=5.0)
+    # head saw the ref drop; the delete must be parked behind the lease
+    deadline = time.monotonic() + 3
+    while head.ref_counts.get(oid, 0) > 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert node.store.contains(oid), "delete ignored the holder lease"
+    with node._lock:
+        node._direct.pop("fake-tid")
+    node._task_departed("fake-tid")
+    deadline = time.monotonic() + 5
+    while node.store.contains(oid) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not node.store.contains(oid), \
+        "deferred delete never applied after the lease released"
+
+
+def test_head_rpc_counter_registered(ray_start_regular):
+    """The counter exists in the standard registry with the op tag as
+    soon as any head RPC is served."""
+    @ray_tpu.remote(num_cpus=2)  # head path: guarantees head activity
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote()) == 1
+    from ray_tpu.util.metrics import registry
+
+    m = registry().snapshot().get("ray_tpu_head_rpcs_total")
+    assert m is not None and m["type"] == "counter"
+    assert any(k and k[0][0] == "op" for k in m["values"])

@@ -192,7 +192,6 @@ def test_direct_stream_zero_head_records(ray_start_regular):
 
     assert len(head.tasks) == before  # no new head task records
     assert not head.streams           # no head stream records
-    assert not head.stream_eof        # nothing was published
 
 
 def test_stream_across_daemon_nodes(ray_start_cluster):
