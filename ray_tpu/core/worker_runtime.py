@@ -36,12 +36,7 @@ from .protocol import Channel, RpcClient, connect
 from .task_spec import TaskSpec
 
 
-def _is_arraylike(v) -> bool:
-    """jax.Array / np.ndarray results take the typed tensor channel.
-    Object dtypes can't view as raw bytes — they serialize instead."""
-    return (hasattr(v, "dtype") and hasattr(v, "shape")
-            and hasattr(v, "__array__")
-            and not getattr(v.dtype, "hasobject", True))
+from ray_tpu.experimental.channel import is_arraylike as _is_arraylike
 
 
 class _ActorState:
@@ -58,6 +53,13 @@ class _ActorState:
         else:
             self.loop = None
             self.pool = ThreadPoolExecutor(max_workers=max_concurrency)
+        # serial actors (sync, max_concurrency=1): compiled-graph executor
+        # loops call the method DIRECTLY under this lock instead of paying
+        # the ~100us pool submit/result thread handoff per hop; eager
+        # method bodies take the same lock on their pool thread, so the
+        # one-method-at-a-time actor contract holds across both planes
+        self.exec_lock = (threading.Lock()
+                          if not is_async and max_concurrency == 1 else None)
 
 
 class WorkerRuntime:
@@ -566,6 +568,7 @@ class WorkerRuntime:
     def _compiled_exec_loop(self, ins, outs, propagate, st, method,
                             template, device) -> None:
         from ray_tpu.experimental.channel import (
+            TAG_BYTES,
             TAG_ERROR,
             TAG_STOP,
             TAG_TENSOR,
@@ -573,8 +576,10 @@ class WorkerRuntime:
         )
 
         while True:
-            # one message per in-edge per execution (lockstep rounds;
-            # reference: per-execution index across CompiledTasks)
+            # one message per in-edge per execution (per-round joins;
+            # reference: per-execution index across CompiledTasks). With
+            # ring channels up to max_inflight rounds queue per edge, so
+            # this loop pipelines against its up/downstream stages.
             edge_vals = []
             failed = None
             for ch in ins:
@@ -587,8 +592,8 @@ class WorkerRuntime:
                     return  # channel unlinked (teardown race)
                 if tag == TAG_ERROR:
                     failed = payload  # upstream error passes through
-                elif tag == TAG_TENSOR:
-                    edge_vals.append(payload)
+                elif tag == TAG_TENSOR or tag == TAG_BYTES:
+                    edge_vals.append(payload)  # typed/raw: no serializer
                 else:
                     edge_vals.append(serialization.deserialize(payload))
             if failed is not None:
@@ -604,15 +609,28 @@ class WorkerRuntime:
                 if st.is_async and asyncio.iscoroutinefunction(method):
                     result = asyncio.run_coroutine_threadsafe(
                         method(*args), st.loop).result()
+                elif st.exec_lock is not None:
+                    # serial-actor fast path: direct call on this loop's
+                    # thread, mutually excluded with eager calls. The
+                    # contract is one-method-at-a-time, NOT
+                    # one-thread-forever: compiled executions run here,
+                    # not on the pool thread (reference: do_exec_tasks
+                    # loops own their thread too)
+                    with st.exec_lock:
+                        result = method(*args)
                 else:
                     result = st.pool.submit(method, *args).result()
                 if device and _is_arraylike(result):
                     for ch in outs:
                         ch.write_array(result)
-                else:
-                    payload = serialization.serialize(result).to_bytes()
+                elif type(result) is bytes:
+                    # raw-bytes results skip the serializer both ways
                     for ch in outs:
-                        ch.write(payload)
+                        ch.write(result, tag=TAG_BYTES)
+                else:
+                    sobj = serialization.serialize(result)
+                    for ch in outs:
+                        ch.write_serialized(sobj)
             except Exception as e:  # noqa: BLE001 — ship to consumer
                 err = TaskError.from_exception(
                     getattr(method, "__name__", "compiled"), e)
@@ -704,7 +722,13 @@ class WorkerRuntime:
                     self._finish(spec, None)
                     return
                 method = getattr(st.instance, fn_name)
-                result = method(*args, **kwargs)
+                if st.exec_lock is not None:
+                    # serialize with compiled-graph direct calls (the
+                    # pool alone no longer owns all method executions)
+                    with st.exec_lock:
+                        result = method(*args, **kwargs)
+                else:
+                    result = method(*args, **kwargs)
                 self._finish(spec, result)
             else:
                 fn = self.get_function(spec.function_id)

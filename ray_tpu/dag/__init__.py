@@ -12,8 +12,12 @@ bookkeeping are out of the loop entirely.
 Arbitrary DAGs are supported (round 4; reference compiles arbitrary
 graphs): multi-upstream nodes read one message per in-edge per
 execution, multi-consumer nodes fan their result out to every out-edge.
-Execution is lockstep per edge (single-slot rendezvous channels), so a
-diamond's branches run concurrently and join deterministically.
+Every edge is an N-slot ring channel sized by
+``experimental_compile(max_inflight=N)``, so up to N executions overlap
+in flight: a K-stage linear pipeline runs at stage-time-bound throughput
+instead of sum-of-stages lockstep, with bounded backpressure (a full
+ring blocks the producer, never wedges the graph). ``max_inflight=1``
+recovers the original rendezvous semantics.
 
 ``experimental_compile(device_channels=True)`` switches inter-actor
 edges to the typed tensor path (reference: the NCCL channel,
@@ -39,6 +43,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core import serialization
 from ray_tpu.experimental.channel import (
+    TAG_BYTES,
     TAG_ERROR,
     TAG_STOP,
     ChannelClosed,
@@ -46,6 +51,12 @@ from ray_tpu.experimental.channel import (
     ShmChannel,
     channel_path,
 )
+from ray_tpu.experimental.channel import is_arraylike as _is_arraylike
+from ray_tpu.util.metrics import Counter as _Counter
+
+_m_executions = _Counter(
+    "ray_tpu_dag_executions_total",
+    "Executions submitted to compiled graphs in this process")
 
 
 class DAGNode:
@@ -84,8 +95,14 @@ class ClassMethodNode(DAGNode):
                 self.args_template.append(("const", a))
 
     def experimental_compile(self, buffer_size_bytes: int = 4 * 1024 * 1024,
-                             device_channels: bool = False):
-        return CompiledDAG(self, buffer_size_bytes, device_channels)
+                             device_channels: bool = False,
+                             max_inflight: int = 4):
+        """Compile the DAG. ``max_inflight`` sizes every edge's ring so
+        that many executions overlap in flight (1 = the old lockstep
+        rendezvous; a K-stage pipeline wants >= K to hide stage
+        latency)."""
+        return CompiledDAG(self, buffer_size_bytes, device_channels,
+                           max_inflight)
 
 
 def _bind(actor_method, *args):
@@ -134,19 +151,45 @@ def _ensure_teardown_reaper() -> None:
 
 class CompiledDAGRef:
     """Result handle for one execute(); results must be consumed in
-    submission order (single output channel — reference semantics)."""
+    submission order (single output channel — reference semantics).
+
+    ``get()`` is idempotent: the first call drains the channel up to this
+    seq and caches the outcome on the ref, so a second call returns the
+    same value (or re-raises the same error) instead of wedging on
+    output messages that will never come."""
+
+    _UNSET = object()
 
     def __init__(self, dag: "CompiledDAG", seq: int):
         self._dag = dag
         self._seq = seq
+        self._value = self._UNSET
+        self._error: Optional[BaseException] = None
 
     def get(self, timeout: Optional[float] = 30.0):
-        return self._dag._read_result(self._seq, timeout)
+        if self._error is not None:
+            raise self._error
+        if self._value is not self._UNSET:
+            return self._value
+        try:
+            self._value = self._dag._read_result(self._seq, timeout)
+        except ChannelTimeout:
+            raise  # result may still arrive: stay uncached, retryable
+        except Exception as e:
+            # cache only real task/channel failures — KeyboardInterrupt
+            # etc. must leave the ref retryable (the result may still be
+            # sitting unread in the output ring)
+            self._error = e
+            raise
+        return self._value
 
 
 class CompiledDAG:
     def __init__(self, output_node: ClassMethodNode, buffer_size: int,
-                 device_channels: bool = False):
+                 device_channels: bool = False, max_inflight: int = 4):
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        self.max_inflight = max_inflight
         # reaper first: __del__ can fire on a HALF-built DAG (executor
         # install below may raise after channels exist), and starting
         # threads from inside the garbage collector is not safe
@@ -186,7 +229,7 @@ class CompiledDAG:
 
         def new_chan(name: str) -> ShmChannel:
             ch = ShmChannel(channel_path(f"{uid}_{name}"), buffer_size,
-                            create=True)
+                            create=True, n_slots=max_inflight)
             self._channels.append(ch)
             return ch
 
@@ -231,42 +274,62 @@ class CompiledDAG:
 
     def execute(self, value: Any,
                 timeout: Optional[float] = 60.0) -> CompiledDAGRef:
+        """Submit one execution. Backpressure is bounded: when
+        ``max_inflight`` rounds are already in the rings, this blocks up
+        to ``timeout`` for a slot and raises ChannelTimeout with NOTHING
+        written — input rounds are all-or-nothing (wait for a free slot
+        on every edge first; the driver is the only writer, so observed
+        free slots cannot vanish), so a timed-out execute leaves the DAG
+        healthy and retryable instead of poisoned."""
+        import time as _time
+
         with self._submit_lock:
             if self._torn_down:
                 raise RuntimeError("compiled DAG was torn down")
-            payload = serialization.serialize(value).to_bytes()
-            # bounded writes: a full pipeline (single-slot channels,
-            # nothing consuming results) raises ChannelTimeout instead of
-            # blocking the driver forever. A PARTIAL round (some input
-            # edges written, one timed out) permanently desyncs the
-            # lockstep joins — poison the DAG rather than return wrong
-            # values on later executes.
-            for i, ch in enumerate(self._input_chans):
-                try:
-                    ch.write(payload, timeout=timeout)
-                except Exception:
-                    if i == 0:
-                        raise  # nothing consumed: safe to retry
-                    self._torn_down = True
-                    raise RuntimeError(
-                        "compiled DAG input round was partially written "
-                        "(pipeline wedged?); the DAG is now poisoned — "
-                        "recompile to continue") from None
+            # one deadline across ALL edges — sequential full-timeout
+            # waits would make the worst case num_edges x timeout
+            deadline = None if timeout is None else \
+                _time.monotonic() + timeout
+            for ch in self._input_chans:
+                ch.wait_writable(
+                    None if deadline is None
+                    else max(0.0, deadline - _time.monotonic()))
+            # dispatch fast path: bytes and typed arrays skip the
+            # serializer entirely (driver-side mirror of the executor's
+            # tensor-channel output path); everything else packs its
+            # serialized segments straight into the ring slot with no
+            # intermediate to_bytes() buffer.
+            if type(value) is bytes:
+                for ch in self._input_chans:
+                    ch.write(value, tag=TAG_BYTES, timeout=timeout)
+            elif _is_arraylike(value):
+                for ch in self._input_chans:
+                    ch.write_array(value, timeout=timeout)
+            else:
+                sobj = serialization.serialize(value)
+                for ch in self._input_chans:
+                    ch.write_serialized(sobj, timeout=timeout)
             seq = self._next_seq
             self._next_seq += 1
+        _m_executions.inc()
         return CompiledDAGRef(self, seq)
 
     def _read_result(self, seq: int, timeout: Optional[float]):
         from ray_tpu.experimental.channel import TAG_TENSOR
 
         with self._read_lock:
+            if seq < self._next_read and seq not in self._results:
+                raise ValueError(
+                    f"result for execution #{seq} was already consumed "
+                    "(CompiledDAGRef.get() caches it on the ref — hold "
+                    "onto the ref instead of re-deriving the seq)")
             while self._next_read <= seq:
                 tag, payload = self._out.read(timeout)
                 self._results[self._next_read] = (tag, payload)
                 self._next_read += 1
             tag, payload = self._results.pop(seq)
-        if tag == TAG_TENSOR:
-            return payload  # typed array, no serialization layer
+        if tag == TAG_TENSOR or tag == TAG_BYTES:
+            return payload  # typed array / raw bytes: no serializer
         value = serialization.deserialize(payload)
         if tag == TAG_ERROR:
             raise value

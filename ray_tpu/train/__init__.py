@@ -29,4 +29,10 @@ from ray_tpu.train.session import (  # noqa: F401
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.pipeline import (  # noqa: F401
+    MPMDPipelineTrainer,
+    init_mlp_params,
+    reference_train_losses,
+    split_stages,
+)
 from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401

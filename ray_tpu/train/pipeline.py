@@ -1,0 +1,436 @@
+"""MPMD pipeline-parallel training over compiled graphs.
+
+Per "Scaling Deep Learning Training with MPMD Pipeline Parallelism"
+(arXiv:2412.14374): instead of one global SPMD program, each pipeline
+stage is its OWN program — here a resident actor holding its slice of
+the param pytree — and stages exchange activations/gradients
+point-to-point. The stage graph (forward chain, loss+grad at the last
+stage, backward chain) is compiled ONCE into ring channels
+(``experimental_compile(device_channels=True, max_inflight=N)``), so a
+training step is M microbatch ``execute()`` calls flowing through the
+pipeline GPipe-style with up to N in flight, activations and gradients
+crossing stages on the typed tensor path (no serialization layer), and
+per-call scheduling completely out of the loop.
+
+Schedule (GPipe, arXiv:1811.06965): all M forwards/backwards stream
+through the compiled graph — backpressure from the rings interleaves
+them 1F1B-style per stage — stages accumulate param grads locally, and
+an eager ``apply_grads()`` barrier applies the mean-of-microbatch SGD
+step after the pipeline drains. Loss-equivalence: the schedule computes
+exactly full-batch gradient descent (mean over microbatch mean-grads),
+so ``reference_train_losses`` reproduces it bit-for-bit in one process.
+
+    trainer = MPMDPipelineTrainer([8, 32, 32, 4], num_stages=2, lr=0.05)
+    losses = trainer.fit(x, y, steps=20, num_microbatches=4)
+    trainer.shutdown()
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import ray_tpu
+
+__all__ = [
+    "MPMDPipelineTrainer",
+    "init_mlp_params",
+    "reference_train_losses",
+    "split_stages",
+]
+
+
+# ------------------------------------------------------------ model math
+#
+# A small MLP: tanh on every layer except the final (linear) one, MSE
+# loss. The SAME functions drive the stage actors and the single-process
+# reference, so loss-equivalence is a property of the schedule, not of
+# two implementations agreeing.
+
+
+def init_mlp_params(layer_sizes: Sequence[int],
+                    seed: int = 0) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Deterministic (W, b) list — one entry per layer."""
+    rng = np.random.RandomState(seed)
+    params = []
+    for fan_in, fan_out in zip(layer_sizes[:-1], layer_sizes[1:]):
+        scale = np.sqrt(2.0 / fan_in)
+        params.append((
+            (rng.randn(fan_in, fan_out) * scale).astype(np.float32),
+            np.zeros((fan_out,), dtype=np.float32),
+        ))
+    return params
+
+
+def split_stages(params: List, num_stages: int) -> List[List]:
+    """Partition the layer list into contiguous, near-even stages."""
+    if num_stages < 1 or num_stages > len(params):
+        raise ValueError(
+            f"num_stages={num_stages} must be in [1, {len(params)}]")
+    base, extra = divmod(len(params), num_stages)
+    out, i = [], 0
+    for s in range(num_stages):
+        n = base + (1 if s < extra else 0)
+        out.append(params[i:i + n])
+        i += n
+    return out
+
+
+def _apply_stage(params, x, final_linear: bool):
+    import jax.numpy as jnp
+
+    for i, (w, b) in enumerate(params):
+        z = x @ w + b
+        x = z if (final_linear and i == len(params) - 1) else jnp.tanh(z)
+    return x
+
+
+def _stage_loss(params, a, y):
+    import jax.numpy as jnp
+
+    pred = _apply_stage(params, a, True)
+    return jnp.mean((pred - y) ** 2)
+
+
+# --------------------------------------------------------- stage actors
+
+
+@ray_tpu.remote
+class PipelineStageActor:
+    """One pipeline stage: a slice of the param pytree, resident on a
+    worker, driven by compiled-graph executor loops. ``fwd*`` stashes its
+    input (GPipe activation rematerialization: backward re-runs the
+    stage under jax.vjp instead of shipping intermediate activations),
+    ``bwd``/``loss_bwd`` accumulate param grads locally; the driver's
+    eager ``apply_grads()`` applies the mean-grad SGD step between
+    batches."""
+
+    def __init__(self, layers, is_last: bool, lr: float):
+        import jax
+        import jax.numpy as jnp
+
+        self.params = [(jnp.asarray(w), jnp.asarray(b)) for w, b in layers]
+        self.lr = lr
+        self.is_last = is_last
+        self._stash: collections.deque = collections.deque()
+        self._grad_sum = None
+        self._nmb = 0
+        self._loss_sum = 0.0
+        self._busy_s = 0.0
+        self._jfwd = jax.jit(lambda p, x: _apply_stage(p, x, False))
+
+        def _vjp(p, x, g):
+            _, vjp_fn = jax.vjp(lambda pp, xx: _apply_stage(pp, xx, False),
+                                p, x)
+            return vjp_fn(g)
+
+        self._jvjp = jax.jit(_vjp)
+        self._jloss = jax.jit(jax.value_and_grad(_stage_loss,
+                                                 argnums=(0, 1)))
+
+    def _accum(self, gparams) -> None:
+        import jax
+
+        if self._grad_sum is None:
+            self._grad_sum = gparams
+        else:
+            self._grad_sum = jax.tree_util.tree_map(
+                lambda a, b: a + b, self._grad_sum, gparams)
+
+    # ---- compiled-graph node methods (one resident loop each) ----
+
+    def fwd(self, x):
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        x = jnp.asarray(x)
+        self._stash.append(x)
+        out = self._jfwd(self.params, x)
+        out.block_until_ready()
+        self._busy_s += time.perf_counter() - t0
+        return out
+
+    def fwd_first(self, xy):
+        return self.fwd(xy[0])
+
+    def bwd(self, g):
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        x = self._stash.popleft()
+        gparams, gx = self._jvjp(self.params, x, jnp.asarray(g))
+        self._accum(gparams)
+        self._nmb += 1
+        gx.block_until_ready()
+        self._busy_s += time.perf_counter() - t0
+        return gx
+
+    def loss_bwd(self, a, xy):
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        a = jnp.asarray(a)
+        y = jnp.asarray(xy[1])
+        loss, (gparams, ga) = self._jloss(self.params, a, y)
+        self._accum(gparams)
+        self._nmb += 1
+        self._loss_sum += float(loss)
+        ga.block_until_ready()
+        self._busy_s += time.perf_counter() - t0
+        return ga
+
+    # ---- eager control-plane methods (between pipeline flushes) ----
+
+    def apply_grads(self):
+        """Mean the accumulated microbatch grads, take one SGD step,
+        reset. Returns the mean microbatch loss (last stage only)."""
+        import jax
+
+        if self._nmb == 0:
+            return None
+        mean_grads = jax.tree_util.tree_map(
+            lambda g: g / self._nmb, self._grad_sum)
+        self.params = jax.tree_util.tree_map(
+            lambda p, g: p - self.lr * g, self.params, mean_grads)
+        loss = (self._loss_sum / self._nmb) if self.is_last else None
+        self._grad_sum = None
+        self._nmb = 0
+        self._loss_sum = 0.0
+        return loss
+
+    def reset_state(self):
+        """Drop accumulated grads/metrics WITHOUT stepping (used after
+        the compile-warming execution)."""
+        self._grad_sum = None
+        self._nmb = 0
+        self._loss_sum = 0.0
+        self._busy_s = 0.0
+
+    def get_params(self):
+        return [(np.asarray(w), np.asarray(b)) for w, b in self.params]
+
+    def stage_stats(self):
+        return {"busy_s": self._busy_s, "stash_depth": len(self._stash)}
+
+    def channel_stats(self):
+        from ray_tpu.experimental.channel import STATS
+
+        return dict(STATS)
+
+
+# ---------------------------------------------------------- the trainer
+
+
+class MPMDPipelineTrainer:
+    """Partition an MLP across resident stage actors, compile the
+    forward/backward stage graph once, and train with GPipe microbatch
+    scheduling over ring channels."""
+
+    def __init__(self, layer_sizes: Sequence[int], num_stages: int,
+                 lr: float = 0.05, seed: int = 0,
+                 max_inflight: Optional[int] = None,
+                 buffer_size_bytes: int = 8 << 20,
+                 params: Optional[List] = None):
+        if num_stages < 2:
+            raise ValueError(
+                "MPMD pipeline needs >= 2 stages (use a plain in-process "
+                "train loop for 1)")
+        self.layer_sizes = list(layer_sizes)
+        self.num_stages = num_stages
+        self.lr = lr
+        if params is None:
+            params = init_mlp_params(layer_sizes, seed)
+        stage_layers = split_stages(params, num_stages)
+        # 2x stages of slack keeps every ring deep enough that the
+        # steady state is stage-time-bound, not handshake-bound
+        self.max_inflight = max_inflight or 2 * num_stages
+        self.stages = [
+            PipelineStageActor.remote(layers, s == num_stages - 1, lr)
+            for s, layers in enumerate(stage_layers)
+        ]
+        # constructor barrier: compile only against live actors
+        ray_tpu.get([s.stage_stats.remote() for s in self.stages])
+
+        from ray_tpu.dag import InputNode
+
+        with InputNode() as inp:
+            h = self.stages[0].fwd_first.bind(inp)
+            for s in self.stages[1:-1]:
+                h = s.fwd.bind(h)
+            g = self.stages[-1].loss_bwd.bind(h, inp)
+            for s in reversed(self.stages[:-1]):
+                g = s.bwd.bind(g)
+        self._dag = g.experimental_compile(
+            buffer_size_bytes=buffer_size_bytes,
+            device_channels=True,
+            max_inflight=self.max_inflight)
+        self._warmed = False
+        self._pipeline_wall_s = 0.0
+        self._microbatches_run = 0
+        self._torn_down = False
+
+    # ---- schedule ----
+
+    def _warmup(self, x: np.ndarray, y: np.ndarray,
+                timeout: float) -> None:
+        """One throwaway microbatch to trigger every stage's XLA compile
+        outside the measured/loss-bearing path, then reset stage state
+        (params untouched — apply_grads is never called)."""
+        self._dag.execute((x, y), timeout=timeout).get(timeout=timeout)
+        ray_tpu.get([s.reset_state.remote() for s in self.stages])
+        self._warmed = True
+
+    def train_step(self, x: np.ndarray, y: np.ndarray,
+                   num_microbatches: int, timeout: float = 120.0) -> float:
+        """One full-batch step = M microbatches streamed through the
+        compiled pipeline, then a mean-grad SGD step per stage."""
+        if self._torn_down:
+            raise RuntimeError("trainer was shut down")
+        x = np.asarray(x, dtype=np.float32)
+        y = np.asarray(y, dtype=np.float32)
+        if len(x) % num_microbatches:
+            raise ValueError(
+                f"batch of {len(x)} does not split into "
+                f"{num_microbatches} equal microbatches")
+        xs = np.split(x, num_microbatches)
+        ys = np.split(y, num_microbatches)
+        if not self._warmed:
+            self._warmup(xs[0], ys[0], timeout)
+        t0 = time.perf_counter()
+        # GPipe with a sliding window: at most max_inflight microbatches
+        # outstanding, so the output ring (also max_inflight deep) can
+        # always absorb every in-flight result — the driver never holds
+        # the submit side while the drain side is the only way forward.
+        pending: collections.deque = collections.deque()
+        for xm, ym in zip(xs, ys):
+            if len(pending) >= self.max_inflight:
+                pending.popleft().get(timeout=timeout)
+            pending.append(self._dag.execute((xm, ym), timeout=timeout))
+        while pending:
+            pending.popleft().get(timeout=timeout)
+        self._pipeline_wall_s += time.perf_counter() - t0
+        self._microbatches_run += num_microbatches
+        losses = ray_tpu.get(
+            [s.apply_grads.remote() for s in self.stages])
+        return losses[-1]
+
+    def fit(self, x: np.ndarray, y: np.ndarray, steps: int,
+            num_microbatches: int) -> List[float]:
+        return [self.train_step(x, y, num_microbatches)
+                for _ in range(steps)]
+
+    # ---- introspection ----
+
+    def get_params(self) -> List[Tuple[np.ndarray, np.ndarray]]:
+        out: List[Tuple[np.ndarray, np.ndarray]] = []
+        for stage in ray_tpu.get(
+                [s.get_params.remote() for s in self.stages]):
+            out.extend(stage)
+        return out
+
+    def pipeline_stats(self) -> Dict[str, Any]:
+        """Measured pipeline efficiency: busy time summed over stages
+        against K x wall (the pipeline's capacity to do work). The
+        complement is the bubble fraction — GPipe's theoretical floor is
+        (K-1)/(M+K-1) per flush."""
+        stats = ray_tpu.get([s.stage_stats.remote() for s in self.stages])
+        busy = sum(s["busy_s"] for s in stats)
+        wall = self._pipeline_wall_s
+        k = self.num_stages
+        eff = busy / (k * wall) if wall > 0 else 0.0
+        return {
+            "num_stages": k,
+            "max_inflight": self.max_inflight,
+            "microbatches_run": self._microbatches_run,
+            "pipeline_wall_s": round(wall, 6),
+            "stage_busy_s": [round(s["busy_s"], 6) for s in stats],
+            "pipeline_efficiency": round(eff, 4),
+            "bubble_fraction": round(1.0 - eff, 4),
+        }
+
+    def channel_stats(self) -> List[Dict[str, int]]:
+        """Per-stage channel byte accounting (the typed-tensor-path
+        proof: serialized_bytes must stay flat across training)."""
+        return ray_tpu.get([s.channel_stats.remote() for s in self.stages])
+
+    def shutdown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        self._dag.teardown()
+        for s in self.stages:
+            try:
+                ray_tpu.kill(s)
+            except Exception:
+                pass
+
+
+# ------------------------------------------------- in-process reference
+
+
+def reference_train_losses(layer_sizes: Sequence[int], seed: int,
+                           x: np.ndarray, y: np.ndarray, steps: int,
+                           num_microbatches: int, num_stages: int,
+                           lr: float = 0.05,
+                           params: Optional[List] = None,
+                           return_params: bool = False):
+    """Single-process replay of the exact pipeline computation: same
+    stage split, same per-stage jax.vjp backward, same
+    mean-over-microbatch grad accumulation, same SGD step — so the
+    distributed trainer must match these losses to numerical noise."""
+    import jax
+    import jax.numpy as jnp
+
+    if params is None:
+        params = init_mlp_params(layer_sizes, seed)
+    stages = [[(jnp.asarray(w), jnp.asarray(b)) for w, b in st]
+              for st in split_stages(params, num_stages)]
+    jfwd = jax.jit(lambda p, xx: _apply_stage(p, xx, False))
+
+    def _vjp(p, xx, g):
+        _, vjp_fn = jax.vjp(lambda pp, aa: _apply_stage(pp, aa, False),
+                            p, xx)
+        return vjp_fn(g)
+
+    jvjp = jax.jit(_vjp)
+    jloss = jax.jit(jax.value_and_grad(_stage_loss, argnums=(0, 1)))
+
+    x = np.asarray(x, dtype=np.float32)
+    y = np.asarray(y, dtype=np.float32)
+    xs = np.split(x, num_microbatches)
+    ys = np.split(y, num_microbatches)
+    losses = []
+    for _ in range(steps):
+        grad_sums = [None] * num_stages
+        loss_sum = 0.0
+
+        def accum(s, g):
+            grad_sums[s] = g if grad_sums[s] is None else \
+                jax.tree_util.tree_map(lambda a, b: a + b, grad_sums[s], g)
+
+        for xm, ym in zip(xs, ys):
+            acts = [jnp.asarray(xm)]
+            for s in range(num_stages - 1):
+                acts.append(jfwd(stages[s], acts[-1]))
+            loss, (gp_last, g) = jloss(stages[-1], acts[-1],
+                                       jnp.asarray(ym))
+            accum(num_stages - 1, gp_last)
+            loss_sum += float(loss)
+            for s in range(num_stages - 2, -1, -1):
+                gp, g = jvjp(stages[s], acts[s], g)
+                accum(s, gp)
+        for s in range(num_stages):
+            mean_g = jax.tree_util.tree_map(
+                lambda gg: gg / num_microbatches, grad_sums[s])
+            stages[s] = jax.tree_util.tree_map(
+                lambda p, gg: p - lr * gg, stages[s], mean_g)
+        losses.append(loss_sum / num_microbatches)
+    if return_params:
+        flat = []
+        for st in stages:
+            flat.extend((np.asarray(w), np.asarray(b)) for w, b in st)
+        return losses, flat
+    return losses

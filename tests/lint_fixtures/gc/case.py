@@ -51,3 +51,29 @@ class WatchedSession:
 
     def watch(self, obj):
         return weakref.ref(obj, self._on_collect)
+
+
+class MiniCompiledDAG:
+    """The compiled-graph teardown shape (PR 6 finding, kept covered
+    through the PR-8 ring-channel rework): ``teardown()`` takes the
+    submit lock AND pushes stop sentinels through shm channels — running
+    it synchronously from ``__del__`` is the same GC-reentrant deadlock.
+    The shipped code defers to the dag teardown-reaper thread instead;
+    this fixture asserts the check still flags the naive version for the
+    ring-channel close path."""
+
+    def __init__(self, chan):
+        self._submit_lock = threading.Lock()
+        self._chan = chan
+        self._torn_down = False
+
+    def teardown(self):
+        with self._submit_lock:
+            if self._torn_down:
+                return
+            self._torn_down = True
+        self._chan.send(b"")  # stop sentinel into the ring
+
+    def __del__(self):
+        # BUG (the pre-PR-6 shape): synchronous teardown inside the GC
+        self.teardown()

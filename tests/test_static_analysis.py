@@ -79,6 +79,14 @@ def test_gc_reentrancy_flags_pr2_del_deadlock():
     assert "lock" in del_finding.message
     # the weakref-callback variant too
     assert "WatchedSession._on_collect" in contexts
+    # the compiled-graph teardown shape: __del__ -> teardown() which
+    # locks AND sends a stop sentinel into a ring channel — must stay
+    # flagged across channel-protocol reworks (the real CompiledDAG
+    # defers to the teardown-reaper thread for exactly this reason)
+    assert "MiniCompiledDAG.__del__" in contexts
+    dag_finding = next(f for f in found
+                       if f.context == "MiniCompiledDAG.__del__")
+    assert "teardown" in dag_finding.message
 
 
 def test_protocol_unhandled_and_dead_ops_flagged():
