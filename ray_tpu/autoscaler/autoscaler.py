@@ -178,5 +178,6 @@ class Autoscaler:
 
     def stop(self, terminate_nodes: bool = True) -> None:
         self._stopped.set()
+        self._thread.join(timeout=2.0)  # event-paced loop: exits promptly
         if terminate_nodes:
             self.provider.shutdown()

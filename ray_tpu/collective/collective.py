@@ -81,7 +81,9 @@ class XlaGroup:
         import functools
         from jax.sharding import PartitionSpec as P
 
-        shard_map = functools.partial(jax.shard_map, check_vma=False)
+        from ray_tpu.util.jax_compat import shard_map as _shard_map
+
+        shard_map = functools.partial(_shard_map, check=False)
 
         key = (op, reduce_op, extra)
         if key in self._compiled:

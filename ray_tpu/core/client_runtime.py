@@ -79,6 +79,9 @@ class ClientRuntime:
             self._channel.close()
         except Exception:
             pass
+        # closing the channel pops the reader out of recv(); reap it so
+        # disconnect() leaves no thread behind
+        self._reader.join(timeout=2.0)
 
     # ---- runtime interface ------------------------------------------------
     @property

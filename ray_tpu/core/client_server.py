@@ -274,10 +274,9 @@ class ClientServer:
 
     def stop(self) -> None:
         self._stopped = True
-        try:
-            self._listener.close()
-        except Exception:
-            pass
+        from .protocol import close_listener
+
+        close_listener(self._listener)  # wakes the parked accept()
         with self._sessions_lock:
             sessions = list(self.sessions)
         for sess in sessions:
@@ -285,3 +284,5 @@ class ClientServer:
                 sess.channel.close()  # unblocks the reader -> clean teardown
             except Exception:
                 pass
+        # the closed listener pops the accept loop; reap it
+        self._thread.join(timeout=2.0)

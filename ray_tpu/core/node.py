@@ -82,6 +82,9 @@ class Node:
             max_workers=32, thread_name_prefix=f"node-{self.hex[:6]}"
         )
         self.alive = True
+        # set by shutdown(); paced loops (steal ticker) wait on it so
+        # they exit the instant the node dies instead of a sleep later
+        self._stop_event = threading.Event()
         self._authkey = os.urandom(16)
         self._sock_path = os.path.join(session_dir, f"node_{self.hex[:12]}.sock")
         self._listener = make_listener(self._sock_path, self._authkey)
@@ -145,11 +148,14 @@ class Node:
         with self._lock:
             for _ in range(min(cfg.worker_prestart_count, self.max_workers)):
                 self._start_worker_locked()
+        self._steal_thread = None
         if cfg.direct_steal_enabled:
             # idle nodes get no pump events: a slow heartbeat re-evaluates
             # stealing (rate-limited + cheap-idle-checked inside)
-            threading.Thread(target=self._steal_ticker, daemon=True,
-                             name=f"steal-{self.hex[:6]}").start()
+            self._steal_thread = threading.Thread(
+                target=self._steal_ticker, daemon=True,
+                name=f"steal-{self.hex[:6]}")
+            self._steal_thread.start()
 
     # ------------------------------------------------------------ dispatch
 
@@ -1121,8 +1127,7 @@ class Node:
     # re-evaluation, inverted into a thief-initiated protocol.)
 
     def _steal_ticker(self) -> None:
-        while self.alive:
-            time.sleep(0.5)
+        while not self._stop_event.wait(0.5):
             try:
                 self._gossip_load()
                 self._maybe_steal()
@@ -1798,6 +1803,7 @@ class Node:
 
     def shutdown(self) -> None:
         self.alive = False
+        self._stop_event.set()
         with self._lock:
             workers = list(self._workers.values())
         for w in workers:
@@ -1809,10 +1815,14 @@ class Node:
                 os.kill(w.pid, 9)
             except (OSError, ProcessLookupError):
                 pass
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        from .protocol import close_listener
+
+        close_listener(self._listener)  # wakes the parked accept()
+        # reap the accept loop and the steal ticker so shutdown leaves
+        # no threads behind
+        self._accept_thread.join(timeout=2.0)
+        if self._steal_thread is not None:
+            self._steal_thread.join(timeout=2.0)
         if getattr(self, "object_server", None) is not None:
             self.object_server.close()
             # drop pooled transfer connections: this node's outbound conns

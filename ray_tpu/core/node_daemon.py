@@ -67,6 +67,18 @@ class RemoteHead:
                                         name="head-link")
         self._reader.start()
 
+    def close(self) -> None:
+        """Daemon teardown: drop the head link and reap the handler
+        machinery (reader exits on channel EOF / the shutdown tag)."""
+        try:
+            self.channel.close()
+        except Exception:
+            pass
+        self._ordered_pool.shutdown(wait=False)
+        self._handler_pool.shutdown(wait=False)
+        if self._reader is not threading.current_thread():
+            self._reader.join(timeout=2.0)
+
     # ------------------------------------------------------------ channel
 
     def _send(self, tag: str, *payload) -> None:
@@ -479,6 +491,7 @@ def main(argv=None) -> int:
         pass
     syncer.stop()
     node.shutdown()
+    head.close()
     from .object_transfer import close_pool
 
     close_pool()  # drop pooled transfer connections with the node

@@ -560,10 +560,9 @@ class ObjectServer:
 
     def close(self) -> None:
         self._alive = False
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        from .protocol import close_listener
+
+        close_listener(self._listener)  # wakes the parked accept()
         with self._conns_lock:
             conns = list(self._conns)
             self._conns.clear()
@@ -572,6 +571,7 @@ class ObjectServer:
                 conn.close()
             except OSError:
                 pass
+        self._thread.join(timeout=2.0)  # accept() raises once closed
 
 
 # --------------------------------------------------------------------------- #

@@ -77,6 +77,21 @@ class Channel:
 
     def close(self) -> None:
         self.closed = True
+        # socket shutdown BEFORE close: a thread parked in recv(2) on
+        # this connection is not interrupted by closing the fd (it would
+        # sit there until the peer sends) — shutdown pops it with EOF
+        # immediately, so reader threads can be joined at teardown
+        try:
+            import os as _os
+            import socket as _socket
+
+            s = _socket.socket(fileno=_os.dup(self.conn.fileno()))
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            finally:
+                s.close()
+        except (OSError, ValueError, AttributeError):
+            pass  # not socket-backed / already closed
         try:
             self.conn.close()
         except OSError:
@@ -155,6 +170,27 @@ def make_listener(address, authkey: bytes) -> mpc.Listener:
                             backlog=64, authkey=authkey)
     return mpc.Listener(address=tuple(address), family="AF_INET",
                         backlog=64, authkey=authkey)
+
+
+def close_listener(listener) -> None:
+    """Close a Listener AND wake any thread parked in ``accept()``.
+
+    A plain ``close()`` frees the fd but leaves a thread blocked in
+    accept(2) parked forever (Linux does not interrupt the syscall), so
+    a teardown path that joins its accept loop would wait out the full
+    join timeout.  ``shutdown(SHUT_RDWR)`` on the listening socket pops
+    accept with an error immediately (verified for AF_UNIX and
+    AF_INET)."""
+    import socket as _socket
+
+    try:
+        listener._listener._socket.shutdown(_socket.SHUT_RDWR)
+    except (OSError, AttributeError):
+        pass
+    try:
+        listener.close()
+    except OSError:
+        pass
 
 
 def set_nodelay(conn) -> None:

@@ -240,12 +240,24 @@ class Head:
             (0.0, None)
         # head node (the driver's node)
         self.head_node = self.add_node(resources, labels=labels)
+        # service threads are retained so shutdown() can join them; the
+        # loops pace on _stop_event so the joins return immediately
+        self._stop_event = threading.Event()
+        self._service_threads: List[threading.Thread] = []
         if global_config().task_record_ttl_s > 0:
-            threading.Thread(target=self._record_gc_loop, daemon=True,
-                             name="task-record-gc").start()
+            self._spawn_service(self._record_gc_loop, "task-record-gc")
         if self.metrics_history is not None:
-            threading.Thread(target=self._metrics_history_loop, daemon=True,
-                             name="metrics-history").start()
+            self._spawn_service(self._metrics_history_loop,
+                                "metrics-history")
+
+    def _spawn_service(self, target, name: str) -> threading.Thread:
+        """Start a head service loop and retain the handle for the
+        shutdown join (resource-lifecycle: a class with a teardown
+        method owns every thread it starts)."""
+        t = threading.Thread(target=target, daemon=True, name=name)
+        self._service_threads.append(t)
+        t.start()
+        return t
 
     # ------------------------------------------------------- observability
 
@@ -392,8 +404,7 @@ class Head:
     def _metrics_history_loop(self) -> None:
         period = max(0.05,
                      global_config().metrics_history_interval_ms / 1000.0)
-        while not self._stopped:
-            time.sleep(period)
+        while not self._stop_event.wait(period):
             try:
                 self.sample_metrics_history()
             except Exception:
@@ -410,8 +421,7 @@ class Head:
         actor incarnation (its death must release the reservation)."""
         cfg = global_config()
         period = max(1.0, cfg.task_record_gc_period_s)
-        while not self._stopped:
-            time.sleep(period)
+        while not self._stop_event.wait(period):
             try:
                 self.gc_task_records(cfg.task_record_ttl_s)
                 # idle pubsub rings fold to tombstones on the same cadence
@@ -518,10 +528,8 @@ class Head:
             if n.node_ip.startswith("127."):
                 n.update_node_ip(self.node_ip)
             n.start_object_server(self._cluster_key)
-        threading.Thread(target=self._node_accept_loop, daemon=True,
-                         name="node-server").start()
-        threading.Thread(target=self._health_check_loop, daemon=True,
-                         name="health-prober").start()
+        self._spawn_service(self._node_accept_loop, "node-server")
+        self._spawn_service(self._health_check_loop, "health-prober")
         return self.node_server_address
 
     def on_node_sync(self, proxy, snap: dict) -> None:
@@ -648,8 +656,7 @@ class Head:
         period = max(0.1, cfg.health_check_period_ms / 1000.0)
         threshold = max(1, cfg.health_check_failure_threshold)
         seq = 0
-        while not self._stopped:
-            time.sleep(period)
+        while not self._stop_event.wait(period):
             seq += 1
             with self._lock:
                 proxies = [n for n in self.nodes.values()
@@ -1618,8 +1625,7 @@ class Head:
         srv = http.server.ThreadingHTTPServer((host, port), Handler)
         self._metrics_server = srv
         self._metrics_address = srv.server_address
-        threading.Thread(target=srv.serve_forever, daemon=True,
-                         name="metrics-http").start()
+        self._spawn_service(srv.serve_forever, "metrics-http")
         return self._metrics_address
 
     def on_stream_item(self, task_id: TaskID, index: int) -> None:
@@ -2119,6 +2125,7 @@ class Head:
 
     def shutdown(self) -> None:
         self._stopped = True
+        self._stop_event.set()  # pops every event-paced service loop
         ref_tracker.reset()  # driver-process entries die with the cluster
         from ray_tpu.util import events as events_mod
         from .object_transfer import close_pool
@@ -2133,10 +2140,9 @@ class Head:
             stop_telemetry.set()
         self.scheduler.stop()
         if self._node_listener is not None:
-            try:
-                self._node_listener.close()
-            except OSError:
-                pass
+            from .protocol import close_listener
+
+            close_listener(self._node_listener)  # wakes parked accept()
             self._node_listener = None
         if self._daemon_pool is not None:
             self._daemon_pool.shutdown(wait=False)
@@ -2152,6 +2158,11 @@ class Head:
         for node in nodes:
             node.shutdown()
         self.gcs.close()
+        # reap the service loops: every one paces on _stop_event or
+        # blocks in an accept()/serve_forever the closes above popped
+        for t in self._service_threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)
 
 
 # --------------------------------------------------------------------------- #

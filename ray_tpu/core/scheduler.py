@@ -244,6 +244,7 @@ class ClusterScheduler:
         with self._lock:
             self._stopped = True
             self._wake.notify_all()
+        self._thread.join(timeout=2.0)  # loop re-checks _stopped on wake
 
     def _loop(self) -> None:
         while True:

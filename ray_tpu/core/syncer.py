@@ -70,3 +70,4 @@ class NodeSyncer:
 
     def stop(self) -> None:
         self._stopped.set()
+        self._thread.join(timeout=2.0)  # event wait: exits immediately

@@ -61,6 +61,18 @@ class _ActorState:
         self.exec_lock = (threading.Lock()
                           if not is_async and max_concurrency == 1 else None)
 
+    def stop(self) -> None:
+        """Release the actor's execution machinery (worker exit path;
+        os._exit would reap the threads anyway, but pending work gets a
+        chance to settle and the lifecycle is explicit)."""
+        self.pool.shutdown(wait=False)
+        if self.loop is not None:
+            try:
+                self.loop.call_soon_threadsafe(self.loop.stop)
+            except RuntimeError:
+                pass  # loop already closed
+            self.loop_thread.join(timeout=1.0)
+
 
 class WorkerRuntime:
     """Runtime installed as the process-global API backend inside workers."""
@@ -457,6 +469,14 @@ class WorkerRuntime:
                     break
         finally:
             self._shutdown.set()
+            # explicit resource teardown (os._exit skips everything):
+            # actor pools/loops first, then the shared task pool
+            for st in list(self._actors.values()):
+                try:
+                    st.stop()
+                except Exception:
+                    pass
+            self._task_pool.shutdown(wait=False)
             dump = getattr(self, "_profile_dump", None)
             if dump is not None:
                 dump()  # os._exit skips atexit

@@ -260,17 +260,31 @@ class CompiledDAG:
         # install resident executor loops (reference: do_exec_tasks)
         import ray_tpu
 
-        acks = []
-        for i, task in enumerate(nodes):
-            acks.append(task.actor.__compiled_exec__.remote({
-                "method": task.method_name,
-                "in_paths": in_paths[i],
-                "out_paths": out_paths[i],
-                "capacity": buffer_size,
-                "args_template": task.args_template,
-                "device": device_channels,
-            }))
-        ray_tpu.get(acks, timeout=60)
+        try:
+            acks = []
+            for i, task in enumerate(nodes):
+                acks.append(task.actor.__compiled_exec__.remote({
+                    "method": task.method_name,
+                    "in_paths": in_paths[i],
+                    "out_paths": out_paths[i],
+                    "capacity": buffer_size,
+                    "args_template": task.args_template,
+                    "device": device_channels,
+                }))
+            ray_tpu.get(acks, timeout=60)
+        except BaseException:
+            # executor install failed: close + unlink every channel NOW
+            # instead of leaking the shm segments until the GC happens to
+            # enqueue a teardown for the half-built DAG (and that
+            # teardown would block on sentinel round-trips to executors
+            # that never came up)
+            self._torn_down = True
+            for ch in self._channels:
+                try:
+                    ch.close(unlink=True)
+                except Exception:
+                    pass
+            raise
 
     def execute(self, value: Any,
                 timeout: Optional[float] = 60.0) -> CompiledDAGRef:

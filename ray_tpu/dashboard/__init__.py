@@ -456,6 +456,7 @@ class DashboardServer:
             self._server.server_close()
         except Exception:
             pass
+        self._thread.join(timeout=2.0)  # serve_forever returns on shutdown
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265,

@@ -222,3 +222,4 @@ class NodeAgent(NodeAgentCore):
             self._server.server_close()
         except Exception:
             pass
+        self._thread.join(timeout=2.0)  # serve_forever returns on shutdown

@@ -559,7 +559,11 @@ class DeltaDatasource(ParquetDatasource):
         pv = self._partitions.get(path) or {}
         file_cols = (None if self._columns is None
                      else [c for c in self._columns if c not in pv])
-        table = pq.read_table(path, columns=file_cols)
+        # partitioning=None: Delta partition values come from the LOG,
+        # not from hive-style path fragments — without this, pyarrow
+        # infers a `date=...` directory into a column and append_column
+        # below duplicates the field in the schema
+        table = pq.read_table(path, columns=file_cols, partitioning=None)
         for name, value in pv.items():
             if self._columns is not None and name not in self._columns:
                 continue

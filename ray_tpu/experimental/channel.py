@@ -26,6 +26,15 @@ then the doorbell. The reader consumes slot ``read_seq % n_slots`` once
 ``write_seq > read_seq``. n_slots=1 degenerates to the original
 rendezvous protocol. Geometry lives in the mapped header, so the opening
 end needs only the path.
+
+The header/slot state machine has a pure, side-effect-free twin in
+``ray_tpu/tools/lint/ring_model.py``; graftlint's ``ring-protocol``
+check exhaustively model-checks every writer/reader interleaving of it
+(lost wakeup, torn publish, backpressure, deadlock), and
+tests/test_static_analysis.py drives THIS class and the model through
+identical traces to keep the two in lockstep.  When changing the
+publish/consume/wait ordering here, change the model to match — the
+mutation tests show what each guard buys.
 """
 
 from __future__ import annotations
@@ -159,38 +168,56 @@ class ShmChannel:
         self._metric_name = base
         flags = os.O_RDWR | (os.O_CREAT if create else 0)
         self._fd = os.open(path, flags, 0o600)
-        if create:
-            if n_slots < 1:
-                raise ValueError(f"n_slots must be >= 1, got {n_slots}")
-            self.capacity = capacity
-            self.n_slots = n_slots
-            total = _HDR_SIZE + n_slots * (_SHDR.size + capacity)
-            os.ftruncate(self._fd, total)  # zero-fills: flags start down
-            self._mm = mmap.mmap(self._fd, total)
-            _GHDR.pack_into(self._mm, 0, 0, 0, n_slots, capacity)
-        else:
-            # geometry rides in the mapped header — the opening end does
-            # not need to agree on capacity/n_slots out of band
-            self._mm = mmap.mmap(self._fd, _GHDR.size)
-            _, _, n, cap = _GHDR.unpack_from(self._mm, 0)
-            self._mm.close()
-            self.capacity = cap
-            self.n_slots = n
-            total = _HDR_SIZE + n * (_SHDR.size + cap)
-            self._mm = mmap.mmap(self._fd, total)
-        self._slot_stride = _SHDR.size + self.capacity
-        # doorbells: data_ready rings the reader, slot_free rings the writer.
-        # O_RDWR on a FIFO never blocks at open and works for both ends.
+        self._mm = None
         self._bells = []
-        for suffix in (".rdy", ".free"):
-            p = path + suffix
+        try:
             if create:
+                if n_slots < 1:
+                    raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+                self.capacity = capacity
+                self.n_slots = n_slots
+                total = _HDR_SIZE + n_slots * (_SHDR.size + capacity)
+                os.ftruncate(self._fd, total)  # zero-fills: flags start down
+                self._mm = mmap.mmap(self._fd, total)
+                _GHDR.pack_into(self._mm, 0, 0, 0, n_slots, capacity)
+            else:
+                # geometry rides in the mapped header — the opening end
+                # does not need to agree on capacity/n_slots out of band
+                self._mm = mmap.mmap(self._fd, _GHDR.size)
+                _, _, n, cap = _GHDR.unpack_from(self._mm, 0)
+                self._mm.close()
+                self.capacity = cap
+                self.n_slots = n
+                total = _HDR_SIZE + n * (_SHDR.size + cap)
+                self._mm = mmap.mmap(self._fd, total)
+            self._slot_stride = _SHDR.size + self.capacity
+            # doorbells: data_ready rings the reader, slot_free rings the
+            # writer.  O_RDWR on a FIFO never blocks at open and works
+            # for both ends.
+            for suffix in (".rdy", ".free"):
+                p = path + suffix
+                if create:
+                    try:
+                        os.mkfifo(p, 0o600)
+                    except FileExistsError:
+                        pass
+                self._bells.append(os.open(p, os.O_RDWR | os.O_NONBLOCK))
+            self._bell_rdy, self._bell_free = self._bells
+        except BaseException:
+            # partial construction must not leak the mapping or fds (a
+            # torn geometry header / missing fifo raises here): release
+            # whatever was acquired, in reverse order
+            if self._mm is not None:
                 try:
-                    os.mkfifo(p, 0o600)
-                except FileExistsError:
+                    self._mm.close()
+                except Exception:
                     pass
-            self._bells.append(os.open(p, os.O_RDWR | os.O_NONBLOCK))
-        self._bell_rdy, self._bell_free = self._bells
+            for fd in (self._fd, *self._bells):
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+            raise
 
     # ---- internals ----
 

@@ -185,8 +185,10 @@ def _shard_mapped(fn, mesh, seq_axis):
                        if a in mesh.axis_names)
     ha = "tensor" if "tensor" in mesh.axis_names else None
     spec = P(batch_axes if batch_axes else None, seq_axis, ha, None)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)
+    from ray_tpu.util.jax_compat import shard_map
+
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check=False)
 
 
 def _attention(cfg: LlamaConfig, q, k, v, mesh):
@@ -355,6 +357,12 @@ def make_train_step(cfg: LlamaConfig, mesh, optimizer=None, rules=None):
     """
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.util.jax_compat import ensure_sharding_invariant_rng
+
+    # init draws params THROUGH the shardings: the same seed must yield
+    # the same params on every mesh layout (test_parallelism_consistency)
+    ensure_sharding_invariant_rng()
 
     rules = rules or DEFAULT_RULES
     optimizer = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95,
@@ -546,10 +554,12 @@ def make_pipeline_train_step(cfg: LlamaConfig, mesh, num_microbatches: int,
         out = pipelined_apply(stage_fn, local, mb, axis_name="pipe")
         return merge_microbatches(out)["x"]
 
-    pipe_fn = jax.shard_map(
+    from ray_tpu.util.jax_compat import shard_map as _sm
+
+    pipe_fn = _sm(
         pipe_region, mesh=mesh,
         in_specs=(layer_specs, act_spec["x"], act_spec["pos"]),
-        out_specs=act_spec["x"], check_vma=False)
+        out_specs=act_spec["x"], check=False)
 
     def loss(params, tokens):
         inputs, targets = tokens[:, :-1], tokens[:, 1:]

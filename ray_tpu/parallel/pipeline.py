@@ -71,7 +71,9 @@ def pipelined_apply(stage_fn: Callable[[Any, Any], Any], stage_params,
     pipe axis must not shard the batch).
     Returns [M, mb, ...] outputs, valid on every stage.
     """
-    P = lax.axis_size(axis_name)
+    from ray_tpu.util.jax_compat import axis_size
+
+    P = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     M = jax.tree.leaves(microbatches)[0].shape[0]
     rotate = [(i, (i + 1) % P) for i in range(P)]
@@ -140,7 +142,9 @@ def make_pipelined_fn(stage_fn, mesh, num_microbatches: int, *,
         out = pipelined_apply(stage_fn, local, mb, axis_name=axis_name)
         return merge_microbatches(out)
 
-    return jax.shard_map(
+    from ray_tpu.util.jax_compat import shard_map
+
+    return shard_map(
         inner, mesh=mesh,
         in_specs=(stage_param_specs, batch_spec),
-        out_specs=batch_spec, check_vma=False)
+        out_specs=batch_spec, check=False)

@@ -111,10 +111,11 @@ def ring_attention(q, k, v, mesh, causal: bool = True,
     repartition per the specs). Falls back to plain attention when the mesh
     has no seq axis.
     """
-    import jax
     from jax.sharding import PartitionSpec as P
 
-    shard_map = functools.partial(jax.shard_map, check_vma=False)
+    from ray_tpu.util.jax_compat import shard_map as _shard_map
+
+    shard_map = functools.partial(_shard_map, check=False)
 
     batch_axes = tuple(a for a in ("slice", "data", "fsdp")
                        if a in mesh.axis_names)

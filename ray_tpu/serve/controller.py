@@ -95,6 +95,10 @@ class ServeController:
             self._deployments.clear()
             self._routes.clear()
             self._version += 1; self._version_cv.notify_all()
+        # reconcile loop re-checks _shutdown within its 0.1s tick; reap
+        # it outside the lock (the loop takes _lock per reconcile)
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=2.0)
 
     # ------------------------------------------------------------ queries
     def get_replicas(self, name: str) -> List[Any]:

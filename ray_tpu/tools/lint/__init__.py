@@ -25,12 +25,27 @@ config-hygiene         every ``RAY_TPU_*`` env read is declared in
                        ``core/config.py`` and mentioned in docs
 metrics-hygiene        metric names are registered once, with one type
                        and one tag set
+resource-lifecycle     every acquired OS-backed resource (threads, shm
+                       channels, sockets, mmaps, subprocesses, pools)
+                       reaches a release on all paths incl. exception
+                       paths, cross-referenced against the owning
+                       class's shutdown/close/teardown methods
+thread-hygiene         no per-item thread spawns reachable from hot
+                       paths (direct in-loop, or via a callee that
+                       unconditionally spawns)
+ring-protocol          the shm ring-channel protocol spec
+                       (``ring_model.py``) passes exhaustive
+                       explicit-state model checking for n_slots 1..3:
+                       no lost wakeup, no torn read, bounded
+                       backpressure, deadlock freedom
 =====================  ====================================================
 
 Run it with ``python -m ray_tpu.tools.lint`` (or ``python -m ray_tpu
-lint``).  Findings are suppressed inline with ``# graftlint:
-ignore[check-id]`` (same line or the line above) or grandfathered in the
-checked-in baseline (``baseline.json``, one justification per entry).
+lint``; ``lint --changed-only`` is the <2 s dev-loop gate).  Findings
+are suppressed inline with ``# graftlint: ignore[check-id]`` (same line
+or the line above) or grandfathered in the checked-in baseline
+(``baseline.json``, one justification per entry — ``--update-baseline``
+refuses new entries without ``--justify`` and auto-prunes stale ones).
 The tree-wide run is a tier-1 test, so every PR is gated on a clean
 report.  See ``docs/static-analysis.md``.
 """

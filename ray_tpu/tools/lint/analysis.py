@@ -58,6 +58,40 @@ HANDLER_PARAMS = {"op", "tag"}
 
 METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
 
+# OS-backed resource constructors (leaf callable name -> kind).  Every
+# acquisition must reach a matching release on all paths — the
+# resource-lifecycle check's ground truth.
+RESOURCE_CTORS = {
+    "Thread": "thread",
+    "ShmChannel": "channel",
+    "socket": "socket",
+    "create_connection": "socket",
+    "socketpair": "socket",
+    "mmap": "mmap",
+    "Popen": "process",
+    "ThreadPoolExecutor": "pool",
+}
+
+# what counts as releasing each resource kind
+RESOURCE_RELEASERS = {
+    "thread": {"join"},
+    "channel": {"close"},
+    "socket": {"close", "shutdown", "detach"},
+    "mmap": {"close"},
+    "process": {"terminate", "kill", "wait", "communicate"},
+    "pool": {"shutdown"},
+}
+ALL_RELEASE_METHODS = frozenset().union(*RESOURCE_RELEASERS.values())
+
+# methods that as a family mean "this class tears itself down"; a
+# self-attr resource's release must be reachable from one of these
+TEARDOWN_METHOD_NAMES = {
+    "close", "shutdown", "stop", "teardown", "join", "terminate",
+    "kill", "cancel", "disconnect", "release", "cleanup", "clear",
+    "__exit__", "__del__", "_close", "_shutdown", "_stop", "_teardown",
+    "_cleanup", "reset",
+}
+
 
 def _expr_name(node: ast.AST) -> str:
     """Best-effort dotted name for a receiver expression."""
@@ -130,6 +164,30 @@ class MetricReg:
 
 
 @dataclass
+class ResourceAcquire:
+    kind: str              # thread | channel | socket | mmap | process | pool
+    ctor: str              # constructor leaf name, e.g. "Thread"
+    target: str            # "self.<attr>" | local name | "<anon>"
+    line: int
+    daemon: bool = False   # threads: daemon=True keyword present
+    in_loop: bool = False  # lexically under a For/While in this function
+    in_branch: bool = False  # under an If/except (conditional acquire)
+    paced_loop: bool = False  # enclosing loop sleeps or accept()s per
+    # iteration: a slow ticker or a per-connection accept loop, not a
+    # per-item hot path
+    with_managed: bool = False  # acquired as a `with ...` context item
+    escapes: bool = False  # handle stored/returned/passed beyond this scope
+
+
+@dataclass
+class ReleaseSite:
+    target: str            # receiver: "self.<attr>" | local name
+    method: str            # join | close | ...
+    line: int
+    in_finally: bool       # lexically inside a finally block
+
+
+@dataclass
 class FunctionInfo:
     qualname: str          # "Class.method" | "func" | "Class.method.<nested>"
     cls: Optional[str]
@@ -143,6 +201,12 @@ class FunctionInfo:
     # (param_name, channel_literal_or_None)
     forwards: Optional[Tuple[str, Optional[str]]] = None
     weakref_callbacks: List[Tuple[str, int]] = field(default_factory=list)
+    resources: List[ResourceAcquire] = field(default_factory=list)
+    releases: List[ReleaseSite] = field(default_factory=list)
+    # unconditional per-iteration call sites inside non-paced loop
+    # bodies (the thread-hygiene check propagates "spawns a thread"
+    # through these; paced = the loop sleeps or accept()s per iteration)
+    loop_calls: List[CallSite] = field(default_factory=list)
 
 
 @dataclass
@@ -383,6 +447,7 @@ class _ModuleCollector:
                                   if a.arg != "self"])
         self.mod.functions[qual] = fi
         self._handler_chain(node, fi)
+        self._scan_resources(node, fi)
         self._walk_block(node.body, held=(), fi=fi, cls=cls,
                          prefix=prefix + node.name + ".")
 
@@ -588,6 +653,226 @@ class _ModuleCollector:
             if op is not None:
                 self.mod.sends.append(SendSite(op=op, line=call.lineno,
                                                channel=chan, prefix=prefix))
+
+    # -------------------------------------------------------- resource scan
+
+    @staticmethod
+    def _resource_ctor(call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(ctor_leaf, kind) when the call constructs an OS-backed
+        resource.  Module-qualified ctors with generic names (socket,
+        mmap, Popen) require the matching receiver so `self.socket(...)`
+        style helpers don't count."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            name, recv = fn.attr, _expr_name(fn.value).rsplit(".", 1)[-1]
+        elif isinstance(fn, ast.Name):
+            name, recv = fn.id, ""
+        else:
+            return None
+        kind = RESOURCE_CTORS.get(name)
+        if kind is None:
+            return None
+        if name == "socket" and recv not in ("socket", ""):
+            return None
+        if name == "mmap" and recv not in ("mmap", ""):
+            return None
+        if name == "Popen" and recv not in ("subprocess", ""):
+            return None
+        if name == "Thread" and recv not in ("threading", ""):
+            return None
+        return name, kind
+
+    @staticmethod
+    def _kw_true(call: ast.Call, kw_name: str) -> bool:
+        for kw in call.keywords:
+            if kw.arg == kw_name and isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+        return False
+
+    def _scan_resources(self, node, fi: FunctionInfo) -> None:
+        """Per-function resource-lifecycle facts: acquisitions (with
+        loop/with/escape context), release calls (with finally context),
+        and loop-resident call sites for thread-hygiene propagation.
+        Nested defs are scanned as their own functions."""
+        acquires: Dict[str, ResourceAcquire] = {}
+        # `t = self._thread` aliasing: a release through the alias counts
+        # as releasing the attribute (Pool.join's `t.join()` idiom)
+        aliases: Dict[str, str] = {}
+
+        def release_method(call: ast.Call) -> Optional[Tuple[str, str]]:
+            fn = call.func
+            if not isinstance(fn, ast.Attribute):
+                return None
+            if fn.attr not in ALL_RELEASE_METHODS:
+                return None
+            recv = _expr_name(fn.value)
+            recv = aliases.get(recv, recv)
+            return recv, fn.attr
+
+        def loop_is_paced(loop) -> bool:
+            # a loop body that sleeps or does a TIMED wait (slow ticker)
+            # or accept()s (one iteration per inbound CONNECTION, bounded
+            # by peers) is not a per-item hot path
+            for child in ast.walk(loop):
+                if not (isinstance(child, ast.Call)
+                        and isinstance(child.func, ast.Attribute)):
+                    continue
+                if child.func.attr in ("sleep", "accept"):
+                    return True
+                if child.func.attr == "wait" and (child.args
+                                                  or child.keywords):
+                    return True  # Event.wait(timeout): a tick, not a park
+            return False
+
+        def visit(stmts, in_loop: bool, in_finally: bool, in_branch: bool,
+                  paced: bool = False):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # separate FunctionInfo
+                if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                    p = paced or loop_is_paced(stmt)
+                    visit(stmt.body, True, in_finally, in_branch, p)
+                    visit(stmt.orelse, True, in_finally, in_branch, p)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    visit(stmt.body, in_loop, in_finally, in_branch, paced)
+                    for h in stmt.handlers:
+                        visit(h.body, in_loop, in_finally, True, paced)
+                    visit(stmt.orelse, in_loop, in_finally, in_branch, paced)
+                    visit(stmt.finalbody, in_loop, True, in_branch, paced)
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        if isinstance(item.context_expr, ast.Call):
+                            rc = self._resource_ctor(item.context_expr)
+                            if rc is not None:
+                                name = (item.optional_vars.id
+                                        if isinstance(item.optional_vars,
+                                                      ast.Name) else "<anon>")
+                                fi.resources.append(ResourceAcquire(
+                                    kind=rc[1], ctor=rc[0], target=name,
+                                    line=item.context_expr.lineno,
+                                    in_loop=in_loop, paced_loop=paced,
+                                    with_managed=True))
+                    visit(stmt.body, in_loop, in_finally, in_branch, paced)
+                    continue
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name) \
+                        and isinstance(stmt.value, ast.Attribute) \
+                        and isinstance(stmt.value.value, ast.Name) \
+                        and stmt.value.value.id == "self":
+                    aliases[stmt.targets[0].id] = \
+                        f"self.{stmt.value.attr}"
+                self._stmt_resources(stmt, fi, acquires, in_loop,
+                                     in_finally, in_branch, paced,
+                                     release_method)
+                if isinstance(stmt, ast.If):
+                    visit(stmt.body, in_loop, in_finally, True, paced)
+                    visit(stmt.orelse, in_loop, in_finally, True, paced)
+                else:
+                    for attr in ("body", "orelse"):
+                        block = getattr(stmt, attr, None)
+                        if block:
+                            visit(block, in_loop, in_finally, in_branch,
+                                  paced)
+
+        visit(node.body, False, False, False)
+        self._mark_escapes(node, acquires)
+
+    def _stmt_resources(self, stmt, fi, acquires, in_loop, in_finally,
+                        in_branch, paced, release_method):
+        # acquisitions ---------------------------------------------------
+        tgt_call = None
+        target = "<anon>"
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.value, ast.Call):
+            tgt_call = stmt.value
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name):
+                target = t.id
+            elif isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                target = f"self.{t.attr}"
+            else:
+                target = "<escaped>"
+        elif isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            call = stmt.value
+            fn = call.func
+            # Thread(...).start() chain: the handle is dropped on the spot
+            if isinstance(fn, ast.Attribute) and \
+                    isinstance(fn.value, ast.Call):
+                tgt_call = fn.value
+            else:
+                tgt_call = call
+        if tgt_call is not None:
+            rc = self._resource_ctor(tgt_call)
+            if rc is not None:
+                acq = ResourceAcquire(
+                    kind=rc[1], ctor=rc[0], target=target,
+                    line=tgt_call.lineno,
+                    daemon=self._kw_true(tgt_call, "daemon"),
+                    in_loop=in_loop, in_branch=in_branch,
+                    paced_loop=paced,
+                    escapes=(target == "<escaped>"))
+                fi.resources.append(acq)
+                if target not in ("<anon>", "<escaped>") \
+                        and not target.startswith("self."):
+                    acquires[target] = acq
+        # releases + loop-resident calls (leaf statements only: compound
+        # statements' blocks are visited statement-by-statement by the
+        # caller, so walking them here would double-record) ------------
+        if hasattr(stmt, "body"):
+            return
+        for child in ast.walk(stmt):
+            if not isinstance(child, ast.Call):
+                continue
+            rel = release_method(child)
+            if rel is not None:
+                fi.releases.append(ReleaseSite(
+                    target=rel[0], method=rel[1], line=child.lineno,
+                    in_finally=in_finally))
+            if in_loop and not paced and not in_branch:
+                fn = child.func
+                if isinstance(fn, ast.Attribute) \
+                        and isinstance(fn.value, ast.Name) \
+                        and fn.value.id == "self":
+                    fi.loop_calls.append(CallSite(
+                        callee=fn.attr, is_self=True,
+                        line=child.lineno, held=()))
+                elif isinstance(fn, ast.Name):
+                    fi.loop_calls.append(CallSite(
+                        callee=fn.id, is_self=False,
+                        line=child.lineno, held=()))
+            # `t.daemon = True` assignments are rare; daemon kw covers
+            # the tree's idiom
+
+    @staticmethod
+    def _mark_escapes(node, acquires: Dict[str, ResourceAcquire]) -> None:
+        """A local resource handle escapes when it is returned, yielded,
+        aliased, stored into a container/attribute, or passed to a call
+        — ownership moved beyond this function, so all-paths release is
+        no longer this function's obligation."""
+        if not acquires:
+            return
+
+        def names_in(sub) -> Set[str]:
+            return {n.id for n in ast.walk(sub)
+                    if isinstance(n, ast.Name) and n.id in acquires}
+
+        for child in ast.walk(node):
+            hits: Set[str] = set()
+            if isinstance(child, (ast.Return, ast.Yield)) and child.value:
+                hits = names_in(child.value)
+            elif isinstance(child, ast.Call):
+                for a in list(child.args) + [k.value for k in child.keywords]:
+                    hits |= names_in(a)
+            elif isinstance(child, ast.Assign):
+                # alias or store: `x = t`, `self.t = t`, `d[k] = t`
+                if isinstance(child.value, (ast.Name, ast.Tuple, ast.List)):
+                    hits = names_in(child.value)
+            for name in hits:
+                acquires[name].escapes = True
 
     # --------------------------------------------------------- handler scan
 

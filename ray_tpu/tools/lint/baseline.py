@@ -11,10 +11,12 @@ Format (``baseline.json``, kept next to this module)::
     }
 
 Workflow: a finding you cannot (or should not) fix gets an entry with a
-*justification string* — ``--update-baseline`` refuses to invent one, it
-writes ``TODO: justify`` so the reviewer sees exactly what was accepted.
-Entries whose finding disappears become *stale* and are reported so the
-baseline only ever shrinks by being cleaned, never silently.
+*justification string*.  ``--update-baseline`` REFUSES to record a new
+entry without one (pass ``--justify "reason"``; it applies to every new
+entry in that run, so grandfather findings one shape at a time).  Stale
+entries — findings that no longer fire — are pruned automatically on
+every ``--update-baseline`` and reported on plain runs, so the baseline
+only ever shrinks by being cleaned, never grows silently.
 """
 
 from __future__ import annotations
@@ -22,9 +24,20 @@ from __future__ import annotations
 import json
 import os
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .checks import Finding
+
+
+class BaselineJustificationError(ValueError):
+    """--update-baseline found new findings but no justification."""
+
+    def __init__(self, keys: List[str]):
+        self.keys = keys
+        super().__init__(
+            f"{len(keys)} new finding(s) need a justification — rerun "
+            "with --justify \"why this is intentional\" (one shape at a "
+            "time), or fix the findings:\n  " + "\n  ".join(keys))
 
 
 def default_baseline_path() -> str:
@@ -79,23 +92,46 @@ class Baseline:
         return unbaselined, baselined, stale
 
     def absorb(self, findings: List[Finding], protocol: Dict,
-               ran_checks: Optional[List[str]] = None) -> None:
+               ran_checks: Optional[List[str]] = None,
+               justification: Optional[str] = None,
+               ) -> Tuple[List[str], List[str]]:
         """--update-baseline: record current findings + op hash, keeping
-        existing justifications, dropping stale entries.  With a check
-        filter (``ran_checks``), entries for checks that did NOT run are
-        preserved untouched — a filtered update must never delete another
-        check's justified entries."""
+        existing justifications and auto-pruning stale entries.
+
+        A NEW entry (no existing justification) requires ``justification``
+        — without one this raises :class:`BaselineJustificationError`
+        and the baseline is untouched.  With a check filter
+        (``ran_checks``), entries for checks that did NOT run are
+        preserved untouched — a filtered update must never delete
+        another check's justified entries.  Returns
+        ``(added_keys, pruned_keys)``."""
         seen: Dict[str, int] = {}
         new: Dict[str, str] = {}
         if ran_checks is not None:
             ran = set(ran_checks)
-            for key, justification in self.findings.items():
+            for key, just in self.findings.items():
                 if key.split(":", 1)[0] not in ran:
-                    new[key] = justification
+                    new[key] = just
+        added: List[str] = []
         for f in findings:
+            if f.check == "protocol-version":
+                # settled by the protocol-hash refresh this same absorb
+                # performs — never a grandfathered entry
+                continue
             n = seen.get(f.key, 0)
             seen[f.key] = n + 1
             key = f.key if n == 0 else f"{f.key}#{n}"
-            new[key] = self.findings.get(key, "TODO: justify")
+            existing = self.findings.get(key)
+            if existing is None:
+                added.append(key)
+            new[key] = existing if existing is not None else \
+                (justification or "")
+        if added and not (justification and justification.strip()):
+            raise BaselineJustificationError(added)
+        pruned = [k for k in self.findings
+                  if k not in new
+                  and (ran_checks is None
+                       or k.split(":", 1)[0] in set(ran_checks))]
         self.findings = new
         self.protocol = protocol
+        return added, pruned

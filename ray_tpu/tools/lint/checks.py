@@ -11,7 +11,13 @@ from collections import defaultdict, deque
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from .analysis import FunctionInfo, ModuleInfo, TreeIndex
+from .analysis import (
+    RESOURCE_RELEASERS,
+    TEARDOWN_METHOD_NAMES,
+    FunctionInfo,
+    ModuleInfo,
+    TreeIndex,
+)
 
 CHECK_LOCK_ORDER = "lock-order"
 CHECK_BLOCKING = "blocking-under-lock"
@@ -20,6 +26,9 @@ CHECK_PROTOCOL = "protocol-completeness"
 CHECK_PROTOCOL_VERSION = "protocol-version"
 CHECK_CONFIG = "config-hygiene"
 CHECK_METRICS = "metrics-hygiene"
+CHECK_RESOURCE = "resource-lifecycle"
+CHECK_THREAD_HYGIENE = "thread-hygiene"
+CHECK_RING = "ring-protocol"
 
 ALL_CHECKS = (
     CHECK_LOCK_ORDER,
@@ -29,6 +38,9 @@ ALL_CHECKS = (
     CHECK_PROTOCOL_VERSION,
     CHECK_CONFIG,
     CHECK_METRICS,
+    CHECK_RESOURCE,
+    CHECK_THREAD_HYGIENE,
+    CHECK_RING,
 )
 
 # Blocking kinds that also count as "channel send" for gc-reentrancy.
@@ -509,6 +521,227 @@ def check_metrics_hygiene(idx: TreeIndex) -> List[Finding]:
     return findings
 
 
+# --------------------------------------------------------- resource-lifecycle
+
+
+def _teardown_reachable(mod: ModuleInfo, cg: "_CallGraph",
+                        cls: str) -> Set[str]:
+    """Quals of methods reachable (transitively, intra-class) from any
+    teardown-family method of ``cls`` — the set a self-attr resource's
+    release must intersect."""
+    roots = [f"{cls}.{m}" for m in mod.classes.get(cls, ())
+             if m in TEARDOWN_METHOD_NAMES]
+    seen: Set[str] = set(roots)
+    queue = deque(roots)
+    while queue:
+        cur = queue.popleft()
+        for tgt in cg.callees(cur):
+            if tgt not in seen and tgt.startswith(f"{cls}."):
+                seen.add(tgt)
+                queue.append(tgt)
+    return seen
+
+
+def check_resource_lifecycle(idx: TreeIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, mod in idx.modules.items():
+        cg = _CallGraph(mod)
+        # ---- class-owned resources (self.<attr> = <ctor>(...)) --------
+        for cls, methods in mod.classes.items():
+            acquires: Dict[str, "ResourceAcquire"] = {}  # noqa: F821
+            releases: Dict[str, List[Tuple[str, "ReleaseSite"]]] = \
+                defaultdict(list)  # noqa: F821
+            has_teardown = any(m in TEARDOWN_METHOD_NAMES for m in methods)
+            for m in methods:
+                fi = mod.functions.get(f"{cls}.{m}")
+                if fi is None:
+                    continue
+                for acq in fi.resources:
+                    if acq.target.startswith("self.") \
+                            and not acq.with_managed:
+                        acquires.setdefault(acq.target, acq)
+                for rel in fi.releases:
+                    if rel.target.startswith("self."):
+                        releases[rel.target].append((fi.qualname, rel))
+            if acquires:
+                reach = _teardown_reachable(mod, cg, cls)
+                for target, acq in sorted(acquires.items()):
+                    ok_methods = RESOURCE_RELEASERS[acq.kind]
+                    sites = [(q, r) for q, r in releases.get(target, ())
+                             if r.method in ok_methods]
+                    if not sites:
+                        findings.append(Finding(
+                            check=CHECK_RESOURCE, path=path, line=acq.line,
+                            context=f"{cls}", detail=f"leak:{target}",
+                            message=(f"{cls} acquires {acq.kind} "
+                                     f"{target} ({acq.ctor}) but no method "
+                                     f"ever calls {target}."
+                                     f"{'/'.join(sorted(ok_methods))}() — "
+                                     "the OS resource outlives the object "
+                                     "on every path")))
+                    elif reach and not any(q in reach for q, _r in sites):
+                        rel_at = ", ".join(sorted({q for q, _r in sites}))
+                        findings.append(Finding(
+                            check=CHECK_RESOURCE, path=path, line=acq.line,
+                            context=f"{cls}",
+                            detail=f"shutdown-miss:{target}",
+                            message=(f"{cls} releases {acq.kind} {target} "
+                                     f"only in {rel_at}, which is not "
+                                     "reachable from any of its "
+                                     "shutdown/close/teardown methods — "
+                                     "the teardown path leaks it")))
+            # ---- unretained service resources ------------------------
+            # a class that manages lifecycle (has a teardown method) must
+            # hold on to threads/pools it spins up at construction: an
+            # anonymous `Thread(...).start()` in __init__/start* can
+            # never be joined by shutdown
+            if has_teardown:
+                for m in methods:
+                    if not (m in ("__init__", "open", "connect")
+                            or m.startswith(("start", "_start"))):
+                        continue
+                    fi = mod.functions.get(f"{cls}.{m}")
+                    if fi is None:
+                        continue
+                    for acq in fi.resources:
+                        if acq.target == "<anon>" and acq.kind in (
+                                "thread", "pool"):
+                            findings.append(Finding(
+                                check=CHECK_RESOURCE, path=path,
+                                line=acq.line, context=fi.qualname,
+                                detail=f"unretained:{acq.ctor}@{fi.qualname}",
+                                message=(f"{fi.qualname} starts a "
+                                         f"{acq.kind} without retaining "
+                                         "the handle; this class has a "
+                                         "teardown method, which can "
+                                         "therefore never join it — "
+                                         "store it on self and join at "
+                                         "shutdown")))
+        # ---- function-local resources ---------------------------------
+        for qual, fi in mod.functions.items():
+            for acq in fi.resources:
+                if acq.target in ("<anon>", "<escaped>") \
+                        or acq.target.startswith("self.") \
+                        or acq.with_managed or acq.escapes:
+                    continue
+                ok_methods = RESOURCE_RELEASERS[acq.kind]
+                sites = [r for r in fi.releases
+                         if r.target == acq.target
+                         and r.method in ok_methods]
+                if not sites:
+                    if acq.kind == "thread" and acq.daemon:
+                        continue  # local daemon worker: fire-and-forget
+                    findings.append(Finding(
+                        check=CHECK_RESOURCE, path=path, line=acq.line,
+                        context=qual,
+                        detail=f"local-leak:{acq.target}",
+                        message=(f"local {acq.kind} {acq.target!r} "
+                                 f"({acq.ctor}) is never "
+                                 f"{'/'.join(sorted(ok_methods))}()d in "
+                                 f"{qual} and does not escape — leaked "
+                                 "on every path")))
+                elif not any(r.in_finally for r in sites) \
+                        and acq.kind != "thread":
+                    findings.append(Finding(
+                        check=CHECK_RESOURCE, path=path, line=acq.line,
+                        context=qual,
+                        detail=f"exception-path:{acq.target}",
+                        message=(f"local {acq.kind} {acq.target!r} "
+                                 f"({acq.ctor}) is released only on the "
+                                 f"normal path in {qual}; an exception "
+                                 "between acquire and release leaks it — "
+                                 "use try/finally or a with block")))
+    return findings
+
+
+# ------------------------------------------------------------ thread-hygiene
+
+
+def check_thread_hygiene(idx: TreeIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, mod in idx.modules.items():
+        cg = _CallGraph(mod)
+        # functions that UNCONDITIONALLY spawn a thread per call (a
+        # conditional spawn is usually a started-once guard)
+        direct_spawn: Dict[str, int] = {}
+        for qual, fi in mod.functions.items():
+            for acq in fi.resources:
+                if acq.kind == "thread" and not acq.in_loop \
+                        and not acq.in_branch and not acq.with_managed:
+                    direct_spawn.setdefault(qual, acq.line)
+        # transitive closure: f spawns if any callee spawns
+        spawns: Set[str] = set(direct_spawn)
+        changed = True
+        while changed:
+            changed = False
+            for qual in mod.functions:
+                if qual in spawns:
+                    continue
+                if any(t in spawns for t in cg.callees(qual)):
+                    spawns.add(qual)
+                    changed = True
+        for qual, fi in mod.functions.items():
+            # direct per-item spawn inside a non-paced loop body
+            for acq in fi.resources:
+                if acq.kind == "thread" and acq.in_loop \
+                        and not acq.paced_loop:
+                    findings.append(Finding(
+                        check=CHECK_THREAD_HYGIENE, path=path,
+                        line=acq.line, context=qual,
+                        detail=f"spawn-in-loop:{qual}",
+                        message=(f"{qual} creates a thread inside a loop "
+                                 "— per-item thread spawns turn a hot "
+                                 "path into ~100us of clone/teardown per "
+                                 "item; use a resident worker or pool")))
+            # loop-resident call into a function that always spawns
+            seen: Set[str] = set()
+            for cs in fi.loop_calls:
+                tgt = cg._resolve(fi, cs.callee, cs.is_self)
+                if tgt is None or tgt == qual or tgt in seen:
+                    continue
+                if tgt in spawns:
+                    seen.add(tgt)
+                    findings.append(Finding(
+                        check=CHECK_THREAD_HYGIENE, path=path,
+                        line=cs.line, context=qual,
+                        detail=f"spawn-via:{tgt}",
+                        message=(f"{qual} calls {cs.callee}() inside a "
+                                 f"loop and {tgt} unconditionally spawns "
+                                 "a thread — a per-item thread creation "
+                                 "reachable from a hot path (the PR-7 "
+                                 "3-threads-per-stream-item shape)")))
+    return findings
+
+
+# -------------------------------------------------------------- ring-protocol
+
+
+def check_ring_protocol_model(idx: TreeIndex) -> List[Finding]:
+    """Exhaustive model check of the ring-channel protocol spec.
+
+    Runs only when the scanned tree contains the channel implementation
+    the spec mirrors (fixture trees don't pay for it).  A violation
+    means an interleaving of the modeled mmap writes breaks a protocol
+    invariant — fix channel.py AND ring_model.py together; the
+    conformance test in tests/test_static_analysis.py keeps them honest.
+    """
+    from .ring_check import CHANNEL_PATH, check_ring_protocol
+
+    if CHANNEL_PATH not in idx.modules:
+        return []
+    findings: List[Finding] = []
+    for res in check_ring_protocol():
+        for v in res.violations:
+            findings.append(Finding(
+                check=CHECK_RING, path=CHANNEL_PATH, line=1,
+                context=f"n_slots={v.n_slots}",
+                detail=f"{v.kind}:n{v.n_slots}",
+                message=(f"ring protocol model check failed: {v.render()}"
+                         " — an interleaving of the published protocol's "
+                         "mmap writes violates this invariant")))
+    return findings
+
+
 # ------------------------------------------------------------------- driver
 
 
@@ -531,6 +764,12 @@ def run_checks(idx: TreeIndex,
         findings += check_config_hygiene(idx)
     if CHECK_METRICS in wanted:
         findings += check_metrics_hygiene(idx)
+    if CHECK_RESOURCE in wanted:
+        findings += check_resource_lifecycle(idx)
+    if CHECK_THREAD_HYGIENE in wanted:
+        findings += check_thread_hygiene(idx)
+    if CHECK_RING in wanted:
+        findings += check_ring_protocol_model(idx)
     findings = [f for f in findings
                 if not idx.suppressed(f.path, f.line, f.check)]
     return sorted(findings, key=lambda f: (f.path, f.line, f.check, f.detail))

@@ -15,8 +15,14 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+import subprocess
+
 from .analysis import collect_tree
-from .baseline import Baseline, default_baseline_path
+from .baseline import (
+    Baseline,
+    BaselineJustificationError,
+    default_baseline_path,
+)
 from .checks import ALL_CHECKS, Finding, protocol_ops_hash, run_checks
 
 
@@ -42,14 +48,78 @@ class LintReport:
     unbaselined: List[Finding] = field(default_factory=list)
     baselined: List[Finding] = field(default_factory=list)
     stale_baseline_keys: List[str] = field(default_factory=list)
+    pruned_baseline_keys: List[str] = field(default_factory=list)
     parse_errors: List = field(default_factory=list)
     ops_hash: str = ""
     protocol_version: Optional[int] = None
     duration_s: float = 0.0
+    changed_only: bool = False
+    changed_paths: Optional[List[str]] = None  # None = full tree
 
     @property
     def ok(self) -> bool:
         return not self.unbaselined and not self.parse_errors
+
+
+def _git(repo_dir: str, *args: str) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo_dir, *args], capture_output=True,
+            text=True, timeout=10)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return out.stdout
+
+
+def changed_files(root: str) -> Optional[List[str]]:
+    """Scan-root-relative paths touched since ``git merge-base`` with
+    the upstream (or default) branch, plus any uncommitted/untracked
+    work.  None when git state can't be determined (callers fall back
+    to the full tree — never silently lint nothing)."""
+    repo_dir = os.path.dirname(os.path.abspath(root))
+    head = _git(repo_dir, "rev-parse", "HEAD")
+    if head is None:
+        return None
+    repo_top = _git(repo_dir, "rev-parse", "--show-toplevel")
+    if repo_top is None:
+        return None
+    repo_top = repo_top.strip()
+    names: set = set()
+    # uncommitted (staged + unstaged) and untracked
+    for args in (("diff", "--name-only", "HEAD"),
+                 ("ls-files", "--others", "--exclude-standard")):
+        out = _git(repo_dir, *args)
+        if out is None:
+            return None
+        names.update(ln for ln in out.splitlines() if ln)
+    # committed work since the merge-base with the upstream/default branch
+    resolved = False
+    for base_ref in ("@{upstream}", "origin/main", "origin/master",
+                     "main", "master"):
+        mb = _git(repo_dir, "merge-base", "HEAD", base_ref)
+        if mb is not None:
+            mb = mb.strip()
+            if mb != head.strip():
+                out = _git(repo_dir, "diff", "--name-only", mb, "HEAD")
+                if out is None:
+                    return None  # can't see branch commits: full tree
+                names.update(ln for ln in out.splitlines() if ln)
+            resolved = True
+            break
+    if not resolved:
+        # no upstream and no main/master ref: branch-committed files are
+        # invisible, and silently dropping them would let the dev-loop
+        # gate pass where the full run fails — fall back to the full tree
+        return None
+    root = os.path.abspath(root)
+    rel: List[str] = []
+    for name in sorted(names):
+        p = os.path.relpath(os.path.join(repo_top, name), root)
+        if not p.startswith(".."):
+            rel.append(p)
+    return rel
 
 
 def run_lint(root: Optional[str] = None,
@@ -57,23 +127,45 @@ def run_lint(root: Optional[str] = None,
              doc_roots: Optional[List[str]] = None,
              checks: Optional[List[str]] = None,
              update_baseline: bool = False,
-             use_baseline: bool = True) -> LintReport:
+             use_baseline: bool = True,
+             justification: Optional[str] = None,
+             changed_only: bool = False) -> LintReport:
     """Programmatic entry point (the tier-1 test calls this)."""
     t0 = time.monotonic()
+    if changed_only and update_baseline:
+        raise ValueError(
+            "--changed-only cannot be combined with --update-baseline: "
+            "a partial view would prune entries for files it never "
+            "looked at")
     root = root or default_root()
     if use_baseline and baseline_path is None:
         baseline_path = default_baseline_path()
     if doc_roots is None:
         doc_roots = default_doc_roots(root)
+    changed: Optional[List[str]] = None
+    if changed_only:
+        changed = changed_files(root)
+        # None (git unavailable) falls back to the full tree: the fast
+        # mode must only ever UNDER-restrict, never lint nothing
     idx = collect_tree(root, doc_roots=doc_roots)
     baseline = Baseline.load(baseline_path if use_baseline else None)
     findings = run_checks(idx, baseline_protocol=baseline.protocol,
                           checks=checks)
     digest, version = protocol_ops_hash(idx)
+    parse_errors = idx.parse_errors
+    if changed is not None:
+        # the analysis always sees the WHOLE tree (cross-module checks
+        # need it); only the reporting narrows to touched files, so the
+        # fast mode agrees with the full run on every touched file
+        in_changed = set(changed)
+        findings = [f for f in findings if f.path in in_changed]
+        parse_errors = [(p, e) for p, e in parse_errors
+                        if p in in_changed]
+    pruned: List[str] = []
     if update_baseline:
-        baseline.absorb(findings,
-                        {"version": version, "ops_hash": digest},
-                        ran_checks=checks)
+        _added, pruned = baseline.absorb(
+            findings, {"version": version, "ops_hash": digest},
+            ran_checks=checks, justification=justification)
         baseline.path = baseline.path or default_baseline_path()
         baseline.save()
         unbaselined, baselined, stale = [], findings, []
@@ -83,11 +175,18 @@ def run_lint(root: Optional[str] = None,
             # a filtered run cannot judge entries for checks it didn't run
             wanted = set(checks)
             stale = [k for k in stale if k.split(":", 1)[0] in wanted]
+        if changed is not None:
+            # a changed-only run cannot judge entries for files it
+            # didn't report on
+            stale = []
     return LintReport(findings=findings, unbaselined=unbaselined,
                       baselined=baselined, stale_baseline_keys=stale,
-                      parse_errors=idx.parse_errors,
+                      pruned_baseline_keys=pruned,
+                      parse_errors=parse_errors,
                       ops_hash=digest, protocol_version=version,
-                      duration_s=time.monotonic() - t0)
+                      duration_s=time.monotonic() - t0,
+                      changed_only=changed_only,
+                      changed_paths=changed)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -104,7 +203,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="report every finding, ignoring the baseline")
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite the baseline to the current findings and "
-                        "wire-op hash (new entries get 'TODO: justify')")
+                        "wire-op hash; stale entries are pruned, and NEW "
+                        "entries are refused unless --justify is given")
+    p.add_argument("--justify", default=None, metavar="REASON",
+                   help="justification recorded for every NEW baseline "
+                        "entry this --update-baseline run adds")
+    p.add_argument("--changed-only", action="store_true",
+                   help="report findings only for files changed since "
+                        "the git merge-base (plus uncommitted work) — "
+                        "the <2s dev-loop gate; analysis still sees the "
+                        "whole tree so results match the full run")
     p.add_argument("--check", action="append", dest="checks",
                    metavar="ID", choices=list(ALL_CHECKS),
                    help="run only this check id (repeatable)")
@@ -119,11 +227,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(c)
         return 0
 
-    report = run_lint(root=args.root,
-                      baseline_path=args.baseline,
-                      checks=args.checks,
-                      update_baseline=args.update_baseline,
-                      use_baseline=not args.no_baseline)
+    try:
+        report = run_lint(root=args.root,
+                          baseline_path=args.baseline,
+                          checks=args.checks,
+                          update_baseline=args.update_baseline,
+                          use_baseline=not args.no_baseline,
+                          justification=args.justify,
+                          changed_only=args.changed_only)
+    except BaselineJustificationError as e:
+        print(f"refusing to update baseline: {e}", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
 
     if args.as_json:
         try:  # noqa: SIM105 — `| head` closing the pipe is not an error
@@ -137,19 +254,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     for f in report.unbaselined:
         print(f.render())
     if args.update_baseline:
+        for key in report.pruned_baseline_keys:
+            print(f"pruned stale baseline entry: {key}", file=sys.stderr)
         print(f"baseline updated: {len(report.findings)} finding(s) "
-              f"recorded, ops hash {report.ops_hash} "
+              f"recorded, {len(report.pruned_baseline_keys)} stale "
+              f"entr(ies) pruned, ops hash {report.ops_hash} "
               f"(PROTOCOL_VERSION {report.protocol_version})")
         return 0
     for key in report.stale_baseline_keys:
         print(f"stale baseline entry (finding no longer fires): {key}",
               file=sys.stderr)
     n_sup = len(report.baselined)
+    scope = ""
+    if report.changed_only:
+        scope = (f" [changed-only: {len(report.changed_paths or [])} "
+                 "file(s)]" if report.changed_paths is not None
+                 else " [changed-only: git unavailable, full tree]")
     summary = (f"graftlint: {len(report.unbaselined)} finding(s), "
                f"{n_sup} baselined, "
                f"{len(report.stale_baseline_keys)} stale baseline "
                f"entr(ies), ops hash {report.ops_hash}, "
-               f"{report.duration_s:.2f}s")
+               f"{report.duration_s:.2f}s{scope}")
     print(summary)
     return 0 if report.ok else 1
 
@@ -163,7 +288,10 @@ def _print_json(report: LintReport) -> None:
         "unbaselined": [f.__dict__ for f in report.unbaselined],
         "baselined": [f.key for f in report.baselined],
         "stale_baseline_keys": report.stale_baseline_keys,
+        "pruned_baseline_keys": report.pruned_baseline_keys,
         "parse_errors": report.parse_errors,
+        "changed_only": report.changed_only,
+        "changed_paths": report.changed_paths,
     }, indent=2))
 
 

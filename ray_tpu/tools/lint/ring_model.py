@@ -1,0 +1,316 @@
+"""Pure spec of the shm ring-channel protocol (experimental/channel.py).
+
+This module is the machine-checkable twin of ``ShmChannel``: every mmap
+write the real code performs is one atomic micro-op here, in the same
+order, with no I/O anywhere.  The explorer (``ring_check.py``)
+enumerates all interleavings of these micro-ops; the conformance test
+drives the REAL channel and this model through identical operation
+traces and compares the mapped header after every step, which is what
+keeps the spec honest when channel.py changes.
+
+Protocol recap (channel.py ring layout v2):
+
+- global header: ``[write_seq][read_seq][n_slots][slot_cap]`` + one
+  parked-flag byte per side.  The writer owns ``write_seq`` and every
+  slot header; the reader owns ``read_seq``.
+- publish (writer): wait writable (``w - r < n_slots``) → payload into
+  slot ``w % n`` → slot header stamped (seq = w+1, stamped LAST) →
+  global ``write_seq`` commit → ring the reader's doorbell iff its
+  parked flag is up.
+- consume (reader): wait readable (``w > r``) → slot header seq
+  cross-checked against ``r + 1`` (catches a partially-published slot)
+  → payload out → ``read_seq`` advance → ring the writer's doorbell iff
+  its parked flag is up.
+- hybrid wait (either side): bounded spin → raise own parked flag →
+  RECHECK the condition → sleep on the doorbell FIFO; wake drains the
+  FIFO and loops.  Set-flag-then-recheck on the parking side and
+  publish-then-check-flag on the ringing side together close the
+  lost-wakeup race; each :class:`Mutations` field deletes exactly one
+  of these guards so the mutation tests can assert the checker notices.
+
+Nothing in this file imports channel.py — the spec must not be able to
+accidentally *become* the implementation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Tuple
+
+# Violation kinds the explorer reports (stable ids, used in tests/docs).
+V_BACKPRESSURE = "backpressure"            # w - r > n_slots or seq regressed
+V_TORN_PUBLISH = "torn-publish-observed"   # slot-seq cross-check fired
+V_TORN_READ = "torn-read-consumed"         # reader consumed a partial slot
+V_LOST_WAKEUP = "lost-wakeup"              # asleep + condition up + no bell
+V_DEADLOCK = "deadlock"                    # non-final state, nothing enabled
+
+
+@dataclass(frozen=True)
+class Mutations:
+    """One deleted guard per field (all False = the shipped protocol)."""
+
+    # parking side sleeps right after raising its flag, without the
+    # ready recheck (_wait's `if ready(): return` after `flag = 1`)
+    drop_parked_recheck: bool = False
+    # writer commits the global write_seq BEFORE stamping the slot
+    # header — breaks the "seq stamped LAST" torn-publish guard
+    commit_before_stamp: bool = False
+    # writer consults the reader's parked flag BEFORE the write_seq
+    # commit — breaks the publish-then-check-flag doorbell ordering
+    flag_check_before_commit: bool = False
+    # reader skips the per-slot seq cross-check entirely
+    drop_slot_seq_check: bool = False
+
+    def writer_publish_ops(self) -> Tuple[str, ...]:
+        if self.commit_before_stamp:
+            # the global commit hoisted to the front: the reader can see
+            # write_seq advance while the slot holds a stale header and
+            # a partial payload — the exact window "seq stamped LAST"
+            # plus the reader cross-check exist to make observable/safe
+            return ("commit", "fill", "stamp", "ring")
+        if self.flag_check_before_commit:
+            return ("fill", "stamp", "ring", "commit")
+        return ("fill", "stamp", "commit", "ring")
+
+
+# ----------------------------------------------------------------- state
+#
+# State is one flat tuple (hashable, tiny):
+#   (w, r, slots, rp, wp, bell_rdy, bell_free, wpc, wmsg, rpc, rmsg)
+# slots: tuple of (stamped_seq, filled_seq) per slot, 0 = never written.
+# wpc/rpc: the side's program counter —
+#   "idle"            between operations
+#   "wait"            inside the spin loop (pre-flag)
+#   "flag"            about to raise the parked flag
+#   "recheck"         flag is up, about to re-test the condition
+#   "sleep"           parked on the doorbell FIFO
+#   ("pub", i)        i'th micro-op of the publish sequence
+#   ("rd", i)         i'th micro-op of the consume sequence
+# wmsg/rmsg: seq of the message currently being published/consumed
+# (needed because mutations reorder the commit relative to the stamp).
+
+IDLE, WAIT, FLAG, RECHECK, SLEEP = "idle", "wait", "flag", "recheck", "sleep"
+
+READER_CONSUME_OPS = ("hdr", "payload", "advance", "ring")
+
+
+def initial_state(n_slots: int):
+    return (0, 0, ((0, 0),) * n_slots, 0, 0, 0, 0, IDLE, 0, IDLE, 0)
+
+
+def writable(state, n_slots: int) -> bool:
+    w, r = state[0], state[1]
+    return w - r < n_slots
+
+
+def readable(state) -> bool:
+    return state[0] > state[1]
+
+
+def is_final(state, n_messages: int) -> bool:
+    w, r, _s, _rp, _wp, _brdy, _bfree, wpc, _wm, rpc, _rm = state
+    return wpc == IDLE and rpc == IDLE and w == n_messages \
+        and r == n_messages
+
+
+def _set(state, **kw):
+    names = ("w", "r", "slots", "rp", "wp", "bell_rdy", "bell_free",
+             "wpc", "wmsg", "rpc", "rmsg")
+    vals = list(state)
+    for k, v in kw.items():
+        vals[names.index(k)] = v
+    return tuple(vals)
+
+
+def enabled_transitions(state, n_slots: int, n_messages: int,
+                        mut: Mutations) -> Iterator[Tuple[str, tuple, List[str]]]:
+    """Yield (action_label, next_state, violations_triggered).
+
+    One yield per atomic step either side could take next.  The spin
+    loop is modeled with nondeterminism: from WAIT the side may either
+    observe the condition (spin hit) or proceed to raise its flag even
+    when the condition holds — that second branch is the real race
+    between the last spin check and the flag write, and it is exactly
+    the interleaving the parked-flag recheck exists to close.
+    """
+    (w, r, slots, rp, wp, brdy, bfree, wpc, wmsg, rpc, rmsg) = state
+
+    # ---------------- writer ------------------------------------------
+    if wpc == IDLE and w < n_messages:
+        if writable(state, n_slots):
+            yield ("w:begin", _set(state, wpc=("pub", 0), wmsg=w + 1), [])
+        else:
+            yield ("w:wait", _set(state, wpc=WAIT), [])
+    elif wpc == WAIT:
+        if writable(state, n_slots):
+            yield ("w:spin-hit", _set(state, wpc=("pub", 0), wmsg=w + 1),
+                   [])
+        yield ("w:flag", _set(state, wpc=FLAG), [])
+    elif wpc == FLAG:
+        nxt = SLEEP if mut.drop_parked_recheck else RECHECK
+        yield ("w:set-flag", _set(state, wp=1, wpc=nxt), [])
+    elif wpc == RECHECK:
+        if writable(state, n_slots):
+            yield ("w:recheck-hit",
+                   _set(state, wp=0, wpc=("pub", 0), wmsg=w + 1), [])
+        else:
+            yield ("w:recheck-miss", _set(state, wpc=SLEEP), [])
+    elif wpc == SLEEP:
+        if bfree:
+            # wake: drain the FIFO, loop back to flag-set + recheck
+            yield ("w:wake", _set(state, bell_free=0, wpc=FLAG), [])
+        # else: blocked (no transition from this side)
+    elif isinstance(wpc, tuple) and wpc[0] == "pub":
+        ops = mut.writer_publish_ops()
+        micro = ops[wpc[1]]
+        after = ("pub", wpc[1] + 1) if wpc[1] + 1 < len(ops) else IDLE
+        if micro == "fill":
+            s = (wmsg - 1) % n_slots
+            new = list(slots)
+            new[s] = (new[s][0], wmsg)
+            yield ("w:fill", _set(state, slots=tuple(new), wpc=after), [])
+        elif micro == "stamp":
+            s = (wmsg - 1) % n_slots
+            new = list(slots)
+            new[s] = (wmsg, new[s][1])
+            yield ("w:stamp", _set(state, slots=tuple(new), wpc=after), [])
+        elif micro == "commit":
+            viol = [V_BACKPRESSURE] if (wmsg - r > n_slots or wmsg <= w) \
+                else []
+            yield ("w:commit", _set(state, w=wmsg, wpc=after), viol)
+        elif micro == "ring":
+            nxt = _set(state, wpc=after)
+            if rp:
+                nxt = _set(nxt, bell_rdy=1)
+            yield ("w:ring-check", nxt, [])
+
+    # ---------------- reader ------------------------------------------
+    if rpc == IDLE and r < n_messages:
+        if readable(state):
+            yield ("r:begin", _set(state, rpc=("rd", 0), rmsg=r + 1), [])
+        else:
+            yield ("r:wait", _set(state, rpc=WAIT), [])
+    elif rpc == WAIT:
+        if readable(state):
+            yield ("r:spin-hit", _set(state, rpc=("rd", 0), rmsg=r + 1), [])
+        yield ("r:flag", _set(state, rpc=FLAG), [])
+    elif rpc == FLAG:
+        nxt = SLEEP if mut.drop_parked_recheck else RECHECK
+        yield ("r:set-flag", _set(state, rp=1, rpc=nxt), [])
+    elif rpc == RECHECK:
+        if readable(state):
+            yield ("r:recheck-hit",
+                   _set(state, rp=0, rpc=("rd", 0), rmsg=r + 1), [])
+        else:
+            yield ("r:recheck-miss", _set(state, rpc=SLEEP), [])
+    elif rpc == SLEEP:
+        if brdy:
+            yield ("r:wake", _set(state, bell_rdy=0, rpc=FLAG), [])
+    elif isinstance(rpc, tuple) and rpc[0] == "rd":
+        micro = READER_CONSUME_OPS[rpc[1]]
+        after = ("rd", rpc[1] + 1) \
+            if rpc[1] + 1 < len(READER_CONSUME_OPS) else IDLE
+        s = (rmsg - 1) % n_slots
+        if micro == "hdr":
+            viol = []
+            if not mut.drop_slot_seq_check and slots[s][0] != rmsg:
+                # the real reader raises ChannelClosed here; in a
+                # crash-free exhaustive run this must be unreachable
+                viol = [V_TORN_PUBLISH]
+            yield ("r:hdr", _set(state, rpc=after), viol)
+        elif micro == "payload":
+            viol = [V_TORN_READ] if slots[s][1] != rmsg else []
+            yield ("r:payload", _set(state, rpc=after), viol)
+        elif micro == "advance":
+            yield ("r:advance", _set(state, r=rmsg, rpc=after), [])
+        elif micro == "ring":
+            nxt = _set(state, rpc=after)
+            if wp:
+                nxt = _set(nxt, bell_free=1)
+            yield ("r:ring-check", nxt, [])
+
+
+def state_hazards(state, n_slots: int, n_messages: int) -> List[str]:
+    """Safety properties evaluated on every reachable STATE (the
+    transition-level violations above cover the others)."""
+    (w, r, _slots, _rp, _wp, brdy, bfree, wpc, _wm, rpc, _rm) = state
+    out = []
+    if w - r > n_slots or r > w:
+        out.append(V_BACKPRESSURE)
+    # lost wakeup: a side is committed to sleeping while its enabling
+    # condition already holds, no doorbell token is pending, and the
+    # peer is BETWEEN operations (a peer mid-publish/mid-consume still
+    # has its ring-check ahead of it, which will see the parked flag —
+    # that in-flight window is the doorbell elision working, not a bug).
+    # With both guards intact this state is unreachable (see module doc).
+    w_mid = isinstance(wpc, tuple)
+    r_mid = isinstance(rpc, tuple)
+    if wpc == SLEEP and writable(state, n_slots) and not bfree \
+            and not r_mid:
+        out.append(V_LOST_WAKEUP)
+    if rpc == SLEEP and readable(state) and not brdy and not w_mid:
+        out.append(V_LOST_WAKEUP)
+    return out
+
+
+# ------------------------------------------------------- conformance twin
+
+
+class RingModel:
+    """Macro-op twin of one ShmChannel for conformance testing.
+
+    ``write()``/``read()`` run the full micro-op sequence atomically —
+    the single-threaded scripted traces the conformance test drives
+    cannot interleave, so atomic macro-ops are exact.  ``header()``
+    returns the same observables the real channel's mapped header holds.
+    """
+
+    def __init__(self, n_slots: int, mut: Mutations = Mutations()):
+        self.n_slots = n_slots
+        self.mut = mut
+        self.state = initial_state(n_slots)
+        # macro mode has no bound on messages: pick an effectively
+        # infinite horizon so IDLE transitions stay enabled
+        self._horizon = 1 << 60
+
+    def _run_side(self, prefix: str) -> None:
+        # drive that side's micro-ops to completion (back to IDLE)
+        while True:
+            steps = [t for t in enabled_transitions(
+                self.state, self.n_slots, self._horizon, self.mut)
+                if t[0].startswith(prefix)]
+            mid = [t for t in steps if not t[0].endswith((":wait", ":flag"))]
+            if not mid:
+                return
+            label, nxt, viol = mid[0]
+            if viol:
+                raise AssertionError(f"model violation at {label}: {viol}")
+            self.state = nxt
+            pc = self.state[7] if prefix == "w" else self.state[9]
+            if pc == IDLE:
+                return
+
+    def writable(self) -> bool:
+        return writable(self.state, self.n_slots)
+
+    def readable(self) -> bool:
+        return readable(self.state)
+
+    def occupancy(self) -> int:
+        return self.state[0] - self.state[1]
+
+    def write(self) -> None:
+        if not self.writable():
+            raise AssertionError("model write on full ring")
+        self._run_side("w")
+
+    def read(self) -> None:
+        if not self.readable():
+            raise AssertionError("model read on empty ring")
+        self._run_side("r")
+
+    def header(self) -> Tuple[int, int, Tuple[int, ...]]:
+        """(write_seq, read_seq, per-slot stamped seqs) — byte-for-byte
+        what the real channel's mapped header should hold at rest."""
+        w, r, slots = self.state[0], self.state[1], self.state[2]
+        return (w, r, tuple(s[0] for s in slots))

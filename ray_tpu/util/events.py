@@ -99,7 +99,11 @@ class _EventBuffer:
                 return
             self._sink = None
             self._stop.set()
+            flusher = self._flusher
             self._flusher = None
+        # join OUTSIDE the lock: the flush loop's flush() takes it
+        if flusher is not None and flusher is not threading.current_thread():
+            flusher.join(timeout=2.0)
 
     def emit(self, ev: ClusterEvent) -> None:
         with self._lock:

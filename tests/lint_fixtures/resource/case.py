@@ -1,0 +1,129 @@
+"""resource-lifecycle fixture corpus: one planted leak per sub-pattern,
+plus negative controls that must NOT be flagged."""
+
+import mmap
+import socket
+import threading
+
+
+def parse_header(data):
+    return data[:4]
+
+
+# -- exception-path leak: released, but only on the normal path ----------
+
+
+def exception_path_leak(fd):
+    m = mmap.mmap(fd, 4096)
+    header = m.read(4)
+    parse_header(header)          # can raise -> m leaks
+    m.close()
+    return header
+
+
+def exception_safe(fd):           # control: finally release, no finding
+    m = mmap.mmap(fd, 4096)
+    try:
+        return m.read(4)
+    finally:
+        m.close()
+
+
+def with_managed(fd):             # control: with-block, no finding
+    with mmap.mmap(fd, 4096) as m:
+        return m.read(4)
+
+
+# -- shutdown-method miss: released, but not on the teardown path --------
+
+
+class DrainOnly:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def drain(self):              # a release... in a non-teardown method
+        self._worker.join()
+
+    def close(self):              # the teardown path never joins it
+        pass
+
+
+# -- plain class-attr leak: never released anywhere ----------------------
+
+
+class NeverReleased:
+    def __init__(self):
+        self._sock = socket.socket()
+
+    def close(self):
+        pass                      # does not close self._sock
+
+
+# -- unretained service thread in a lifecycle class ----------------------
+
+
+class FireAndForget:
+    def __init__(self):
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        pass
+
+    def shutdown(self):
+        pass                      # nothing to join: the handle is gone
+
+
+# -- local thread leak ---------------------------------------------------
+
+
+def local_thread_leak():
+    t = threading.Thread(target=parse_header, args=(b"",))
+    t.start()                     # non-daemon, never joined, no escape
+
+
+def local_daemon_ok():            # control: local daemon is fire-and-forget
+    t = threading.Thread(target=parse_header, args=(b"",), daemon=True)
+    t.start()
+
+
+def escaping_thread(registry):    # control: ownership moves to the caller
+    t = threading.Thread(target=parse_header, args=(b"",))
+    t.start()
+    registry.append(t)
+    return t
+
+
+# -- control: attr released from the teardown path -----------------------
+
+
+class ProperlyClosed:
+    def __init__(self):
+        self._sock = socket.socket()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        self._sock.close()
+        self._worker.join(timeout=1.0)
+
+
+class AliasClosed:
+    """Release through a local alias (the Pool.join idiom)."""
+
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def join(self):
+        t = self._worker
+        t.join(timeout=1.0)

@@ -1,0 +1,44 @@
+"""thread-hygiene fixture corpus: the per-item spawn shapes (the PR-7
+3-threads-per-stream-item regression), plus paced/conditional controls."""
+
+import threading
+import time
+
+
+def handle(item):
+    return item
+
+
+class Consumer:
+    # direct per-item spawn in a consume loop — MUST be flagged
+    def consume(self, queue):
+        while True:
+            item = queue.get()
+            threading.Thread(target=handle, args=(item,),
+                             daemon=True).start()
+
+    # per-item spawn via a callee that unconditionally spawns — flagged
+    def pump(self, items):
+        for item in items:
+            self._kick(item)
+
+    def _kick(self, item):
+        threading.Thread(target=handle, args=(item,), daemon=True).start()
+
+    # control: slow ticker (sleeps per iteration) — not a hot path
+    def ticker(self):
+        while True:
+            time.sleep(0.5)
+            threading.Thread(target=handle, args=(None,),
+                             daemon=True).start()
+
+    # control: callee spawns only CONDITIONALLY (started-once guard)
+    def ensure_loop(self, items):
+        for item in items:
+            self._maybe_start(item)
+
+    def _maybe_start(self, item):
+        if not getattr(self, "_started", False):
+            self._started = True
+            threading.Thread(target=handle, args=(item,),
+                             daemon=True).start()

@@ -70,10 +70,15 @@ def test_up_status_down_cross_process(tmp_path):
                 break
             time.sleep(0.5)
         assert state, "cluster state file never appeared"
-        host, port = state["dashboard"]
         deadline = time.time() + 120
         nodes = []
         while time.time() < deadline:
+            # re-read the state each round: a STALE state file (left by
+            # a previous run killed mid-suite) points at a dead
+            # dashboard — the fresh `up` overwrites it with the live
+            # address once its own init completes
+            state = read_cluster_state("launchtest") or state
+            host, port = state["dashboard"]
             try:
                 with urllib.request.urlopen(
                         f"http://{host}:{port}/api/nodes", timeout=5) as r:
@@ -84,6 +89,7 @@ def test_up_status_down_cross_process(tmp_path):
                 pass
             time.sleep(0.5)
         assert len([n for n in nodes if n["alive"]]) >= 2, nodes
+        host, port = state["dashboard"]
 
         # a remote driver connects through the launched cluster
         ch, cp = state["client_address"]

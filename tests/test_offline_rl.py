@@ -62,6 +62,9 @@ def test_bc_learns_cartpole_from_offline_data(cluster, tmp_path):
     assert ret >= 400, f"BC policy return {ret} < 400"
 
 
+@pytest.mark.slow  # ~200s of gradient steps on a 1-core box: the
+# heaviest single test in the tree, far past the tier-1 wall budget;
+# the BC gate above keeps offline-RL learning covered in tier-1
 def test_cql_learns_pendulum_from_offline_data(cluster, tmp_path):
     """Learning gate: CQL on noisy-expert Pendulum data reaches >=-500
     (random ~= -1300, behavior policy ~= -250) without any env sampling.

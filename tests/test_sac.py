@@ -99,6 +99,8 @@ class TestSAC:
             np.testing.assert_allclose(a, b)
 
 
+@pytest.mark.slow  # ~50s of env steps + gradient work on a 1-core box;
+# the appo/impala/dqn/bc learning gates keep RL covered in tier-1
 def test_sac_learns_pendulum():
     """Learning gate: mean return rises from ~-1300 (random) to >= -600
     on Pendulum-v1 (reference: tuned_examples/sac/pendulum-sac.yaml
