@@ -291,7 +291,7 @@ class Head:
                 with self._lock:
                     self._store_info_seq += 1
                     req_id = self._store_info_seq
-                    slot = [threading.Event(), None]
+                    slot = [threading.Event(), None, h]
                     self._store_info_pending[req_id] = slot
                 if n._send("store_info", req_id):
                     waiters.append((h, req_id, slot))
@@ -304,6 +304,17 @@ class Head:
             if slot[1] is not None:
                 out[h] = slot[1]
         return out
+
+    def _fail_store_info_waiters(self, node_hex: str) -> None:
+        """A daemon died: collectors parked on its ``store_info`` round
+        learn now instead of waiting out the rest of their timeout."""
+        with self._lock:
+            gone = [(rid, s) for rid, s in self._store_info_pending.items()
+                    if len(s) > 2 and s[2] == node_hex]
+            for rid, _s in gone:
+                self._store_info_pending.pop(rid, None)
+        for _rid, slot in gone:
+            slot[0].set()  # slot[1] stays None: the node is simply absent
 
     def memory_table(self, limit: int = 100_000,
                      timeout: float = 1.0) -> List[dict]:
@@ -821,9 +832,9 @@ class Head:
                                              req_id, op, args)
 
     def _handle_daemon_req(self, proxy, req_id: int, op: str, args) -> None:
-        if op != "worker_rpc":  # worker_rpc counts inside its handler
-            self._count_head_rpc(op)
         try:
+            if op != "worker_rpc":  # worker_rpc counts inside its handler
+                self._count_head_rpc(op)
             if op == "locate":
                 result = self._locate_for_daemon(*args)
             elif op == "wait_objects":
@@ -924,6 +935,7 @@ class Head:
 
         events_mod.emit("WARNING", events_mod.SOURCE_NODE,
                         f"node {node_hex[:8]} dead", entity_id=node_hex)
+        self._fail_store_info_waiters(node_hex)
         retry_deletes = []
         with self._lock:
             self.node_loads.pop(node_hex, None)

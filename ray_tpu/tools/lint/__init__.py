@@ -38,10 +38,30 @@ ring-protocol          the shm ring-channel protocol spec
                        explicit-state model checking for n_slots 1..3:
                        no lost wakeup, no torn read, bounded
                        backpressure, deadlock freedom
+rpc-cycle              no synchronous request-reply cycles between
+                       process classes, and no handler blocks on a
+                       reverse RPC toward its requesting class
+                       (site -> handler -> site traces in findings)
+reply-completeness     every request-reply handler replies, fails the
+                       parked slot, or delegates on EVERY path,
+                       including exception paths
+death-path-            every registry of parked waiters (reply slots,
+completeness           stream-sub slots, leases, checkouts) has a
+                       removal site reachable from a death/disconnect
+                       or teardown handler
+ring-protocol-net      the NETWORK ring protocol spec
+                       (``ring_model_net.py``) — the cross-host
+                       transport contract — passes exhaustively for
+                       n_slots 1..2 under message loss, duplication,
+                       reordering, and peer crash-restart, incl. a
+                       goal-reachability (anti-livelock) pass
 =====================  ====================================================
 
 Run it with ``python -m ray_tpu.tools.lint`` (or ``python -m ray_tpu
-lint``; ``lint --changed-only`` is the <2 s dev-loop gate).  Findings
+lint``; ``lint --changed-only`` is the <2 s dev-loop gate).  Results
+are cached on disk (``.graftlint_cache/``, keyed by file content hash
+and invalidated by the lint tool's own source digest) so warm full-tree
+runs cost ~0.1 s; ``--no-cache`` bypasses the layer.  Findings
 are suppressed inline with ``# graftlint: ignore[check-id]`` (same line
 or the line above) or grandfathered in the checked-in baseline
 (``baseline.json``, one justification per entry — ``--update-baseline``

@@ -92,6 +92,29 @@ TEARDOWN_METHOD_NAMES = {
     "_cleanup", "reset",
 }
 
+# ---- wire-level analysis vocabularies (rpc-cycle / reply-completeness /
+# ---- death-path-completeness) ------------------------------------------
+
+# The request-id name of the wire protocol's request/reply framing.
+# Deliberately exact: serve-layer ``request_id``s (observability ids)
+# and other ``rid`` locals are not wire reply obligations.
+REQID_NAME_RE = re.compile(r"^req_id$")
+
+# attributes that hold parked-waiter registries by naming convention
+# (pending reply slots, arg leases, pool checkouts, in-flight tables)
+REGISTRY_NAME_RE = re.compile(
+    r"(pending|lease|waiter|checkout|inflight|parked)")
+
+# constructors whose result parks a thread until someone completes it
+WAITER_CTORS = {"Event", "Future", "Condition", "Semaphore"}
+
+# death/disconnect handler families: a waiter registry's failure path
+# must be reachable from one of these (or from a teardown method) via
+# the intra-class call graph.  Substrings, matched against method names.
+DEATH_METHOD_RE = re.compile(
+    r"(remove_node|_dead|dead_|_died\b|death|crashed|_exit\b|_eof\b|"
+    r"disconnect|_gone\b|_closed\b|closed_|drop_peer|fail|abort)")
+
 
 def _expr_name(node: ast.AST) -> str:
     """Best-effort dotted name for a receiver expression."""
@@ -140,6 +163,12 @@ class SendSite:
     # themselves required to have a handler: dispatch is polymorphic
     # across runtime implementations (local mode vs head vs client)
     via_dispatcher: bool = False
+    func: Optional[str] = None  # qualname of the enclosing function
+    # True for `.call(...)` round-trips (the rpc layer parks on the
+    # reply future).  Plain `.send` sites are upgraded to synchronous by
+    # the rpc-cycle check when the enclosing function also parks on a
+    # wait/result (the framed send-then-Event.wait request idiom).
+    sync: bool = False
 
 
 @dataclass
@@ -147,6 +176,10 @@ class HandlerChain:
     func: str              # qualname of the dispatch function
     param: str
     ops: List[Tuple[str, int]]  # (literal, line)
+    # op literal -> self-method callee names inside that dispatch branch
+    # (the handler ladder's `if op == "x": self._handle_x(...)` bodies) —
+    # the rpc-cycle check seeds its handler-closure walk from these
+    op_calls: Dict[str, List[str]] = field(default_factory=dict)
 
 
 @dataclass
@@ -188,6 +221,47 @@ class ReleaseSite:
 
 
 @dataclass
+class ReplyInfo:
+    """Request-reply obligations of one function (reply-completeness).
+
+    ``param`` is the request-id name the function binds (parameter or
+    local unpacked from the frame).  A *reply site* is any call that
+    passes the request id onward — a real reply (``self._reply(w,
+    req_id, ...)``), a parked-slot failure, or a delegation into
+    another function/registry; a subscript store keyed by the request
+    id (``self._pending[req_id] = slot``) also counts as delegation.
+    ``gaps`` are paths that exit the function with the id bound but no
+    reply/delegation performed: (line, kind) with kind in ``fall`` (end
+    of function), ``return`` (early return), ``except`` (an exception
+    can escape outside any catch-all that replies)."""
+
+    param: str
+    sites: List[int] = field(default_factory=list)
+    gaps: List[Tuple[int, str]] = field(default_factory=list)
+    # a nested def replies (deferred reply from a spawned thread):
+    # all-paths analysis of the outer function would be a false positive
+    nested_delegate: bool = False
+
+
+@dataclass
+class RegistryStore:
+    """``self.<attr>[key] = value`` — a keyed registry insertion."""
+
+    attr: str              # the attribute name (no "self." prefix)
+    line: int
+    waiterish: bool        # value (or the function) constructs Event/Future
+
+
+@dataclass
+class RegistryClear:
+    """``self.<attr>.pop/del/clear`` — a registry removal site."""
+
+    attr: str
+    line: int
+    method: str            # pop | del | clear | reassign
+
+
+@dataclass
 class FunctionInfo:
     qualname: str          # "Class.method" | "func" | "Class.method.<nested>"
     cls: Optional[str]
@@ -207,6 +281,10 @@ class FunctionInfo:
     # bodies (the thread-hygiene check propagates "spawns a thread"
     # through these; paced = the loop sleeps or accept()s per iteration)
     loop_calls: List[CallSite] = field(default_factory=list)
+    # wire-level facts ---------------------------------------------------
+    reply: Optional[ReplyInfo] = None
+    registry_stores: List[RegistryStore] = field(default_factory=list)
+    registry_clears: List[RegistryClear] = field(default_factory=list)
 
 
 @dataclass
@@ -448,6 +526,8 @@ class _ModuleCollector:
         self.mod.functions[qual] = fi
         self._handler_chain(node, fi)
         self._scan_resources(node, fi)
+        self._scan_registries(node, fi)
+        self._scan_reply_paths(node, fi)
         self._walk_block(node.body, held=(), fi=fi, cls=cls,
                          prefix=prefix + node.name + ".")
 
@@ -519,7 +599,7 @@ class _ModuleCollector:
         # weakref callbacks ----------------------------------------------
         self._maybe_weakref(call, fi)
         # wire sends ------------------------------------------------------
-        self._maybe_send(call)
+        self._maybe_send(call, fi)
         # literal-arg call record (dispatcher-send resolution) -----------
         leaf_name = None
         if isinstance(fn, ast.Attribute):
@@ -611,12 +691,13 @@ class _ModuleCollector:
                 if cb_name:
                     fi.weakref_callbacks.append((cb_name, call.lineno))
 
-    def _maybe_send(self, call: ast.Call):
+    def _maybe_send(self, call: ast.Call, fi: Optional[FunctionInfo] = None):
+        fname = fi.qualname if fi is not None else None
         fn = call.func
         if not isinstance(fn, ast.Attribute):
             # bare forwarder call: f("op", ...)
             if isinstance(fn, ast.Name):
-                self._maybe_forwarder_call(fn.id, call)
+                self._maybe_forwarder_call(fn.id, call, fi)
             return
         meth = fn.attr
         recv = _expr_name(fn.value)
@@ -627,23 +708,26 @@ class _ModuleCollector:
                 # the channel literal IS the wire tag the rpc layer sends
                 # (RpcClient.call -> channel.send(tag, req_id, op, ...))
                 self.mod.sends.append(SendSite(
-                    op=chan.value, line=call.lineno, channel=None))
+                    op=chan.value, line=call.lineno, channel=None,
+                    func=fname, sync=True))
                 op, prefix = _op_literal(call.args[1])
                 if op is not None:
                     self.mod.sends.append(SendSite(
                         op=op, line=call.lineno, channel=chan.value,
-                        prefix=prefix))
+                        prefix=prefix, func=fname, sync=True))
             return
         if meth in ("send", "_send", "_notify") and call.args:
             op, prefix = _op_literal(call.args[0])
             if op is not None:
                 self.mod.sends.append(SendSite(op=op, line=call.lineno,
-                                               channel=None, prefix=prefix))
+                                               channel=None, prefix=prefix,
+                                               func=fname))
             return
         # method-style forwarder call: self._call("op", ...)
-        self._maybe_forwarder_call(meth, call)
+        self._maybe_forwarder_call(meth, call, fi)
 
-    def _maybe_forwarder_call(self, name: str, call: ast.Call):
+    def _maybe_forwarder_call(self, name: str, call: ast.Call,
+                              fi: Optional[FunctionInfo] = None):
         entry = self._forwarder_names.get(name)
         if entry is None:
             return
@@ -651,8 +735,10 @@ class _ModuleCollector:
         if len(call.args) > idx:
             op, prefix = _op_literal(call.args[idx])
             if op is not None:
-                self.mod.sends.append(SendSite(op=op, line=call.lineno,
-                                               channel=chan, prefix=prefix))
+                self.mod.sends.append(SendSite(
+                    op=op, line=call.lineno, channel=chan, prefix=prefix,
+                    func=fi.qualname if fi is not None else None,
+                    sync=chan is not None))
 
     # -------------------------------------------------------- resource scan
 
@@ -874,6 +960,125 @@ class _ModuleCollector:
             for name in hits:
                 acquires[name].escapes = True
 
+    # ---------------------------------------------------- registries (death)
+
+    def _scan_registries(self, node, fi: FunctionInfo) -> None:
+        """Keyed registry insertions (``self.X[k] = v``) and removals
+        (``pop``/``del``/``clear``/reassign-to-empty) for the
+        death-path-completeness check.  Nested defs are scanned as their
+        own functions (same class), so skip them here."""
+        constructs_waiter = False
+        for child, in_lambda in _walk_marking_lambdas(node):
+            if in_lambda or not isinstance(child, ast.Call):
+                continue
+            f = child.func
+            leaf = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if leaf in WAITER_CTORS:
+                constructs_waiter = True
+                break
+
+        def self_attr(expr) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self":
+                return expr.attr
+            return None
+
+        for child, in_lambda in _walk_marking_lambdas(node):
+            if in_lambda:
+                continue
+            if isinstance(child, ast.Assign):
+                for tgt in child.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = self_attr(tgt.value)
+                        if attr is not None:
+                            waiterish = constructs_waiter or any(
+                                isinstance(c, ast.Call)
+                                and getattr(c.func, "attr",
+                                            getattr(c.func, "id", ""))
+                                in WAITER_CTORS
+                                for c in ast.walk(child.value))
+                            fi.registry_stores.append(RegistryStore(
+                                attr=attr, line=child.lineno,
+                                waiterish=waiterish))
+                    elif isinstance(tgt, ast.Attribute):
+                        attr = self_attr(tgt)
+                        if attr is not None and isinstance(
+                                child.value, (ast.Dict, ast.List)) \
+                                and not getattr(child.value, "keys", None) \
+                                and not getattr(child.value, "elts", None):
+                            fi.registry_clears.append(RegistryClear(
+                                attr=attr, line=child.lineno,
+                                method="reassign"))
+                    elif isinstance(tgt, ast.Tuple) and isinstance(
+                            child.value, ast.Tuple) \
+                            and len(tgt.elts) == len(child.value.elts):
+                        # swap-and-drain: `pending, self._p = self._p, {}`
+                        for t_e, v_e in zip(tgt.elts, child.value.elts):
+                            attr = self_attr(t_e)
+                            if attr is not None and isinstance(
+                                    v_e, (ast.Dict, ast.List)) \
+                                    and not getattr(v_e, "keys", None) \
+                                    and not getattr(v_e, "elts", None):
+                                fi.registry_clears.append(RegistryClear(
+                                    attr=attr, line=child.lineno,
+                                    method="reassign"))
+            elif isinstance(child, ast.Delete):
+                for tgt in child.targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = self_attr(tgt.value)
+                        if attr is not None:
+                            fi.registry_clears.append(RegistryClear(
+                                attr=attr, line=child.lineno, method="del"))
+            elif isinstance(child, ast.Call) \
+                    and isinstance(child.func, ast.Attribute) \
+                    and child.func.attr in ("pop", "popitem", "clear"):
+                attr = self_attr(child.func.value)
+                if attr is not None:
+                    fi.registry_clears.append(RegistryClear(
+                        attr=attr, line=child.lineno,
+                        method=child.func.attr))
+
+    # ------------------------------------------------ reply-path analysis
+
+    def _scan_reply_paths(self, node, fi: FunctionInfo) -> None:
+        """All-paths reply analysis for request-reply handlers.
+
+        Finds the request-id name the function binds, then symbolically
+        walks the statement tree tracking per-path (bound, replied)
+        state.  A *reply* is any statement that passes the id onward
+        (reply call, parked-slot store, pop/del cleanup).  Exits with
+        the id bound but never passed on are recorded as gaps, including
+        exception escapes not absorbed by a catch-all that itself
+        replies (or a finally that does)."""
+        rid = None
+        for p in fi.params:
+            if REQID_NAME_RE.match(p):
+                rid = p
+                break
+        if rid is None:
+            for child, in_lambda in _walk_marking_lambdas(node):
+                if in_lambda:
+                    continue
+                if isinstance(child, ast.Name) \
+                        and isinstance(child.ctx, ast.Store) \
+                        and REQID_NAME_RE.match(child.id):
+                    rid = child.id
+                    break
+        if rid is None:
+            return
+        info = ReplyInfo(param=rid)
+        # nested defs replying = deferred reply from a spawned thread
+        for child in ast.walk(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and child is not node:
+                if any(_stmt_replies(c, rid) for c in child.body):
+                    info.nested_delegate = True
+        _ReplyPathScan(rid, info).run(node)
+        if info.sites or info.gaps:
+            fi.reply = info
+
     # --------------------------------------------------------- handler scan
 
     def _handler_chain(self, node, fi: FunctionInfo):
@@ -915,8 +1120,63 @@ class _ModuleCollector:
                         ops.append((e.value, e.lineno))
                         param_used = name
         if ops and param_used:
-            self.mod.handlers.append(HandlerChain(
-                func=fi.qualname, param=param_used, ops=ops))
+            chain = HandlerChain(func=fi.qualname, param=param_used,
+                                 ops=ops)
+            self._collect_op_calls(node, chain)
+            self.mod.handlers.append(chain)
+
+    @staticmethod
+    def _collect_op_calls(node, chain: HandlerChain) -> None:
+        """op literal -> self-method/bare callee names called inside the
+        matching ``if op == "x":`` branch body (elif arms are nested If
+        nodes in ``orelse``, so walking every If covers the ladder).
+        The compare's left side must be the ladder's dispatch variable:
+        an unrelated ``mode == "x"`` whose literal collides with an op
+        name must not adopt that branch's callees."""
+        known = {op for op, _ln in chain.ops}
+        for child in ast.walk(node):
+            if not isinstance(child, ast.If) \
+                    or not isinstance(child.test, ast.Compare) \
+                    or len(child.test.ops) != 1 \
+                    or not isinstance(child.test.ops[0], (ast.Eq, ast.In)):
+                continue
+            left = child.test.left
+            if isinstance(left, ast.Name):
+                if left.id != chain.param:
+                    continue
+            elif (isinstance(left, ast.Subscript)
+                  and isinstance(left.value, ast.Name)):
+                if left.value.id != chain.param:
+                    continue
+            else:
+                continue
+            branch_ops: List[str] = []
+            right = child.test.comparators[0]
+            if isinstance(right, ast.Constant) \
+                    and isinstance(right.value, str) \
+                    and right.value in known:
+                branch_ops = [right.value]
+            elif isinstance(right, (ast.Tuple, ast.List, ast.Set)):
+                branch_ops = [e.value for e in right.elts
+                              if isinstance(e, ast.Constant)
+                              and isinstance(e.value, str)
+                              and e.value in known]
+            if not branch_ops:
+                continue
+            callees: List[str] = []
+            for sub in child.body:
+                for c in ast.walk(sub):
+                    if not isinstance(c, ast.Call):
+                        continue
+                    f = c.func
+                    if isinstance(f, ast.Attribute) \
+                            and isinstance(f.value, ast.Name) \
+                            and f.value.id == "self":
+                        callees.append(f.attr)
+                    elif isinstance(f, ast.Name):
+                        callees.append(f.id)
+            for op in branch_ops:
+                chain.op_calls.setdefault(op, []).extend(callees)
 
     # ----------------------------------------------------------- forwarders
 
@@ -949,6 +1209,255 @@ class _ModuleCollector:
                     return
 
 
+def _name_in(tree: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(tree))
+
+
+def _stmt_replies(stmt: ast.AST, rid, carriers=()) -> bool:
+    """True when the statement passes the request id onward: a call with
+    the id in its arguments (reply, slot-failure, delegation, pop), a
+    subscript store keyed by it (parking it in a registry), or a ``del``
+    of a slot keyed by it.  ``carriers`` are names the id was unpacked
+    from (the framed payload tuple): forwarding the whole frame
+    (``Thread(args=payload)``) also delegates the reply."""
+    names = {rid, *carriers}
+
+    def any_name(tree: ast.AST) -> bool:
+        return any(_name_in(tree, n) for n in names)
+
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Call):
+            for a in list(n.args) + [k.value for k in n.keywords]:
+                if any_name(a):
+                    return True
+        elif isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript) and _name_in(t.slice, rid):
+                    return True
+        elif isinstance(n, ast.Delete):
+            for t in n.targets:
+                if isinstance(t, ast.Subscript) and _name_in(t.slice, rid):
+                    return True
+    return False
+
+
+def _stmt_binds(stmt: ast.AST, rid: str) -> bool:
+    """True when the statement (re)binds the request-id name."""
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store) \
+                and n.id == rid:
+            return True
+    return False
+
+
+def _stmt_has_call(stmt: ast.AST) -> bool:
+    return any(isinstance(n, ast.Call) for n in ast.walk(stmt))
+
+
+class _ReplyPathScan:
+    """Symbolic all-paths walk for :meth:`_scan_reply_paths`.
+
+    Path state is a set of ``(bound, replied)`` pairs.  Statements that
+    reply set ``replied``; binding statements set ``bound``; exits
+    (function end, return, raise, uncovered may-raise) with a
+    ``(True, False)`` state record a gap.  Try frames whose catch-all
+    handler (or finally block) replies on all of its own paths absorb
+    exception escapes from their body."""
+
+    MAX_GAPS = 3
+
+    def __init__(self, rid: str, info: ReplyInfo, param_rid: bool = True,
+                 carriers=()):
+        self.rid = rid
+        self.info = info
+        self.param_rid = param_rid
+        self.carriers = tuple(carriers)
+        self._except_seen = False
+
+    def run(self, node) -> None:
+        # carrier names: `req_id, op, *rest = payload` marks `payload`
+        # as carrying the id — forwarding the frame delegates the reply.
+        # Only pure unpack/index bindings qualify: a call on the RHS
+        # (`req_id = self._decode(payload)`) derives a NEW id, and
+        # treating its argument names (or `self`) as carriers would
+        # silently accept unrelated later calls as replies.
+        carriers = set()
+        for child, in_lambda in _walk_marking_lambdas(node):
+            if in_lambda or not isinstance(child, ast.Assign):
+                continue
+            if not any(_stmt_binds(t, self.rid) for t in child.targets):
+                continue
+            if any(isinstance(n, ast.Call)
+                   for n in ast.walk(child.value)):
+                continue
+            for n in ast.walk(child.value):
+                if isinstance(n, ast.Name):
+                    carriers.add(n.id)
+        self.carriers = tuple(carriers - {self.rid, "self"})
+        is_param = self.rid in {a.arg for a in node.args.args}
+        # exception escapes only matter when the id arrived as a
+        # parameter: the request came from outside and a raise strands
+        # its parked waiter.  A locally-minted id's pre-reply raise
+        # propagates to the caller, which IS the requester.
+        self.param_rid = is_param
+        out = self._scan(node.body, {(is_param, False)}, covered=False)
+        last = node.body[-1].lineno if node.body else node.lineno
+        if any(b and not r for b, r in out):
+            self._gap(last, "fall")
+
+    # ------------------------------------------------------------- helpers
+
+    def _gap(self, line: int, kind: str) -> None:
+        if kind == "except":
+            if self._except_seen or not self.param_rid:
+                return
+            self._except_seen = True
+        if len(self.info.gaps) < self.MAX_GAPS:
+            self.info.gaps.append((line, kind))
+
+    @staticmethod
+    def _catch_all(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        names = []
+        for n in ([t.elts] if isinstance(t, ast.Tuple) else [[t]])[0]:
+            if isinstance(n, ast.Attribute):
+                names.append(n.attr)
+            elif isinstance(n, ast.Name):
+                names.append(n.id)
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    def _block_replies_fully(self, stmts) -> bool:
+        """Does this block reply on every path (used for catch-all
+        handlers and finally blocks)?  Evaluated with a throwaway scan
+        so its internal gaps are not double-recorded."""
+        probe = _ReplyPathScan(self.rid, ReplyInfo(param=self.rid),
+                               param_rid=self.param_rid,
+                               carriers=self.carriers)
+        out = probe._scan(stmts, {(True, False)}, covered=True)
+        return not probe.info.gaps and all(r for _b, r in out) \
+            and bool(probe.info.sites)
+
+    # ---------------------------------------------------------------- scan
+
+    def _scan(self, stmts, states, covered: bool):
+        states = set(states)
+        for stmt in stmts:
+            if not states:
+                return states
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            replies = _stmt_replies(stmt, self.rid, self.carriers)
+            binds = _stmt_binds(stmt, self.rid)
+            if isinstance(stmt, ast.Return):
+                if replies:
+                    self.info.sites.append(stmt.lineno)
+                    states = {(True, True)}
+                for b, r in states:
+                    if b and not r:
+                        self._gap(stmt.lineno, "return")
+                        break
+                return set()
+            if isinstance(stmt, ast.Raise):
+                if not covered and any(b and not r for b, r in states):
+                    self._gap(stmt.lineno, "except")
+                return set()
+            if isinstance(stmt, ast.Try):
+                # a catch-all handler means exceptions do not ESCAPE the
+                # function — whether the handler's continuation replies
+                # is judged by the normal path scan of the handler body
+                # and whatever follows the try
+                cover_here = any(self._catch_all(h) for h in stmt.handlers)
+                fin_replies = bool(stmt.finalbody) and \
+                    self._block_replies_fully(stmt.finalbody)
+                body_out = self._scan(stmt.body, states,
+                                      covered or cover_here or fin_replies)
+                # Handler entry state: the exception fired somewhere in
+                # the body, so model "before anything happened" — the
+                # try-entry states unchanged (mid-body raises after the
+                # binding are reported by the may-raise scan inside the
+                # body itself).  One refinement: when every substantive
+                # body statement IS a reply, the only way into the
+                # handler is the reply transport failing — the requester
+                # is gone, so the obligation is discharged (the
+                # ``try: send(rep) except OSError: pass`` idiom).
+                body_all_reply = all(
+                    _stmt_replies(s, self.rid, self.carriers)
+                    or isinstance(s, ast.Pass)
+                    or (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))
+                    for s in stmt.body)
+                handler_entry = {(b, r or body_all_reply)
+                                 for b, r in states}
+                out = set()
+                for h in stmt.handlers:
+                    out |= self._scan(h.body, handler_entry, covered)
+                if stmt.orelse:
+                    # a body fall-through continues INTO the else block;
+                    # keeping body_out alongside would double-count the
+                    # pre-else state as a function exit
+                    out |= self._scan(stmt.orelse, body_out, covered)
+                else:
+                    out |= body_out
+                if stmt.finalbody:
+                    out = self._scan(stmt.finalbody, out, covered)
+                    if fin_replies:
+                        out = {(b, True) for b, _r in out}
+                states = out
+                continue
+            if isinstance(stmt, ast.If):
+                out = self._scan(stmt.body, states, covered)
+                out |= self._scan(stmt.orelse, states, covered) \
+                    if stmt.orelse else states
+                states = out
+                continue
+            if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                bound_in_body = any(_stmt_binds(s, self.rid)
+                                    for s in stmt.body)
+                body_out = self._scan(stmt.body, states, covered)
+                if bound_in_body and any(b and not r for b, r in body_out):
+                    # the next iteration rebinds the id: the previous
+                    # request is dropped without a reply
+                    self._gap(stmt.lineno, "fall")
+                    body_out = {(b, True) for b, _r in body_out}
+                states = states | body_out
+                if stmt.orelse:
+                    states = self._scan(stmt.orelse, states, covered)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                if replies and any(
+                        _stmt_replies(it.context_expr, self.rid,
+                                      self.carriers)
+                        for it in stmt.items):
+                    self.info.sites.append(stmt.lineno)
+                    states = {(True, True)}
+                states = self._scan(stmt.body, states, covered)
+                continue
+            if isinstance(stmt, ast.Match):
+                out = set()
+                exhaustive = False
+                for case in stmt.cases:
+                    out |= self._scan(case.body, states, covered)
+                    if isinstance(case.pattern, ast.MatchAs) \
+                            and case.pattern.pattern is None:
+                        exhaustive = True
+                states = out if exhaustive else out | states
+                continue
+            # ------------------------------------------- simple statement
+            if not replies and _stmt_has_call(stmt) and not covered \
+                    and any(b and not r for b, r in states):
+                self._gap(stmt.lineno, "except")
+            if replies:
+                self.info.sites.append(stmt.lineno)
+                states = {(True, True)}
+            elif binds:
+                states = {(True, r) for _b, r in states}
+        return states
+
+
 def _walk_marking_lambdas(node: ast.AST):
     """ast.walk that reports whether each node sits under a Lambda or a
     nested function definition (deferred execution)."""
@@ -975,23 +1484,45 @@ def iter_py_files(root: str):
                 yield os.path.join(dirpath, fn)
 
 
-def collect_tree(root: str, doc_roots: Optional[List[str]] = None) -> TreeIndex:
+def collect_tree(root: str, doc_roots: Optional[List[str]] = None,
+                 cache=None) -> TreeIndex:
     """Parse every module under ``root`` into a TreeIndex.
 
     ``doc_roots`` are directories/files of markdown scanned only as text
-    (for the config-hygiene "mentioned in docs" requirement)."""
+    (for the config-hygiene "mentioned in docs" requirement).
+    ``cache`` (a :class:`~.cache.LintCache`) serves per-file
+    :class:`ModuleInfo` results keyed by content hash, so an unchanged
+    file is never re-parsed."""
     root = os.path.abspath(root)
     idx = TreeIndex(root=root)
     for path in iter_py_files(root):
         rel = os.path.relpath(path, root)
         try:
-            with open(path, "r", encoding="utf-8") as f:
-                source = f.read()
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            idx.parse_errors.append((rel, str(e)))
+            continue
+        digest = None
+        if cache is not None:
+            from .cache import content_hash
+
+            # path folded into the key: identical contents at different
+            # paths (empty __init__.py files) must not collide
+            digest = content_hash(raw + b"\0" + rel.encode())
+            mod = cache.get_module(digest)
+            if mod is not None and mod.path == rel:
+                idx.modules[rel] = mod
+                continue
+        try:
+            source = raw.decode("utf-8")
             tree = ast.parse(source, filename=path)
-        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        except (SyntaxError, UnicodeDecodeError, ValueError) as e:
             idx.parse_errors.append((rel, str(e)))
             continue
         idx.modules[rel] = _ModuleCollector(rel, tree, source).collect()
+        if cache is not None and digest is not None:
+            cache.put_module(digest, idx.modules[rel])
     texts = []
     for droot in doc_roots or []:
         if os.path.isfile(droot):

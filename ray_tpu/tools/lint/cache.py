@@ -1,0 +1,130 @@
+"""On-disk lint result cache.
+
+The full-tree tier-1 gate re-parses ~200 modules and re-explores the
+ring protocol state spaces on every run; almost none of that changes
+between runs.  This cache keys everything on **content hashes** so it
+can never serve stale results:
+
+- per-file :class:`~.analysis.ModuleInfo` pickles, keyed by the sha256
+  of the file's bytes — a changed file simply misses;
+- model-check results (the ``ring-protocol`` / ``ring-protocol-net``
+  exhaustive explorations), keyed by their check id — their outcome
+  depends only on the lint tool's own sources;
+- everything lives under a directory named by the **tool digest** (the
+  sha256 over the lint package's own sources), so editing any analyzer
+  or model file invalidates the whole cache wholesale.  Old digest
+  directories are pruned on first use of a new one.
+
+Writes are atomic (tempfile + ``os.replace``) so concurrent lint runs
+never observe torn pickles.  ``--no-cache`` bypasses the layer
+entirely; the agreement test in tests/test_static_analysis.py asserts
+a warm run reports byte-identical findings to a cold one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Optional
+
+_TOOL_DIGEST: Optional[str] = None
+
+
+def tool_digest() -> str:
+    """sha256 (hex16) over the lint package's own source bytes —
+    bumping ANY analyzer/model/check file invalidates the cache."""
+    global _TOOL_DIGEST
+    if _TOOL_DIGEST is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        h = hashlib.sha256()
+        for fn in sorted(os.listdir(here)):
+            if fn.endswith(".py"):
+                with open(os.path.join(here, fn), "rb") as f:
+                    h.update(fn.encode())
+                    h.update(f.read())
+        _TOOL_DIGEST = h.hexdigest()[:16]
+    return _TOOL_DIGEST
+
+
+def content_hash(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()[:16]
+
+
+class LintCache:
+    """Content-addressed pickle store under ``<dir>/<tool_digest>/``."""
+
+    def __init__(self, cache_dir: str):
+        self.base = os.path.abspath(cache_dir)
+        self.dir = os.path.join(self.base, tool_digest())
+        self.hits = 0
+        self.misses = 0
+        self._ready = False
+
+    def _ensure(self) -> None:
+        if self._ready:
+            return
+        fresh = not os.path.isdir(self.dir)
+        os.makedirs(self.dir, exist_ok=True)
+        if fresh:
+            # a new tool digest obsoletes every older directory
+            try:
+                for name in os.listdir(self.base):
+                    p = os.path.join(self.base, name)
+                    if name != tool_digest() and os.path.isdir(p):
+                        shutil.rmtree(p, ignore_errors=True)
+            except OSError:
+                pass
+        self._ready = True
+
+    # ----------------------------------------------------------- raw store
+
+    def _path(self, kind: str, key: str) -> str:
+        return os.path.join(self.dir, f"{kind}-{key}.pkl")
+
+    def get(self, kind: str, key: str) -> Optional[Any]:
+        try:
+            with open(self._path(kind, key), "rb") as f:
+                value = pickle.load(f)
+            self.hits += 1
+            return value
+        except (OSError, pickle.UnpicklingError, EOFError,
+                AttributeError, ImportError):
+            self.misses += 1
+            return None
+
+    def put(self, kind: str, key: str, value: Any) -> None:
+        try:
+            self._ensure()
+        except OSError:
+            return  # read-only checkout: lint runs uncached, never fails
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump(value, f, protocol=pickle.HIGHEST_PROTOCOL)
+                os.replace(tmp, self._path(kind, key))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except OSError:
+            pass  # cache is best-effort: a full disk must not fail lint
+
+    # ------------------------------------------------------ typed helpers
+
+    def get_module(self, digest: str):
+        return self.get("mod", digest)
+
+    def put_module(self, digest: str, mod) -> None:
+        self.put("mod", digest, mod)
+
+    def get_check_result(self, check_id: str):
+        return self.get("res", check_id)
+
+    def put_check_result(self, check_id: str, value) -> None:
+        self.put("res", check_id, value)

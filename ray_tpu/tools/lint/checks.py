@@ -29,6 +29,10 @@ CHECK_METRICS = "metrics-hygiene"
 CHECK_RESOURCE = "resource-lifecycle"
 CHECK_THREAD_HYGIENE = "thread-hygiene"
 CHECK_RING = "ring-protocol"
+CHECK_RPC_CYCLE = "rpc-cycle"
+CHECK_REPLY = "reply-completeness"
+CHECK_DEATH_PATH = "death-path-completeness"
+CHECK_RING_NET = "ring-protocol-net"
 
 ALL_CHECKS = (
     CHECK_LOCK_ORDER,
@@ -41,6 +45,10 @@ ALL_CHECKS = (
     CHECK_RESOURCE,
     CHECK_THREAD_HYGIENE,
     CHECK_RING,
+    CHECK_RPC_CYCLE,
+    CHECK_REPLY,
+    CHECK_DEATH_PATH,
+    CHECK_RING_NET,
 )
 
 # Blocking kinds that also count as "channel send" for gc-reentrancy.
@@ -716,7 +724,8 @@ def check_thread_hygiene(idx: TreeIndex) -> List[Finding]:
 # -------------------------------------------------------------- ring-protocol
 
 
-def check_ring_protocol_model(idx: TreeIndex) -> List[Finding]:
+def check_ring_protocol_model(idx: TreeIndex,
+                              cache=None) -> List[Finding]:
     """Exhaustive model check of the ring-channel protocol spec.
 
     Runs only when the scanned tree contains the channel implementation
@@ -724,13 +733,20 @@ def check_ring_protocol_model(idx: TreeIndex) -> List[Finding]:
     means an interleaving of the modeled mmap writes breaks a protocol
     invariant — fix channel.py AND ring_model.py together; the
     conformance test in tests/test_static_analysis.py keeps them honest.
+    The result depends only on the lint tool's own sources, so it is
+    cached under the tool digest.
     """
     from .ring_check import CHANNEL_PATH, check_ring_protocol
 
     if CHANNEL_PATH not in idx.modules:
         return []
+    results = cache.get_check_result(CHECK_RING) if cache else None
+    if results is None:
+        results = check_ring_protocol()
+        if cache is not None:
+            cache.put_check_result(CHECK_RING, results)
     findings: List[Finding] = []
-    for res in check_ring_protocol():
+    for res in results:
         for v in res.violations:
             findings.append(Finding(
                 check=CHECK_RING, path=CHANNEL_PATH, line=1,
@@ -742,12 +758,52 @@ def check_ring_protocol_model(idx: TreeIndex) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------- ring-protocol-net
+
+
+def check_ring_protocol_net_model(idx: TreeIndex,
+                                  cache=None) -> List[Finding]:
+    """Exhaustive model check of the NETWORK ring-channel protocol spec
+    (``ring_model_net.py``): the cross-host transport contract, checked
+    under doorbell loss/duplication/reorder and peer crash-restart.
+
+    Runs only when the scanned tree contains the channel implementation
+    (fixture trees don't pay for it).  The spec has no implementation
+    yet — it is the machine-checked contract the cross-host transport
+    PR implements against; a violation means the CONTRACT is broken
+    and the port must not proceed."""
+    from .ring_check import CHANNEL_PATH
+    from .ring_model_net import check_net_ring_protocol
+
+    if CHANNEL_PATH not in idx.modules:
+        return []
+    results = cache.get_check_result(CHECK_RING_NET) if cache else None
+    if results is None:
+        results = check_net_ring_protocol()
+        if cache is not None:
+            cache.put_check_result(CHECK_RING_NET, results)
+    findings: List[Finding] = []
+    for res in results:
+        for v in res.violations:
+            findings.append(Finding(
+                check=CHECK_RING_NET, path=CHANNEL_PATH, line=1,
+                context=f"n_slots={v.n_slots},crash={res.crash or '-'}",
+                detail=f"{v.kind}:n{v.n_slots}:{res.crash or '-'}",
+                message=(f"network ring protocol model check failed: "
+                         f"{v.render()} — an interleaving of sends, "
+                         "deliveries, faults and restarts violates "
+                         "this invariant of the cross-host transport "
+                         "contract")))
+    return findings
+
+
 # ------------------------------------------------------------------- driver
 
 
 def run_checks(idx: TreeIndex,
                baseline_protocol: Optional[dict] = None,
-               checks: Optional[Iterable[str]] = None) -> List[Finding]:
+               checks: Optional[Iterable[str]] = None,
+               cache=None) -> List[Finding]:
     wanted = set(checks) if checks else set(ALL_CHECKS)
     findings: List[Finding] = []
     if CHECK_LOCK_ORDER in wanted:
@@ -769,7 +825,22 @@ def run_checks(idx: TreeIndex,
     if CHECK_THREAD_HYGIENE in wanted:
         findings += check_thread_hygiene(idx)
     if CHECK_RING in wanted:
-        findings += check_ring_protocol_model(idx)
+        findings += check_ring_protocol_model(idx, cache=cache)
+    if wanted & {CHECK_RPC_CYCLE, CHECK_REPLY, CHECK_DEATH_PATH}:
+        from .wire_checks import (
+            check_death_path_completeness,
+            check_reply_completeness,
+            check_rpc_cycle,
+        )
+
+        if CHECK_RPC_CYCLE in wanted:
+            findings += check_rpc_cycle(idx)
+        if CHECK_REPLY in wanted:
+            findings += check_reply_completeness(idx)
+        if CHECK_DEATH_PATH in wanted:
+            findings += check_death_path_completeness(idx)
+    if CHECK_RING_NET in wanted:
+        findings += check_ring_protocol_net_model(idx, cache=cache)
     findings = [f for f in findings
                 if not idx.suppressed(f.path, f.line, f.check)]
     return sorted(findings, key=lambda f: (f.path, f.line, f.check, f.detail))

@@ -23,7 +23,12 @@ from .baseline import (
     BaselineJustificationError,
     default_baseline_path,
 )
+from .cache import LintCache
 from .checks import ALL_CHECKS, Finding, protocol_ops_hash, run_checks
+
+# version of the --json output schema; bump on any incompatible change
+# to the keys/shapes below (validated by tests/test_static_analysis.py)
+JSON_SCHEMA_VERSION = 1
 
 
 def default_root() -> str:
@@ -55,6 +60,9 @@ class LintReport:
     duration_s: float = 0.0
     changed_only: bool = False
     changed_paths: Optional[List[str]] = None  # None = full tree
+    cache_hits: int = 0
+    cache_misses: int = 0
+    cache_dir: Optional[str] = None  # None = cache disabled
 
     @property
     def ok(self) -> bool:
@@ -122,6 +130,13 @@ def changed_files(root: str) -> Optional[List[str]]:
     return rel
 
 
+def default_cache_dir(root: str) -> str:
+    """``.graftlint_cache`` next to the scanned package (inside the
+    repo, so lint never touches files outside it)."""
+    return os.path.join(os.path.dirname(os.path.abspath(root)),
+                        ".graftlint_cache")
+
+
 def run_lint(root: Optional[str] = None,
              baseline_path: Optional[str] = None,
              doc_roots: Optional[List[str]] = None,
@@ -129,7 +144,9 @@ def run_lint(root: Optional[str] = None,
              update_baseline: bool = False,
              use_baseline: bool = True,
              justification: Optional[str] = None,
-             changed_only: bool = False) -> LintReport:
+             changed_only: bool = False,
+             use_cache: bool = True,
+             cache_dir: Optional[str] = None) -> LintReport:
     """Programmatic entry point (the tier-1 test calls this)."""
     t0 = time.monotonic()
     if changed_only and update_baseline:
@@ -137,20 +154,30 @@ def run_lint(root: Optional[str] = None,
             "--changed-only cannot be combined with --update-baseline: "
             "a partial view would prune entries for files it never "
             "looked at")
+    explicit_root = root is not None
     root = root or default_root()
     if use_baseline and baseline_path is None:
         baseline_path = default_baseline_path()
     if doc_roots is None:
         doc_roots = default_doc_roots(root)
+    cache = None
+    if use_cache:
+        # only cache by default for the installed-package scan; an
+        # explicit --root (fixture trees, scratch dirs) must opt in via
+        # cache_dir so lint never litters arbitrary directories
+        if cache_dir is not None:
+            cache = LintCache(cache_dir)
+        elif not explicit_root:
+            cache = LintCache(default_cache_dir(root))
     changed: Optional[List[str]] = None
     if changed_only:
         changed = changed_files(root)
         # None (git unavailable) falls back to the full tree: the fast
         # mode must only ever UNDER-restrict, never lint nothing
-    idx = collect_tree(root, doc_roots=doc_roots)
+    idx = collect_tree(root, doc_roots=doc_roots, cache=cache)
     baseline = Baseline.load(baseline_path if use_baseline else None)
     findings = run_checks(idx, baseline_protocol=baseline.protocol,
-                          checks=checks)
+                          checks=checks, cache=cache)
     digest, version = protocol_ops_hash(idx)
     parse_errors = idx.parse_errors
     if changed is not None:
@@ -186,7 +213,10 @@ def run_lint(root: Optional[str] = None,
                       ops_hash=digest, protocol_version=version,
                       duration_s=time.monotonic() - t0,
                       changed_only=changed_only,
-                      changed_paths=changed)
+                      changed_paths=changed,
+                      cache_hits=cache.hits if cache else 0,
+                      cache_misses=cache.misses if cache else 0,
+                      cache_dir=cache.dir if cache else None)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -216,6 +246,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--check", action="append", dest="checks",
                    metavar="ID", choices=list(ALL_CHECKS),
                    help="run only this check id (repeatable)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the on-disk result cache (keyed by file "
+                        "content hash, invalidated by the lint tool's "
+                        "own source digest)")
+    p.add_argument("--cache-dir", default=None,
+                   help="cache directory (default: .graftlint_cache "
+                        "next to the scanned package; explicit --root "
+                        "scans only cache when this is given)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--list-checks", action="store_true",
@@ -234,7 +272,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                           update_baseline=args.update_baseline,
                           use_baseline=not args.no_baseline,
                           justification=args.justify,
-                          changed_only=args.changed_only)
+                          changed_only=args.changed_only,
+                          use_cache=not args.no_cache,
+                          cache_dir=args.cache_dir)
     except BaselineJustificationError as e:
         print(f"refusing to update baseline: {e}", file=sys.stderr)
         return 2
@@ -270,17 +310,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         scope = (f" [changed-only: {len(report.changed_paths or [])} "
                  "file(s)]" if report.changed_paths is not None
                  else " [changed-only: git unavailable, full tree]")
+    cache_note = ""
+    if report.cache_dir is not None:
+        cache_note = (f", cache {report.cache_hits} hit(s)/"
+                      f"{report.cache_misses} miss(es)")
     summary = (f"graftlint: {len(report.unbaselined)} finding(s), "
                f"{n_sup} baselined, "
                f"{len(report.stale_baseline_keys)} stale baseline "
                f"entr(ies), ops hash {report.ops_hash}, "
-               f"{report.duration_s:.2f}s{scope}")
+               f"{report.duration_s:.2f}s{cache_note}{scope}")
     print(summary)
     return 0 if report.ok else 1
 
 
-def _print_json(report: LintReport) -> None:
-    print(json.dumps({
+def report_as_dict(report: LintReport) -> dict:
+    """The versioned --json payload (schema_version
+    :data:`JSON_SCHEMA_VERSION`; shape validated by
+    tests/test_static_analysis.py — bump the version on any
+    incompatible change)."""
+    return {
+        "schema_version": JSON_SCHEMA_VERSION,
         "ok": report.ok,
         "ops_hash": report.ops_hash,
         "protocol_version": report.protocol_version,
@@ -292,7 +341,17 @@ def _print_json(report: LintReport) -> None:
         "parse_errors": report.parse_errors,
         "changed_only": report.changed_only,
         "changed_paths": report.changed_paths,
-    }, indent=2))
+        "cache": {
+            "enabled": report.cache_dir is not None,
+            "dir": report.cache_dir,
+            "hits": report.cache_hits,
+            "misses": report.cache_misses,
+        },
+    }
+
+
+def _print_json(report: LintReport) -> None:
+    print(json.dumps(report_as_dict(report), indent=2))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,474 @@
+"""Network-variant ring-channel protocol spec + explorer.
+
+The shm ring model (:mod:`ring_model`) proves the SAME-HOST protocol:
+writer and reader share the mmap'd header, and doorbells are reliable
+FIFO writes.  The cross-host transport the roadmap targets replaces
+shared memory with a message-passing session over the peer mesh — and
+messages, unlike mmap stores, can be **lost, duplicated, and
+reordered**, and a peer can **crash and restart mid-protocol**.  This
+module is the machine-checked contract that transport must implement
+against, surfaced as lint check id ``ring-protocol-net``.
+
+Protocol (NetRing v1 — the spec the cross-host port implements):
+
+- The writer keeps ``w`` (highest produced seq, durable: the unacked
+  payloads live in its ring slots until acknowledged) and ``acked``
+  (its view of the reader's cumulative ack; a session-volatile cache).
+- The reader keeps a receive ring of ``n_slots`` slots and ``r``
+  (highest consumed seq).  Data messages ``(d, seq)`` stamp slot
+  ``(seq-1) % n_slots``; consumption is strictly in seq order with the
+  same per-slot seq cross-check the shm protocol uses.
+- Acks are **cumulative**: ``(a, r)`` after every consume; the writer
+  folds them in with ``max()`` so stale/reordered/duplicated acks are
+  harmless.
+- **Send window** (the guard behind bounded backpressure): the writer
+  only produces while ``w - acked < n_slots`` — at most ``n_slots``
+  payloads can be un-acknowledged, so a data message can never
+  overwrite an unconsumed slot.
+- **Seq dedup + re-ack** (the guard behind no-torn-read): the reader
+  drops a data message unless ``r < seq <= r + n_slots``, and answers
+  every dropped one with its cumulative ack (the Go-Back-N receiver
+  rule).  The re-ack is load-bearing: a lost final ack would otherwise
+  pin the writer's window shut forever — its retransmissions would be
+  dropped silently and nothing would ever re-open the window (the
+  first version of this very spec had exactly that wedge; the explorer
+  found it).
+- **Retransmit** (the guard behind loss recovery): the writer may
+  re-send ``acked + 1`` (cumulative-ack retransmission) any time an
+  unacked message exists.  Retransmit + re-ack also heal a *writer*
+  restart without any handshake — ``acked`` rebuilds from the first
+  re-ack — which is why the resync handshake below is reader-only.
+- **Hybrid park/wake** carries over from the shm protocol verbatim:
+  bounded spin, raise own parked flag, RECHECK the condition, sleep;
+  a *delivery* (the network analog of the doorbell) rings the parked
+  side iff its flag is up.  Set-flag-then-recheck closes the same
+  lost-wakeup race the shm model proves.
+- **Resync on restart** (the guard behind crash recovery): a restarted
+  *reader* has no cursor (``r`` and the receive ring are session
+  state) and MUST run the resync handshake before consuming: send
+  ``(rrq)``, the writer answers ``(rbase, acked)``, and the reader
+  adopts ``r = acked`` (delivery for the unacked window degrades to
+  at-least-once across a reader restart — the DAG layer's seq-tagged
+  results make re-execution idempotent).  A restarted *writer* keeps
+  ``w`` and its unacked slot payloads (they are durable by contract:
+  the ring retains a payload until acknowledged) and recovers
+  ``acked`` from re-acks, no handshake needed.
+
+Checked invariants, exhaustively for ``n_slots ∈ {1, 2}`` with ring-
+wrapping message counts under loss + duplication + reorder and one
+crash-restart per run:
+
+- **no-lost-wakeup** — a side never sleeps while its condition holds
+  with no bell pending and no in-flight delivery that would ring it;
+- **no-torn-read** — the reader's slot-seq cross-check never fires and
+  no seq is consumed out of order;
+- **bounded backpressure** — ``w - acked <= n_slots`` always;
+- **deadlock freedom** — every non-goal state has an enabled action;
+- **no-wedge** — from every reachable state the goal (all messages
+  consumed) is still reachable: this is the check that catches
+  *livelocks*, e.g. a restarted peer that skipped resync spinning on
+  retransmissions the other side silently drops forever.
+
+Each :class:`NetMutations` field deletes exactly one guard; the
+mutation tests assert the explorer reports a violation with a concrete
+counterexample trace for every one of them.
+
+Like :mod:`ring_model`, nothing here imports the transport (there is
+none yet) — the spec must not be able to become the implementation.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# violation kinds (stable ids, used in tests/docs)
+V_BACKPRESSURE = "backpressure"
+V_TORN_READ = "torn-read-consumed"
+V_LOST_WAKEUP = "lost-wakeup"
+V_DEADLOCK = "deadlock"
+V_WEDGE = "wedge"  # goal unreachable: deadlock OR livelock
+
+
+@dataclass(frozen=True)
+class NetMutations:
+    """One deleted guard per field (all False = the shipped spec)."""
+
+    # parking side sleeps right after raising its flag, without the
+    # condition recheck — reintroduces the shm lost-wakeup race, now
+    # against message deliveries instead of mmap stores
+    drop_parked_recheck: bool = False
+    # reader stamps any delivered seq without the `r < s <= r+n_slots`
+    # window check — a duplicated/zombie data message overwrites a slot
+    drop_seq_dedup: bool = False
+    # writer produces without the `w - acked < n_slots` send window
+    drop_send_window: bool = False
+    # no retransmission: a single lost data message stops the world
+    drop_retransmit: bool = False
+    # a restarted peer resumes with zeroed session state instead of the
+    # resync handshake
+    drop_resync: bool = False
+
+
+# --------------------------------------------------------------- state
+#
+# One flat hashable tuple:
+#   (w, acked, r, slots, wpc, rpc, wflag, rflag, wbell, rbell,
+#    data, acks, crashed)
+# slots: per-slot stamped seq (0 = empty), reader side.
+# data:  frozenset of writer->reader messages ("d", seq) | ("wrq",)
+#        | ("rbase", base)
+# acks:  frozenset of reader->writer messages ("a", seq) | ("rrq",)
+# crashed: 1 once the (single) crash budget is spent.
+
+IDLE, WAIT, FLAG, RECHECK, SLEEP, RESYNC = (
+    "idle", "wait", "flag", "recheck", "sleep", "resync")
+
+_NAMES = ("w", "acked", "r", "slots", "wpc", "rpc", "wflag", "rflag",
+          "wbell", "rbell", "data", "acks", "crashed")
+_IDX = {n: i for i, n in enumerate(_NAMES)}
+
+
+def initial_state(n_slots: int):
+    return (0, 0, 0, (0,) * n_slots, IDLE, IDLE, 0, 0, 0, 0,
+            frozenset(), frozenset(), 0)
+
+
+def _set(state, **kw):
+    # hot path of the explorer (millions of calls): dict lookup, not
+    # tuple.index
+    vals = list(state)
+    for k, v in kw.items():
+        vals[_IDX[k]] = v
+    return tuple(vals)
+
+
+def window_open(state, n_slots: int) -> bool:
+    return state[0] - state[1] < n_slots
+
+
+def readable(state, n_slots: int) -> bool:
+    r, slots = state[2], state[3]
+    return slots[r % n_slots] != 0
+
+
+def is_goal(state, n_messages: int) -> bool:
+    return state[2] == n_messages
+
+
+def enabled_transitions(state, n_slots: int, n_messages: int,
+                        mut: NetMutations,
+                        crash: Optional[str] = None,
+                        ) -> Iterator[Tuple[str, tuple, List[str]]]:
+    """Yield (action_label, next_state, violations_triggered)."""
+    (w, acked, r, slots, wpc, rpc, wflag, rflag, wbell, rbell,
+     data, acks, crashed) = state
+
+    # ---------------- writer ------------------------------------------
+    def produce(st):
+        nw = st[0] + 1
+        viol = [V_BACKPRESSURE] if nw - st[1] > n_slots else []
+        return _set(st, w=nw, wpc=IDLE, wflag=0,
+                    data=st[10] | {("d", nw)}), viol
+
+    if wpc == IDLE and w < n_messages:
+        if window_open(state, n_slots) or mut.drop_send_window:
+            nxt, viol = produce(state)
+            yield ("w:produce", nxt, viol)
+        if not window_open(state, n_slots):
+            yield ("w:wait", _set(state, wpc=WAIT), [])
+    elif wpc == WAIT:
+        if window_open(state, n_slots):
+            nxt, viol = produce(state)
+            yield ("w:spin-hit", nxt, viol)
+        yield ("w:flag", _set(state, wpc=FLAG), [])
+    elif wpc == FLAG:
+        nxt_pc = SLEEP if mut.drop_parked_recheck else RECHECK
+        yield ("w:set-flag", _set(state, wflag=1, wpc=nxt_pc), [])
+    elif wpc == RECHECK:
+        if window_open(state, n_slots):
+            nxt, viol = produce(_set(state, wflag=0))
+            yield ("w:recheck-hit", nxt, viol)
+        else:
+            yield ("w:recheck-miss", _set(state, wpc=SLEEP), [])
+    elif wpc == SLEEP:
+        if wbell:
+            yield ("w:wake", _set(state, wbell=0, wflag=0, wpc=IDLE), [])
+
+    # retransmission timer: independent of the writer's parked state
+    # (a real impl runs it on the transport thread)
+    if not mut.drop_retransmit and acked < w:
+        msg = ("d", acked + 1)
+        if msg not in data:
+            yield ("w:retransmit", _set(state, data=data | {msg}), [])
+
+    # ---------------- reader ------------------------------------------
+    if rpc == IDLE and r < n_messages:
+        if readable(state, n_slots):
+            sv = slots[r % n_slots]
+            viol = [V_TORN_READ] if sv != r + 1 else []
+            new_slots = list(slots)
+            new_slots[r % n_slots] = 0
+            nr = r + 1
+            yield ("r:consume",
+                   _set(state, r=nr, slots=tuple(new_slots),
+                        acks=acks | {("a", nr)}), viol)
+        else:
+            yield ("r:wait", _set(state, rpc=WAIT), [])
+    elif rpc == WAIT:
+        if readable(state, n_slots):
+            yield ("r:spin-hit", _set(state, rpc=IDLE, rflag=0), [])
+        yield ("r:flag", _set(state, rpc=FLAG), [])
+    elif rpc == FLAG:
+        nxt_pc = SLEEP if mut.drop_parked_recheck else RECHECK
+        yield ("r:set-flag", _set(state, rflag=1, rpc=nxt_pc), [])
+    elif rpc == RECHECK:
+        if readable(state, n_slots):
+            yield ("r:recheck-hit", _set(state, rflag=0, rpc=IDLE), [])
+        else:
+            yield ("r:recheck-miss", _set(state, rpc=SLEEP), [])
+    elif rpc == SLEEP:
+        if rbell:
+            yield ("r:wake", _set(state, rbell=0, rflag=0, rpc=IDLE), [])
+    elif rpc == RESYNC:
+        yield ("r:resync-send", _set(state, acks=acks | {("rrq",)}), [])
+
+    # ---------------- deliveries (the network doorbells) ---------------
+    # delivery picks ANY in-flight message (= reorder); each has a
+    # consume-variant (removed) and a dup-variant (left in flight);
+    # loss removes without processing.
+    for msg in sorted(data):
+        for keep, suffix in ((False, ""), (True, "+dup")):
+            nxt = _deliver_data(state, msg, n_slots, mut)
+            if nxt is None:
+                continue
+            st, viol = nxt
+            if not keep:
+                st = _set(st, data=st[10] - {msg})
+            yield (f"net:deliver-{_mlabel(msg)}{suffix}", st, viol)
+        yield (f"net:lose-{_mlabel(msg)}",
+               _set(state, data=data - {msg}), [])
+    for msg in sorted(acks):
+        for keep, suffix in ((False, ""), (True, "+dup")):
+            nxt = _deliver_ack(state, msg, mut)
+            if nxt is None:
+                continue
+            st, viol = nxt
+            if not keep:
+                st = _set(st, acks=st[11] - {msg})
+            yield (f"net:deliver-{_mlabel(msg)}{suffix}", st, viol)
+        yield (f"net:lose-{_mlabel(msg)}",
+               _set(state, acks=acks - {msg}), [])
+
+    # ---------------- crash-restart ------------------------------------
+    # writer restart: w and the unacked payloads are durable; acked is
+    # session state and rebuilds from re-acks (no handshake needed)
+    if crash == "writer" and not crashed:
+        st = _set(state, acked=0, wflag=0, wbell=0, wpc=IDLE,
+                  data=frozenset(), acks=frozenset(), crashed=1)
+        yield ("x:crash-writer", st, [])
+    elif crash == "reader" and not crashed:
+        st = _set(state, r=0, slots=(0,) * n_slots, rflag=0, rbell=0,
+                  data=frozenset(), acks=frozenset(), crashed=1,
+                  rpc=IDLE if mut.drop_resync else RESYNC)
+        yield ("x:crash-reader", st, [])
+
+
+def _mlabel(msg) -> str:
+    return msg[0] + (str(msg[1]) if len(msg) > 1 else "")
+
+
+def _deliver_data(state, msg, n_slots: int, mut: NetMutations):
+    """Reader-side delivery of a writer->reader message; returns
+    (next_state, violations) or None when the message is not
+    deliverable in this state."""
+    r, slots, rpc, rflag = state[2], state[3], state[5], state[7]
+    kind = msg[0]
+    if kind == "d":
+        s = msg[1]
+        if rpc == RESYNC:
+            # restarted reader has no cursor yet: drop; retransmission
+            # re-covers the unacked window after resync
+            return state, []
+        if not mut.drop_seq_dedup and not (r < s <= r + n_slots):
+            # dropped stale/zombie seq: re-ack (Go-Back-N receiver) so
+            # a lost final ack cannot pin the writer's window shut
+            return _set(state, acks=state[11] | {("a", r)}), []
+        new_slots = list(slots)
+        new_slots[(s - 1) % n_slots] = s
+        st = _set(state, slots=tuple(new_slots))
+        if rflag:
+            st = _set(st, rbell=1)
+        return st, []
+    if kind == "rbase":
+        if rpc == RESYNC:
+            return _set(state, r=msg[1], rpc=IDLE), []
+        return state, []  # stale resync reply
+    return None
+
+
+def _deliver_ack(state, msg, mut: NetMutations):
+    """Writer-side delivery of a reader->writer message."""
+    acked, wpc, wflag = state[1], state[4], state[6]
+    kind = msg[0]
+    if kind == "a":
+        new_acked = max(acked, msg[1])
+        st = _set(state, acked=new_acked)
+        if wflag and new_acked > acked:
+            st = _set(st, wbell=1)
+        return st, []
+    if kind == "rrq":
+        # reader resync request: answer with the retained-base seq
+        return _set(state, data=state[10] | {("rbase", acked)}), []
+    return None
+
+
+def state_hazards(state, n_slots: int, n_messages: int) -> List[str]:
+    """Safety properties evaluated on every reachable state.
+
+    The backpressure bound is a *produce-time* transition check
+    (``w' - acked > n_slots``) plus this crash-free state form: after a
+    crash, ``acked`` (writer restart) or ``r`` (reader restart, before
+    resync completes) are legitimately stale caches mid-rebuild."""
+    (w, acked, r, slots, wpc, rpc, wflag, rflag, wbell, rbell,
+     data, acks, crashed) = state
+    out = []
+    if not crashed and (w - r > n_slots or r > w):
+        out.append(V_BACKPRESSURE)
+    # lost wakeup: a side committed to sleeping while its condition
+    # holds, no bell pending, and no in-flight delivery would ring it
+    if wpc == SLEEP and window_open(state, n_slots) and w < n_messages \
+            and not wbell and not any(m[0] == "a" for m in acks):
+        out.append(V_LOST_WAKEUP)
+    if rpc == SLEEP and readable(state, n_slots) and not rbell \
+            and not any(m[0] == "d" and r < m[1] <= r + n_slots
+                        for m in data):
+        out.append(V_LOST_WAKEUP)
+    return out
+
+
+# ------------------------------------------------------------- explorer
+
+
+@dataclass
+class NetViolation:
+    kind: str
+    n_slots: int
+    trace: Tuple[str, ...]
+    state: tuple
+
+    def render(self) -> str:
+        tail = " -> ".join(self.trace[-8:])
+        return (f"{self.kind} (n_slots={self.n_slots}, "
+                f"{len(self.trace)} steps): ... {tail}")
+
+
+@dataclass
+class NetExploreResult:
+    n_slots: int
+    n_messages: int
+    crash: Optional[str]
+    states: int = 0
+    violations: List[NetViolation] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def explore_net(n_slots: int, n_messages: Optional[int] = None,
+                mut: NetMutations = NetMutations(),
+                crash: Optional[str] = None,
+                max_violations: int = 4) -> NetExploreResult:
+    """BFS over every reachable state; first counterexample per kind
+    (BFS order = shortest trace).  After the forward pass, a backward
+    reachability pass from the goal states reports any reachable state
+    that can no longer reach the goal (``wedge``: deadlock OR
+    livelock)."""
+    if n_messages is None:
+        # ring-wrapping horizon; crash configs drop one message to keep
+        # the (already fault-multiplied) state space economical while
+        # still lapping every slot
+        n_messages = n_slots + (1 if crash else 2)
+    init = initial_state(n_slots)
+    res = NetExploreResult(n_slots=n_slots, n_messages=n_messages,
+                           crash=crash)
+    parent: Dict[tuple, Optional[Tuple[tuple, str]]] = {init: None}
+    successors: Dict[tuple, List[tuple]] = {}
+    seen_kinds: set = set()
+    queue = deque([init])
+    res.states = 1
+
+    def trace_to(state, extra: Tuple[str, ...] = ()) -> Tuple[str, ...]:
+        labels: List[str] = []
+        cur = state
+        while parent[cur] is not None:
+            prev, label = parent[cur]
+            labels.append(label)
+            cur = prev
+        labels.reverse()
+        return tuple(labels) + extra
+
+    def report(kind: str, state, extra: Tuple[str, ...] = ()):
+        if kind in seen_kinds or len(res.violations) >= max_violations:
+            return
+        seen_kinds.add(kind)
+        res.violations.append(NetViolation(
+            kind=kind, n_slots=n_slots, trace=trace_to(state, extra),
+            state=state))
+
+    goals: List[tuple] = []
+    while queue:
+        state = queue.popleft()
+        for kind in state_hazards(state, n_slots, n_messages):
+            report(kind, state)
+        if is_goal(state, n_messages):
+            goals.append(state)
+            successors[state] = []
+            continue  # post-goal behavior is irrelevant: stop expanding
+        succ: List[tuple] = []
+        for label, nxt, viols in enabled_transitions(
+                state, n_slots, n_messages, mut, crash):
+            for kind in viols:
+                report(kind, state, extra=(label,))
+            succ.append(nxt)
+            if nxt not in parent:
+                parent[nxt] = (state, label)
+                res.states += 1
+                queue.append(nxt)
+        successors[state] = succ
+        if not succ:
+            report(V_DEADLOCK, state)
+    # ---- backward pass: every reachable state must still reach goal
+    if goals or parent:
+        co: set = set(goals)
+        preds: Dict[tuple, List[tuple]] = {}
+        for st, succ in successors.items():
+            for nx in succ:
+                preds.setdefault(nx, []).append(st)
+        bq = deque(goals)
+        while bq:
+            cur = bq.popleft()
+            for p in preds.get(cur, ()):
+                if p not in co:
+                    co.add(p)
+                    bq.append(p)
+        for st in successors:
+            if st not in co:
+                report(V_WEDGE, st)
+                break
+    return res
+
+
+DEFAULT_SLOT_COUNTS = (1, 2)
+DEFAULT_CRASHES = (None, "writer", "reader")
+
+
+def check_net_ring_protocol(
+        slot_counts: Tuple[int, ...] = DEFAULT_SLOT_COUNTS,
+        crashes: Tuple[Optional[str], ...] = DEFAULT_CRASHES,
+        mut: NetMutations = NetMutations()) -> List[NetExploreResult]:
+    """The tier-1 entry: exhaustive exploration per configuration."""
+    return [explore_net(n, mut=mut, crash=c)
+            for n in slot_counts for c in crashes]

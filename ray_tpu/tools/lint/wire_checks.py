@@ -1,0 +1,377 @@
+"""Wire-level (cross-process) graftlint checks.
+
+The intra-process checks (lock-order, resource-lifecycle, …) guard one
+process's invariants; this module guards the invariants BETWEEN
+processes, built from the same :class:`TreeIndex` facts:
+
+``rpc-cycle``
+    Builds the cross-process request-reply graph: every synchronous
+    send site (``.call`` round-trips and framed send-then-wait
+    requests) resolved to the handler ladder that dispatches its op,
+    attributed to the *process class* on each side (the Python class
+    containing the send / the ladder — Head, Node, RemoteHead,
+    WorkerRuntime, ObjectServer, _ClientSession…).  Two finding shapes:
+
+    - a strongly-connected component of ≥2 process classes in the
+      synchronous-request graph (A waits on B while B waits on A —
+      the distributed deadlock shape), and
+    - a handler that, through the intra-class call graph, reaches a
+      synchronous send toward a class that sends ops this very ladder
+      dispatches — a reverse RPC toward the requesting class.  If the
+      requester issues its call from the thread that serves OUR
+      reverse request, both sides park forever.  Deliberate designs
+      (handlers hopped onto their own thread before blocking) are
+      baselined with a justification.
+
+``reply-completeness``
+    Every request-reply handler (a function binding the wire framing's
+    ``req_id``) must pass the id onward on EVERY path — reply, fail
+    the parked slot, or delegate — including exception paths.  A path
+    that drops the id leaves the requester parked for its full
+    timeout: the exact shape behind the 2.0 s → 10 ms teardown fixes.
+
+``death-path-completeness``
+    Every registry of parked waiters (pending reply slots, stream-sub
+    slots, arg leases, pool checkouts) must have a removal site
+    reachable from a death/disconnect handler (``remove_node``,
+    worker-death, channel-EOF, ``fail_all`` families) or a teardown
+    method via the intra-class call graph.  A registry only ever
+    cleaned on the happy path wedges its waiters when the peer dies —
+    the FT-readiness guarantee the restartable-head work builds on.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import defaultdict, deque
+from typing import Dict, List, Optional, Set, Tuple
+
+from .analysis import (
+    DEATH_METHOD_RE,
+    REGISTRY_NAME_RE,
+    TEARDOWN_METHOD_NAMES,
+    ModuleInfo,
+    SendSite,
+    TreeIndex,
+)
+from .checks import Finding, _CallGraph, _find_cycles
+
+CHECK_RPC_CYCLE = "rpc-cycle"
+CHECK_REPLY = "reply-completeness"
+CHECK_DEATH_PATH = "death-path-completeness"
+
+# reply/ack tags are the *response* half of a round-trip, not requests;
+# they never create request edges even when sent from a waiting function
+_REPLY_OP_RE = re.compile(r"(rep$|^pong$|^ack$)")
+
+
+# --------------------------------------------------------------- proc graph
+
+
+class _ProcGraph:
+    """Cross-process request-reply facts extracted once per tree."""
+
+    def __init__(self, idx: TreeIndex):
+        self.idx = idx
+        # op -> [(class key, chain, path)]; class keys are bare class
+        # names (unique per tree in this codebase; collisions merge)
+        self.op_handlers: Dict[str, List[Tuple[str, object, str]]] = \
+            defaultdict(list)
+        # op -> [(class key, path, SendSite)]  (synchronous sends only)
+        self.op_senders: Dict[str, List[Tuple[str, str, SendSite]]] = \
+            defaultdict(list)
+        # path -> func qualname -> its synchronous non-reply send sites
+        self.sync_sends_by_func: Dict[str, Dict[str, List[SendSite]]] = {}
+        self._cgs: Dict[str, _CallGraph] = {}
+        self._collect()
+
+    def callgraph(self, path: str) -> _CallGraph:
+        cg = self._cgs.get(path)
+        if cg is None:
+            cg = self._cgs[path] = _CallGraph(self.idx.modules[path])
+        return cg
+
+    @staticmethod
+    def _cls_of(qual: Optional[str], mod: ModuleInfo,
+                path: str) -> Optional[str]:
+        if qual is None:
+            return None
+        fi = mod.functions.get(qual)
+        if fi is not None and fi.cls:
+            return fi.cls
+        if "." in qual:
+            head = qual.split(".", 1)[0]
+            if head in mod.classes:
+                return head
+        return f"<module {path}>"
+
+    def _collect(self) -> None:
+        for path, mod in self.idx.modules.items():
+            waiting_funcs = {
+                q for q, fi in mod.functions.items()
+                if any(b.kind in ("wait", "result") for b in fi.blocking)}
+            for chain in mod.handlers:
+                cls = self._cls_of(chain.func, mod, path)
+                if cls is None:
+                    continue
+                for op, _line in chain.ops:
+                    self.op_handlers[op].append((cls, chain, path))
+            by_func: Dict[str, List[SendSite]] = defaultdict(list)
+            self.sync_sends_by_func[path] = by_func
+            for s in mod.sends:
+                if s.prefix or s.func is None:
+                    continue
+                if _REPLY_OP_RE.search(s.op):
+                    continue
+                sync = s.sync or s.func in waiting_funcs
+                if not sync:
+                    continue
+                by_func[s.func].append(s)
+                cls = self._cls_of(s.func, mod, path)
+                if cls is None:
+                    continue
+                self.op_senders[s.op].append((cls, path, s))
+
+    def sync_edges(self):
+        """(sender_cls, handler_cls, op, path, SendSite) for every
+        synchronous cross-class request."""
+        for op, senders in sorted(self.op_senders.items()):
+            handlers = self.op_handlers.get(op, ())
+            for scls, spath, site in senders:
+                for hcls, _chain, hpath in handlers:
+                    if hcls != scls:
+                        yield scls, hcls, op, spath, site, hpath
+
+
+# ------------------------------------------------------------- rpc-cycle
+
+
+def check_rpc_cycle(idx: TreeIndex) -> List[Finding]:
+    pg = _ProcGraph(idx)
+    findings: List[Finding] = []
+
+    # ---- shape 1: synchronous request cycles between process classes
+    graph: Dict[str, Set[str]] = defaultdict(set)
+    rep: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for scls, hcls, op, spath, site, _hpath in pg.sync_edges():
+        graph[scls].add(hcls)
+        rep.setdefault((scls, hcls), (spath, site.line, op))
+    for cycle in _find_cycles(graph):
+        edges = []
+        for i, node in enumerate(cycle):
+            nxt = cycle[(i + 1) % len(cycle)]
+            r = rep.get((node, nxt))
+            if r:
+                edges.append(f"{node} --{r[2]}--> {nxt} "
+                             f"(sent at {r[0]}:{r[1]})")
+        first = rep.get((cycle[0], cycle[1 % len(cycle)]),
+                        ("<unknown>", 0, ""))
+        findings.append(Finding(
+            check=CHECK_RPC_CYCLE, path=first[0], line=first[1],
+            context="-", detail="cycle:" + "<->".join(cycle),
+            message=("synchronous request-reply cycle between process "
+                     "classes " + " -> ".join(cycle + [cycle[0]]) + "; "
+                     + "; ".join(edges) + " — if each side issues its "
+                     "request from the thread that serves the other's, "
+                     "both park forever")))
+
+    # ---- shape 2: handler blocks on a reverse RPC toward its requester
+    seen: Set[str] = set()
+    for op, handlers in sorted(pg.op_handlers.items()):
+        senders = {scls for scls, _p, _s in pg.op_senders.get(op, ())}
+        if not senders:
+            continue
+        for hcls, chain, hpath in handlers:
+            mod = idx.modules[hpath]
+            cg = pg.callgraph(hpath)
+            # seed the closure from the op's OWN branch callees: walking
+            # the whole ladder function would attribute another branch's
+            # sends to this op.  A branch with no resolvable self-method
+            # callees is self-contained — its direct sends are either
+            # replies (excluded) or reported via their own class edge.
+            roots = []
+            for callee in chain.op_calls.get(op, ()):
+                qual = f"{hcls}.{callee}"
+                if qual in mod.functions:
+                    roots.append(qual)
+            if not roots:
+                continue
+            hit = None
+            for path_quals, send in _closure_sync_sends(
+                    pg, hpath, cg, hcls, roots):
+                targets = {tcls for tcls, _c, _p in
+                           pg.op_handlers.get(send.op, ())}
+                back = sorted((targets & senders) - {hcls})
+                if back:
+                    hit = (path_quals, send, back)
+                    break
+            if hit is None:
+                continue
+            path_quals, send, back = hit
+            # one finding per (ladder, reverse op): the per-op variants
+            # share the same blocking closure and the same fix
+            key = f"{hcls}:{chain.func}->{send.op}"
+            if key in seen:
+                continue
+            seen.add(key)
+            # cite the requesting class's own send site, not whichever
+            # class happened to send the op first
+            sender_at = next(s for s in pg.op_senders[op]
+                             if s[0] == back[0])
+            findings.append(Finding(
+                check=CHECK_RPC_CYCLE, path=hpath, line=send.line,
+                context=chain.func,
+                detail=f"reverse:{chain.func}->{send.op}",
+                message=(f"handler ladder {chain.func} (op {op!r}, sent "
+                         f"by {back[0]} at {sender_at[1]}:"
+                         f"{sender_at[2].line}) reaches a synchronous "
+                         f"send of {send.op!r} back toward {back[0]} "
+                         f"via {' -> '.join(path_quals)} "
+                         f"({hpath}:{send.line}) — the handler blocks "
+                         "on a reverse RPC toward the requesting class; "
+                         "serve it off-thread or make the reverse send "
+                         "asynchronous")))
+    return findings
+
+
+def _closure_sync_sends(pg: _ProcGraph, path: str, cg: _CallGraph,
+                        cls: str, roots: List[str]):
+    """BFS the intra-class call graph from the handler roots, yielding
+    (qual_path, SendSite) for every reachable synchronous send site in
+    shortest-path order."""
+    sends_by_func = pg.sync_sends_by_func.get(path, {})
+    seen = set(roots)
+    queue = deque([(r, [r]) for r in roots])
+    while queue:
+        cur, qpath = queue.popleft()
+        for s in sends_by_func.get(cur, ()):
+            yield qpath, s
+        for tgt in cg.callees(cur):
+            if tgt not in seen and tgt.startswith(f"{cls}."):
+                seen.add(tgt)
+                queue.append((tgt, qpath + [tgt]))
+
+
+# ------------------------------------------------------ reply-completeness
+
+
+_GAP_KINDS = {
+    "fall": ("falls off the end", "the requester waits out its full "
+             "timeout"),
+    "return": ("returns early", "the requester waits out its full "
+               "timeout"),
+    "except": ("can raise out of the handler", "an exception path "
+               "strands the parked waiter"),
+}
+
+
+def check_reply_completeness(idx: TreeIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, mod in idx.modules.items():
+        # only modules participating in the wire protocol: a handler
+        # ladder or send sites (serve-layer request_ids etc. are not
+        # wire reply obligations)
+        if not mod.handlers and not mod.sends:
+            continue
+        for qual, fi in sorted(mod.functions.items()):
+            info = fi.reply
+            if info is None or not info.gaps:
+                continue
+            if info.nested_delegate:
+                continue  # deferred reply from a spawned thread
+            if not info.sites:
+                continue  # binds the id but never replies: plumbing
+            for line, kind in info.gaps:
+                what, why = _GAP_KINDS[kind]
+                findings.append(Finding(
+                    check=CHECK_REPLY, path=path, line=line,
+                    context=qual, detail=f"{kind}:{qual}",
+                    message=(f"request-reply handler {qual} {what} "
+                             f"without replying (req id "
+                             f"{info.param!r}) — {why}; reply, fail "
+                             "the parked slot, or delegate on every "
+                             "path (replies seen at line(s) "
+                             f"{', '.join(map(str, info.sites[:4]))})")))
+    return findings
+
+
+# ------------------------------------------- death-path-completeness
+
+
+def check_death_path_completeness(idx: TreeIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, mod in idx.modules.items():
+        cg = _CallGraph(mod)
+        # a name-matched (but not waiter-constructing) registry is only
+        # a wire obligation in modules that actually speak the wire
+        # protocol — driver-side "pending work" maps (data operators,
+        # RL in-flight rollouts) surface failures as exceptions from
+        # get/wait, not via a peer-death event
+        has_wire = bool(mod.handlers or mod.sends)
+        for cls, methods in sorted(mod.classes.items()):
+            # registries inserted into by this class's methods
+            stores: Dict[str, Tuple[str, object]] = {}
+            clears: Dict[str, List[str]] = defaultdict(list)
+            for qual, fi in mod.functions.items():
+                if fi.cls != cls:
+                    continue
+                for st in fi.registry_stores:
+                    if st.waiterish or (has_wire
+                                        and REGISTRY_NAME_RE.search(st.attr)):
+                        stores.setdefault(st.attr, (qual, st))
+                for cl in fi.registry_clears:
+                    # constructing the empty registry in __init__ is
+                    # initialization, not cleanup
+                    if cl.method == "reassign" and fi.name == "__init__":
+                        continue
+                    clears[cl.attr].append(qual)
+            if not stores:
+                continue
+            # methods a death/disconnect event reaches (intra-class)
+            death_roots = [
+                f"{cls}.{m}" for m in mod.classes.get(cls, ())
+                if DEATH_METHOD_RE.search(m) or m in TEARDOWN_METHOD_NAMES]
+            reach: Set[str] = set(death_roots)
+            queue = deque(death_roots)
+            while queue:
+                cur = queue.popleft()
+                for tgt in cg.callees(cur):
+                    if tgt not in reach and tgt.startswith(f"{cls}."):
+                        reach.add(tgt)
+                        queue.append(tgt)
+            for attr, (qual, st) in sorted(stores.items()):
+                cleaners = clears.get(attr, ())
+                if not cleaners:
+                    findings.append(Finding(
+                        check=CHECK_DEATH_PATH, path=path, line=st.line,
+                        context=cls, detail=f"never-cleared:{attr}",
+                        message=(f"{cls}.{attr} registers parked "
+                                 f"waiters (inserted in {qual}) but no "
+                                 "method of the class ever removes or "
+                                 "fails entries — every waiter leaks")))
+                    continue
+                # covered when some cleaner is itself a death/teardown
+                # method, is reachable from one, or is a nested function
+                # (a resident drainer thread owns the registry and pops
+                # entries as completions/errors arrive)
+                covered = any(
+                    c in reach
+                    or DEATH_METHOD_RE.search(c.split(".")[-1])
+                    or c.split(".")[-1] in TEARDOWN_METHOD_NAMES
+                    or c.count(".") >= 2
+                    for c in cleaners)
+                if not covered:
+                    rel = ", ".join(sorted(set(cleaners))[:4])
+                    findings.append(Finding(
+                        check=CHECK_DEATH_PATH, path=path, line=st.line,
+                        context=cls, detail=f"no-death-path:{attr}",
+                        message=(f"{cls}.{attr} registers parked waiters "
+                                 f"(inserted in {qual}) and is cleaned "
+                                 f"only by {rel}, none of which is a "
+                                 "death/disconnect or teardown handler "
+                                 "or reachable from one "
+                                 "(remove_node/worker-death/channel-EOF "
+                                 "families) — when the peer dies, "
+                                 "parked waiters wait out their full "
+                                 "timeout instead of failing fast")))
+    return findings

@@ -139,14 +139,23 @@ class _FunctionTrainableActor:
         self._thread.start()
 
     def fetch(self):
-        """Drain queued results; returns (results, done, error)."""
+        """Drain queued results; returns (results, done, error).
+
+        ``_done`` is read BEFORE draining: the trainable thread puts
+        its results and only then sets ``_done``, so done-before-drain
+        guarantees every result is already in the queue when we report
+        done=True.  The reverse order had a lost-result race — drain,
+        then the thread puts its final report and sets the flag, then
+        we read done=True and the controller stops the trial with
+        results still queued (the tier-1 tune load flake)."""
+        done, error = self._done, self._error
         out = []
         while True:
             try:
                 out.append(self._queue.get_nowait())
             except Exception:
                 break
-        return out, self._done, self._error
+        return out, done, error
 
     def stop(self):
         return True

@@ -1,0 +1,50 @@
+"""Planted death-path-completeness bugs: a waiter registry cleaned
+only on the happy path (no death/teardown coverage) and a lease table
+that is never cleaned at all."""
+import threading
+
+
+class PendingTable:
+    """Reply slots popped only when the reply arrives: a peer death
+    leaves every parked waiter stuck for its full timeout."""
+
+    def __init__(self, ch):
+        self._ch = ch
+        self._pending = {}
+        self._seq = 0
+
+    def register(self, req_id):
+        slot = [threading.Event(), None]
+        self._pending[req_id] = slot
+        return slot
+
+    def complete(self, req_id, value):
+        slot = self._pending.pop(req_id, None)
+        if slot is not None:
+            slot[1] = value
+            slot[0].set()
+
+    def close(self):
+        # BUG: teardown never fails the parked slots
+        self._ch.send("bye")
+
+
+class LeaseTable:
+    """Leases acquired per in-flight request and never released by any
+    method — the registry only ever grows."""
+
+    def __init__(self, ch):
+        self._ch = ch
+        self._leases = {}
+
+    def acquire(self, oid):
+        # BUG: no method of the class ever removes entries
+        self._leases[oid] = self._leases.get(oid, 0) + 1
+        self._ch.send("lease_evt", oid)
+
+    def _reader_loop(self):
+        while True:
+            tag, payload = self._ch.recv()
+            op = payload[0]
+            if op == "lease_probe":
+                self._ch.send("lease_evt", len(self._leases))

@@ -1,0 +1,51 @@
+"""Negative control: the same registries with death/teardown coverage."""
+import threading
+
+
+class GoodPendingTable:
+    def __init__(self, ch):
+        self._ch = ch
+        self._pending = {}
+
+    def register(self, req_id):
+        slot = [threading.Event(), None]
+        self._pending[req_id] = slot
+        return slot
+
+    def complete(self, req_id, value):
+        slot = self._pending.pop(req_id, None)
+        if slot is not None:
+            slot[1] = value
+            slot[0].set()
+
+    def fail_all(self, cause):
+        # the death path: every parked waiter learns immediately
+        gone, self._pending = self._pending, {}
+        for slot in gone.values():
+            slot[1] = cause
+            slot[0].set()
+
+    def close(self):
+        self.fail_all(ConnectionError("closed"))
+        self._ch.send("bye")
+
+
+class GoodLeaseTable:
+    def __init__(self, ch):
+        self._ch = ch
+        self._leases = {}
+
+    def acquire(self, oid):
+        self._leases[oid] = self._leases.get(oid, 0) + 1
+        self._ch.send("lease_evt", oid)
+
+    def release(self, oid):
+        n = self._leases.get(oid, 0) - 1
+        if n <= 0:
+            self._leases.pop(oid, None)
+        else:
+            self._leases[oid] = n
+
+    def on_peer_dead(self, oids):
+        for oid in oids:
+            self._leases.pop(oid, None)

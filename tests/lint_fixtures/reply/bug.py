@@ -1,0 +1,36 @@
+"""Planted reply-completeness bugs: a handler branch that never
+replies, an early return that strands the requester, and a risky call
+outside the try/except-reply wrapper."""
+
+
+class StoreServer:
+    def __init__(self):
+        self._data = {}
+        self._ready = False
+
+    def handle_store(self, ch, req_id, op, args):
+        # BUG (exception path): _audit runs OUTSIDE the try — if it
+        # raises, the requester's slot is never failed
+        self._audit(op)
+        try:
+            if op == "get":
+                ch.send("rep", req_id, True, self._data.get(args[0]))
+            elif op == "put":
+                # BUG (missing branch reply): the put branch stores the
+                # value but never acknowledges — the requester waits
+                # out its full timeout
+                self._data[args[0]] = args[1]
+            else:
+                ch.send("rep", req_id, False, ValueError(op))
+        except Exception as e:
+            ch.send("rep", req_id, False, e)
+
+    def handle_query(self, ch, req_id, q):
+        if not self._ready:
+            # BUG (early return): guard path drops the request
+            return
+        ch.send("rep", req_id, True, list(self._data))
+
+    def _audit(self, op):
+        if op not in ("get", "put", "query"):
+            raise ValueError(f"unknown op {op}")

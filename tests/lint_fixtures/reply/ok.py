@@ -1,0 +1,48 @@
+"""Negative control: handlers that reply, fail the slot, or delegate
+on every path including exception paths."""
+import threading
+
+
+class GoodServer:
+    def __init__(self):
+        self._data = {}
+        self._pending = {}
+        self._seq = 0
+
+    def handle_store(self, ch, req_id, op, args):
+        try:
+            self._audit(op)
+            if op == "get":
+                ch.send("rep", req_id, True, self._data.get(args[0]))
+            elif op == "put":
+                self._data[args[0]] = args[1]
+                ch.send("rep", req_id, True, None)
+            else:
+                ch.send("rep", req_id, False, ValueError(op))
+        except Exception as e:
+            ch.send("rep", req_id, False, e)
+
+    def handle_query(self, ch, req_id, q):
+        if not self._data:
+            # guard path still answers: the slot is failed, not dropped
+            ch.send("rep", req_id, False, RuntimeError("not ready"))
+            return
+        ch.send("rep", req_id, True, list(self._data))
+
+    def park(self, payload):
+        # delegation: parking the id in a registry discharges the
+        # obligation here (death-path-completeness owns the registry)
+        req_id, rest = payload
+        slot = [threading.Event(), None]
+        self._pending[req_id] = slot
+        return slot
+
+    def reply_now(self, ch, req_id, value):
+        try:
+            ch.send("rep", req_id, True, value)
+        except OSError:
+            pass  # requester went away: nothing left to answer
+
+    def _audit(self, op):
+        if op not in ("get", "put", "query"):
+            raise ValueError(f"unknown op {op}")

@@ -1,0 +1,73 @@
+"""Planted rpc-cycle bugs: a synchronous request-reply cycle between
+two process classes AND a handler that blocks on a reverse RPC toward
+its requesting class."""
+import threading
+
+
+class AlphaServer:
+    def __init__(self, rpc):
+        self.rpc = rpc
+        self.stats = {}
+
+    def _reader_loop(self, ch):
+        while True:
+            tag, payload = ch.recv()
+            req_id, op, *args = payload
+            if op == "alpha_ping":
+                self._handle_ping(ch, req_id)
+            elif op == "alpha_stats":
+                self._reply(ch, req_id, dict(self.stats))
+            elif op == "alpha_sync":
+                self._handle_sync(ch, req_id)
+
+    def _handle_ping(self, ch, req_id):
+        self._reply(ch, req_id, "pong-payload")
+
+    def _handle_sync(self, ch, req_id):
+        # BUG: a synchronous reverse RPC toward the class that sent
+        # alpha_sync — if BetaServer issues alpha_sync from the thread
+        # that serves beta_probe, both sides park forever
+        val = self.rpc.call("breq", "beta_probe")
+        self._reply(ch, req_id, val)
+
+    def _reply(self, ch, req_id, value):
+        try:
+            ch.send("rep", req_id, True, value)
+        except OSError:
+            pass
+
+
+class BetaServer:
+    def __init__(self, rpc):
+        self.rpc = rpc
+
+    def run_round(self):
+        # synchronous request toward AlphaServer
+        return self.rpc.call("areq", "alpha_sync")
+
+    def poke(self):
+        return self.rpc.call("areq", "alpha_ping")
+
+    def _reader_loop(self, ch):
+        while True:
+            tag, payload = ch.recv()
+            req_id, op, *args = payload
+            if op == "beta_probe":
+                self._reply(ch, req_id, 1)
+            elif op == "beta_other":
+                self._reply(ch, req_id, 2)
+            elif op == "beta_extra":
+                self._reply(ch, req_id, 3)
+
+    def _reply(self, ch, req_id, value):
+        try:
+            ch.send("rep", req_id, True, value)
+        except OSError:
+            pass
+
+
+def _sender_of_dead_ops(rpc):
+    # keep the >=3-op ladders alive for protocol-completeness symmetry
+    rpc.call("areq", "alpha_stats")
+    rpc.call("breq", "beta_other")
+    rpc.call("breq", "beta_extra")

@@ -1,0 +1,44 @@
+"""Negative control: same two-class shape, but the reverse path is a
+fire-and-forget notification (no blocking round-trip), so there is no
+synchronous cycle and no reverse-RPC block."""
+
+
+class GammaServer:
+    def __init__(self, rpc):
+        self.rpc = rpc
+
+    def _reader_loop(self, ch):
+        while True:
+            tag, payload = ch.recv()
+            req_id, op, *args = payload
+            if op == "gamma_ping":
+                self._reply(ch, req_id, "pong-payload")
+            elif op == "gamma_sync":
+                self._handle_sync(ch, req_id)
+
+    def _handle_sync(self, ch, req_id):
+        # one-way notification toward the requester: no reply expected,
+        # nothing blocks (the function performs no wait)
+        ch.send("delta_note", "refreshed")
+        self._reply(ch, req_id, True)
+
+    def _reply(self, ch, req_id, value):
+        try:
+            ch.send("rep", req_id, True, value)
+        except OSError:
+            pass
+
+
+class DeltaClient:
+    def __init__(self, rpc):
+        self.rpc = rpc
+
+    def run_round(self):
+        return self.rpc.call("greq", "gamma_sync")
+
+    def _reader_loop(self, ch):
+        while True:
+            tag, payload = ch.recv()
+            op = payload[0]
+            if op == "delta_note":
+                self._note = payload[1]

@@ -217,3 +217,59 @@ def test_pbt_runs(ray_init, tmp_path):
         storage_path=str(tmp_path), checkpoint_freq=2)
     assert len(results) == 4
     assert results.get_best_result().metrics["score"] > 0
+
+
+def test_fetch_reads_done_before_draining_queue():
+    """Lost-result race regression (the tier-1 tune load flake): the
+    trainable thread puts its final report THEN sets _done; fetch must
+    therefore read _done BEFORE draining, or a put+done landing
+    between the drain and the flag read reports done=True with
+    results still queued — the controller stops the trial and the
+    final reports (e.g. the best score) are silently dropped.
+
+    Drives the raw actor class (no cluster) with a queue whose
+    get_nowait simulates the racing thread: the first drain sees
+    nothing, and the moment the drain finishes, the final result and
+    the done flag appear.  Order-correct fetch reports done=False for
+    that round and picks up the result (with done) next round;
+    order-broken fetch loses it."""
+    import queue as _q
+
+    from ray_tpu.tune.controller import _FunctionTrainableActor
+
+    raw = _FunctionTrainableActor._cls
+    actor = object.__new__(raw)
+    actor._error = None
+    actor._done = False
+
+    class RacingQueue:
+        """Empty until the first full drain completes; then the
+        trainable 'thread' publishes its final result and sets done."""
+
+        def __init__(self, owner):
+            self.owner = owner
+            self.items = []
+            self.raced = False
+
+        def get_nowait(self):
+            if self.items:
+                return self.items.pop(0)
+            if not self.raced:
+                # the drain just observed "empty": NOW the trainable
+                # finishes — final result enqueued, done flag set
+                self.raced = True
+                self.items.append({"score": 42})
+                self.owner._done = True
+            raise _q.Empty
+
+    actor._queue = RacingQueue(actor)
+
+    results, done, error = raw.fetch(actor)
+    # the done flag was read before the race fired: this round must
+    # NOT claim completion (the result arrives with the next round)
+    assert done is False, (
+        "fetch read _done after draining: the final result would be "
+        "dropped when the controller stops the trial on done=True")
+    results2, done2, _ = raw.fetch(actor)
+    assert done2 is True
+    assert (results + results2) == [{"score": 42}]
