@@ -34,7 +34,8 @@ class Cluster:
             resources.setdefault("CPU", args.pop("num_cpus", 4))
             if "num_tpus" in args:
                 resources["TPU"] = args.pop("num_tpus")
-            self.head = Head(resources, labels=args.pop("labels", None))
+            self.head = Head(resources, labels=args.pop("labels", None),
+                             storage=args.pop("storage", None))
             api._head = self.head
             if connect:
                 self.connect()

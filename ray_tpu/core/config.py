@@ -124,7 +124,17 @@ class Config:
     # retained for reconstruction after the sealing node dies (reference:
     # object_recovery_manager.h + max_lineage_bytes-style cap); 0 = off
     direct_lineage_max: int = 4096
+    # actor re-creation backoff: the first restart waits delay_ms, each
+    # further restart doubles it up to max_delay_ms (reference:
+    # gcs_actor_manager restart backoff); delay_ms=0 restarts immediately
     actor_restart_delay_ms: int = 0
+    actor_restart_max_delay_ms: int = 10_000
+    # head restart: how long a daemon keeps re-dialing a bounced head
+    # before giving up and shutting down, and how long the restarted head
+    # waits for known daemons to re-register before declaring them dead
+    # (their actors then fail over per max_restarts)
+    head_rejoin_timeout_s: float = 30.0
+    daemon_rejoin_grace_s: float = 10.0
     # node prober: period * threshold = grace before a silent daemon is
     # declared dead (generous default — pongs share the daemon's handler
     # pool, so a saturated 1-core host must not look dead)
@@ -189,6 +199,11 @@ class Config:
     # head-freeness proof: with this at >=50, direct actor-call p50 and
     # cross-process stream items/s must not move (bench_core --actor-bench)
     test_head_delay_ms: int = 0
+    # deterministic chaos harness (core/fault_injection.py): named failure
+    # points armed with crash/raise/drop/fail/delay actions at exact hit
+    # counts, e.g. "worker.exec.boom=crash@2;wire.send.sync=drop@1+".
+    # Ships with the Config snapshot, so one env var arms every process.
+    test_fault_spec: str = ""
 
     # ---- debug assertions ----
     # dynamic lock-order checking (core/lock_debug.py): runtime locks

@@ -524,6 +524,10 @@ class DirectTaskManager:
         if settled_spec is not None or sealed_oids:
             self._wake_waiters()
         if actor_handoff is not None:
+            # the ordered submitter either parks the call for resubmission
+            # or seals an ATTRIBUTED ActorDiedError itself (it can resolve
+            # the actor's death cause / restarting state; this manager
+            # can't) — True means it took ownership either way
             handled = (self._actor_failed_cb is not None
                        and self._actor_failed_cb(actor_handoff, err_name))
             if not handled:
@@ -927,7 +931,8 @@ class _ActorRoute:
     """Per-(owner, actor) submission state."""
 
     __slots__ = ("seq", "loc", "state", "queue", "ready", "inflight",
-                 "parked", "death_cause", "send_buf", "sender_active")
+                 "parked", "death_cause", "send_buf", "sender_active",
+                 "loc_bounces", "last_bounce_loc")
 
     def __init__(self):
         self.seq = 0
@@ -943,6 +948,14 @@ class _ActorRoute:
         # ready prefix moves into send_buf and exactly ONE thread drains it
         self.send_buf: List[TaskSpec] = []
         self.sender_active = False
+        # consecutive ActorMissingError bounces against the same resolved
+        # location: the FSM lags the node's worker table by a beat, and
+        # resubmitting into the stale answer instantly would spin the
+        # submit->bounce->resolve cycle hot (GIL-starving the very reader
+        # thread that would update the FSM). Past a few bounces the
+        # resolver holds the route until its backoff tick instead.
+        self.loc_bounces = 0
+        self.last_bounce_loc: Optional[str] = None
 
 
 class DirectActorSubmitter:
@@ -1077,8 +1090,10 @@ class DirectActorSubmitter:
     # ------------------------------------------------------------ failure
 
     def _on_call_failed(self, spec: TaskSpec, err_name: str) -> bool:
-        """Transport/executor failure for an in-flight call. True = parked
-        for resubmission; False = let the manager seal ActorDiedError."""
+        """Transport/executor failure for an in-flight call. True = this
+        submitter took ownership: parked for resubmission, or sealed an
+        attributed ActorDiedError (death cause + restarting state from
+        the actor FSM). False = let the manager seal a generic error."""
         aid = spec.actor_id
         retry_ok = (err_name in _ACTOR_LOC_ERRS
                     or spec.attempt < spec.max_retries)
@@ -1088,14 +1103,77 @@ class DirectActorSubmitter:
                 if rt is not None:
                     rt.inflight.pop(spec.task_id, None)
                     self._drained_cv.notify_all()
+                if rt is not None and rt.state == "DEAD":
+                    dead_cause = rt.death_cause or "actor is dead"
+                else:
+                    dead_cause = None
+            else:
+                dead_cause = ()  # sentinel: retry path below
+        if dead_cause is None or isinstance(dead_cause, str):
+            # retries exhausted (or route gone): seal with the actor
+            # FSM's attributed cause; flag restarting when the actor
+            # itself is coming back but THIS call's budget is spent
+            from .exceptions import ActorDiedError
+
+            cause, restarting = dead_cause, False
+            if cause is None:
+                # the failure reply and the crash report race out of the
+                # actor's node: give the FSM a bounded moment to learn
+                # the attributed cause before sealing. Kept SHORT — this
+                # runs on the owner's reply-processing chain, so every
+                # reply behind it waits; the node reports the crash to
+                # the head BEFORE replying (node.py _on_worker_dead), so
+                # the first resolve normally already has the cause.
+                import time as _time
+
+                deadline = _time.monotonic() + 0.5
+                while True:
+                    try:
+                        info = self._resolve(aid)
+                    except Exception:
+                        info = None
+                    if info is not None:
+                        cause = info.get("death_cause")
+                        restarting = info.get("state") in (
+                            "RESTARTING", "PENDING_CREATION")
+                    if (info is None or cause
+                            or info.get("state") == "DEAD"
+                            or _time.monotonic() >= deadline):
+                        break
+                    _time.sleep(0.05)
+            self._mgr.seal_error_local(spec, ActorDiedError(
+                aid, cause or f"actor call failed ({err_name}), "
+                              "retries exhausted",
+                restarting=restarting))
+            return True
+        with self._lock:
+            rt = self._routes.get(aid)
+            if rt is None:
                 return False
             rt.inflight.pop(spec.task_id, None)
-            if err_name not in _ACTOR_LOC_ERRS:
-                spec.attempt += 1  # executed-and-died consumes a retry
-            rt.parked.append(spec)
-            rt.state = "WAITING"
-            rt.loc = None
-            self._resolve_queue.add(aid)
+            if rt.state == "DEAD":
+                # the route died between the two lock windows: parking
+                # now would strand the call forever (_actor_dead already
+                # flushed parked+queued)
+                died_between = rt.death_cause or "actor is dead"
+            else:
+                died_between = None
+                if err_name not in _ACTOR_LOC_ERRS:
+                    spec.attempt += 1  # executed-and-died consumes a retry
+                    rt.loc_bounces = 0
+                else:
+                    rt.loc_bounces += 1
+                    rt.last_bounce_loc = spec.actor_node_hex or rt.loc
+                rt.parked.append(spec)
+                rt.state = "WAITING"
+                rt.loc = None
+                self._resolve_queue.add(aid)
+        if died_between is not None:
+            from .exceptions import ActorDiedError
+
+            self._mgr.seal_error_local(
+                spec, ActorDiedError(aid, died_between))
+            return True
         self._ensure_resolver()
         self._resolve_kick.set()
         return True
@@ -1130,6 +1208,21 @@ class DirectActorSubmitter:
                     continue  # control link hiccup; retry next round
                 if info is not None and info.get("state") == "ALIVE" \
                         and info.get("node_hex"):
+                    with self._lock:
+                        rt = self._routes.get(aid)
+                        stale = (rt is not None and rt.loc_bounces >= 3
+                                 and info["node_hex"] == rt.last_bounce_loc)
+                        if stale:
+                            # the same answer keeps bouncing: hold the
+                            # route THIS round and let the backoff tick
+                            # retry — the FSM (or a bounced head's node
+                            # table) is lagging. The streak DECAYS per
+                            # held round, so the route always resubmits
+                            # again at backoff cadence instead of either
+                            # hot-spinning or parking forever.
+                            rt.loc_bounces -= 1
+                    if stale:
+                        continue
                     self._actor_alive(aid, info["node_hex"])
                     progress = True
                 elif info is None or info.get("state") == "DEAD":
@@ -1196,6 +1289,7 @@ class DirectActorSubmitter:
             rt = self._routes.get(spec.actor_id)
             if rt is not None:
                 rt.inflight.pop(spec.task_id, None)
+                rt.loc_bounces = 0  # the route works: reset the streak
                 self._drained_cv.notify_all()
 
     def remove_call(self, spec: TaskSpec) -> None:

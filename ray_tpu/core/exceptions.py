@@ -42,13 +42,52 @@ class ActorError(TaskError):
     """An actor method raised."""
 
 
-class ActorDiedError(RayTpuError):
-    """The actor is dead (crashed, killed, or exceeded max_restarts)."""
+def format_death_cause(cause: str, node_hex: str | None = None,
+                       pid: int | None = None,
+                       worker_hex: str | None = None) -> str:
+    """The one formatter every death cause goes through: attribute WHERE
+    the death happened (node hex, worker pid/hex) alongside WHY, so no
+    surface — eager call, stream subscriber, compiled-DAG ref — ever
+    reports a bare timeout or an unattributed "actor died". Cause
+    strings travel the wire as text (actor FSM ``death_cause``), so the
+    attribution is baked into the string once, at the process that
+    observed the death."""
+    where = []
+    if node_hex:
+        where.append(f"node {node_hex[:8]}")
+    if pid:
+        where.append(f"worker pid {pid}")
+    if worker_hex:
+        where.append(f"worker {worker_hex[:8]}")
+    return f"{cause} ({', '.join(where)})" if where else cause
 
-    def __init__(self, actor_id=None, reason: str = "actor died"):
+
+class ActorDiedError(RayTpuError):
+    """The actor is dead (crashed, killed, or exceeded max_restarts) —
+    or, with ``restarting=True``, this CALL died with an incarnation
+    that the runtime is restarting (the call's retry budget was
+    exhausted even though the actor itself will come back)."""
+
+    def __init__(self, actor_id=None, reason: str = "actor died",
+                 restarting: bool = False):
         self.actor_id = actor_id
         self.reason = reason
-        super().__init__(reason)
+        self.restarting = restarting
+        msg = reason
+        if actor_id is not None:
+            try:
+                msg = f"actor {actor_id.hex()[:8]}: {reason}"
+            except AttributeError:
+                pass
+        if restarting:
+            msg += " [actor is restarting: new calls will reach the " \
+                   "next incarnation]"
+        super().__init__(msg)
+
+    def __reduce__(self):
+        # default Exception pickling would re-call __init__ with the
+        # formatted message as actor_id — carry the real fields instead
+        return (type(self), (self.actor_id, self.reason, self.restarting))
 
 
 class ActorUnavailableError(RayTpuError):

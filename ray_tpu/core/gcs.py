@@ -97,6 +97,11 @@ class GCS:
 
         self._lock = threading.RLock()
         self._store = store or InMemoryStore()
+        # durable-table writes only happen against a real backend: the
+        # default InMemoryStore would no-op them anyway, but the object
+        # directory rides the seal hot path, so even building the
+        # journal record must be skipped when nothing persists it
+        self._durable = not isinstance(self._store, InMemoryStore)
         self.kv: Dict[str, Dict[bytes, bytes]] = defaultdict(dict)  # namespace -> kv
         self.functions: Dict[str, bytes] = {}  # function_id -> pickled fn/class
         # recover durable tables (reference: GCS restart w/ RedisStoreClient)
@@ -120,6 +125,18 @@ class GCS:
             except Exception:
                 pass
         self.object_dir: Dict[ObjectID, Set[str]] = defaultdict(set)  # oid -> node hexes
+        # ---- restart recovery of the PR-7-era control tables ----------
+        # actor records (incl. pickled creation specs for restartable /
+        # detached actors), the named-actor registry (rebuilt from live
+        # records), the object directory (locations go live again only
+        # when their node re-registers — every lookup filters on
+        # head.nodes membership), and placement specs. The Head decides
+        # what to DO with these (re-create detached actors, fail the
+        # rest); this layer only rehydrates them.
+        self._rehydrate_actors_objdir(recovered)
+        self.recovered_placements: Dict[str, dict] = \
+            dict(recovered.get("placements", {}))
+        self.meta: Dict[str, Any] = dict(recovered.get("meta", {}))
         self.pubsub = PubSub()
         cfg = global_config()
         self.task_events: deque = deque(maxlen=cfg.task_events_max_buffered)
@@ -187,6 +204,23 @@ class GCS:
             return [n for n in self.nodes.values() if n.alive]
 
     # ---- actors (reference: gcs_actor_manager.cc FSM) ----
+    def _persist_actor_locked(self, info: ActorInfo) -> None:
+        """Journal one actor record (reference: the GCS actor table the
+        RedisStoreClient makes restart-durable). ``creation_spec`` is
+        already pickled bytes — the restart seed for detached actors."""
+        if not self._durable:
+            return
+        self._store.put("actors", info.actor_id.binary(), {
+            "name": info.name, "namespace": info.namespace,
+            "class_name": info.class_name, "state": info.state,
+            "node_hex": info.node_hex,
+            "max_restarts": info.max_restarts,
+            "num_restarts": info.num_restarts,
+            "max_task_retries": info.max_task_retries,
+            "death_cause": info.death_cause, "detached": info.detached,
+            "creation_spec": info.creation_spec,
+        })
+
     def register_actor(self, info: ActorInfo) -> None:
         with self._lock:
             self.actors[info.actor_id] = info
@@ -195,6 +229,7 @@ class GCS:
                 if key in self.named_actors:
                     raise ValueError(f"actor name {info.name!r} already taken")
                 self.named_actors[key] = info.actor_id
+            self._persist_actor_locked(info)
 
     def update_actor(self, actor_id: ActorID, **fields_) -> None:
         with self._lock:
@@ -204,6 +239,7 @@ class GCS:
             for k, v in fields_.items():
                 setattr(info, k, v)
             state = fields_.get("state")
+            self._persist_actor_locked(info)
         if state:
             self.pubsub.publish("actor", (actor_id, state))
 
@@ -238,18 +274,31 @@ class GCS:
         self._store.close()
 
     # ---- object directory (reference: ownership_based_object_directory.cc) ----
+    def _persist_objdir_locked(self, oid: ObjectID) -> None:
+        if not self._durable:
+            return
+        locs = self.object_dir.get(oid)
+        if locs:
+            self._store.put("objdir", oid.binary(), sorted(locs))
+        else:
+            self._store.delete("objdir", oid.binary())
+
     def add_object_location(self, oid: ObjectID, node_hex: str) -> None:
         with self._lock:
-            self.object_dir[oid].add(node_hex)
+            locs = self.object_dir[oid]
+            if node_hex not in locs:
+                locs.add(node_hex)
+                self._persist_objdir_locked(oid)
         self.pubsub.publish("object", (oid, node_hex))
 
     def remove_object_location(self, oid: ObjectID, node_hex: str) -> None:
         with self._lock:
             locs = self.object_dir.get(oid)
-            if locs:
+            if locs and node_hex in locs:
                 locs.discard(node_hex)
                 if not locs:
                     del self.object_dir[oid]
+                self._persist_objdir_locked(oid)
 
     def get_object_locations(self, oid: ObjectID) -> Set[str]:
         with self._lock:
@@ -261,11 +310,89 @@ class GCS:
         with self._lock:
             for oid in list(self.object_dir):
                 locs = self.object_dir[oid]
+                if node_hex not in locs:
+                    continue
                 locs.discard(node_hex)
                 if not locs:
                     del self.object_dir[oid]
                     lost.append(oid)
+                self._persist_objdir_locked(oid)
         return lost
+
+    def _rehydrate_actors_objdir(self, recovered: dict) -> None:
+        """The one place durable actor records and object-directory
+        entries become live state — cold-start recovery (__init__) and
+        bounce reload both ride it, so a new journal field can never
+        silently diverge the two paths."""
+        for aid_bin, rec in recovered.get("actors", {}).items():
+            try:
+                info = ActorInfo(
+                    actor_id=ActorID(aid_bin), name=rec.get("name"),
+                    namespace=rec.get("namespace", "default"),
+                    class_name=rec.get("class_name", ""),
+                    state=rec.get("state", "DEAD"),
+                    node_hex=rec.get("node_hex"),
+                    max_restarts=rec.get("max_restarts", 0),
+                    num_restarts=rec.get("num_restarts", 0),
+                    max_task_retries=rec.get("max_task_retries", 0),
+                    death_cause=rec.get("death_cause"),
+                    detached=rec.get("detached", False),
+                    creation_spec=rec.get("creation_spec"))
+                self.actors[info.actor_id] = info
+                if info.name and info.state != "DEAD":
+                    self.named_actors[(info.namespace, info.name)] = \
+                        info.actor_id
+            except Exception:
+                pass  # one unreadable record must not poison recovery
+        for oid_bin, hexes in recovered.get("objdir", {}).items():
+            try:
+                self.object_dir[ObjectID(oid_bin)] = set(hexes)
+            except Exception:
+                pass
+
+    def reload_from_store(self) -> None:
+        """Head-bounce support: REPLACE the durable-table views with what
+        the journal actually holds — the restarted head must run off
+        recovered state, not off conveniently-surviving process memory
+        (that is what makes the bounce an honest persistence test). The
+        in-memory and journaled views are written synchronously, so on a
+        healthy journal this round-trips; a divergence is exactly the
+        bug the chaos suite exists to catch. No-op without a durable
+        backend (daemon replay alone carries an in-memory bounce)."""
+        if not self._durable:
+            return
+        recovered = self._store.load()
+        with self._lock:
+            self.kv.clear()
+            for (ns, key), value in recovered.get("kv", {}).items():
+                self.kv[ns][key] = value
+            self.functions = dict(recovered.get("functions", {}))
+            self.actors.clear()
+            self.named_actors.clear()
+            self.object_dir.clear()
+            self._rehydrate_actors_objdir(recovered)
+            self.meta = dict(recovered.get("meta", {}))
+
+    # ---- restart metadata + placement specs (durable) ----
+    def set_meta(self, key: str, value: Any) -> None:
+        """Small durable restart metadata: head epoch, deferred-delete
+        set, daemon lease views (journaled on their natural cadence)."""
+        with self._lock:
+            self.meta[key] = value
+            if self._durable:
+                self._store.put("meta", key, value)
+
+    def persist_placement(self, pg_id_hex: str,
+                          rec: Optional[dict]) -> None:
+        """Journal (or, with ``rec=None``, retire) one placement-group
+        spec — the restart seed for re-reserving bundles."""
+        if not self._durable:
+            return
+        with self._lock:
+            if rec is None:
+                self._store.delete("placements", pg_id_hex)
+            else:
+                self._store.put("placements", pg_id_hex, rec)
 
     # ---- task events (reference: gcs_task_manager.h) ----
     def record_task_event(self, ev: TaskEvent) -> None:

@@ -5,21 +5,36 @@ Reference: the GCS's StoreClient abstraction —
 default, state dies with the process) vs ``RedisStoreClient``
 (redis_store_client.h:33, enables GCS restart recovery). Same split here:
 :class:`InMemoryStore` is a no-op sink; :class:`FileStore` journals every
-durable-table write (KV, function registry, job history, workflow-style
-metadata) to an append-only log with periodic snapshot compaction, and a
-restarted head (``ray_tpu.init(storage=...)``) replays it.
+durable-table write (KV, function registry, actor/placement records, the
+object directory, job history) to an append-only log with periodic
+snapshot compaction, and a restarted head (``ray_tpu.init(storage=...)``)
+replays it.
 
 Redis isn't in this environment (and a TPU-pod head has a local disk /
 NFS mount), so the durable backend is a file journal — same recovery
 contract, zero extra services.
+
+Crash safety: journal records are framed (magic + length + CRC32 over the
+pickled payload), so a process dying mid-append leaves a torn tail the
+next replay detects, keeps everything before, and TRUNCATES away — the
+write handle then appends after the last good record instead of after
+torn garbage (which would poison every later record). Snapshot compaction
+is fsync'd (file + directory) before the journal resets, so a crash
+between the two never loses acknowledged writes.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
+import struct
 import threading
-from typing import Any, Dict, Iterator, Optional, Tuple
+import zlib
+from typing import Any, Dict, Tuple
+
+# journal frame: magic + u32 payload length + u32 crc32(payload)
+_MAGIC = b"\xabRJ1"
+_FRAME_HDR = struct.Struct("<4sII")
 
 
 class GcsStore:
@@ -49,13 +64,25 @@ class InMemoryStore(GcsStore):
         return {}
 
 
-class FileStore(GcsStore):
-    """Append-only journal + snapshot under a directory.
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass  # e.g. directories not fsync-able on this fs
 
-    Layout: ``snapshot.pkl`` (full table dump) + ``journal.pkl`` (stream of
-    pickled ("put"|"del", table, key, value) records since the snapshot).
-    Writes append+flush; after ``compact_every`` journal records the state
-    is re-snapshotted and the journal truncated.
+
+class FileStore(GcsStore):
+    """Append-only framed journal + snapshot under a directory.
+
+    Layout: ``snapshot.pkl`` (full table dump) + ``journal.pkl`` (framed
+    records of pickled ("put"|"del", table, key, value) tuples since the
+    snapshot). Writes append+flush; after ``compact_every`` journal
+    records the state is re-snapshotted (fsync'd) and the journal
+    truncated.
     """
 
     def __init__(self, path: str, compact_every: int = 1000):
@@ -65,11 +92,20 @@ class FileStore(GcsStore):
         self._journal_path = os.path.join(path, "journal.pkl")
         self._compact_every = compact_every
         self._lock = threading.Lock()
-        self._tables = self._replay()
+        self._tables, good_end = self._replay()
+        # torn/truncated tail from a crash mid-append: cut the journal
+        # back to the last whole record BEFORE reopening for append —
+        # appending after torn bytes would poison every later record
+        if os.path.exists(self._journal_path) \
+                and os.path.getsize(self._journal_path) > good_end:
+            with open(self._journal_path, "r+b") as f:
+                f.truncate(good_end)
         self._journal = open(self._journal_path, "ab")
         self._since_compact = 0
 
-    def _replay(self) -> Dict[str, Dict[Any, Any]]:
+    def _replay(self) -> Tuple[Dict[str, Dict[Any, Any]], int]:
+        """Replay snapshot + journal. Returns (tables, good_end): the
+        journal byte offset after the last whole, checksum-valid record."""
         tables: Dict[str, Dict[Any, Any]] = {}
         if os.path.exists(self._snap_path):
             try:
@@ -77,25 +113,65 @@ class FileStore(GcsStore):
                     tables = pickle.load(f)
             except Exception:
                 tables = {}
+        good_end = 0
         if os.path.exists(self._journal_path):
+            with open(self._journal_path, "rb") as f:
+                head = f.read(4)
+                f.seek(0)
+                if head and head != _MAGIC:
+                    # legacy unframed journal (pre-crash-safety format):
+                    # raw pickle stream, replayed with per-record offset
+                    # tracking so a torn tail still truncates cleanly
+                    good_end = self._replay_legacy(f, tables)
+                else:
+                    good_end = self._replay_framed(f, tables)
+        return tables, good_end
+
+    @staticmethod
+    def _apply(tables: Dict[str, Dict[Any, Any]], rec) -> None:
+        op, table, key, value = rec
+        t = tables.setdefault(table, {})
+        if op == "put":
+            t[key] = value
+        else:
+            t.pop(key, None)
+
+    def _replay_framed(self, f, tables) -> int:
+        good_end = 0
+        while True:
+            hdr = f.read(_FRAME_HDR.size)
+            if len(hdr) < _FRAME_HDR.size:
+                break  # clean EOF or torn header
+            magic, length, crc = _FRAME_HDR.unpack(hdr)
+            if magic != _MAGIC:
+                break  # torn/garbage tail
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                break  # crash mid-append: partial or corrupt payload
             try:
-                with open(self._journal_path, "rb") as f:
-                    while True:
-                        try:
-                            op, table, key, value = pickle.load(f)
-                        except EOFError:
-                            break
-                        t = tables.setdefault(table, {})
-                        if op == "put":
-                            t[key] = value
-                        else:
-                            t.pop(key, None)
+                rec = pickle.loads(payload)
             except Exception:
-                pass  # torn tail record: keep what replayed cleanly
-        return tables
+                break  # checksummed but unreadable (version skew): stop
+            self._apply(tables, rec)
+            good_end = f.tell()
+        return good_end
+
+    def _replay_legacy(self, f, tables) -> int:
+        good_end = 0
+        try:
+            while True:
+                rec = pickle.load(f)
+                self._apply(tables, rec)
+                good_end = f.tell()
+        except Exception:  # torn tail (EOFError/UnpicklingError): keep prefix
+            pass
+        return good_end
 
     def _append(self, record: Tuple) -> None:
-        pickle.dump(record, self._journal)
+        payload = pickle.dumps(record)
+        self._journal.write(_FRAME_HDR.pack(_MAGIC, len(payload),
+                                            zlib.crc32(payload)))
+        self._journal.write(payload)
         self._journal.flush()
         self._since_compact += 1
         if self._since_compact >= self._compact_every:
@@ -105,9 +181,17 @@ class FileStore(GcsStore):
         tmp = self._snap_path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(self._tables, f)
+            f.flush()
+            os.fsync(f.fileno())  # snapshot durable BEFORE it replaces
         os.replace(tmp, self._snap_path)
+        _fsync_dir(self.dir)  # the rename itself must survive a crash
         self._journal.close()
         self._journal = open(self._journal_path, "wb")
+        # the truncation must be durable before new records append: a
+        # crash here must not replay OLD journal records over the NEW
+        # snapshot they are already folded into
+        self._journal.flush()
+        os.fsync(self._journal.fileno())
         self._since_compact = 0
 
     def put(self, table: str, key: Any, value: Any) -> None:
@@ -126,6 +210,11 @@ class FileStore(GcsStore):
 
     def close(self) -> None:
         with self._lock:
+            try:
+                self._journal.flush()
+                os.fsync(self._journal.fileno())
+            except (OSError, ValueError):
+                pass
             try:
                 self._journal.close()
             except Exception:

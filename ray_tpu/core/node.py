@@ -23,6 +23,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from . import fault_injection
 from .config import global_config
 from .ids import NodeID, ObjectID, WorkerID
 from .object_store import LocalObjectStore
@@ -169,6 +170,10 @@ class Node:
 
     def dispatch_to_worker(self, worker_id: WorkerID, spec: TaskSpec) -> bool:
         """Direct dispatch to a specific (actor) worker, bypassing leasing."""
+        # chaos point: "node.dispatch_worker=fail@N" bounces this dispatch
+        # as if the worker were already gone (provably-undelivered path)
+        if fault_injection.fire("node.dispatch_worker") == "fail":
+            return False
         with self._lock:
             w = self._workers.get(worker_id)
             if w is None or w.state == "dead":
@@ -510,6 +515,19 @@ class Node:
             except Exception:
                 pass
 
+    def replay_snapshot(self) -> dict:
+        """What this node replays to a RESTARTED head at re-registration
+        (node_daemon rejoin): the store manifest (rebuilds the object
+        directory), live holder leases (re-guards deferred deletes), and
+        hosted actors (revives their ALIVE records + routing). All of it
+        is node-resident state the head merely mirrors — the same tables
+        the 1 s syncer keeps fresh, shipped once, in full."""
+        with self._lock:
+            actors = list(self._actor_workers.items())
+            leases = list(self._arg_leases.keys())
+        objects = [row[0] for row in self.store.object_infos()]
+        return {"objects": objects, "leases": leases, "actors": actors}
+
     def has_lease(self, oid: ObjectID) -> bool:
         """Lock-free: an in-flight direct task through this node leases
         ``oid`` (consulted by the in-process head's delete decisions)."""
@@ -587,15 +605,18 @@ class Node:
             slot[1] = rep
             slot[0].set()
 
-    def _fail_worker_ssubs(self, worker_id) -> None:
+    def _fail_worker_ssubs(self, worker_id, pid=None) -> None:
         """The owner worker died: its parked subscribers learn now."""
+        from .exceptions import format_death_cause
+
         with self._ssub_lock:
             gone = [(rid, s) for rid, s in self._ssub_pending.items()
                     if s[2] == worker_id]
             for rid, _s in gone:
                 self._ssub_pending.pop(rid, None)
+        cause = format_death_cause("stream owner worker died", self.hex, pid)
         for _rid, slot in gone:
-            slot[1] = ("gone", "stream owner worker died")
+            slot[1] = ("gone", cause)
             slot[0].set()
 
     def serve_stream_sub(self, owner, task_id, index: int,
@@ -690,16 +711,20 @@ class Node:
         """Round-trip to the owner worker on THIS node over its channel."""
         if isinstance(worker_id, bytes):
             worker_id = WorkerID(worker_id)  # routes carry raw id bytes
+        from .exceptions import format_death_cause
+
         with self._lock:
             w = self._workers.get(worker_id)
         if w is None or w.state == "dead":
-            return ("gone", "stream owner worker died")
+            return ("gone", format_death_cause("stream owner worker died",
+                                               self.hex))
         req_id, slot = self._ssub_slot(worker_id)
         try:
             w.channel.send("ssub", req_id, task_id, index, timeout)
         except OSError:
             self._ssub_reply(req_id, None)
-            return ("gone", "stream owner worker died")
+            return ("gone", format_death_cause("stream owner worker died",
+                                               self.hex, w.pid))
         if not slot[0].wait((timeout or 0) + 5.0):
             with self._ssub_lock:
                 self._ssub_pending.pop(req_id, None)
@@ -1620,11 +1645,13 @@ class Node:
             w.state = "dead"
             self._workers.pop(w.worker_id, None)
             lost = self._drop_actor_direct_locked(w)
-        self._fail_worker_ssubs(w.worker_id)
+        self._fail_worker_ssubs(w.worker_id, w.pid)
+        # head first (same reasoning as _on_worker_dead): owners failing
+        # these calls read the FSM for the attributed death cause
+        self.head.on_worker_exit(self, w)
         for origin, spec, err in lost:
             self._task_departed(spec.task_id)
             self._reply_direct(origin, spec.task_id, err, [])
-        self.head.on_worker_exit(self, w)
 
     def _drop_actor_direct_locked(self, w: WorkerHandle):
         """Remove a dead actor worker from the routing index and collect
@@ -1666,8 +1693,18 @@ class Node:
                 self._direct_stream_oids.pop(tid, None)
             lost_actor = self._drop_actor_direct_locked(w)
         w.channel.close()
-        self._fail_worker_ssubs(w.worker_id)
+        self._fail_worker_ssubs(w.worker_id, w.pid)
         head_assigned = [e for e in assigned if e[0].task_id not in direct_ids]
+        # head FIRST, owner replies second: the owner's failure handling
+        # (possibly inline on THIS thread for an in-process driver)
+        # consults the actor FSM for the attributed death cause and the
+        # restart decision — reporting the crash after the replies would
+        # make it read a stale ALIVE
+        if head_assigned:
+            for spec, binding, _attempt in head_assigned:
+                self.head.on_worker_crashed(self, w, spec, binding, prev_state)
+        else:
+            self.head.on_worker_crashed(self, w, None, None, prev_state)
         # direct tasks: the OWNER retries — report the crash straight back
         for origin, spec, _t0 in direct:
             self._task_departed(spec.task_id)
@@ -1675,11 +1712,6 @@ class Node:
         for origin, spec, err in lost_actor:
             self._task_departed(spec.task_id)
             self._reply_direct(origin, spec.task_id, err, [])
-        if head_assigned:
-            for spec, binding, _attempt in head_assigned:
-                self.head.on_worker_crashed(self, w, spec, binding, prev_state)
-        else:
-            self.head.on_worker_crashed(self, w, None, None, prev_state)
         self._pump()
 
     def cancel_task(self, task_id, worker_id: Optional[WorkerID],

@@ -31,10 +31,33 @@ from .ids import JobID, NodeID, ObjectID
 from .protocol import Channel, RpcClient, connect, parse_address
 
 
-class RemoteHead:
-    """Daemon-side proxy implementing the Head interface a Node calls."""
+# daemon->head messages that MUST NOT be lost across a head bounce: they
+# carry state the head can't re-derive (results, seals, death reports,
+# batched direct events). Buffered while the link is down and replayed in
+# order after re-registration. Telemetry (sync/metrics/logs/pongs) and
+# refs reports re-arrive on their own cadence and are droppable.
+_RELIABLE_TAGS = frozenset({
+    "task_finished", "sealed", "sealed_payload", "stream_item",
+    "worker_exit", "worker_crashed", "dispatch_worker_failed",
+    "devents", "cevents", "pub1",
+})
+_OUTBOX_MAX = 10_000
 
-    def __init__(self, channel: Channel, welcome: dict, cluster_key: bytes):
+
+class RemoteHead:
+    """Daemon-side proxy implementing the Head interface a Node calls.
+
+    Survives a head bounce: on link EOF (or an explicit ``reregister``
+    from a restarted head that spotted our stale epoch) the reader
+    re-dials the head address, re-registers under the SAME node hex with
+    a replay snapshot (store manifest + holder leases + hosted actors —
+    Node.replay_snapshot), and flushes the reliable-message outbox, so
+    the restarted head converges to the pre-crash view without any
+    daemon-resident state having moved."""
+
+    def __init__(self, channel: Channel, welcome: dict, cluster_key: bytes,
+                 address=None):
+        from collections import deque
         from concurrent.futures import ThreadPoolExecutor
 
         self.channel = channel
@@ -42,6 +65,14 @@ class RemoteHead:
         self.job_id = JobID(welcome["job_id"])
         self.node_hex: str = welcome["node_hex"]
         self.cluster_key = cluster_key
+        self.address = address  # head endpoint, re-dialed after a bounce
+        self.epoch = welcome.get("epoch", 1)  # head incarnation
+        # the node_ready payload, retained so re-registration can resend
+        # it (main() fills it in before the first send)
+        self.ready_payload: dict = {}
+        self._outbox: "deque" = deque(maxlen=_OUTBOX_MAX)
+        self._outbox_lock = threading.Lock()
+        self._closing = False
         # no head-backed pin view: store eviction/delete protection on a
         # daemon is the node-local holder lease (Node._arg_leases) — the
         # old per-object is_pinned head RPC is gone from the wire
@@ -70,6 +101,7 @@ class RemoteHead:
     def close(self) -> None:
         """Daemon teardown: drop the head link and reap the handler
         machinery (reader exits on channel EOF / the shutdown tag)."""
+        self._closing = True
         try:
             self.channel.close()
         except Exception:
@@ -83,9 +115,29 @@ class RemoteHead:
 
     def _send(self, tag: str, *payload) -> None:
         try:
+            if self._outbox:
+                # opportunistic drain BEFORE this message (keeps order):
+                # covers stragglers that failed into the outbox after
+                # _reconnect's bounded flush — without this, a seal
+                # parked in that window would wait for the NEXT
+                # disconnect to ever be delivered
+                with self._outbox_lock:
+                    while self._outbox:
+                        t, p = self._outbox[0]
+                        # deliberate: the lock exists precisely to
+                        # serialize outbox drains (peek-send-pop must
+                        # not interleave across threads or messages
+                        # deliver twice); nothing else is taken under it
+                        # graftlint: ignore[blocking-under-lock]
+                        self.channel.send(t, *p)
+                        self._outbox.popleft()
             self.channel.send(tag, *payload)
         except (OSError, EOFError, ValueError):
-            self.stopped.set()
+            # link down (head bouncing, or gone for good): reliable
+            # messages park in the outbox and replay after rejoin; the
+            # reader thread owns reconnection and final-death decisions
+            if tag in _RELIABLE_TAGS and not self._closing:
+                self._outbox.append((tag, payload))
 
     def _read_loop(self) -> None:
         while True:
@@ -93,18 +145,96 @@ class RemoteHead:
                 tag, payload = self.channel.recv()
             except (EOFError, OSError):
                 self.rpc.fail_all(ConnectionError("head link lost"))
-                self.stopped.set()
-                return
+                if self._closing or self.stopped.is_set():
+                    self.stopped.set()
+                    return
+                # head bounce? re-dial and re-register under the same
+                # node hex; only a timed-out reconnect kills the daemon
+                if self.address is None or not self._reconnect():
+                    self.stopped.set()
+                    return
+                continue
             if tag == "rep":
                 self.rpc.handle_reply(*payload)
             elif tag == "shutdown":
+                self._closing = True
                 self.stopped.set()
                 return
+            elif tag == "reregister":
+                # the head restarted and spotted our stale epoch on the
+                # syncer: drop this link; the EOF path re-registers
+                self.rpc.fail_all(ConnectionError("head restarted"))
+                try:
+                    self.channel.close()
+                except Exception:
+                    pass
+                if self.address is None or not self._reconnect():
+                    self.stopped.set()
+                    return
             elif tag in ("dispatch", "dispatch_worker", "cancel",
                          "kill_worker"):
                 self._ordered_pool.submit(self._handle, tag, payload)
             else:
                 self._handler_pool.submit(self._handle, tag, payload)
+
+    def _reconnect(self) -> bool:
+        """Re-dial the bounced head and re-register (same node hex, full
+        replay snapshot), then flush the reliable outbox. Runs on the
+        reader thread; other threads' sends keep failing into the outbox
+        until the swapped-in channel is live."""
+        from .config import global_config
+        from .protocol import check_protocol, connect
+
+        deadline = time.monotonic() + global_config().head_rejoin_timeout_s
+        while time.monotonic() < deadline and not self._closing:
+            try:
+                ch = connect(self.address, self.cluster_key)
+            except Exception:
+                time.sleep(0.3)
+                continue
+            try:
+                ch.send("hello", {"rejoin": self.node_hex})
+                tag, (welcome,) = ch.recv()
+                assert tag == "welcome", tag
+                check_protocol(welcome)
+                if welcome["node_hex"] != self.node_hex:
+                    raise ConnectionError("head did not honor rejoin hex")
+                ready = dict(self.ready_payload)
+                ready["replay"] = (self.node.replay_snapshot()
+                                   if self.node is not None else {})
+                ch.send("node_ready", ready)
+                # replay reliable messages IN ORDER before the swap so
+                # buffered results precede anything sent afterwards.
+                # Peek-send-pop: a send failure mid-flush leaves the
+                # message AT THE FRONT for the next reconnect attempt
+                # (pop-first would silently drop it — the exact lost-seal
+                # bug the outbox exists to prevent)
+                with self._outbox_lock:
+                    while self._outbox:
+                        t, p = self._outbox[0]
+                        ch.send(t, *p)
+                        self._outbox.popleft()
+                self.epoch = welcome.get("epoch", self.epoch + 1)
+                self.channel = ch
+                self.rpc.channel = ch
+                # stragglers that failed into the outbox between the
+                # flush and the swap drain on the next healthy _send
+                # (opportunistic pre-send drain) — nothing is stranded
+                # until "the next disconnect"
+                from ray_tpu.util import events as events_mod
+
+                events_mod.emit(
+                    "INFO", events_mod.SOURCE_NODE,
+                    f"re-registered with restarted head "
+                    f"(epoch {self.epoch})", entity_id=self.node_hex)
+                return True
+            except Exception:
+                try:
+                    ch.close()
+                except Exception:
+                    pass
+                time.sleep(0.3)
+        return False
 
     def _handle(self, tag: str, payload) -> None:
         try:
@@ -444,7 +574,8 @@ def main(argv=None) -> int:
     # adopt the head's config so scheduler/store thresholds agree cluster-wide
     set_global_config(Config.from_json(welcome["config"]))
 
-    head = RemoteHead(channel, welcome, key)
+    head = RemoteHead(channel, welcome, key,
+                      address=parse_address(args.address))
     # this process's cluster events flush over the head link (one-way)
     from ray_tpu.util import events as events_mod
 
@@ -471,13 +602,16 @@ def main(argv=None) -> int:
 
     loopback = node_ip in ("127.0.0.1", "localhost")
     agent = NodeAgent(node, host="127.0.0.1" if loopback else "0.0.0.0")
-    channel.send("node_ready", {
+    # retained on the proxy: re-registration after a head bounce resends
+    # this payload (plus a replay snapshot) under the same node hex
+    head.ready_payload = {
         "resources": resources,
         "labels": labels,
         "object_addr": list(server.address),
         "pid": os.getpid(),
         "agent_addr": [node_ip, agent.address[1]],
-    })
+    }
+    channel.send("node_ready", head.ready_payload)
     from .syncer import NodeSyncer
 
     syncer = NodeSyncer(head, node)

@@ -28,23 +28,26 @@ import threading
 from multiprocessing import connection as mpc
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from .fault_injection import should_drop as _fault_should_drop
+
 
 # Wire protocol version, carried in every welcome handshake (node daemon
 # join, client-driver connect). Bump on any incompatible change to message
 # tags/payload shapes — mixed-version clusters fail fast with a clear
 # error instead of unpickling garbage (the pickle-schema analog of the
 # reference's versioned protobuf wire format, src/ray/protobuf/).
-PROTOCOL_VERSION = 7  # v7: head-free actor plane — owner-side ref
-# accounting and stream publication. DELETED head hot-path ops: dpin +
-# pin_delta (arg pins are now the owner's pin table + holder-node
-# leases), is_pinned (daemon store reclaim consults the local lease),
-# dspub/dseof + stream_pub_item/stream_pub_eof (published streams are
-# served BY THE OWNER, never mirrored into the head store). ADDED
-# owner-subscription reply-chain ops: worker->node rpc "stream_sub",
-# node->worker "ssub"/worker->node "srep", peer<->peer "psub"/"psubrep".
-# (v6: dropped dead worker->node "release" tag. v5: memory observability
-# — "refs" reports + store_info/store_info_rep. v4: pooled object
-# transfer, stat/pullr. v3: ddone/pdone exec_hex; arg_hints)
+PROTOCOL_VERSION = 8  # v8: restartable head — daemon rejoin. ADDED
+# head->daemon "reregister" (stale-epoch kick); the "hello" payload may
+# carry {"rejoin": node_hex} (daemon re-registering after a head bounce
+# keeps its hex), "welcome" carries the head epoch, "node_ready" may
+# carry a replay snapshot (store manifest + holder leases + hosted
+# actors), and syncer snapshots echo the epoch.
+# (v7: head-free actor plane — owner-side ref accounting and stream
+# publication; DELETED head hot-path ops dpin/pin_delta/is_pinned/
+# dspub/dseof/stream_pub_item/stream_pub_eof, ADDED stream_sub/ssub/
+# srep/psub/psubrep. v6: dropped dead worker->node "release" tag.
+# v5: memory observability — "refs" reports + store_info/store_info_rep.
+# v4: pooled object transfer, stat/pullr. v3: ddone/pdone exec_hex)
 
 
 class ProtocolVersionError(ConnectionError):
@@ -69,6 +72,11 @@ class Channel:
         self.closed = False
 
     def send(self, tag: str, *payload) -> None:
+        # chaos harness: "wire.send.<tag>=drop@N" silently loses this
+        # message, "...=delay:MS" stalls it (fault_injection.py); the
+        # fast path when no spec is armed is one string compare
+        if _fault_should_drop("wire.send", tag):
+            return
         with self._send_lock:
             self.conn.send((tag, payload))
 

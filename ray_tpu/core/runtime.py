@@ -27,7 +27,7 @@ from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
-from . import ref_tracker, serialization
+from . import fault_injection, ref_tracker, serialization
 from .config import global_config
 from .exceptions import (
     ActorDiedError,
@@ -36,6 +36,7 @@ from .exceptions import (
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,
+    format_death_cause,
 )
 from .gcs import GCS, ActorInfo, JobInfo, NodeInfo, TaskEvent
 from .ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
@@ -184,6 +185,8 @@ class Head:
         # general pubsub channels (reference: src/ray/pubsub/publisher.h)
         self.pubsub = PubsubBroker()
         self.scheduler = ClusterScheduler(self._dispatch_to_node)
+        # placement specs journal through the GCS store (restart seed)
+        self.scheduler.persist_pg = self.gcs.persist_placement
         self.nodes: Dict[str, Node] = {}
         from .lock_debug import tracked_rlock
 
@@ -205,12 +208,27 @@ class Head:
         self.owner_pin_counts: Optional[Callable[[], dict]] = None
         self.owner_stream_next: Optional[Callable] = None
         # deletes deferred while an owner pin was live (released via
-        # release_owner_pins on the task-settle reply chain)
-        self._deferred_deletes: Set[ObjectID] = set()
+        # release_owner_pins on the task-settle reply chain) — durable:
+        # a head bounce must not lose one (the delete would leak) or
+        # forget the lease guard (the delete would double-apply early)
+        self._deferred_deletes: Set[ObjectID] = {
+            ObjectID(b) for b in self.gcs.meta.get("deferred_deletes", ())}
         self.node_loads: Dict[str, dict] = {}  # node hex -> syncer snapshot
         # daemon-held arg leases, piggybacked on the sync cadence
-        # (kept apart from node_loads, which must stay JSON-safe)
-        self._daemon_leases: Dict[str, set] = {}
+        # (kept apart from node_loads, which must stay JSON-safe).
+        # Recovered lease views guard deferred deletes until the daemon
+        # re-registers (fresh view) or the rejoin grace declares it dead.
+        self._daemon_leases: Dict[str, set] = {
+            h: {ObjectID(b) for b in oids}
+            for h, oids in (self.gcs.meta.get("daemon_leases") or {}).items()}
+        # head incarnation: bumped on every construction-from-storage and
+        # every bounce; daemons echo it on the syncer so a restarted head
+        # can tell stale registrations from current ones
+        self.epoch = int(self.gcs.meta.get("epoch", 0)) + 1
+        self.gcs.set_meta("epoch", self.epoch)
+        # daemons expected to re-register after a bounce/restart, and the
+        # deadline after which the ones that didn't are declared dead
+        self._rejoin_pending: Set[str] = set()
         self._view_version = 0
         self._stopped = False
         self._node_listener = None
@@ -249,6 +267,9 @@ class Head:
         if self.metrics_history is not None:
             self._spawn_service(self._metrics_history_loop,
                                 "metrics-history")
+        # restart recovery: re-create durable placements + detached
+        # actors, retire owner-bound ones (must run after head_node is up)
+        self._recover_durable_state()
 
     def _spawn_service(self, target, name: str) -> threading.Thread:
         """Start a head service loop and retain the handle for the
@@ -258,6 +279,196 @@ class Head:
         self._service_threads.append(t)
         t.start()
         return t
+
+    # ------------------------------------------------- restart recovery
+
+    def _recover_durable_state(self) -> None:
+        """Rehydrate the durable GCS-analog tables into live runtime
+        state (reference: GCS server restart with RedisStoreClient —
+        gcs_actor_manager/gcs_placement_group_manager table replay).
+
+        Placements re-reserve under their original ids; DETACHED actors
+        with a journaled creation spec re-create from it (their owner is
+        the cluster, so they survive the head); owner-bound actors are
+        retired DEAD — their owner (the old driver process) died with
+        the head. Recovered object-directory entries stay inert until a
+        node with that hex re-registers (every lookup filters on live
+        membership); stale ones are dropped after the rejoin grace."""
+        recovered_pgs = self.gcs.recovered_placements
+        for pg_hex, rec in list(recovered_pgs.items()):
+            try:
+                self.scheduler.create_placement_group(
+                    rec["bundles"], rec.get("strategy", "PACK"),
+                    rec.get("name", ""),
+                    pg_id=PlacementGroupID(bytes.fromhex(pg_hex)))
+            except Exception:
+                pass  # an unreadable spec must not block recovery
+        stale_hexes: Set[str] = set()
+        for info in self.gcs.list_actors():
+            with self._lock:
+                known = info.actor_id in self.actors
+            if known or info.state == "DEAD":
+                continue
+            if info.node_hex:
+                stale_hexes.add(info.node_hex)
+            if info.detached and info.creation_spec:
+                try:
+                    spec = pickle.loads(info.creation_spec)
+                except Exception:
+                    spec = None
+                if spec is not None:
+                    self._recreate_recovered_actor(info, spec)
+                    continue
+            self.gcs.update_actor(
+                info.actor_id, state="DEAD",
+                death_cause="head restarted; non-detached actor died "
+                            "with its owner")
+            self.gcs.remove_actor_name(info.actor_id)
+        # nodes the durable tables still reference: give them the rejoin
+        # grace to re-register before their directory entries are purged
+        with self.gcs._lock:
+            for locs in self.gcs.object_dir.values():
+                stale_hexes.update(locs)
+        with self._lock:
+            stale_hexes -= set(self.nodes)
+        if stale_hexes:
+            self._rejoin_pending.update(stale_hexes)
+            self._spawn_rejoin_reaper()
+
+    def _recreate_recovered_actor(self, info, spec: TaskSpec) -> None:
+        """Resubmit a recovered detached actor's creation under a fresh
+        task id (its old incarnation died with the old head)."""
+        import copy
+
+        new_spec = copy.deepcopy(spec)
+        new_spec.task_id = TaskID.from_random()
+        new_spec.attempt = 0
+        arec = ActorRecord(info.actor_id, creation_spec=new_spec,
+                          max_restarts=info.max_restarts,
+                          num_restarts=info.num_restarts)
+        arec.state = "RESTARTING"
+        with self._lock:
+            self.actors[info.actor_id] = arec
+            self.tasks[new_spec.task_id] = TaskRecord(new_spec)
+        self.gcs.update_actor(info.actor_id, state="RESTARTING",
+                              node_hex=None)
+        self._resolve_then_queue(self.tasks[new_spec.task_id])
+
+    def _expect_rejoin(self, proxy: "NodeProxy") -> None:
+        """Detach a daemon we told (or expect) to re-register WITHOUT
+        running the death path: its actors and objects stay intact for
+        the replay; only if it misses the grace window does the reaper
+        declare it lost. Marking .alive False first keeps the reader's
+        EOF handler from invoking remove_node and killing healthy
+        max_restarts=0 actors whose workers are still running."""
+        proxy.alive = False
+        with self._lock:
+            self.nodes.pop(proxy.hex, None)
+            self._rejoin_pending.add(proxy.hex)
+        self._fail_store_info_waiters(proxy.hex)
+        try:
+            proxy.channel.close()
+        except Exception:
+            pass
+        self.scheduler.remove_node(proxy.hex)
+        self._spawn_rejoin_reaper()
+
+    def _spawn_rejoin_reaper(self) -> None:
+        """After the rejoin grace, nodes that never (re-)registered are
+        declared dead: their directory entries purge, their actors fail
+        over per max_restarts, their lease views stop guarding deletes.
+        At most one reaper runs at a time (repeated reregister kicks
+        must not pile up service threads)."""
+        with self._lock:
+            if getattr(self, "_rejoin_reaper_active", False):
+                return
+            self._rejoin_reaper_active = True
+        grace = max(0.1, global_config().daemon_rejoin_grace_s)
+
+        def run():
+            try:
+                if self._stop_event.wait(grace) or self._stopped:
+                    return
+                with self._lock:
+                    gone = {h for h in self._rejoin_pending
+                            if h not in self.nodes}
+                    self._rejoin_pending.clear()
+                # cold path, bounded by cluster size: runs once per
+                # bounce/restart, for daemons that never came back
+                for node_hex in gone:
+                    # graftlint: ignore[thread-hygiene]
+                    self._declare_node_lost(node_hex)
+            finally:
+                with self._lock:
+                    self._rejoin_reaper_active = False
+
+        self._spawn_service(run, "rejoin-grace")
+
+    def _declare_node_lost(self, node_hex: str) -> None:
+        """Death handling for a node we have no live connection to (it
+        never re-registered after a bounce/restart): everything
+        remove_node does, minus the proxy shutdown."""
+        self.gcs.mark_node_dead(node_hex)
+        from ray_tpu.util import events as events_mod
+
+        events_mod.emit("WARNING", events_mod.SOURCE_NODE,
+                        f"node {node_hex[:8]} did not re-register after "
+                        "head restart; declared dead", entity_id=node_hex)
+        if self._node_listener is not None:
+            self._broadcast_cluster_view()
+        self._fail_node_workloads(
+            node_hex, "node did not re-register after head restart")
+
+    def _fail_node_workloads(self, node_hex: str, cause: str) -> None:
+        """The one post-disconnect failover body remove_node and
+        _declare_node_lost share: fail parked store-info collectors,
+        release the daemon's lease view (retrying deletes parked behind
+        it), purge the node's directory entries, fail/retry its RUNNING
+        head-path tasks, and fail over its actors per max_restarts."""
+        self._fail_store_info_waiters(node_hex)
+        retry_deletes = []
+        with self._lock:
+            self.node_loads.pop(node_hex, None)
+            if self._daemon_leases.pop(node_hex, None):
+                self._persist_leases_locked()
+                retry_deletes = [oid for oid in self._deferred_deletes
+                                 if self.ref_counts.get(oid, 0) <= 0]
+        for oid in retry_deletes:
+            if not self._stopped:
+                self.delete_object(oid)
+        self.gcs.drop_node_objects(node_hex)
+        # RUNNING head-path tasks on the node have no one left to ever
+        # report them: fail/retry now or their callers park until timeout
+        with self._lock:
+            affected = [r for r in self.tasks.values()
+                        if r.state == "RUNNING" and r.node_hex == node_hex]
+            dead_actors = [a for a in self.actors.values()
+                           if a.node_hex == node_hex
+                           and a.state in ("ALIVE", "PENDING_CREATION")]
+        # cold path (once per dead node); retry/backoff threads are one
+        # per affected task/actor
+        for rec in affected:
+            # graftlint: ignore[thread-hygiene]
+            self._handle_task_failure(
+                rec, WorkerCrashedError(cause), results=None)
+        for arec in dead_actors:
+            # graftlint: ignore[thread-hygiene]
+            self._handle_actor_failure(
+                arec, format_death_cause(cause, node_hex))
+        with self._object_cv:
+            self._object_cv.notify_all()
+
+    def _persist_deferred_locked(self) -> None:
+        if self.gcs._durable:
+            self.gcs.set_meta("deferred_deletes",
+                              [o.binary() for o in self._deferred_deletes])
+
+    def _persist_leases_locked(self) -> None:
+        if self.gcs._durable:
+            self.gcs.set_meta(
+                "daemon_leases",
+                {h: [o.binary() for o in oids]
+                 for h, oids in self._daemon_leases.items()})
 
     # ------------------------------------------------------- observability
 
@@ -546,6 +757,15 @@ class Head:
     def on_node_sync(self, proxy, snap: dict) -> None:
         """Merge a daemon's load report (reference: RaySyncer RESOURCE_VIEW
         consumption in the GCS). A sync also counts as liveness."""
+        # head-incarnation check: a daemon still syncing under a pre-
+        # bounce epoch somehow kept a live channel into the restarted
+        # head — tell it to drop the link and re-register (EOF detection
+        # is the normal path; this is the belt to its braces)
+        ep = snap.pop("epoch", None)
+        if ep is not None and ep != self.epoch:
+            proxy._send("reregister")
+            self._expect_rejoin(proxy)
+            return
         # leases travel on the sync but live in their own table —
         # node_loads stays JSON-safe for the state API / dashboard
         leases = set(snap.pop("leases", None) or ())
@@ -556,7 +776,9 @@ class Head:
                     "version", 0):
                 return  # stale out-of-order update
             self.node_loads[proxy.hex] = snap
-            self._daemon_leases[proxy.hex] = leases
+            if self._daemon_leases.get(proxy.hex) != leases:
+                self._daemon_leases[proxy.hex] = leases
+                self._persist_leases_locked()
             if self._deferred_deletes:
                 # a daemon lease releasing shows up as the oid vanishing
                 # from its sync view: retry deletes parked behind it
@@ -688,18 +910,20 @@ class Head:
     def cluster_key_hex(self) -> Optional[str]:
         return self._cluster_key.hex() if self._cluster_key else None
 
-    def _node_accept_loop(self) -> None:
+    def _node_accept_loop(self, listener=None) -> None:
         import multiprocessing.context as _mpctx
 
         from .protocol import Channel
 
+        listener = listener or self._node_listener
         while not self._stopped:
             try:
-                conn = self._node_listener.accept()
+                conn = listener.accept()
             except (OSError, EOFError, _mpctx.AuthenticationError):
                 # a client dropping mid-handshake raises here too; only a
-                # closed listener (shutdown) ends the loop
-                if self._stopped or self._node_listener is None:
+                # closed/superseded listener (shutdown or a head bounce
+                # reopening the endpoint) ends the loop
+                if self._stopped or self._node_listener is not listener:
                     return
                 continue
             from .protocol import set_nodelay
@@ -713,9 +937,16 @@ class Head:
             channel.close()
             return
         try:
-            tag, _ = channel.recv()
+            tag, payload = channel.recv()
             assert tag == "hello"
-            node_id = NodeID.from_random()
+            hello = payload[0] if payload else {}
+            # rejoin (daemon re-registering after a head bounce): honor
+            # its existing node hex so every route, lease, and actor
+            # record that names this node stays valid
+            rejoin_hex = (hello.get("rejoin")
+                          if isinstance(hello, dict) else None)
+            node_id = (NodeID(bytes.fromhex(rejoin_hex)) if rejoin_hex
+                       else NodeID.from_random())
             from .protocol import PROTOCOL_VERSION
 
             channel.send("welcome", {
@@ -723,6 +954,7 @@ class Head:
                 "job_id": self.job_id.binary(),
                 "config": global_config().to_json(),
                 "proto": PROTOCOL_VERSION,
+                "epoch": self.epoch,
             })
             tag, (ready,) = channel.recv()
             assert tag == "node_ready"
@@ -738,20 +970,74 @@ class Head:
             proxy.shutdown()
             return
         with self._lock:
+            stale = self.nodes.get(proxy.hex)
+            if stale is not None and stale is not proxy:
+                # kill the old registration FIRST: its reader thread's
+                # EOF handler checks .alive, and with it still True the
+                # EOF would run remove_node(hex) — destroying the NEW
+                # proxy we are about to install
+                stale.alive = False
             self.nodes[proxy.hex] = proxy
+        if stale is not None:
+            try:
+                stale.channel.close()
+            except Exception:
+                pass
+        if stale is not None or rejoin_hex:
+            # replace any half-dead registration wholesale so the
+            # scheduler never double-counts the node's resources
+            self.scheduler.remove_node(proxy.hex)
         self.gcs.register_node(NodeInfo(node_id, proxy.hex,
                                         resources_total=dict(ready["resources"]),
                                         labels=proxy.labels))
         self.scheduler.add_node(proxy.hex, proxy.resources)
+        if rejoin_hex:
+            self._apply_daemon_replay(proxy, ready.get("replay") or {})
         from ray_tpu.util import events as events_mod
 
         events_mod.emit("INFO", events_mod.SOURCE_NODE,
                         f"node {proxy.hex[:8]} alive (daemon pid="
-                        f"{proxy.pid})", entity_id=proxy.hex,
+                        f"{proxy.pid}"
+                        f"{', rejoined' if rejoin_hex else ''})",
+                        entity_id=proxy.hex,
                         resources=dict(ready["resources"]))
         self._broadcast_cluster_view()
         threading.Thread(target=self._daemon_reader, args=(proxy,),
                          daemon=True, name=f"daemon-{proxy.hex[:6]}").start()
+
+    def _apply_daemon_replay(self, proxy: "NodeProxy", replay: dict) -> None:
+        """Fold a rejoining daemon's replay snapshot into head state:
+        object locations re-enter the directory, holder leases re-guard
+        deferred deletes, and hosted actors revive as ALIVE with their
+        routing (worker id) intact — the PR-7 owner-side tables converge
+        back to the pre-crash view without the daemon having moved any
+        state."""
+        for oid in replay.get("objects", ()):
+            self.gcs.add_object_location(oid, proxy.hex)
+        with self._lock:
+            self._daemon_leases[proxy.hex] = set(replay.get("leases", ()))
+            self._persist_leases_locked()
+            self._rejoin_pending.discard(proxy.hex)
+        for aid, wid in replay.get("actors", ()):
+            flush = []
+            with self._lock:
+                arec = self.actors.get(aid)
+                if arec is None or arec.state == "DEAD":
+                    continue
+                if arec.node_hex not in (None, proxy.hex):
+                    continue  # restarted elsewhere meanwhile: replay stale
+                arec.state = "ALIVE"
+                arec.node_hex = proxy.hex
+                arec.worker_id = wid
+                while arec.pending:
+                    flush.append(arec.pending.popleft())
+            self.gcs.update_actor(aid, state="ALIVE", node_hex=proxy.hex)
+            for mspec in flush:
+                rec = self.tasks.get(mspec.task_id)
+                if rec is not None:
+                    self._submit_actor_task(rec)
+        with self._object_cv:
+            self._object_cv.notify_all()  # gets parked on lost locations
 
     def _daemon_reader(self, proxy: "NodeProxy") -> None:
         import types
@@ -796,7 +1082,8 @@ class Head:
                 rec = self.tasks.get(task_id)
                 if rec is not None:
                     self._handle_task_failure(
-                        rec, ActorDiedError(actor_id, "actor node/worker gone"),
+                        rec, ActorDiedError(actor_id, format_death_cause(
+                            "actor node/worker gone", proxy.hex)),
                         None)
             elif tag == "pong":
                 proxy.last_pong = time.monotonic()
@@ -833,6 +1120,11 @@ class Head:
 
     def _handle_daemon_req(self, proxy, req_id: int, op: str, args) -> None:
         try:
+            # chaos point: "head.daemon_req[.<op>]=drop@N" strands this
+            # round-trip ON PURPOSE — the injected fault IS the missing
+            # reply; the daemon's bounded rounds re-issue the request
+            if fault_injection.fire("head.daemon_req", op) == "drop":
+                return  # graftlint: ignore[reply-completeness]
             if op != "worker_rpc":  # worker_rpc counts inside its handler
                 self._count_head_rpc(op)
             if op == "locate":
@@ -935,33 +1227,89 @@ class Head:
 
         events_mod.emit("WARNING", events_mod.SOURCE_NODE,
                         f"node {node_hex[:8]} dead", entity_id=node_hex)
-        self._fail_store_info_waiters(node_hex)
-        retry_deletes = []
-        with self._lock:
-            self.node_loads.pop(node_hex, None)
-            # deletes parked behind this daemon's leases must not leak:
-            # the lease died with the node — retry them (delete_object
-            # rechecks every remaining pin/lease guard)
-            if self._daemon_leases.pop(node_hex, None):
-                retry_deletes = [oid for oid in self._deferred_deletes
-                                 if self.ref_counts.get(oid, 0) <= 0]
-        for oid in retry_deletes:
-            if not self._stopped:
-                self.delete_object(oid)
         if self._node_listener is not None:
             self._broadcast_cluster_view()
         node.shutdown()
-        lost = self.gcs.drop_node_objects(node_hex)
-        # fail/retry running tasks that were on the node
+        self._fail_node_workloads(node_hex, "node died")
+
+    # ------------------------------------------------------------ bounce
+
+    def bounce(self) -> None:
+        """Chaos harness: crash-and-restart the head's control plane in
+        place (the closest a head-in-driver architecture gets to killing
+        the GCS process; reference: GCS FT drills restart the gcs_server
+        while raylets and workers keep running).
+
+        What "dies": the daemon join endpoint and every daemon link
+        (closed abruptly — no shutdown handshake), plus all daemon-
+        derived soft state (load views, lease views, ref reports, the
+        proxies themselves and their scheduler entries). What a real
+        restart would reload from the journal is reloaded from the
+        journal (``GCS.reload_from_store``), including the deferred-
+        delete set and last-known lease views. Driver-owned state (the
+        owner-side ref/pin/stream tables of PR 7, head-path task
+        records) survives by design — the driver process IS the owner
+        and its state never lived in the GCS-analog tables.
+
+        Recovery: the endpoint reopens on the SAME port; daemons detect
+        the EOF (or a stale epoch on their next sync), re-dial, and
+        re-register under their existing hex with a full replay snapshot
+        (store manifest, holder leases, hosted actors) plus their
+        reliable-message outbox. Daemons that never return are declared
+        dead after ``daemon_rejoin_grace_s`` and fail over normally."""
+        if self._stopped:
+            return
+        from ray_tpu.util import events as events_mod
+
+        events_mod.emit("WARNING", events_mod.SOURCE_NODE,
+                        "head bounce injected: control plane restarting")
+        addr = self.node_server_address
+        listener, self._node_listener = self._node_listener, None
         with self._lock:
-            affected = [r for r in self.tasks.values()
-                        if r.state == "RUNNING" and r.node_hex == node_hex]
-            dead_actors = [a for a in self.actors.values()
-                           if a.node_hex == node_hex and a.state in ("ALIVE", "PENDING_CREATION")]
-        for rec in affected:
-            self._handle_task_failure(rec, WorkerCrashedError("node died"), results=None)
-        for arec in dead_actors:
-            self._handle_actor_failure(arec, "node died")
+            proxies = [n for n in self.nodes.values()
+                       if not self._is_local(n)]
+            for p in proxies:
+                self.nodes.pop(p.hex, None)
+            self._rejoin_pending.update(p.hex for p in proxies)
+            self.node_loads.clear()
+            self._ref_reports.clear()
+            self._memory_table_cache = (0.0, None)
+        if listener is not None:
+            from .protocol import close_listener
+
+            close_listener(listener)
+        for p in proxies:
+            p.alive = False
+            self._fail_store_info_waiters(p.hex)
+            try:
+                p.channel.close()
+            except Exception:
+                pass
+            self.scheduler.remove_node(p.hex)
+        # run off recovered state, not off surviving process memory —
+        # this is the honest half of the persistence test
+        self.gcs.reload_from_store()
+        if self.gcs._durable:
+            with self._lock:
+                self._deferred_deletes = {
+                    ObjectID(b)
+                    for b in self.gcs.meta.get("deferred_deletes", ())}
+                self._daemon_leases = {
+                    h: {ObjectID(b) for b in oids}
+                    for h, oids in (self.gcs.meta.get("daemon_leases")
+                                    or {}).items()}
+        self.epoch += 1
+        self.gcs.set_meta("epoch", self.epoch)
+        if addr is not None and self._cluster_key is not None:
+            from .protocol import make_listener
+
+            new_listener = make_listener(tuple(addr), self._cluster_key)
+            self._node_listener = new_listener
+            self.node_server_address = new_listener.address
+            self._spawn_service(
+                lambda: self._node_accept_loop(new_listener), "node-server")
+        if proxies:
+            self._spawn_rejoin_reaper()
         with self._object_cv:
             self._object_cv.notify_all()
 
@@ -1027,8 +1375,10 @@ class Head:
         rec.worker_id = worker_id
         self._inject_delay("actor_dispatch")
         if node is None or not node.dispatch_to_worker(worker_id, spec):
-            self._handle_task_failure(rec, ActorDiedError(spec.actor_id, "actor node/worker gone"),
-                                      results=None)
+            self._handle_task_failure(
+                rec, ActorDiedError(spec.actor_id, format_death_cause(
+                    "actor node/worker gone", rec.node_hex)),
+                results=None)
 
     def create_actor(self, spec: TaskSpec, name: Optional[str], namespace: str,
                      max_restarts: int, detached: bool,
@@ -1036,10 +1386,16 @@ class Head:
         arec = ActorRecord(spec.actor_id, creation_spec=spec, max_restarts=max_restarts)
         with self._lock:
             self.actors[spec.actor_id] = arec
+        # detached actors journal their pickled creation spec: a restarted
+        # head re-creates them from it (reference: GCS FT replays the
+        # actor table and reconstructs detached actors). Non-detached
+        # actors die with their owner, so the spec would be dead weight.
+        spec_bytes = pickle.dumps(spec) if detached else None
         self.gcs.register_actor(ActorInfo(
             actor_id=spec.actor_id, name=name, namespace=namespace,
             class_name=spec.function_name, state="PENDING_CREATION",
-            max_restarts=max_restarts, detached=detached, creation_spec=None,
+            max_restarts=max_restarts, detached=detached,
+            creation_spec=spec_bytes,
             max_task_retries=max_task_retries,
         ))
         self.submit_spec(spec)
@@ -1401,12 +1757,14 @@ class Head:
             restart = arec.num_restarts < arec.max_restarts or arec.max_restarts == -1
             inflight = list(arec.inflight)
             arec.inflight.clear()
+            # retained through RESTARTING too: calls failing against the
+            # down incarnation attribute the LAST observed death
+            arec.death_cause = cause
             if restart:
                 arec.state = "RESTARTING"
                 arec.num_restarts += 1
             else:
                 arec.state = "DEAD"
-                arec.death_cause = cause
                 pending = list(arec.pending)
                 arec.pending.clear()
         # fail in-flight method calls (they may be retried onto the new
@@ -1419,10 +1777,14 @@ class Head:
                 if rec.spec.max_retries > rec.spec.attempt and rec.spec.retry_exceptions:
                     self._retry_task(rec, None)
                 else:
-                    self._fail_task_now(rec, ActorDiedError(arec.actor_id, cause),
-                                        _guard=False)
+                    self._fail_task_now(
+                        rec, ActorDiedError(arec.actor_id, cause,
+                                            restarting=restart),
+                        _guard=False)
         if restart:
-            self.gcs.update_actor(arec.actor_id, state="RESTARTING")
+            self.gcs.update_actor(arec.actor_id, state="RESTARTING",
+                                  death_cause=cause,
+                                  num_restarts=arec.num_restarts)
             # release old incarnation's resources and resubmit creation
             self._release_actor_creation(arec)
             cspec = arec.creation_spec
@@ -1433,7 +1795,8 @@ class Head:
             arec.creation_spec = new_spec
             with self._lock:
                 self.tasks[new_spec.task_id] = TaskRecord(new_spec)
-            self._resolve_then_queue(self.tasks[new_spec.task_id])
+            self._resubmit_after_backoff(self.tasks[new_spec.task_id],
+                                         arec.num_restarts)
         else:
             self.gcs.update_actor(arec.actor_id, state="DEAD", death_cause=cause)
             self.gcs.remove_actor_name(arec.actor_id)
@@ -1445,6 +1808,33 @@ class Head:
                 if rec is not None:
                     self._fail_task_now(rec, ActorDiedError(arec.actor_id, cause))
 
+    @staticmethod
+    def _restart_backoff_s(num_restarts: int) -> float:
+        """Exponential re-creation backoff: the Nth restart waits
+        base * 2^(N-1), capped (reference: gcs_actor_manager backoff —
+        a crash-looping actor must not monopolize the scheduler)."""
+        cfg = global_config()
+        base = cfg.actor_restart_delay_ms
+        if base <= 0 or num_restarts <= 0:
+            return 0.0
+        delay = base * (2 ** (num_restarts - 1))
+        return min(delay, cfg.actor_restart_max_delay_ms) / 1000.0
+
+    def _resubmit_after_backoff(self, rec: TaskRecord,
+                                num_restarts: int) -> None:
+        delay = self._restart_backoff_s(num_restarts)
+        if delay <= 0:
+            self._resolve_then_queue(rec)
+            return
+
+        def run():
+            # pace on the stop event so shutdown never waits out a backoff
+            if not self._stop_event.wait(delay) and not self._stopped:
+                self._resolve_then_queue(rec)
+
+        threading.Thread(target=run, daemon=True,
+                         name="actor-restart-backoff").start()
+
     def actor_location(self, actor_id: ActorID) -> Optional[dict]:
         """Direct-actor-path resolve: owners ask once per incarnation and
         then call the actor's node directly (reference: the actor-table
@@ -1454,7 +1844,8 @@ class Head:
             if arec is None:
                 return None
             return {"state": arec.state, "node_hex": arec.node_hex,
-                    "death_cause": arec.death_cause}
+                    "death_cause": arec.death_cause,
+                    "num_restarts": arec.num_restarts}
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         with self._lock:
@@ -1486,7 +1877,8 @@ class Head:
                 arec = self.actors.get(w.actor_id)
                 if arec is not None:
                     arec.state = "DEAD"
-                    arec.death_cause = "actor exited gracefully"
+                    arec.death_cause = format_death_cause(
+                        "actor exited gracefully", node.hex, w.pid)
                     pending = list(arec.pending)
                     arec.pending.clear()
                 else:
@@ -1512,7 +1904,8 @@ class Head:
             with self._lock:
                 arec = self.actors.get(w.actor_id)
             if arec is not None:
-                self._handle_actor_failure(arec, "actor worker process died")
+                self._handle_actor_failure(arec, format_death_cause(
+                    "actor worker process died", node.hex, w.pid))
             return
         if spec is not None:
             rec = self.tasks.get(spec.task_id)
@@ -1964,6 +2357,7 @@ class Head:
         if epc is not None and epc(oid):
             with self._lock:
                 self._deferred_deletes.add(oid)
+                self._persist_deferred_locked()
             return
         # holder-lease guard: an in-flight WORKER-owned direct task leases
         # its args on the node it flows through — that lease must defer
@@ -1981,10 +2375,13 @@ class Head:
                              for ls in self._daemon_leases.values())
             if leased:
                 self._deferred_deletes.add(oid)
+                self._persist_deferred_locked()
                 return
         local_nodes = []
         with self._lock:
-            self._deferred_deletes.discard(oid)
+            if oid in self._deferred_deletes:
+                self._deferred_deletes.discard(oid)
+                self._persist_deferred_locked()
             locs = self.gcs.get_object_locations(oid)
             for h in locs:
                 node = self.nodes.get(h)

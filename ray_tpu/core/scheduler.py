@@ -420,9 +420,13 @@ class ClusterScheduler:
         bundles: List[Dict[str, float]],
         strategy: str = "PACK",
         name: str = "",
+        pg_id: Optional[PlacementGroupID] = None,
     ) -> PlacementGroup:
+        """``pg_id`` is supplied only by head-restart recovery, which
+        re-creates durable placement specs under their ORIGINAL ids so
+        recovered actors' scheduling strategies still resolve."""
         pg = PlacementGroup(
-            pg_id=PlacementGroupID.from_random(),
+            pg_id=pg_id or PlacementGroupID.from_random(),
             bundles=[Bundle(i, ResourceSet(b)) for i, b in enumerate(bundles)],
             strategy=strategy,
             name=name,
@@ -431,6 +435,10 @@ class ClusterScheduler:
             self._pgs[pg.pg_id] = pg
             self._pending_pgs.append(pg)
             self._wake.notify_all()
+        persist = getattr(self, "persist_pg", None)
+        if persist is not None:
+            persist(pg.pg_id.hex(),
+                    {"bundles": bundles, "strategy": strategy, "name": name})
         return pg
 
     def get_placement_group(self, pg_id: PlacementGroupID) -> Optional[PlacementGroup]:
@@ -440,6 +448,9 @@ class ClusterScheduler:
     def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
         from .resources import ResourceSet
 
+        persist = getattr(self, "persist_pg", None)
+        if persist is not None:
+            persist(pg_id.hex(), None)  # retire the durable spec
         with self._lock:
             pg = self._pgs.get(pg_id)
             if pg is None or pg.state == "REMOVED":

@@ -59,14 +59,25 @@ class NodeSyncer:
         self._thread.start()
 
     def _loop(self) -> None:
+        from .fault_injection import should_drop
+
         while not self._stopped.wait(self._period):
+            if should_drop("daemon.sync"):
+                continue  # chaos point: lose this snapshot
             self._version += 1
             try:
                 snap = collect_load(self._node)
                 snap["version"] = self._version
+                # head-incarnation echo: a restarted head that sees a
+                # stale epoch on the sync tells the daemon to reregister
+                snap["epoch"] = getattr(self._head, "epoch", None)
                 self._head._send("sync", snap)
             except Exception:
-                return  # head link gone; daemon is shutting down
+                # transient (head bouncing, RemoteHead mid-reconnect):
+                # keep reporting — only a declared-dead link ends the loop
+                if getattr(self._head, "stopped", None) is not None \
+                        and self._head.stopped.is_set():
+                    return
 
     def stop(self) -> None:
         self._stopped.set()

@@ -25,6 +25,7 @@ import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import fault_injection
 from . import object_ref as object_ref_mod
 from . import ref_tracker, serialization
 from .config import Config, set_global_config, global_config
@@ -685,6 +686,10 @@ class WorkerRuntime:
         try:
             if spec.task_id in self._cancelled:
                 raise TaskCancelledError(f"task {spec.task_id.hex()} cancelled")
+            # chaos point: "worker.exec[.<fn>]=crash@N" hard-kills this
+            # worker before user code runs; raise/delay surface inline
+            fault_injection.fire("worker.exec",
+                                 spec.function_name.rsplit(".", 1)[-1])
             if spec.trace_ctx is not None:
                 # child span joins the caller's trace (reference:
                 # tracing_helper.py context propagation)

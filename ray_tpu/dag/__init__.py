@@ -218,18 +218,53 @@ class CompiledDAG:
         if not input_ids:
             raise ValueError("compiled DAG must read from an InputNode")
         self._nodes = nodes
+        self._output_node = output_node
         self._buffer_size = buffer_size
         self._device = device_channels
+        # death-path state: the unique executor actors, the incarnation
+        # (num_restarts) each was compiled against, and — once a death is
+        # detected — the attributed error every outstanding and future
+        # read raises. ``restarting=True`` on that error means the next
+        # execute() may REBIND fresh ring channels to the restarted
+        # incarnation instead of failing (graftlint death-path contract:
+        # a killed executor never wedges execute()/get()).
+        self._actors: Dict[Any, Any] = {}
+        for n in nodes:
+            aid = n.actor._actor_id
+            self._actors.setdefault(aid, n.actor)
+        self._incarnations: Dict[Any, int] = {}
+        self._broken: Optional[BaseException] = None
+
+        # split locks: a submitter blocked on a full pipeline must not
+        # prevent a reader from draining results (that would deadlock)
+        self._submit_lock = threading.Lock()
+        self._read_lock = threading.Lock()
+        self._next_seq = 0
+        self._next_read = 0
+        self._results: dict = {}
+        self._torn_down = False
+        self._channels: List[ShmChannel] = []
+        self._input_chans: List[ShmChannel] = []
+        self._build()
+
+    def _build(self) -> None:
+        """Create the per-edge ring channels and install the resident
+        executor loops (reference: do_exec_tasks). Called at compile time
+        and again by a rebind after an executor restart — each build uses
+        a fresh uid, so stale loops on old incarnations can never cross
+        wires with the new rings."""
+        nodes = self._nodes
         uid = uuid.uuid4().hex[:10]
         node_idx = {id(n): i for i, n in enumerate(nodes)}
 
         # one channel per edge: (producer id | "input") -> consumer slot
-        self._channels: List[ShmChannel] = []
-        self._input_chans: List[ShmChannel] = []
+        self._channels = []
+        self._input_chans = []
 
         def new_chan(name: str) -> ShmChannel:
-            ch = ShmChannel(channel_path(f"{uid}_{name}"), buffer_size,
-                            create=True, n_slots=max_inflight)
+            ch = ShmChannel(channel_path(f"{uid}_{name}"),
+                            self._buffer_size, create=True,
+                            n_slots=self.max_inflight)
             self._channels.append(ch)
             return ch
 
@@ -247,17 +282,8 @@ class CompiledDAG:
                     out_paths.setdefault(node_idx[id(u)], []).append(ch.path)
         out_ch = new_chan("out")
         self._out = out_ch
-        out_paths[node_idx[id(output_node)]].append(out_ch.path)
+        out_paths[node_idx[id(self._output_node)]].append(out_ch.path)
 
-        # split locks: a submitter blocked on a full pipeline must not
-        # prevent a reader from draining results (that would deadlock)
-        self._submit_lock = threading.Lock()
-        self._read_lock = threading.Lock()
-        self._next_seq = 0
-        self._next_read = 0
-        self._results: dict = {}
-        self._torn_down = False
-        # install resident executor loops (reference: do_exec_tasks)
         import ray_tpu
 
         try:
@@ -267,9 +293,9 @@ class CompiledDAG:
                     "method": task.method_name,
                     "in_paths": in_paths[i],
                     "out_paths": out_paths[i],
-                    "capacity": buffer_size,
+                    "capacity": self._buffer_size,
                     "args_template": task.args_template,
-                    "device": device_channels,
+                    "device": self._device,
                 }))
             ray_tpu.get(acks, timeout=60)
         except BaseException:
@@ -285,6 +311,129 @@ class CompiledDAG:
                 except Exception:
                     pass
             raise
+        for aid in self._actors:
+            info = self._actor_state(aid)
+            self._incarnations[aid] = \
+                (info or {}).get("num_restarts", 0) or 0
+
+    # ------------------------------------------------- executor death path
+
+    @staticmethod
+    def _resolve_actor(aid):
+        from ray_tpu.core.runtime import get_current_runtime
+
+        rt = get_current_runtime()
+        head = getattr(rt, "head", None)
+        if head is None:
+            return None
+        try:
+            return head.actor_location(aid)
+        except Exception:
+            return None
+
+    def _actor_state(self, aid):
+        return self._resolve_actor(aid)
+
+    def _probe_dead(self):
+        """Resolve every executor actor against the actor FSM. Returns
+        (attributed_error | None, restart_possible)."""
+        from ray_tpu.core.exceptions import ActorDiedError
+
+        for aid in self._actors:
+            info = self._actor_state(aid)
+            if info is None:
+                continue  # no resolver (client mode): stay timeout-based
+            state = info.get("state")
+            cause = info.get("death_cause")
+            if state == "DEAD":
+                return ActorDiedError(
+                    aid, f"compiled-graph executor died: "
+                         f"{cause or 'actor is dead'}"), False
+            if (info.get("num_restarts", 0) or 0) != \
+                    self._incarnations.get(aid, 0) \
+                    or state in ("RESTARTING", "PENDING_CREATION"):
+                # the loop died with the old incarnation; the actor
+                # itself is (or will be) back — a rebind can recover
+                return ActorDiedError(
+                    aid, f"compiled-graph executor incarnation died: "
+                         f"{cause or 'worker process died'}",
+                    restarting=True), True
+        return None, False
+
+    def _poison_all(self) -> None:
+        """Best-effort STOP sentinel into EVERY edge. After a mid-graph
+        executor death, stages downstream of the corpse would otherwise
+        park forever on rings nobody will write again; the driver holds
+        (and created) every channel, and a dead stage's out-edges have no
+        live writer, so it can safely act as the writer of last resort."""
+        for ch in self._channels:
+            try:
+                ch.write(b"", tag=TAG_STOP, timeout=0.2)
+            except Exception:
+                pass
+
+    def _handle_executor_death(self, err, restartable: bool) -> None:
+        """An executor is gone: every outstanding CompiledDAGRef fails
+        with the attributed error (their in-flight rounds died inside
+        the graph), surviving stage loops get poisoned out of their
+        parked reads, and — for a permanent death — the rings tear down
+        via the reaper. The DAG object stays; a restartable death lets
+        the next execute() rebind."""
+        self._broken = err
+        self._poison_all()
+        if not restartable:
+            self._torn_down = True
+            chans = list(self._channels)
+
+            def reap():
+                for ch in chans:
+                    try:
+                        ch.close(unlink=True)
+                    except Exception:
+                        pass
+
+            _teardown_queue.append(reap)
+            _teardown_event.set()
+
+    def _try_rebind_locked(self) -> bool:
+        """Under _submit_lock, after a restartable executor death: if
+        every executor actor is ALIVE again, close the poisoned rings and
+        build fresh ones against the new incarnations. Outstanding refs
+        stay failed (their rounds died); new executes flow normally."""
+        if self._torn_down or self._broken is None:
+            return False
+        if not getattr(self._broken, "restarting", False):
+            return False
+        for aid in self._actors:
+            info = self._actor_state(aid)
+            if info is None or info.get("state") != "ALIVE":
+                return False
+        with self._read_lock:
+            old = list(self._channels)
+            for ch in old:
+                try:
+                    ch.close(unlink=True)
+                except Exception:
+                    pass
+            try:
+                # deliberate: the rebind holds BOTH dag locks across the
+                # executor re-install round-trip — it must be exclusive
+                # against every submit/read, and the install rides the
+                # actor plane, which never takes dag locks (no cycle)
+                # graftlint: ignore[blocking-under-lock]
+                self._build()
+            except BaseException:
+                self._torn_down = True
+                raise
+            # outstanding (unread) rounds died with the old rings: reads
+            # for them keep raising via the per-seq check in _read_result
+            self._dead_seqs = getattr(self, "_dead_seqs", {})
+            for s in range(self._next_read, self._next_seq):
+                if s not in self._results:
+                    self._dead_seqs[s] = self._broken
+            self._next_read = self._next_seq
+            self._broken = None
+        return True
 
     def execute(self, value: Any,
                 timeout: Optional[float] = 60.0) -> CompiledDAGRef:
@@ -294,20 +443,47 @@ class CompiledDAG:
         written — input rounds are all-or-nothing (wait for a free slot
         on every edge first; the driver is the only writer, so observed
         free slots cannot vanish), so a timed-out execute leaves the DAG
-        healthy and retryable instead of poisoned."""
+        healthy and retryable instead of poisoned.
+
+        Executor death never wedges this call: slot waits run in bounded
+        rounds that probe the actor FSM, a detected death raises an
+        attributed ActorDiedError, and a RESTARTED executor (the actor
+        had max_restarts budget) gets fresh rings bound transparently
+        before the next submission."""
         import time as _time
 
         with self._submit_lock:
+            if self._broken is not None and not self._torn_down:
+                # deliberate: rebinding under _submit_lock blocks other
+                # submitters for the install round-trip — exclusivity is
+                # the point (see _try_rebind_locked)
+                # graftlint: ignore[blocking-under-lock]
+                self._try_rebind_locked()
             if self._torn_down:
-                raise RuntimeError("compiled DAG was torn down")
+                raise self._broken or \
+                    RuntimeError("compiled DAG was torn down")
+            if self._broken is not None:
+                raise self._broken
             # one deadline across ALL edges — sequential full-timeout
             # waits would make the worst case num_edges x timeout
             deadline = None if timeout is None else \
                 _time.monotonic() + timeout
             for ch in self._input_chans:
-                ch.wait_writable(
-                    None if deadline is None
-                    else max(0.0, deadline - _time.monotonic()))
+                while True:
+                    remaining = (None if deadline is None
+                                 else max(0.0, deadline - _time.monotonic()))
+                    round_t = 1.0 if remaining is None \
+                        else min(1.0, remaining)
+                    try:
+                        ch.wait_writable(round_t)
+                        break
+                    except ChannelTimeout:
+                        err, restartable = self._probe_dead()
+                        if err is not None:
+                            self._handle_executor_death(err, restartable)
+                            raise err
+                        if remaining is not None and remaining <= round_t:
+                            raise
             # dispatch fast path: bytes and typed arrays skip the
             # serializer entirely (driver-side mirror of the executor's
             # tensor-channel output path); everything else packs its
@@ -329,16 +505,49 @@ class CompiledDAG:
         return CompiledDAGRef(self, seq)
 
     def _read_result(self, seq: int, timeout: Optional[float]):
+        import time as _time
+
         from ray_tpu.experimental.channel import TAG_TENSOR
 
         with self._read_lock:
+            dead = getattr(self, "_dead_seqs", None)
+            if dead and seq in dead:
+                raise dead.pop(seq)  # round died in a rebound ring
             if seq < self._next_read and seq not in self._results:
                 raise ValueError(
                     f"result for execution #{seq} was already consumed "
                     "(CompiledDAGRef.get() caches it on the ref — hold "
                     "onto the ref instead of re-deriving the seq)")
+            # bounded rounds, never an unbounded park: each timeout round
+            # probes the executor actors, so a killed stage surfaces as
+            # an attributed ActorDiedError instead of a wedged get()
+            deadline = None if timeout is None else \
+                _time.monotonic() + timeout
             while self._next_read <= seq:
-                tag, payload = self._out.read(timeout)
+                if self._broken is not None and seq not in self._results:
+                    raise self._broken
+                remaining = (None if deadline is None
+                             else deadline - _time.monotonic())
+                round_t = 1.0 if remaining is None \
+                    else min(1.0, max(0.0, remaining))
+                try:
+                    tag, payload = self._out.read(round_t)
+                except ChannelTimeout:
+                    err, restartable = self._probe_dead()
+                    if err is not None:
+                        self._handle_executor_death(err, restartable)
+                        raise err
+                    if remaining is not None and remaining <= round_t:
+                        raise
+                    continue
+                except ChannelClosed:
+                    # torn slot (writer crashed mid-publish) or poisoned
+                    # ring: attribute it if an executor is down
+                    err, restartable = self._probe_dead()
+                    if err is not None:
+                        self._handle_executor_death(err, restartable)
+                        raise err
+                    raise
                 self._results[self._next_read] = (tag, payload)
                 self._next_read += 1
             tag, payload = self._results.pop(seq)

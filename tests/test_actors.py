@@ -113,8 +113,12 @@ def test_kill_actor(ray_start_regular):
     assert ray_tpu.get(a.ping.remote()) == "pong"
     ray_tpu.kill(a)
     time.sleep(0.5)
-    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.TaskError)):
+    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.TaskError)) as ei:
         ray_tpu.get(a.ping.remote(), timeout=10)
+    if isinstance(ei.value, ray_tpu.ActorDiedError):
+        # attribution contract (exceptions.format_death_cause): the
+        # cause names WHERE the actor died, never a bare timeout
+        assert "node " in str(ei.value), str(ei.value)
 
 
 def test_actor_restart(ray_start_regular):

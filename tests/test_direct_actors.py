@@ -114,8 +114,11 @@ class TestDirectActorLocal:
         ray_tpu.kill(s)
         with pytest.raises(Exception):
             ray_tpu.get(ref, timeout=60)
-        with pytest.raises(Exception):
+        with pytest.raises(Exception) as ei:
             ray_tpu.get(s.nap.remote(0), timeout=60)
+        if isinstance(ei.value, ray_tpu.ActorDiedError):
+            # attributed death cause (node/pid), never a bare timeout
+            assert "node " in str(ei.value), str(ei.value)
 
     def test_async_actor_direct(self):
         @ray_tpu.remote
