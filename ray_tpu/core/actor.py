@@ -70,7 +70,8 @@ class ActorHandle:
     def __getattr__(self, name: str):
         if (name.startswith("__") and name.endswith("__")
                 and name not in ("__ray_terminate__", "__collective_init__",
-                                 "__compiled_exec__")):
+                                 "__compiled_exec__", "__compiled_setup__",
+                                 "__compiled_poison__")):
             raise AttributeError(name)
         return ActorMethod(self, name, self._method_num_returns.get(name, 1))
 

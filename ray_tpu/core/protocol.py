@@ -36,13 +36,19 @@ from .fault_injection import should_drop as _fault_should_drop
 # tags/payload shapes — mixed-version clusters fail fast with a clear
 # error instead of unpickling garbage (the pickle-schema analog of the
 # reference's versioned protobuf wire format, src/ray/protobuf/).
-PROTOCOL_VERSION = 8  # v8: restartable head — daemon rejoin. ADDED
+PROTOCOL_VERSION = 9  # v9: cross-host compiled-graph rings. ADDED the
+# NetRing session ops (core/net_ring.py, the machine-checked
+# ring-protocol-net transport): "nring" (writer hello naming a ring id),
+# "nrd" (data: seq + tag + payload), "nra" (cumulative ack), "nrrq"
+# (reader resync request), "nrbase" (resync reply carrying the writer's
+# acked base).
+# (v8: restartable head — daemon rejoin. ADDED
 # head->daemon "reregister" (stale-epoch kick); the "hello" payload may
 # carry {"rejoin": node_hex} (daemon re-registering after a head bounce
 # keeps its hex), "welcome" carries the head epoch, "node_ready" may
 # carry a replay snapshot (store manifest + holder leases + hosted
 # actors), and syncer snapshots echo the epoch.
-# (v7: head-free actor plane — owner-side ref accounting and stream
+# v7: head-free actor plane — owner-side ref accounting and stream
 # publication; DELETED head hot-path ops dpin/pin_delta/is_pinned/
 # dspub/dseof/stream_pub_item/stream_pub_eof, ADDED stream_sub/ssub/
 # srep/psub/psubrep. v6: dropped dead worker->node "release" tag.

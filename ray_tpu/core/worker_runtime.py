@@ -22,6 +22,7 @@ import sys
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -61,6 +62,12 @@ class _ActorState:
         # one-method-at-a-time actor contract holds across both planes
         self.exec_lock = (threading.Lock()
                           if not is_async and max_concurrency == 1 else None)
+        # compiled-exec scheduling: tokens from higher-priority loops
+        # holding the actor (1F1B backward-over-forward); deque for
+        # thread-safe append/pop, condition for the low-priority loops
+        # to park on instead of polling while a backward runs
+        self.prio_waiting: deque = deque()
+        self.prio_cv = threading.Condition()
 
     def stop(self) -> None:
         """Release the actor's execution machinery (worker exit path;
@@ -546,20 +553,56 @@ class WorkerRuntime:
             self._current_task.actor_id = None
             self._current_task.name = None
 
-    def _start_compiled_exec(self, st: _ActorState, desc: dict) -> None:
-        from ray_tpu.experimental.channel import (
-            TAG_ERROR,
-            TAG_STOP,
-            TAG_TENSOR,
-            ChannelClosed,
-            ShmChannel,
-        )
+    def _compiled_setup(self, desc: dict) -> dict:
+        """Phase A of a cross-node compiled-graph install: create the
+        NetRing reader endpoints this process owns (the READING side
+        holds the receive ring) and return the dial-in for this
+        process's ring host so producing processes can connect."""
+        from ray_tpu.core import net_ring
 
-        ins = [ShmChannel(p, desc["capacity"]) for p in desc["in_paths"]]
-        outs = [ShmChannel(p, desc["capacity"]) for p in desc["out_paths"]]
+        for spec in desc.get("rings", ()):
+            net_ring.create_reader(spec["ring"], spec["n_slots"],
+                                   spec["capacity"],
+                                   advertise_ip=self.node_ip)
+        host = net_ring.ensure_host(self.node_ip)
+        return {"addr": list(host.address), "key": host.authkey.hex()}
+
+    def _open_compiled_chan(self, d, capacity: int):
+        """Open one compiled-graph edge from its descriptor: a /dev/shm
+        ring path, a locally-created net reader (Phase A), or a net
+        writer dialing a remote ring host."""
+        from ray_tpu.core import net_ring
+        from ray_tpu.experimental.channel import ShmChannel
+
+        if isinstance(d, str):
+            return ShmChannel(d, capacity)
+        kind = d[0]
+        if kind == "shm":
+            return ShmChannel(d[1], capacity)
+        if kind == "netr":
+            reader = net_ring.ensure_host(self.node_ip).get(d[1])
+            if reader is None:
+                raise RuntimeError(
+                    f"net ring {d[1]} was not set up in this process")
+            return reader
+        if kind == "netw":
+            _, host, port, key, ring_id, n_slots = d
+            return net_ring.NetRingWriter.connect(
+                (host, port), bytes.fromhex(key), ring_id, n_slots,
+                capacity)
+        raise ValueError(f"unknown channel descriptor {d!r}")
+
+    def _start_compiled_exec(self, st: _ActorState, desc: dict) -> None:
+        ins = [self._open_compiled_chan(p, desc["capacity"])
+               for p in desc["in_paths"]]
+        outs = [self._open_compiled_chan(p, desc["capacity"])
+                for p in desc["out_paths"]]
         method = getattr(st.instance, desc["method"])
         template = list(desc.get("args_template") or [("edge", 0)])
         device = bool(desc.get("device"))
+        priority = int(desc.get("priority") or 0)
+
+        from ray_tpu.experimental.channel import TAG_STOP
 
         def close_all():
             for ch in ins + outs:
@@ -579,7 +622,7 @@ class WorkerRuntime:
         def loop():
             try:
                 self._compiled_exec_loop(ins, outs, propagate, st, method,
-                                         template, device)
+                                         template, device, priority)
             finally:
                 close_all()
 
@@ -587,7 +630,7 @@ class WorkerRuntime:
                          name=f"compiled-exec-{desc['method']}").start()
 
     def _compiled_exec_loop(self, ins, outs, propagate, st, method,
-                            template, device) -> None:
+                            template, device, priority=0) -> None:
         from ray_tpu.experimental.channel import (
             TAG_BYTES,
             TAG_ERROR,
@@ -636,9 +679,35 @@ class WorkerRuntime:
                     # contract is one-method-at-a-time, NOT
                     # one-thread-forever: compiled executions run here,
                     # not on the pool thread (reference: do_exec_tasks
-                    # loops own their thread too)
-                    with st.exec_lock:
-                        result = method(*args)
+                    # loops own their thread too).
+                    # Priority (the 1F1B scheduling rule): when a
+                    # higher-priority loop on this actor has an input
+                    # ready (backward microbatch), lower-priority loops
+                    # (forward) yield the actor to it instead of racing
+                    # for the lock — backward-over-forward is what keeps
+                    # the pipeline's activation window at K instead of
+                    # growing with the microbatch count.
+                    if priority > 0:
+                        st.prio_waiting.append(1)
+                        try:
+                            with st.exec_lock:
+                                result = method(*args)
+                        finally:
+                            st.prio_waiting.pop()
+                            with st.prio_cv:
+                                st.prio_cv.notify_all()
+                    else:
+                        # park (never poll) while a backward holds the
+                        # actor; bounded waits make a missed notify
+                        # harmless. Advisory ordering: the re-check
+                        # races a backward arriving right after, which
+                        # only costs one forward running first.
+                        while st.prio_waiting:
+                            with st.prio_cv:
+                                if st.prio_waiting:
+                                    st.prio_cv.wait(0.05)
+                        with st.exec_lock:
+                            result = method(*args)
                 else:
                     result = st.pool.submit(method, *args).result()
                 if device and _is_arraylike(result):
@@ -736,6 +805,20 @@ class WorkerRuntime:
                     # (reference: compiled_dag_node.py do_exec_tasks :92)
                     self._start_compiled_exec(st, args[0])
                     self._finish(spec, None)
+                    return
+                if fn_name == "__compiled_setup__":
+                    # Phase A of a cross-node compile: create this
+                    # process's net-ring reader endpoints, return the
+                    # ring-host dial-in for the producing processes
+                    self._finish(spec, self._compiled_setup(args[0]))
+                    return
+                if fn_name == "__compiled_poison__":
+                    # death-path broadcast: fail the local net readers
+                    # under the DAG uid so loops parked on a dead peer's
+                    # ring pop with ChannelClosed
+                    from ray_tpu.core import net_ring
+
+                    self._finish(spec, net_ring.poison_rings(args[0]))
                     return
                 if fn_name == "__collective_init__":
                     # runtime-level hook so any actor can join a collective

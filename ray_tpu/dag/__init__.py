@@ -93,6 +93,14 @@ class ClassMethodNode(DAGNode):
                 k += 1
             else:
                 self.args_template.append(("const", a))
+        # executor-loop scheduling priority on the hosting actor: loops
+        # with a higher priority preempt lower ones for the actor's
+        # exec slot when both have inputs ready (1F1B: backward > forward)
+        self.priority = 0
+
+    def with_priority(self, priority: int) -> "ClassMethodNode":
+        self.priority = int(priority)
+        return self
 
     def experimental_compile(self, buffer_size_bytes: int = 4 * 1024 * 1024,
                              device_channels: bool = False,
@@ -247,55 +255,219 @@ class CompiledDAG:
         self._input_chans: List[ShmChannel] = []
         self._build()
 
+    @staticmethod
+    def _local_identity():
+        """(node_hex, advertise_ip) of the calling (driver) process —
+        the placement the shm-vs-net edge decision compares actors
+        against. None hex = no resolver (client mode): every edge
+        stays shm, the pre-cross-host behavior."""
+        from ray_tpu.core.runtime import get_current_runtime
+
+        rt = get_current_runtime()
+        node = getattr(getattr(rt, "head", None), "head_node", None)
+        if node is not None:
+            return node.hex, getattr(node, "node_ip", "127.0.0.1")
+        return (getattr(rt, "node_hex", None),
+                getattr(rt, "node_ip", "127.0.0.1"))
+
+    def _resolve_locations(self, timeout: float = 30.0) -> Dict[Any, Optional[str]]:
+        """Placement of every executor actor, for the shm-vs-net edge
+        decision. An actor bound into a DAG right after ``.remote()``
+        may not have a registered record yet — compiling against a
+        guessed placement would silently lay a same-host shm ring under
+        a cross-host edge — so this WAITS (bounded) for each record.
+        Without a resolver (worker-process or client drivers) every
+        location is None and all edges stay shm, the pre-cross-host
+        behavior."""
+        import time as _time
+
+        from ray_tpu.core.runtime import get_current_runtime
+
+        rt = get_current_runtime()
+        if getattr(rt, "head", None) is None:
+            return {aid: None for aid in self._actors}
+        locs: Dict[Any, Optional[str]] = {}
+        deadline = _time.monotonic() + timeout
+        for aid in self._actors:
+            while True:
+                info = self._actor_state(aid)
+                node_hex = (info or {}).get("node_hex")
+                if node_hex or _time.monotonic() > deadline:
+                    locs[aid] = node_hex
+                    break
+                _time.sleep(0.02)
+        return locs
+
     def _build(self) -> None:
-        """Create the per-edge ring channels and install the resident
+        """Create the per-edge channels and install the resident
         executor loops (reference: do_exec_tasks). Called at compile time
         and again by a rebind after an executor restart — each build uses
         a fresh uid, so stale loops on old incarnations can never cross
-        wires with the new rings."""
+        wires with the new rings, and placement is RE-resolved, so a
+        restarted actor that came back on a different node gets net-ring
+        (or shm) edges matching its NEW placement.
+
+        Edge transport is resolved from actor placement: both endpoints
+        on the same node share a /dev/shm ring; endpoints on different
+        nodes get a NetRing (core/net_ring.py — the machine-checked
+        ring-protocol-net transport) over the authenticated peer mesh.
+        Net rings install in two phases because the READING process owns
+        the receive ring: (A) ``__compiled_setup__`` creates the reader
+        endpoints on each consuming actor and returns that process's
+        ring-host address+key, (B) ``__compiled_exec__`` starts the
+        loops with channel descriptors — shm paths, local ring ids, or
+        dial-out targets."""
         nodes = self._nodes
         uid = uuid.uuid4().hex[:10]
+        self._uid = uid
         node_idx = {id(n): i for i, n in enumerate(nodes)}
+
+        drv_hex, drv_ip = self._local_identity()
+        locs = self._resolve_locations()
+
+        def actor_hex(n) -> Optional[str]:
+            return locs.get(n.actor._actor_id)
+
+        def is_net(prod_hex, cons_hex) -> bool:
+            # shm ONLY when the driver shares the node with both
+            # endpoints: the driver creates every shm segment in ITS
+            # /dev/shm and is the death-path writer of last resort for
+            # it — neither works for a segment that would have to live
+            # on another host. Co-located actors on a REMOTE node get a
+            # net ring too (loopback TCP there); remote-created shm for
+            # that case is a roadmapped follow-up.
+            if drv_hex is None or prod_hex is None or cons_hex is None:
+                return False  # no resolver: pre-cross-host behavior
+            return not (prod_hex == cons_hex == drv_hex)
 
         # one channel per edge: (producer id | "input") -> consumer slot
         self._channels = []
         self._input_chans = []
+        self._net_actors = set()  # actor ids holding net endpoints
 
-        def new_chan(name: str) -> ShmChannel:
+        def new_shm(name: str) -> ShmChannel:
             ch = ShmChannel(channel_path(f"{uid}_{name}"),
                             self._buffer_size, create=True,
                             n_slots=self.max_inflight)
             self._channels.append(ch)
             return ch
 
-        in_paths: Dict[int, List[str]] = {}
-        out_paths: Dict[int, List[str]] = {}
+        # per-edge plan; net consumer descriptors resolve in Phase A
+        in_descs: Dict[int, List] = {}
+        out_descs: Dict[int, List] = {}
+        setup_rings: Dict[Any, List[dict]] = {}   # aid -> reader specs
+        net_writers: List[dict] = []  # producer-side dial targets to fix up
+        driver_net_inputs: List[str] = []  # ring ids the driver dials
+
         for i, n in enumerate(nodes):
-            in_paths[i] = []
-            out_paths.setdefault(i, [])
+            in_descs[i] = []
+            out_descs.setdefault(i, [])
+            cons_hex = actor_hex(n)
+            cons_aid = n.actor._actor_id
             for k, u in enumerate(n.upstreams):
-                ch = new_chan(f"e{i}_{k}")
-                in_paths[i].append(ch.path)
+                name = f"e{i}_{k}"
+                prod_hex = drv_hex if isinstance(u, InputNode) \
+                    else actor_hex(u)
+                if not is_net(prod_hex, cons_hex):
+                    ch = new_shm(name)
+                    in_descs[i].append(("shm", ch.path))
+                    if isinstance(u, InputNode):
+                        self._input_chans.append(ch)
+                    else:
+                        out_descs.setdefault(node_idx[id(u)], []).append(
+                            ("shm", ch.path))
+                    continue
+                ring_id = f"{uid}_{name}"
+                setup_rings.setdefault(cons_aid, []).append(
+                    {"ring": ring_id, "n_slots": self.max_inflight,
+                     "capacity": self._buffer_size})
+                self._net_actors.add(cons_aid)
+                in_descs[i].append(("netr", ring_id))
                 if isinstance(u, InputNode):
-                    self._input_chans.append(ch)
+                    driver_net_inputs.append(ring_id)
+                    net_writers.append({"ring": ring_id, "reader": cons_aid,
+                                        "driver": True})
                 else:
-                    out_paths.setdefault(node_idx[id(u)], []).append(ch.path)
-        out_ch = new_chan("out")
-        self._out = out_ch
-        out_paths[node_idx[id(self._output_node)]].append(out_ch.path)
+                    pi = node_idx[id(u)]
+                    slot = len(out_descs.setdefault(pi, []))
+                    out_descs[pi].append(None)  # fixed up after Phase A
+                    net_writers.append({"ring": ring_id, "reader": cons_aid,
+                                        "driver": False, "node": pi,
+                                        "slot": slot})
+                    self._net_actors.add(u.actor._actor_id)
+
+        # output edge: last stage -> driver
+        oi = node_idx[id(self._output_node)]
+        out_hex = actor_hex(self._output_node)
+        if is_net(out_hex, drv_hex):
+            from ray_tpu.core import net_ring
+
+            ring_id = f"{uid}_out"
+            reader = net_ring.create_reader(
+                ring_id, self.max_inflight, self._buffer_size,
+                advertise_ip=drv_ip)
+            self._channels.append(reader)
+            self._out = reader
+            host = net_ring.ensure_host(drv_ip)
+            out_descs[oi].append(("netw", host.address[0], host.address[1],
+                                  host.authkey.hex(), ring_id,
+                                  self.max_inflight))
+            self._net_actors.add(self._output_node.actor._actor_id)
+        else:
+            out_ch = new_shm("out")
+            self._out = out_ch
+            out_descs[oi].append(("shm", out_ch.path))
 
         import ray_tpu
 
         try:
+            # Phase A: consuming actors create their net reader endpoints
+            # and report their ring-host dial-in (address + session key)
+            hosts: Dict[Any, dict] = {}
+            if setup_rings:
+                aids = list(setup_rings)
+                acks = [self._actors[aid].__compiled_setup__.remote(
+                            {"rings": setup_rings[aid]})
+                        for aid in aids]
+                for aid, rep in zip(aids, ray_tpu.get(acks, timeout=60)):
+                    hosts[aid] = rep
+
+            def dial_desc(wspec) -> tuple:
+                rep = hosts[wspec["reader"]]
+                host, port = rep["addr"]
+                return ("netw", host, port, rep["key"], wspec["ring"],
+                        self.max_inflight)
+
+            for wspec in net_writers:
+                if wspec["driver"]:
+                    continue
+                out_descs[wspec["node"]][wspec["slot"]] = dial_desc(wspec)
+
+            # driver-side net writers (input edges into remote stage 0s)
+            from ray_tpu.core import net_ring
+
+            for wspec in net_writers:
+                if not wspec["driver"]:
+                    continue
+                rep = hosts[wspec["reader"]]
+                w = net_ring.NetRingWriter.connect(
+                    tuple(rep["addr"]), bytes.fromhex(rep["key"]),
+                    wspec["ring"], self.max_inflight, self._buffer_size)
+                self._channels.append(w)
+                self._input_chans.append(w)
+
+            # Phase B: install the resident loops
             acks = []
             for i, task in enumerate(nodes):
                 acks.append(task.actor.__compiled_exec__.remote({
                     "method": task.method_name,
-                    "in_paths": in_paths[i],
-                    "out_paths": out_paths[i],
+                    "in_paths": in_descs[i],
+                    "out_paths": out_descs[i],
                     "capacity": self._buffer_size,
                     "args_template": task.args_template,
                     "device": self._device,
+                    "uid": uid,
+                    "priority": getattr(task, "priority", 0),
                 }))
             ray_tpu.get(acks, timeout=60)
         except BaseException:
@@ -361,14 +533,33 @@ class CompiledDAG:
         return None, False
 
     def _poison_all(self) -> None:
-        """Best-effort STOP sentinel into EVERY edge. After a mid-graph
+        """Best-effort STOP/poison into EVERY edge. After a mid-graph
         executor death, stages downstream of the corpse would otherwise
-        park forever on rings nobody will write again; the driver holds
-        (and created) every channel, and a dead stage's out-edges have no
-        live writer, so it can safely act as the writer of last resort."""
+        park forever on rings nobody will write again. Shm edges: the
+        driver holds (and created) every channel, and a dead stage's
+        out-edges have no live writer, so it safely acts as the writer
+        of last resort. Net edges: the driver poisons its own endpoints
+        directly and broadcasts a fire-and-forget ``__compiled_poison__``
+        so each surviving actor fails its local reader endpoints under
+        this DAG's uid (the driver cannot reach a ring between two
+        remote processes from here)."""
         for ch in self._channels:
+            if isinstance(ch, ShmChannel):
+                try:
+                    ch.write(b"", tag=TAG_STOP, timeout=0.2)
+                except Exception:
+                    pass
+            else:
+                try:
+                    ch.poison()
+                except Exception:
+                    pass
+        for aid in getattr(self, "_net_actors", ()):
             try:
-                ch.write(b"", tag=TAG_STOP, timeout=0.2)
+                # fire-and-forget: the dead actor's call bounces, the
+                # survivors unpark; waiting here would block the death
+                # path on the very processes being declared dead
+                self._actors[aid].__compiled_poison__.remote(self._uid)
             except Exception:
                 pass
 

@@ -144,6 +144,50 @@ def is_arraylike(v) -> bool:
             and not getattr(v.dtype, "hasobject", True))
 
 
+def tensor_payload(arr):
+    """TAG_TENSOR wire format: [meta_len u32][meta json][raw buffer].
+    One format for every ring transport (shm slots pack it in place via
+    ``write_array``; the net ring ships it as one payload) — the reader
+    side is :func:`parse_tensor` either way."""
+    import json
+
+    import numpy as _np
+
+    view = _np.asarray(arr)
+    if not view.flags.c_contiguous:
+        view = _np.ascontiguousarray(view)
+    raw = view.reshape(-1).view(_np.uint8)
+    meta = json.dumps({"dtype": str(view.dtype),
+                       "shape": list(view.shape)}).encode()
+    return meta, raw
+
+
+def parse_tensor(buf, off: int, to_device: bool):
+    """Materialize a TAG_TENSOR payload from ``buf`` at ``off``.
+    ``to_device`` puts straight onto the local jax device from the
+    source view — no intermediate serialization buffer."""
+    import json
+
+    import numpy as _np
+
+    (meta_len,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    meta = json.loads(bytes(buf[off:off + meta_len]))
+    off += meta_len
+    dtype = _np.dtype(meta["dtype"])
+    shape = tuple(meta["shape"])
+    count = int(_np.prod(shape)) if shape else 1
+    view = _np.frombuffer(buf, dtype=dtype, count=count,
+                          offset=off).reshape(shape)
+    if to_device:
+        import jax
+
+        out = jax.device_put(view)
+        out.block_until_ready()
+        return out
+    return view.copy()
+
+
 class ChannelTimeout(Exception):
     pass
 
@@ -354,16 +398,7 @@ class ShmChannel:
         shared slot in ONE transfer: on the CPU backend ``np.asarray`` of
         a jax.Array is a zero-copy view, so the only host copy is the
         buffer->shm memcpy; on TPU it is the D2H DMA itself."""
-        import json
-
-        import numpy as _np
-
-        view = _np.asarray(arr)
-        if not view.flags.c_contiguous:
-            view = _np.ascontiguousarray(view)
-        raw = view.reshape(-1).view(_np.uint8)
-        meta = json.dumps({"dtype": str(view.dtype),
-                           "shape": list(view.shape)}).encode()
+        meta, raw = tensor_payload(arr)
 
         def fill(mm, off):
             struct.pack_into("<I", mm, off, len(meta))
@@ -403,29 +438,8 @@ class ShmChannel:
 
     def _read_tensor(self, off: int, to_device: bool):
         """Materialize the typed payload BEFORE acking the slot (the
-        writer may overwrite after the ack). ``to_device`` puts straight
-        onto the local jax device from the mapped view — no intermediate
-        serialization buffer."""
-        import json
-
-        import numpy as _np
-
-        (meta_len,) = struct.unpack_from("<I", self._mm, off)
-        off += 4
-        meta = json.loads(bytes(self._mm[off:off + meta_len]))
-        off += meta_len
-        dtype = _np.dtype(meta["dtype"])
-        shape = tuple(meta["shape"])
-        count = int(_np.prod(shape)) if shape else 1
-        view = _np.frombuffer(self._mm, dtype=dtype, count=count,
-                              offset=off).reshape(shape)
-        if to_device:
-            import jax
-
-            out = jax.device_put(view)
-            out.block_until_ready()
-            return out
-        return view.copy()
+        writer may overwrite after the ack)."""
+        return parse_tensor(self._mm, off, to_device)
 
     def close(self, unlink: bool = False) -> None:
         try:

@@ -73,8 +73,12 @@ Each :class:`NetMutations` field deletes exactly one guard; the
 mutation tests assert the explorer reports a violation with a concrete
 counterexample trace for every one of them.
 
-Like :mod:`ring_model`, nothing here imports the transport (there is
-none yet) — the spec must not be able to become the implementation.
+Like :mod:`ring_model`, nothing here imports the transport
+(``ray_tpu/core/net_ring.py`` implements this contract) — the spec
+must not be able to become the implementation.  The two are held in
+lockstep by ``tests/test_net_ring_conformance.py``, which drives the
+real endpoints and this spec through identical scripted + seeded
+traces and compares the mapped protocol state after every op.
 """
 
 from __future__ import annotations

@@ -95,6 +95,8 @@ def test_mpmd_three_stages(ray_start_regular):
 def test_mpmd_validation(ray_start_regular):
     with pytest.raises(ValueError):
         MPMDPipelineTrainer(LAYERS, num_stages=1)
+    with pytest.raises(ValueError):
+        MPMDPipelineTrainer(LAYERS, num_stages=2, schedule="bogus")
     x, y = _data()
     trainer = MPMDPipelineTrainer(LAYERS, num_stages=2, seed=0)
     try:
@@ -102,3 +104,100 @@ def test_mpmd_validation(ray_start_regular):
             trainer.train_step(x, y, num_microbatches=5)  # 32 % 5 != 0
     finally:
         trainer.shutdown()
+
+
+def test_1f1b_bounds_activation_stash_at_k(ray_start_regular):
+    """The 1F1B memory property: with the default schedule, no stage
+    ever stashes more than K activations — even with M >> K
+    microbatches per step — because the in-flight window is K and
+    backward microbatches (which pop the stash) preempt forwards."""
+    x, y = _data(n=48)
+    trainer = MPMDPipelineTrainer(LAYERS, num_stages=2, lr=0.05, seed=5)
+    try:
+        assert trainer.schedule == "1f1b"
+        assert trainer.window == 2
+        trainer.fit(x, y, steps=2, num_microbatches=12)
+        stats = trainer.pipeline_stats()
+        assert stats["stash_max"] <= trainer.num_stages, stats
+        assert stats["microbatches_run"] == 24
+    finally:
+        trainer.shutdown()
+
+
+def test_1f1b_and_gpipe_match_reference_and_each_other(ray_start_regular):
+    """1F1B reorders execution and overlaps the weight update into the
+    drain — the MATH is still full-batch GD, so both schedules must
+    match the single-process reference loss-for-loss and
+    param-for-param."""
+    x, y = _data()
+    ref_losses, ref_params = reference_train_losses(
+        LAYERS, 9, x, y, steps=4, num_microbatches=4, num_stages=2,
+        lr=0.05, return_params=True)
+    for schedule in ("1f1b", "gpipe"):
+        trainer = MPMDPipelineTrainer(LAYERS, num_stages=2, lr=0.05,
+                                      seed=9, schedule=schedule)
+        try:
+            losses = trainer.fit(x, y, steps=4, num_microbatches=4)
+            np.testing.assert_allclose(losses, ref_losses, rtol=1e-5,
+                                       err_msg=schedule)
+            for (gw, gb), (rw, rb) in zip(trainer.get_params(),
+                                          ref_params):
+                np.testing.assert_allclose(gw, rw, rtol=1e-5, atol=1e-6)
+                np.testing.assert_allclose(gb, rb, rtol=1e-5, atol=1e-6)
+        finally:
+            trainer.shutdown()
+
+
+def test_llama_stage_pipeline_matches_reference(ray_start_regular):
+    """Transformer-block stages (models/llama.py blocks): stage 0 owns
+    embedding+blocks, the last stage owns blocks+norm+head+xent; the
+    distributed pipeline must match the in-process replay loss-for-loss
+    and param-for-param, with zero serialized bytes on the stages."""
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.train.pipeline import reference_llama_losses
+
+    cfg = LlamaConfig.debug()
+    rng = np.random.RandomState(2)
+    tokens = rng.randint(0, cfg.vocab_size, (8, 17)).astype(np.int32)
+    trainer = MPMDPipelineTrainer(num_stages=2, lr=0.1, seed=4,
+                                  model="llama", llama_cfg=cfg)
+    try:
+        losses = trainer.fit(tokens, steps=3, num_microbatches=4)
+        ref_losses, ref_params = reference_llama_losses(
+            cfg, 4, tokens, steps=3, num_microbatches=4, num_stages=2,
+            lr=0.1, return_params=True)
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-4,
+                                   atol=1e-5)
+        assert losses[-1] < losses[0]  # it actually trains
+        import jax
+
+        for got, want in zip(trainer.get_params(), ref_params):
+            for g, w in zip(jax.tree_util.tree_leaves(got),
+                            jax.tree_util.tree_leaves(want)):
+                np.testing.assert_allclose(g, w, rtol=1e-3, atol=1e-5)
+        for cs in trainer.channel_stats():
+            assert cs["serialized_bytes"] == 0, cs
+    finally:
+        trainer.shutdown()
+
+
+def test_llama_stage_split_validation():
+    from ray_tpu.models.llama import LlamaConfig, init_params
+    from ray_tpu.train.pipeline import split_llama_stages
+
+    import jax
+
+    cfg = LlamaConfig.debug()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    stages = split_llama_stages(cfg, params, 2)
+    assert "embedding" in stages[0] and "embedding" not in stages[1]
+    assert "lm_head" in stages[-1] and "final_norm" in stages[-1]
+    assert sum(s["layers"]["wq"].shape[0] for s in stages) == cfg.n_layers
+    tied = LlamaConfig(vocab_size=64, dim=16, n_layers=2, n_heads=2,
+                       n_kv_heads=1, mlp_dim=32, max_seq_len=32,
+                       tie_embeddings=True, remat=False)
+    with pytest.raises(ValueError):
+        split_llama_stages(tied, init_params(tied, jax.random.PRNGKey(0)),
+                           2)
+    with pytest.raises(ValueError):
+        split_llama_stages(cfg, params, cfg.n_layers + 1)
