@@ -97,12 +97,257 @@ def peak_flops_per_chip() -> float:
     return 197e12 if d.platform == "tpu" else 1e12  # CPU: nominal
 
 
+def measure_sharded(cfg, mesh, batch, seq, steps, donate=True,
+                    gspmd_parity=False):
+    """One sharded-train measurement (train/spmd.py shard_map step):
+    tokens/s/chip, MFU, and the step-time breakdown the ISSUE asks for
+    — compile (first step), ingest (per-shard device_put dispatch; the
+    transfers themselves overlap compute), steady step time.
+
+    ``mfu`` here is STANDARD MFU (attention FLOPs included, the
+    PaLM/Chinchilla definition); ``mfu_params_only`` is the
+    conservative 6ND-only numerator the headline section reports.
+    """
+    import jax
+    import numpy as np
+
+    from ray_tpu.parallel.sharding import shard_device_put
+    from ray_tpu.train.spmd import make_spmd_train_step
+
+    n_dev = mesh.size
+    init, step, data_sharding, _ = make_spmd_train_step(
+        cfg, mesh, donate=donate)
+    state = init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    pool = [rng.randint(0, cfg.vocab_size,
+                        (batch, seq + 1)).astype(np.int32)
+            for _ in range(4)]
+
+    parity = None
+    if gspmd_parity:
+        # same seed + same first batch through the GSPMD step: the two
+        # programs must produce the same first-step loss
+        from ray_tpu.models.llama import make_train_step
+
+        ginit, gstep, gds, _ = make_train_step(cfg, mesh)
+        gstate = ginit(jax.random.PRNGKey(0))
+        _, gloss = gstep(gstate, jax.device_put(pool[0], gds))
+        parity = float(gloss)
+        del gstate
+
+    # compile + warmup (sync via host fetch; see run_config note)
+    t0 = time.perf_counter()
+    state, loss = step(state, shard_device_put(pool[0], data_sharding))
+    first_loss = float(loss)
+    compile_s = time.perf_counter() - t0
+    for i in range(2):
+        state, loss = step(state, shard_device_put(pool[i % 4],
+                                                   data_sharding))
+    float(loss)
+
+    # timed: double-buffered ingest — batch N+1 is placed (per-shard,
+    # async dispatch) before batch N's step result is awaited
+    ingest_s = 0.0
+    t0 = time.perf_counter()
+    ti = time.perf_counter()
+    pending = shard_device_put(pool[0], data_sharding)
+    ingest_s += time.perf_counter() - ti
+    for i in range(steps):
+        toks = pending
+        ti = time.perf_counter()
+        pending = shard_device_put(pool[(i + 1) % 4], data_sharding)
+        ingest_s += time.perf_counter() - ti
+        state, loss = step(state, toks)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n_params = cfg.num_params()
+    model_flops = 6.0 * n_params * tokens_per_sec
+    attn_flops = (6.0 * cfg.n_layers * cfg.n_heads * seq * cfg.head_dim
+                  * tokens_per_sec)
+    peak = peak_flops_per_chip() * n_dev
+    out = {
+        "platform": jax.devices()[0].platform,
+        "devices": n_dev,
+        "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+        "params_m": round(n_params / 1e6, 1),
+        "batch": batch,
+        "seq": seq,
+        "steps": steps,
+        "donate": bool(donate),
+        "tokens_per_sec": round(tokens_per_sec, 2),
+        "tokens_per_sec_per_chip": round(tokens_per_sec / n_dev, 2),
+        "mfu": round((model_flops + attn_flops) / peak, 4),
+        "mfu_params_only": round(model_flops / peak, 4),
+        "breakdown": {
+            "compile_s": round(compile_s, 3),
+            "ingest_dispatch_ms_per_step": round(1e3 * ingest_s / steps, 3),
+            "step_ms": round(1e3 * dt / steps, 3),
+        },
+        "first_loss": round(first_loss, 6),
+        "final_loss": round(final_loss, 6),
+    }
+    if parity is not None:
+        out["gspmd_first_loss"] = round(parity, 6)
+        out["loss_parity_rel"] = round(
+            abs(first_loss - parity) / max(abs(parity), 1e-9), 6)
+    print(f"# sharded mesh={out['mesh']} devices={n_dev} batch={batch} "
+          f"seq={seq} mfu={out['mfu']:.3f} "
+          f"tok/s/chip={out['tokens_per_sec_per_chip']:.0f} "
+          f"step={out['breakdown']['step_ms']:.1f}ms "
+          f"ingest={out['breakdown']['ingest_dispatch_ms_per_step']:.2f}ms",
+          file=sys.stderr)
+    return out
+
+
+def spmd_bench(args):
+    """--spmd-bench: sharded-train sweep over device counts →
+    BENCH_SPMD.json with a --check gate.
+
+    Each device count runs in a fresh subprocess: real accelerators
+    when the host has that many chips, else virtual CPU devices (the
+    --devices re-exec; the CHILD decides, and reports its platform in
+    the run record — the gates below key off what was actually
+    measured, never the parent's platform). Gates:
+
+    - parity: sharded first-step loss == GSPMD first-step loss (same
+      seed/batch) within 2% at every device count;
+    - scaling: weak-scaling throughput flat or better as devices grow.
+      On real accelerators that is tokens/s/chip (each chip has its own
+      silicon); on a shared-core virtual CPU mesh N devices split one
+      host's compute, so the honest flat-line is TOTAL tokens/s
+      (= per-chip × N, the "host-normalized per-chip" rate) — raw
+      per-chip numbers on virtual devices measure core oversubscription,
+      not SPMD overhead;
+    - ingest: per-shard device_put dispatch stays under 25% of step
+      time (the transfer itself overlaps compute);
+    - mfu: >= 0.55 at devices=1 on TPU hardware. On CPU there is no
+      hardware peak to hold the step to, so the gate is recorded as
+      not-applicable (the committed artifact carries the measured CPU
+      mfu for trend only; BENCH_r0N carries the TPU number).
+    """
+    import subprocess
+
+    devices = [int(d) for d in (args.spmd_devices or "1,2,4").split(",")]
+    runs = []
+    for n in devices:
+        argv = [sys.executable, os.path.abspath(sys.argv[0]),
+                "--spmd", "--devices", str(n), "--steps", str(args.steps)]
+        if args.config != "bench":
+            argv += ["--config", args.config]
+        if args.batch:
+            argv += ["--batch", str(args.batch)]
+        if args.seq:
+            argv += ["--seq", str(args.seq)]
+        proc = subprocess.run(
+            argv, capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(f"spmd child devices={n} failed "
+                               f"rc={proc.returncode}")
+        runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+
+    # gates key off what each child actually measured on (run records
+    # carry the platform), never this parent process's platform
+    platforms = {r.get("platform", "cpu") for r in runs}
+    base = runs[0]
+    gates = {}
+    # parity reference (GSPMD step, same seed/batch) runs on the CPU
+    # children only — hardware runs gate on MFU/scaling instead
+    rels = [r["loss_parity_rel"] for r in runs if "loss_parity_rel" in r]
+    gates["parity"] = {
+        "worst_rel": max(rels) if rels else None,
+        "limit": 0.02,
+        "runs_with_parity": len(rels),
+        "ok": all(r <= 0.02 for r in rels),
+    }
+    # weak scaling: fixed per-chip batch, so the flat line is total
+    # tokens/s on a shared-core virtual mesh, per-chip on real chips.
+    # Ratios compare WITHIN a platform group only (a sweep that spills
+    # past the real chip count mixes TPU and CPU-fallback children —
+    # cross-platform ratios would gate one platform against the other's
+    # throughput and fail spuriously); each group scales vs its own
+    # smallest-device run.
+    groups: dict = {}
+    for r in runs:
+        groups.setdefault(r.get("platform", "cpu"), []).append(r)
+    ratio_rows = []
+    for plat, rs in sorted(groups.items()):
+        key = ("tokens_per_sec" if plat == "cpu"
+               else "tokens_per_sec_per_chip")
+        limit = 0.75 if plat == "cpu" else 0.9
+        b = rs[0]
+        for r in rs[1:]:
+            ratio_rows.append({
+                "platform": plat,
+                "devices": r["devices"],
+                "metric": key,
+                "ratio_vs_smallest": round(r[key] / b[key], 4),
+                "limit": limit,
+            })
+    gates["scaling_flat"] = {
+        "note": "cpu gates on total tokens/s (virtual devices share "
+                "the host cores; per-chip would measure "
+                "oversubscription); hardware gates on tokens/s/chip",
+        "ratios": ratio_rows,
+        "ok": all(r["ratio_vs_smallest"] >= r["limit"]
+                  for r in ratio_rows),
+    }
+    ingest_frac = [
+        r["breakdown"]["ingest_dispatch_ms_per_step"]
+        / max(r["breakdown"]["step_ms"], 1e-9) for r in runs]
+    gates["ingest_overlap"] = {
+        "dispatch_frac": [round(f, 4) for f in ingest_frac],
+        "limit": 0.25,
+        "ok": all(f <= 0.25 for f in ingest_frac),
+    }
+    hw_runs = [r for r in runs if r.get("platform", "cpu") != "cpu"]
+    if hw_runs:
+        hw_base = min(hw_runs, key=lambda r: r["devices"])
+        gates["mfu"] = {"value": hw_base["mfu"],
+                        "devices": hw_base["devices"], "target": 0.55,
+                        "ok": hw_base["mfu"] >= 0.55}
+    else:
+        gates["mfu"] = {
+            "value": base["mfu"],
+            "target": 0.55,
+            "ok": True,
+            "note": "target applies on TPU hardware; CPU has no HW peak "
+                    "to hold the step to — see BENCH_r0N 'sharded' for "
+                    "the TPU number",
+        }
+    out = {
+        "bench": "spmd_sharded_train",
+        "platform": "+".join(sorted(platforms)),
+        "runs": runs,
+        "gates": gates,
+        "check": all(g["ok"] for g in gates.values()),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_SPMD.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({"metric": "spmd_sharded_train", "check": out["check"],
+                      "gates": {k: g["ok"] for k, g in gates.items()},
+                      "path": path}))
+    if args.check and not out["check"]:
+        raise SystemExit(1)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
                         help="tiny config for CPU smoke-testing")
     parser.add_argument("--steps", type=int, default=20)
-    parser.add_argument("--batch", type=int, default=0)
+    parser.add_argument("--batch", type=int, default=0,
+                        help="GLOBAL batch for the GSPMD sections; the "
+                        "--spmd/--spmd-bench weak-scaling sweep "
+                        "interprets it PER-CHIP (global = batch x "
+                        "devices), so the per-chip workload stays fixed "
+                        "as devices grow — don't compare numbers across "
+                        "the two modes at the 'same' --batch")
     parser.add_argument("--seq", type=int, default=0)
     parser.add_argument("--config", default="bench",
                         choices=["debug", "small", "medium", "bench",
@@ -118,24 +363,47 @@ def main():
     parser.add_argument("--mesh", default="",
                         help="axis spec for --devices runs, e.g. "
                         "'fsdp=2,seq=2,tensor=2' (default fsdp=N)")
+    parser.add_argument("--spmd", action="store_true",
+                        help="run ONLY the sharded-train section "
+                        "(train/spmd.py shard_map step) and print its "
+                        "JSON line")
+    parser.add_argument("--spmd-bench", action="store_true",
+                        help="sharded-train sweep over --spmd-devices "
+                        "-> BENCH_SPMD.json")
+    parser.add_argument("--spmd-devices", default="",
+                        help="comma list of device counts for "
+                        "--spmd-bench (default 1,2,4)")
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 if a BENCH_SPMD gate fails")
     args = parser.parse_args()
 
-    if args.devices and os.environ.get("_RAY_TPU_BENCH_CHILD") != "1":
-        import subprocess
+    if args.spmd_bench and os.environ.get("_RAY_TPU_BENCH_CHILD") != "1":
+        spmd_bench(args)
+        return
 
-        env = dict(os.environ)
-        env["_RAY_TPU_BENCH_CHILD"] = "1"
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        flags = [f for f in env.get("XLA_FLAGS", "").split()
-                 if "xla_force_host_platform_device_count" not in f]
-        flags.append(
-            f"--xla_force_host_platform_device_count={args.devices}")
-        env["XLA_FLAGS"] = " ".join(flags)
-        argv = [os.path.abspath(sys.argv[0])] + sys.argv[1:]
-        raise SystemExit(subprocess.run(
-            [sys.executable] + argv, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__))).returncode)
+    if args.devices and os.environ.get("_RAY_TPU_BENCH_CHILD") != "1":
+        # real accelerators win when the host has enough of them: only
+        # re-exec onto a virtual CPU mesh (shared host cores — measures
+        # oversubscription, not silicon) as the fallback
+        import jax as _jax
+
+        if (_jax.devices()[0].platform == "cpu"
+                or len(_jax.devices()) < args.devices):
+            import subprocess
+
+            env = dict(os.environ)
+            env["_RAY_TPU_BENCH_CHILD"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if "xla_force_host_platform_device_count" not in f]
+            flags.append(
+                f"--xla_force_host_platform_device_count={args.devices}")
+            env["XLA_FLAGS"] = " ".join(flags)
+            argv = [os.path.abspath(sys.argv[0])] + sys.argv[1:]
+            raise SystemExit(subprocess.run(
+                [sys.executable] + argv, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__))).returncode)
 
     import jax
     import numpy as np
@@ -144,6 +412,10 @@ def main():
     from ray_tpu.parallel import MeshConfig, make_mesh
 
     n_dev = len(jax.devices())
+    if args.devices:
+        # honor the requested count on hosts with more real chips
+        # (make_mesh slices devices[:product])
+        n_dev = min(n_dev, args.devices)
     on_cpu = args.quick or jax.devices()[0].platform == "cpu"
     if on_cpu:
         # CPU (incl. --devices virtual mesh): debug config unless the user
@@ -169,6 +441,23 @@ def main():
             axes[k.strip()] = int(v)
     mesh = make_mesh(MeshConfig(**axes))
     n_dev = mesh.size  # per-chip metrics count only devices in the mesh
+
+    if args.spmd:
+        # sharded-train section: shard_map step + partition rules +
+        # donated state + overlapped per-shard ingest (train/spmd.py).
+        # Default layout: pure data-parallel over the mesh's devices
+        # (weak scaling — fixed per-chip batch); --mesh may add fsdp.
+        smesh = mesh if args.mesh else make_mesh(
+            axis_sizes={"data": n_dev})
+        per_chip = args.batch or (8 if on_cpu else 16)
+        from ray_tpu.core.config import global_config
+
+        res = measure_sharded(
+            cfg, smesh, per_chip * smesh.size, seq, steps,
+            donate=global_config().train_donate,
+            gspmd_parity=on_cpu)
+        print(json.dumps(res))
+        return
 
     def run_config(cfg, batch, seq, steps, flagship=False):
         """Measure one training config; returns the metrics dict."""
@@ -234,6 +523,22 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": primary["vs_baseline"],
     }
+    if not on_cpu:
+        # ride-along sharded-train section on hardware (ISSUE 14 gate:
+        # standard MFU >= 0.55 at devices=1): shard_map step, donated
+        # state, overlapped per-shard ingest, batch 16/chip. Never
+        # loses the headline on failure.
+        try:
+            from ray_tpu.core.config import global_config
+
+            _compile_cleanup()
+            smesh = make_mesh(axis_sizes={"data": n_dev})
+            out["sharded"] = run_with_compile_retries(
+                lambda: measure_sharded(
+                    cfg, smesh, 16 * n_dev, seq, max(5, args.steps // 2),
+                    donate=global_config().train_donate))
+        except Exception as e:  # noqa: BLE001 — headline survives
+            out["sharded"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     # the flagship pass (1B, the largest single-v5e-chip config) rides
     # along on real hardware: BENCH_r{N} then carries both the 664M trend
     # line and the flagship MFU (round-4 VERDICT ask #10)

@@ -27,6 +27,8 @@ from ray_tpu.collective.collective import (  # noqa: F401
     destroy_collective_group,
     get_group_handle,
     init_collective_group,
+    pmean_tree,
+    psum_tree,
     recv,
     reduce,
     reducescatter,

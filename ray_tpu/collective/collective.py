@@ -181,6 +181,60 @@ class XlaGroup:
 
 
 # --------------------------------------------------------------------------- #
+# In-program collectives (for shard_map / pmap bodies)
+# --------------------------------------------------------------------------- #
+#
+# The group classes above are *host-level* collectives: eager calls from
+# driver code over materialized tensors. These helpers are the *traced*
+# counterpart — called INSIDE a shard_map/pmap program (e.g. the SPMD
+# train step's gradient reduction, train/spmd.py), where the axis names
+# of the enclosing mesh are in scope. They ride the same jax_compat
+# shims the XlaGroup programs compile through, so one spelling works on
+# every supported jax build.
+
+
+def psum_tree(tree, axis_names):
+    """Sum every leaf of ``tree`` over ``axis_names`` (str or sequence).
+
+    Inside shard_map this lowers to one fused cross-replica all-reduce
+    per leaf (XLA combines adjacent psums over the same axes)."""
+    import jax
+
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    axis_names = tuple(axis_names)
+    if not axis_names:
+        return tree
+
+    def red(x):
+        for ax in axis_names:
+            x = jax.lax.psum(x, ax)
+        return x
+
+    return jax.tree.map(red, tree)
+
+
+def pmean_tree(tree, axis_names):
+    """Mean of every leaf over ``axis_names`` — the gradient reduction
+    of a data-parallel shard_map train step. The divisor comes from
+    :func:`ray_tpu.util.jax_compat.axis_size`, which folds to a
+    trace-time constant on every supported build."""
+    import jax
+
+    from ray_tpu.util.jax_compat import axis_size
+
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    axis_names = tuple(axis_names)
+    if not axis_names:
+        return tree
+    denom = 1
+    for ax in axis_names:
+        denom = denom * axis_size(ax)
+    return jax.tree.map(lambda x: x / denom, psum_tree(tree, axis_names))
+
+
+# --------------------------------------------------------------------------- #
 # Cross-process store-backed group (gloo analog)
 # --------------------------------------------------------------------------- #
 

@@ -227,6 +227,21 @@ class Config:
     # ---- collective ----
     collective_timeout_s: float = 300.0
 
+    # ---- sharded training (train/spmd.py) ----
+    # mesh axis spec for the SPMD train loop, e.g. "data=4,fsdp=2";
+    # empty = pure data-parallel over all local devices. The same
+    # config runs devices=1 and devices=N — with one device every
+    # collective folds to the identity.
+    train_mesh: str = ""
+    # donate the carried train state on the jit step (params/optimizer
+    # buffers alias their outputs — in-place update instead of a full
+    # state copy per step). Toggle exists so benches can price it.
+    train_donate: bool = True
+    # batches kept in flight by the sharded to_jax ingest path
+    # (per-shard device_put double-buffering: host→device transfer of
+    # batch N+1 overlaps compute on batch N)
+    train_ingest_prefetch: int = 2
+
     def __post_init__(self):
         for f in fields(self):
             cur = getattr(self, f.name)

@@ -54,6 +54,10 @@ dials the reader process's :class:`NetRingHost` listener):
 
     writer -> host:   ("nring", ring_id)          attach to the ring
     writer -> reader: ("nrd", seq, tag, payload)  data (seq from 1)
+                      ("nrdv", seq, tag, nbytes)  data header; the next
+                                                  frame is the raw
+                                                  writev'd segment body
+                                                  (tensor zero-copy)
                       ("nrbase", acked)           resync reply
     reader -> writer: ("nra", r)                  cumulative ack
                       ("nrrq",)                   resync request
@@ -98,6 +102,50 @@ from .fault_injection import should_drop as _fault_should_drop
 _SPIN_ITERS = 1000
 
 
+class _Segments(tuple):
+    """A tensor payload kept as its framed segments — (len-prefix, meta,
+    raw buffer view) — all the way to the socket write.
+
+    ``_LockedSend.send_segments`` writevs the segments straight into
+    the connection as one mpc-framed body, so NO joined intermediate
+    copy of the tensor ever exists on the send path (the shm rings'
+    pack-into-the-slot equivalent for TCP). Instances sit in
+    ``_unacked`` as-is for retransmission: the segments are VIEWS of
+    the produced array, retained until acked per the durable-slot
+    contract — which makes ``write_array`` an ownership transfer
+    (MPI_Isend semantics): the caller must not mutate the array until
+    it is acked, or a retransmit after a session break/stall ships the
+    mutated bytes. The compiled-graph producers honor this by
+    construction — jax arrays are immutable and each execution
+    produces fresh numpy results; a caller recycling one host buffer
+    must copy before writing."""
+
+    __slots__ = ()
+
+    @property
+    def total(self) -> int:
+        return sum(len(s) for s in self)
+
+    def join(self) -> bytes:
+        """Materialize (the non-writev fallback); counted as a copy."""
+        STATS["tensor_copy_bytes"] += self.total
+        return b"".join(bytes(s) if not isinstance(s, bytes) else s
+                        for s in self)
+
+
+def _writev_all(fd, buffers) -> None:
+    """``os.writev`` the buffer list fully (blocking fd): partial writes
+    advance across segment boundaries without re-buffering."""
+    bufs = [memoryview(b).cast("B") for b in buffers if len(b)]
+    while bufs:
+        n = os.writev(fd, bufs)
+        while bufs and n >= len(bufs[0]):
+            n -= len(bufs[0])
+            bufs.pop(0)
+        if n and bufs:
+            bufs[0] = bufs[0][n:]
+
+
 class _LockedSend:
     """Serialize sends on one duplex connection: the consume thread's
     acks and the serve/rx thread's protocol replies share the socket,
@@ -116,15 +164,49 @@ class _LockedSend:
         with self._lock:
             self._conn.send(msg)  # graftlint: ignore[blocking-under-lock]
 
+    def send_segments(self, header_msg, segments: _Segments) -> None:
+        """Two frames under one lock hold: the pickled header tuple,
+        then the segments writev'd as a single raw mpc-framed body
+        (``!i`` length prefix — same framing ``Connection.send_bytes``
+        emits, so the peer's ``recv_bytes`` reads it back verbatim).
+        The lock keeps the frame pair adjacent on the stream."""
+        import struct
+
+        total = segments.total
+        if total > 0x7FFFFFFF:  # mpc large-frame pre-header territory
+            raise ValueError(f"segment body of {total}B exceeds the "
+                             f"single-frame limit")
+        frame = [struct.pack("!i", total)] + list(segments)
+        with self._lock:
+            self._conn.send(header_msg)  # graftlint: ignore[blocking-under-lock]
+            _writev_all(self._conn.fileno(), frame)  # graftlint: ignore[blocking-under-lock]
+
 
 def _net_send(send, tag: str, *payload) -> bool:
     """Send one net-ring message through ``send`` with the chaos
     wire-point applied. Returns False when the message was dropped (by
     injection or a broken session) — callers never raise: the protocol
-    recovers every loss via retransmit/re-ack."""
+    recovers every loss via retransmit/re-ack.
+
+    A data message whose payload is a :class:`_Segments` rides the
+    writev path when the session sender supports it: the wire carries
+    ``("nrdv", seq, tag, nbytes)`` followed by the raw framed body (the
+    serve loop reassembles ``("nrd", seq, tag, body)`` before applying
+    it, so the protocol state machine sees one identical "nrd" either
+    way — the chaos point is likewise keyed "nrd" for both spellings).
+    Senders without a socket (model-conformance harnesses, scripted
+    traces) fall back to joining — the copy the counter then records."""
     if _fault_should_drop("wire.send", tag):
         return False
     try:
+        if payload and isinstance(payload[-1], _Segments):
+            body = payload[-1]
+            if tag == "nrd" and hasattr(send, "send_segments"):
+                send.send_segments(
+                    ("nrdv",) + payload[:-1] + (body.total,), body)
+                return True
+            send((tag,) + payload[:-1] + (body.join(),))
+            return True
         send((tag,) + payload)
         return True
     except Exception:
@@ -287,13 +369,25 @@ class NetRingWriter(_Endpoint):
 
     def write_array(self, arr, timeout: Optional[float] = None) -> None:
         """Typed-tensor path: same wire format as the shm TENSOR slots
-        ([meta_len][meta][raw]) and no OBJECT serializer on either end
-        — the remaining copies are the payload assembly (one join) and
-        the connection framing; raw send_bytes/sendfile bodies are the
-        roadmapped follow-up for MB-scale activations."""
+        ([meta_len][meta][raw]) and no OBJECT serializer on either end.
+        The payload stays a :class:`_Segments` (prefix, meta, raw view)
+        all the way to the socket, where the session sender writevs the
+        framed body — zero full-tensor copies between the produced
+        array and the TCP stream (``STATS["tensor_copy_bytes"]``
+        asserts it; the pre-writev code paid one copy joining the
+        segments and a second pickling the joined payload).
+
+        Zero-copy contract: the array is borrowed until acked (the
+        retransmit buffer holds views, not a snapshot — see
+        :class:`_Segments`). Don't mutate a numpy ``arr`` after
+        writing; pass a copy if the buffer is recycled."""
         meta, raw = tensor_payload(arr)
-        payload = b"".join((len(meta).to_bytes(4, "little"), meta,
-                            memoryview(raw)))
+        payload = _Segments((len(meta).to_bytes(4, "little"), meta,
+                             memoryview(raw)))
+        if payload.total > self.capacity:
+            raise ValueError(
+                f"message of {payload.total}B exceeds channel slot "
+                f"capacity {self.capacity}B (raise buffer_size_bytes)")
         self._wait(self.writable, timeout)
         self.produce(payload, TAG_TENSOR)
         STATS["tensor_bytes"] += raw.nbytes
@@ -665,6 +759,15 @@ class NetRingHost:
             reader.start_resync()
             while self._alive:
                 msg = conn.recv()
+                if (isinstance(msg, tuple) and msg
+                        and msg[0] == "nrdv"):
+                    # writev'd data: the header frame names the body
+                    # length; the next frame on this connection IS the
+                    # raw body (the sender holds its lock across the
+                    # pair, so no frame interleaves). Reassembled into
+                    # the canonical "nrd" before the state machine.
+                    body = conn.recv_bytes()
+                    msg = ("nrd", msg[1], msg[2], body)
                 reader.on_message(msg, reply=my_send)
         except (EOFError, OSError, TypeError, ValueError):
             pass  # session over: writer re-dials and retransmits

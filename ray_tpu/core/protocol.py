@@ -36,7 +36,12 @@ from .fault_injection import should_drop as _fault_should_drop
 # tags/payload shapes — mixed-version clusters fail fast with a clear
 # error instead of unpickling garbage (the pickle-schema analog of the
 # reference's versioned protobuf wire format, src/ray/protobuf/).
-PROTOCOL_VERSION = 9  # v9: cross-host compiled-graph rings. ADDED the
+PROTOCOL_VERSION = 10  # v10: zero-copy net-ring tensor bodies. ADDED
+# "nrdv" (data-with-raw-body: header (nrdv, seq, tag, nbytes) followed
+# by one raw mpc frame carrying the writev'd segment body; the serve
+# loop reassembles the canonical "nrd" before the protocol state
+# machine — see core/net_ring.py _net_send/send_segments).
+# (v9: cross-host compiled-graph rings. ADDED the
 # NetRing session ops (core/net_ring.py, the machine-checked
 # ring-protocol-net transport): "nring" (writer hello naming a ring id),
 # "nrd" (data: seq + tag + payload), "nra" (cumulative ack), "nrrq"

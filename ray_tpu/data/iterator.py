@@ -204,8 +204,17 @@ class DataIterator:
         before batch N is handed to the consumer (dispatch is async), so
         host-side rechunk/transfer overlaps device compute on the
         current batch (SURVEY.md §7.6 / tf.data prefetch-to-device).
+
+        Sharding-aware: when ``sharding`` spans multiple devices, each
+        batch is sliced into the exact shards the sharding prescribes
+        and placed per-device (``parallel.sharding.shard_device_put``)
+        — N independent async transfers of batch/N bytes each instead
+        of one global put, so the sharded train step's ingest overlaps
+        compute the same way the single-device path does.
         """
         import jax
+
+        from ray_tpu.parallel.sharding import shard_device_put
 
         def place(batch: Dict[str, np.ndarray]):
             if columns:
@@ -214,7 +223,7 @@ class DataIterator:
                 batch = {k: v.astype(dtypes[k]) if k in dtypes else v
                          for k, v in batch.items()}
             if sharding is not None:
-                return {k: jax.device_put(v, sharding)
+                return {k: shard_device_put(v, sharding)
                         for k, v in batch.items()}
             return {k: jax.device_put(v) for k, v in batch.items()}
 

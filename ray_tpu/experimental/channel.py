@@ -70,7 +70,12 @@ TAG_BYTES = 4   # raw bytes payload: serializer skipped entirely
 # buffer->buffer). The authoritative hot-path counters — the registry
 # metrics below are flushed FROM these off the dispatch path.
 STATS = {"serialized_bytes": 0, "tensor_bytes": 0, "raw_bytes": 0,
-         "messages": 0}
+         "messages": 0,
+         # full-tensor intermediate copies made ASSEMBLING a tensor
+         # payload on a send path (shm packs slots in place = 0; the
+         # net ring writevs framed segments = 0, except on sends that
+         # fall back to joining, e.g. model-conformance harness sends)
+         "tensor_copy_bytes": 0}
 
 # Registry metrics (satellite: the channel accounting must be visible to
 # the standard observability surfaces, not just a module dict). Counter

@@ -233,6 +233,14 @@ def _layer(cfg: LlamaConfig, mesh, x, layer_params, positions):
     g = jax.nn.silu(h @ p["w_gate"].astype(cd))
     u = h @ p["w_up"].astype(cd)
     x = x + ((g * u) @ p["w_down"].astype(cd)).astype(x.dtype)
+    if mesh is not None and mesh.size > 1:
+        # pin the residual stream's layout at every block boundary:
+        # without the constraint GSPMD is free to pick a different
+        # sharding for the scan carry than the embed output, paying a
+        # resharding collective on entry/exit of every layer
+        from ray_tpu.parallel.sharding import constraint
+
+        x = constraint(x, ("batch", "seq", None), mesh)
     return x
 
 

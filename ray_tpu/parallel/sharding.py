@@ -146,3 +146,29 @@ def constraint(x, logical_axes, mesh=None, rules=None):
             return x
     return jax.lax.with_sharding_constraint(
         x, logical_sharding(logical_axes, mesh, rules))
+
+
+def shard_device_put(x, sharding):
+    """Per-shard host→device placement for ingest.
+
+    Slices the host array into exactly the shards ``sharding``
+    prescribes and ``device_put``s each slice straight onto its device,
+    assembling the global array with
+    ``jax.make_array_from_single_device_arrays`` — each device's H2D
+    copy is a separate async transfer of batch/N bytes, dispatched
+    back-to-back, instead of one synchronous global put. With a single
+    device (or a fully-replicated spec) this degrades to a plain
+    ``device_put``.
+    """
+    import jax
+    import numpy as np
+
+    devices = getattr(sharding, "device_set", None)
+    if devices is None or len(devices) <= 1:
+        return jax.device_put(x, sharding)
+    x = np.asarray(x) if not isinstance(x, np.ndarray) else x
+    index_map = sharding.addressable_devices_indices_map(x.shape)
+    shards = [jax.device_put(np.ascontiguousarray(x[idx]), d)
+              for d, idx in index_map.items()]
+    return jax.make_array_from_single_device_arrays(
+        x.shape, sharding, shards)

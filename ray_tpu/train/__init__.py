@@ -35,4 +35,12 @@ from ray_tpu.train.pipeline import (  # noqa: F401
     reference_train_losses,
     split_stages,
 )
+from ray_tpu.train.spmd import (  # noqa: F401
+    build_train_mesh,
+    llama_partition_rules,
+    make_shard_and_gather_fns,
+    make_spmd_train_step,
+    match_partition_rules,
+    spmd_train_loop,
+)
 from ray_tpu.train.trainer import JaxTrainer, Result  # noqa: F401

@@ -50,17 +50,27 @@ class JaxBackend(Backend):
     ``jax.distributed.initialize`` plus megascale-style env for multi-slice.
     """
 
-    def __init__(self, coordinator_port: int = 8476):
+    def __init__(self, coordinator_port: int = 8476,
+                 train_overrides: Optional[Dict[str, Any]] = None):
         self.coordinator_port = coordinator_port
+        # per-gang Config field overrides (e.g. {"train_mesh": "fsdp=4",
+        # "train_donate": False}) applied on every worker before the
+        # loop starts — the per-run counterpart of the cluster-wide
+        # RAY_TPU_TRAIN_* knobs the Config snapshot ships
+        self.train_overrides = dict(train_overrides or {})
 
     def on_start(self, worker_metadata: List[dict]) -> List[dict]:
         n = len(worker_metadata)
+        base: Dict[str, Any] = {}
+        if self.train_overrides:
+            base["config_overrides"] = self.train_overrides
         if n == 1:
-            return [{}]
+            return [dict(base)]
         coord_ip = worker_metadata[0].get("ip", "127.0.0.1")
         coord = f"{coord_ip}:{self.coordinator_port}"
         return [
             {
+                **base,
                 "env": {
                     "JAX_COORDINATOR_ADDRESS": coord,
                     "JAX_NUM_PROCESSES": str(n),
@@ -104,6 +114,21 @@ class TrainWorker:
               dataset_shards: Optional[Dict[str, Any]]) -> bool:
         for k, v in backend_payload.get("env", {}).items():
             os.environ[k] = v
+        overrides = backend_payload.get("config_overrides")
+        if overrides:
+            from ray_tpu.core.config import global_config, set_global_config
+
+            cfg = global_config()
+            # validate the whole payload BEFORE touching the live
+            # config — global_config() is the shared singleton, so a
+            # mid-loop raise would leave it half-overridden
+            unknown = [k for k in overrides if not hasattr(cfg, k)]
+            if unknown:
+                raise ValueError(f"unknown Config field(s) {unknown!r} "
+                                 f"in backend config_overrides")
+            for k, v in overrides.items():
+                setattr(cfg, k, v)
+            set_global_config(cfg)
         jd = backend_payload.get("jax_distributed")
         if jd is not None:
             import jax

@@ -1,0 +1,521 @@
+"""SPMD sharded training: regex partition rules + a shard_map train step.
+
+This is the manual-SPMD counterpart of the GSPMD path in
+``models/llama.py:make_train_step``: instead of letting XLA infer every
+collective from output shardings, the parallelism is written down —
+
+- **Regex partition rules** (``match_partition_rules``) map '/'-joined
+  param-tree paths to ``PartitionSpec``s (the EasyLM/fmengine idiom, see
+  SNIPPETS.md [1]): one table names how every weight shards, checkable
+  at a glance, and applies to checkpoints loaded from disk just as well
+  as to freshly-initialized trees.
+- **Shard/gather fns** (``make_shard_and_gather_fns``) are jit-compiled
+  per-leaf placement programs: ``shard`` lays a host (or replicated)
+  leaf out across the mesh, ``gather`` pulls a sharded leaf back to a
+  fully-replicated array for checkpointing. Round-tripping a tree
+  through shard→gather is byte-identical per leaf (tested).
+- **The shard_map train step** (``make_spmd_train_step``) runs the
+  per-device program explicitly: each device all-gathers the param
+  shards it needs (``fsdp`` axis), computes loss/grad on its batch
+  shard with plain single-device model code (``mesh=None`` — no nested
+  GSPMD), and the cross-replica gradient reduction rides the
+  ``collective`` package's in-program psum/pmean (which go through the
+  ``util.jax_compat`` shims, so the step runs on both shard_map
+  spellings). fsdp-sharded leaves reduce-scatter their grads back to
+  shards (ZeRO-3: optimizer state stays sharded); replicated leaves
+  psum. The jit step donates the carried state, so XLA aliases every
+  param/optimizer buffer to its output and updates in place instead of
+  writing a second copy of the training state per step.
+- **Sharded ingest** (``data/iterator.py to_jax`` +
+  ``parallel/sharding.py shard_device_put``) slices each host batch
+  into exactly the shards the data sharding prescribes and device_puts
+  them per-device, double-buffered, so host→device transfer of batch
+  N+1 overlaps compute on batch N.
+
+The same config runs devices=1 and devices=N: the mesh comes from the
+``RAY_TPU_TRAIN_MESH`` Config knob (e.g. ``"data=4,fsdp=2"``) or
+defaults to pure data-parallel over all local devices; with one device
+every collective folds to the identity.
+
+Supported mesh axes here: the batch axes (``slice``/``data``) plus
+``fsdp`` (param + optimizer-state sharding). Tensor/sequence/pipeline
+parallelism stay on the GSPMD/pipeline paths (``make_train_step`` /
+``make_pipeline_train_step``), which this step matches numerically
+(same-seed loss parity is tested — both draw init through
+``ensure_sharding_invariant_rng``).
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "match_partition_rules",
+    "make_shard_and_gather_fns",
+    "llama_partition_rules",
+    "make_spmd_train_step",
+    "spmd_train_loop",
+    "tree_paths",
+]
+
+
+# --------------------------------------------------------------------------- #
+# Regex partition rules (SNIPPETS.md [1]: match_partition_rules)
+# --------------------------------------------------------------------------- #
+
+
+def tree_paths(tree, sep: str = "/"):
+    """Mirror ``tree`` with '/'-joined key-path strings at the leaves."""
+    import jax
+    from jax.tree_util import tree_map_with_path
+
+    def name(path):
+        parts = []
+        for k in path:
+            parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+        return sep.join(parts)
+
+    return tree_map_with_path(lambda p, _: name(p), tree)
+
+
+def match_partition_rules(rules, params, sep: str = "/"):
+    """Pytree of PartitionSpec from ``rules``: ordered (regex, spec)
+    pairs matched with ``re.search`` against each leaf's '/'-joined
+    path. Scalars and size-1 leaves never partition. A leaf no rule
+    matches is an error — silent replication of a large weight is the
+    classic way to quietly lose FSDP memory savings."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def spec_for(name, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                return spec
+        raise ValueError(f"no partition rule matches param {name!r}")
+
+    names = tree_paths(params, sep)
+    return jax.tree.map(spec_for, names, params)
+
+
+def llama_partition_rules():
+    """Partition rules for the llama param tree (models/llama.py).
+
+    Mirrors ``parallel/sharding.DEFAULT_RULES``'s logical-axis mapping
+    (embed→fsdp, heads/kv_heads/mlp/vocab→tensor) but keyed by name, so
+    the table reads like the model: every projection shards its embed
+    dim over ``fsdp`` and its heads/mlp dim over ``tensor``; the scan
+    ('layers') dim never shards."""
+    from jax.sharding import PartitionSpec as P
+
+    return (
+        # embedding: (vocab, embed)
+        (r"(^|/)embedding$", P("tensor", "fsdp")),
+        # q/k/v and gate/up: (L, embed, heads*hd | mlp)
+        (r"layers/w(q|k|v)$", P(None, "fsdp", "tensor")),
+        (r"layers/w_(gate|up)$", P(None, "fsdp", "tensor")),
+        # output projections: (L, heads*hd | mlp, embed)
+        (r"layers/(wo|w_down)$", P(None, "tensor", "fsdp")),
+        # norm scales: replicated
+        (r"norm$", P()),
+        # lm_head: (embed, vocab)
+        (r"(^|/)lm_head$", P("fsdp", "tensor")),
+    )
+
+
+def _restrict_spec(spec, mesh):
+    """Drop mesh axes the spec names that this mesh does not have (or
+    has at size 1 — ``make_mesh`` omits size-1 axes from the name set),
+    so one rule table serves every layout."""
+    from jax.sharding import PartitionSpec as P
+
+    def live(axes):
+        if axes is None:
+            return None
+        if isinstance(axes, (tuple, list)):
+            keep = tuple(a for a in axes if a in mesh.axis_names)
+            return keep if keep else None
+        return axes if axes in mesh.axis_names else None
+
+    return P(*(live(a) for a in spec))
+
+
+def make_shard_and_gather_fns(partition_specs, mesh, dtype_specs=None):
+    """Per-leaf jit-compiled placement fns from a PartitionSpec pytree.
+
+    ``shard_fns[leaf](host_array)`` lays the leaf out across ``mesh``
+    per its spec (optionally casting float leaves to ``dtype_specs``);
+    ``gather_fns[leaf](sharded)`` returns the fully-replicated array.
+    Compilation is per-leaf and cached by jax, so checkpoint load/save
+    of a whole tree costs one compiled program per distinct
+    (shape, dtype, spec)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def to_dtype(x):
+        if dtype_specs is not None and jax.numpy.issubdtype(
+                getattr(x, "dtype", np.int32), jax.numpy.floating):
+            return x.astype(dtype_specs)
+        return x
+
+    # one jitted callable per DISTINCT sharding (jax's jit cache keys on
+    # the callable identity first, so a fresh wrapper per leaf would
+    # compile per leaf even when dozens share (shape, dtype, spec))
+    jitted: Dict[Any, Any] = {}
+
+    def placement_fn(sharding):
+        if sharding not in jitted:
+            jitted[sharding] = jax.jit(to_dtype, out_shardings=sharding)
+        return jitted[sharding]
+
+    def make_shard(spec):
+        fn = placement_fn(NamedSharding(mesh, _restrict_spec(spec, mesh)))
+
+        def shard(x):
+            return fn(x)
+
+        return shard
+
+    gather_jit = jax.jit(lambda x: x,
+                         out_shardings=NamedSharding(mesh, P()))
+
+    def make_gather(spec):
+        def gather(x):
+            return gather_jit(x)
+
+        return gather
+
+    is_spec = lambda x: isinstance(x, jax.sharding.PartitionSpec)  # noqa: E731
+    shard_fns = jax.tree.map(make_shard, partition_specs, is_leaf=is_spec)
+    gather_fns = jax.tree.map(make_gather, partition_specs, is_leaf=is_spec)
+    return shard_fns, gather_fns
+
+
+# --------------------------------------------------------------------------- #
+# shard_map train step (manual DP + fsdp ZeRO-3)
+# --------------------------------------------------------------------------- #
+
+
+def make_spmd_train_step(cfg, mesh, optimizer=None, rules=None,
+                         donate: bool = True):
+    """Build (init, step, data_sharding, state_shardings) with the SPMD
+    program written out in shard_map, matching ``make_train_step``'s
+    contract and numerics.
+
+    Per device: all-gather fsdp param shards → single-device
+    loss/grad (``loss_fn(..., mesh=None)``) on the local batch shard →
+    grad reduction via ``collective.pmean_tree`` (psum through the
+    jax_compat shims) with fsdp leaves reduce-scattered back to shards
+    → optax update on the shards (ZeRO-3).
+
+    A caller-supplied ``optimizer`` runs INSIDE shard_map on the fsdp
+    shards, so per-leaf elementwise transforms (adam/adamw moments,
+    per-leaf clipping, weight decay) are exact, but transforms that
+    mix leaves or need a GLOBAL statistic — ``clip_by_global_norm``,
+    lamb's trust ratio — would compute it over each device's shard
+    only and silently diverge from the GSPMD step. Use
+    ``make_train_step`` for those, or reduce the statistic explicitly
+    (psum over the fsdp axis) in a custom transform.
+
+    ``donate=True`` donates the carried state (params + optimizer
+    moments + step), so XLA aliases every param/moment input buffer to
+    its output and updates in place — without it each step writes a
+    second full copy of the training state before freeing the first.
+    The token batch is deliberately NOT donated: an int32 input has no
+    same-shape/dtype output to alias onto, so XLA would ignore the
+    donation (with a warning) — the per-step ingest copy is killed on
+    the data path instead (fresh per-shard ``device_put`` buffers,
+    double-buffered — see ``DataIterator.to_jax``). Callers that
+    re-feed one token buffer every step (benches) work unchanged.
+    Toggle via the ``RAY_TPU_TRAIN_DONATE`` Config knob when comparing
+    (``spmd_train_loop`` threads it through)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.collective import pmean_tree
+    from ray_tpu.models.llama import init_params, loss_fn
+    from ray_tpu.parallel.sharding import opt_state_shardings
+    from ray_tpu.util.jax_compat import (
+        axis_size,
+        ensure_sharding_invariant_rng,
+        shard_map,
+    )
+
+    for ax in ("tensor", "seq", "pipe", "expert"):
+        if ax in mesh.axis_names and mesh.shape[ax] > 1:
+            raise ValueError(
+                f"make_spmd_train_step shards over batch axes + fsdp only; "
+                f"mesh has live {ax!r} axis — use make_train_step (GSPMD) "
+                f"or make_pipeline_train_step for that layout")
+
+    ensure_sharding_invariant_rng()
+    optimizer = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95,
+                                         weight_decay=0.1)
+
+    from ray_tpu.parallel.mesh import batch_sharding, data_axes
+
+    batch_axes = data_axes(mesh)  # the canonical ("slice","data","fsdp")
+    fsdp = "fsdp" if "fsdp" in mesh.axis_names else None
+    dp_axes = tuple(a for a in batch_axes if a != "fsdp")
+    repl = NamedSharding(mesh, P())
+    data_sharding = batch_sharding(mesh)
+    data_spec = data_sharding.spec
+
+    sample_params = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    param_specs = jax.tree.map(
+        lambda s: _restrict_spec(s, mesh),
+        match_partition_rules(rules or llama_partition_rules(),
+                              sample_params),
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+    param_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_specs,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+
+    def init_state(key):
+        params = init_params(cfg, key)
+        return {"params": params, "opt_state": optimizer.init(params),
+                "step": jnp.zeros((), jnp.int32)}
+
+    sample = jax.eval_shape(init_state, jax.random.PRNGKey(0))
+    state_shardings = {
+        "params": param_shardings,
+        "opt_state": opt_state_shardings(
+            optimizer, sample["params"], param_shardings, repl),
+        "step": repl,
+    }
+    init_jit = jax.jit(init_state, out_shardings=state_shardings)
+
+    state_specs = jax.tree.map(lambda s: s.spec, state_shardings,
+                               is_leaf=lambda x: isinstance(x, NamedSharding))
+
+    def gather_leaf(p, spec):
+        """Local shard → full leaf (the fsdp all-gather)."""
+        for dim, ax in enumerate(spec):
+            if ax is not None:
+                p = jax.lax.all_gather(p, ax, axis=dim, tiled=True)
+        return p
+
+    def reduce_leaf(g, spec):
+        """Full local grad → globally-reduced shard: mean over every
+        batch axis; fsdp leaves keep only their scatter shard (the
+        all-gather's transpose)."""
+        for ax in dp_axes:
+            g = jax.lax.psum(g, ax)
+        if fsdp is not None:
+            dims = [d for d, ax in enumerate(spec)
+                    if ax is not None and (ax == fsdp or fsdp in (
+                        ax if isinstance(ax, tuple) else (ax,)))]
+            if dims:
+                g = jax.lax.psum_scatter(g, fsdp, scatter_dimension=dims[0],
+                                         tiled=True)
+            else:
+                g = jax.lax.psum(g, fsdp)
+        denom = 1
+        for ax in batch_axes:
+            denom = denom * axis_size(ax)
+        return g / denom
+
+    def sm_step(state, tokens):
+        # params-major maps: the array tree's structure governs, so the
+        # PartitionSpec leaves (tuple subclasses) are passed whole
+        full_params = jax.tree.map(gather_leaf, state["params"], param_specs)
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, tokens, mesh=None))(full_params)
+        grads = jax.tree.map(reduce_leaf, grads, param_specs)
+        loss = pmean_tree(loss, batch_axes)
+        updates, new_opt = optimizer.update(grads, state["opt_state"],
+                                            state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return ({"params": new_params, "opt_state": new_opt,
+                 "step": state["step"] + 1}, loss)
+
+    sharded_step = shard_map(
+        sm_step, mesh=mesh,
+        in_specs=(state_specs, data_spec),
+        out_specs=(state_specs, P()),
+        check=False)
+
+    train_step = jax.jit(
+        sharded_step,
+        in_shardings=(state_shardings, data_sharding),
+        out_shardings=(state_shardings, repl),
+        donate_argnums=(0,) if donate else (),
+    )
+    return init_jit, train_step, data_sharding, state_shardings
+
+
+# --------------------------------------------------------------------------- #
+# Train-loop wiring (JaxTrainer default loop)
+# --------------------------------------------------------------------------- #
+
+
+def parse_mesh_spec(spec: str) -> Dict[str, int]:
+    """``"data=4,fsdp=2"`` → ``{"data": 4, "fsdp": 2}``."""
+    axes: Dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad mesh spec part {part!r} in {spec!r}")
+        k, v = part.split("=", 1)
+        axes[k.strip()] = int(v)
+    return axes
+
+
+def build_train_mesh(spec: str = "", devices=None):
+    """Mesh for the sharded train loop: ``spec`` (the
+    ``RAY_TPU_TRAIN_MESH`` knob / config key) or pure data-parallel
+    over all local devices when empty. The same empty spec therefore
+    runs devices=1 and devices=N unchanged."""
+    import jax
+
+    from ray_tpu.parallel import make_mesh
+
+    from ray_tpu.parallel.mesh import AXIS_ORDER
+
+    devs = list(devices) if devices is not None else jax.devices()
+    axes = parse_mesh_spec(spec)
+    unknown = [k for k in axes if k not in AXIS_ORDER]
+    if unknown:
+        # make_mesh keeps only AXIS_ORDER names, so a typo'd axis would
+        # otherwise yield a silent size-1 mesh (no parallelism at all)
+        raise ValueError(f"unknown mesh axis(es) {unknown!r} in "
+                         f"{spec!r}; valid axes: {AXIS_ORDER}")
+    if not axes:
+        axes = {"data": len(devs)}
+    n = int(np.prod(list(axes.values())))
+    if n > len(devs):
+        raise ValueError(f"mesh spec {spec!r} needs {n} devices, "
+                         f"have {len(devs)}")
+    return make_mesh(axis_sizes=axes, devices=devs[:n])
+
+
+def _synthetic_token_batches(vocab_size: int, batch: int, seq: int,
+                             seed: int = 0, distinct: int = 8):
+    """Host-side token stream for loops without a dataset: ``distinct``
+    pre-generated numpy batches cycled forever (generation cost off the
+    measured path, fresh buffer semantics preserved)."""
+    rng = np.random.RandomState(seed)
+    pool = [rng.randint(0, vocab_size, (batch, seq + 1)).astype(np.int32)
+            for _ in range(distinct)]
+    i = 0
+    while True:
+        yield pool[i % len(pool)]
+        i += 1
+
+
+def spmd_train_loop(config: Optional[Dict[str, Any]] = None):
+    """Default ``train_loop_per_worker`` for :class:`JaxTrainer` —
+    sharded llama training that runs the SAME config at devices=1 and
+    devices=N.
+
+    config keys (all optional): ``model`` (LlamaConfig preset name,
+    default "debug") or ``llama_config`` (a LlamaConfig), ``steps``,
+    ``batch_per_device``, ``seq``, ``seed``, ``lr``, ``mesh`` (axis
+    spec, else the ``RAY_TPU_TRAIN_MESH`` Config knob), ``donate``
+    (else ``RAY_TPU_TRAIN_DONATE``), ``report_every``. With a
+    ``datasets={"train": ds}`` trainer dataset, batches come from the
+    shard's ``to_jax`` (sharded, double-buffered ingest) reading the
+    ``tokens`` column; otherwise a synthetic token stream feeds the
+    step through the same per-shard placement path.
+    """
+    import jax
+    import optax
+
+    from ray_tpu.core.config import global_config
+    from ray_tpu.models.llama import LlamaConfig
+    from ray_tpu.parallel.sharding import shard_device_put
+    from ray_tpu.train import session
+
+    config = dict(config or {})
+    knobs = global_config()
+    cfg = config.get("llama_config") or getattr(
+        LlamaConfig, config.get("model", "debug"))()
+    steps = int(config.get("steps", 10))
+    seq = int(config.get("seq", min(128, cfg.max_seq_len)))
+    seed = int(config.get("seed", 0))
+    report_every = int(config.get("report_every", 1))
+    mesh = build_train_mesh(config.get("mesh", knobs.train_mesh))
+    if jax.process_count() > 1:
+        # the ingest path assembles the global batch from THIS
+        # process's host array (shard_device_put places addressable
+        # shards of it) — across a jax.distributed gang that would
+        # silently drop every other process's rows. Multi-host SPMD
+        # (process-local batch assembly) is the roadmapped next step.
+        raise NotImplementedError(
+            "spmd_train_loop drives a single-process mesh; multi-host "
+            "SPMD over jax.distributed gangs is not wired up yet "
+            "(see ROADMAP: SPMD training)")
+    donate = bool(config.get("donate", knobs.train_donate))
+    batch = int(config.get("batch_per_device", 2)) * mesh.size
+
+    optimizer = None
+    if "lr" in config:
+        optimizer = optax.adamw(float(config["lr"]), b1=0.9, b2=0.95,
+                                weight_decay=0.1)
+    init, step_fn, data_sharding, _ = make_spmd_train_step(
+        cfg, mesh, optimizer=optimizer, donate=donate)
+    state = init(jax.random.PRNGKey(seed))
+
+    try:
+        shard = session.get_dataset_shard("train")
+    except (KeyError, RuntimeError):
+        shard = None
+    if shard is not None and hasattr(shard, "to_jax"):
+        batches = ({"tokens": b["tokens"]} for b in shard.to_jax(
+            batch_size=batch, columns=["tokens"], sharding=data_sharding,
+            drop_last=True,
+            prefetch_batches=max(1, knobs.train_ingest_prefetch)))
+
+        def next_tokens():
+            # a finite dataset ends training at exhaustion (drop_last
+            # can eat the tail): None stops the loop after the steps
+            # that DID run, instead of StopIteration escaping the
+            # worker fn
+            b = next(batches, None)
+            return None if b is None else b["tokens"]
+    else:
+        host = _synthetic_token_batches(
+            cfg.vocab_size, batch, seq, seed,
+            distinct=int(config.get("distinct_batches", 8)))
+        pending = shard_device_put(next(host), data_sharding)
+
+        def next_tokens():
+            # same double-buffer discipline as to_jax: place N+1 before
+            # handing N to the step, so H2D overlaps compute
+            nonlocal pending
+            out = pending
+            pending = shard_device_put(next(host), data_sharding)
+            return out
+
+    t0 = time.perf_counter()
+    tokens_done = 0
+    loss = None
+    for i in range(steps):
+        toks = next_tokens()
+        if toks is None:
+            break
+        state, loss = step_fn(state, toks)
+        tokens_done += int(toks.shape[0]) * (int(toks.shape[1]) - 1)
+        if (i + 1) % report_every == 0 or i == steps - 1:
+            lf = float(loss)
+            dt = max(time.perf_counter() - t0, 1e-9)
+            session.report({
+                "loss": lf,
+                "step": i + 1,
+                "tokens_per_sec": tokens_done / dt,
+                "tokens_per_sec_per_chip": tokens_done / dt / mesh.size,
+                "devices": mesh.size,
+                "mesh": dict(mesh.shape),
+            })
+    return float(loss) if loss is not None else None

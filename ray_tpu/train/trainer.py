@@ -77,7 +77,7 @@ class _CheckpointManager:
 class JaxTrainer:
     def __init__(
         self,
-        train_loop_per_worker: Callable,
+        train_loop_per_worker: Optional[Callable] = None,
         *,
         train_loop_config: Optional[Dict[str, Any]] = None,
         scaling_config: Optional[ScalingConfig] = None,
@@ -86,6 +86,13 @@ class JaxTrainer:
         datasets: Optional[Dict[str, Any]] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
     ):
+        if train_loop_per_worker is None:
+            # default loop: SPMD sharded llama training (train/spmd.py)
+            # — the same train_loop_config runs devices=1 and devices=N
+            # (mesh from the config's "mesh" key or RAY_TPU_TRAIN_MESH)
+            from ray_tpu.train.spmd import spmd_train_loop
+
+            train_loop_per_worker = spmd_train_loop
         self.train_loop = train_loop_per_worker
         self.config = dict(train_loop_config or {})
         self.scaling = scaling_config or ScalingConfig()

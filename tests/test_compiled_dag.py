@@ -458,16 +458,23 @@ def test_multi_consumer_fanout(ray_start_regular):
 def test_device_channel_zero_serialization(ray_start_regular):
     """Device-resident edges: jax results cross actor boundaries via the
     typed tensor channel with ZERO serialization-layer bytes (reference:
-    torch_tensor_nccl_channel.py:191 — tensors bypass serialization)."""
+    torch_tensor_nccl_channel.py:191 — tensors bypass serialization).
+
+    Deadline-on-observable-state (ADVICE.md): under full-suite load a
+    transient executor error can propagate as a serialized TAG_ERROR
+    message, polluting the zero-serialization stats of an
+    otherwise-correct pipeline — and a single-shot assertion (or a
+    fixed retry count) turns that scheduling noise into a flake. The
+    observable state asserted here is "one clean execution moved the
+    tensor with zero serialized bytes": fresh actors per round, rounds
+    until the deadline, only then fail with the last counterexample.
+    """
     import numpy as np
 
     from ray_tpu.dag import InputNode
 
-    # one retry: a transient executor error under full-suite load
-    # propagates as a serialized TAG_ERROR message, polluting the
-    # zero-serialization stats of an otherwise-correct pipeline
-    last_err = None
-    for _attempt in range(2):
+    deadline = time.monotonic() + 60
+    while True:
         a = Worker2.remote()
         b = Worker2.remote()
         with InputNode() as inp:
@@ -489,8 +496,10 @@ def test_device_channel_zero_serialization(ray_start_regular):
             assert stats_b["tensor_bytes"] >= 128 * 4
             assert stats_b["serialized_bytes"] == 0, stats_b
             return
-        except AssertionError as e:
-            last_err = e
+        except AssertionError:
+            if time.monotonic() > deadline:
+                raise
         finally:
             compiled.teardown()
-    raise last_err
+        time.sleep(0.2)  # let the transient (load spike, exec
+        # error in flight) drain before the next observation

@@ -1,0 +1,314 @@
+"""SPMD sharded training (train/spmd.py) on the virtual 8-device mesh:
+partition rules, shard/gather round-trips, sharding invariance, the
+shard_map train step's parity with GSPMD, donation, sharded ingest, and
+the devices=1 JaxTrainer smoke path."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.models.llama import LlamaConfig, init_params, make_train_step
+from ray_tpu.parallel import MeshConfig, make_mesh
+from ray_tpu.train.spmd import (
+    build_train_mesh,
+    llama_partition_rules,
+    make_shard_and_gather_fns,
+    make_spmd_train_step,
+    match_partition_rules,
+    parse_mesh_spec,
+    tree_paths,
+)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return LlamaConfig.debug()
+
+
+@pytest.fixture(scope="module")
+def tokens(cfg):
+    rng = np.random.RandomState(0)
+    return rng.randint(0, cfg.vocab_size, (8, 33)).astype(np.int32)
+
+
+# --------------------------------------------------------------------------- #
+# partition rules
+# --------------------------------------------------------------------------- #
+
+
+def test_match_partition_rules_llama_tree(cfg):
+    """Every llama param leaf gets a spec; matrices shard, norms and
+    scalars replicate; paths drive the regex match."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    params = jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    specs = match_partition_rules(llama_partition_rules(), params)
+    assert specs["embedding"] == P("tensor", "fsdp")
+    assert specs["layers"]["wq"] == P(None, "fsdp", "tensor")
+    assert specs["layers"]["wo"] == P(None, "tensor", "fsdp")
+    assert specs["layers"]["attn_norm"] == P()  # norm$ rule
+    assert specs["final_norm"] == P()
+    assert specs["lm_head"] == P("fsdp", "tensor")
+    # paths are '/'-joined key paths
+    names = tree_paths(params)
+    assert names["layers"]["wq"] == "layers/wq"
+
+
+def test_match_partition_rules_unmatched_leaf_raises():
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    tree = {"mystery": np.zeros((4, 4), np.float32)}
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules(((r"known$", P("fsdp")),), tree)
+    # scalars replicate without needing a rule
+    out = match_partition_rules((), {"s": np.float32(1.0)})
+    assert out["s"] == P()
+
+
+def test_parse_mesh_spec_and_build():
+    assert parse_mesh_spec("data=4,fsdp=2") == {"data": 4, "fsdp": 2}
+    assert parse_mesh_spec("") == {}
+    with pytest.raises(ValueError):
+        parse_mesh_spec("data:4")
+    mesh = build_train_mesh("data=2,fsdp=4")
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 4}
+    assert build_train_mesh("").size == 8  # all local (virtual) devices
+    with pytest.raises(ValueError, match="devices"):
+        build_train_mesh("data=64")
+
+
+# --------------------------------------------------------------------------- #
+# shard/gather + sharding invariance (satellite: 1xN vs Nx1)
+# --------------------------------------------------------------------------- #
+
+
+def test_shard_gather_round_trip_byte_identical(cfg):
+    """shard → gather is byte-identical per leaf, on two layouts."""
+    import jax
+
+    from ray_tpu.util.jax_compat import ensure_sharding_invariant_rng
+
+    ensure_sharding_invariant_rng()
+    params = jax.device_get(init_params(cfg, jax.random.PRNGKey(3)))
+    specs = match_partition_rules(llama_partition_rules(), params)
+    for mc in [MeshConfig(data=1, fsdp=8), MeshConfig(data=2, fsdp=4)]:
+        mesh = make_mesh(mc)
+        shard_fns, gather_fns = make_shard_and_gather_fns(specs, mesh)
+        sharded = jax.tree.map(lambda f, x: f(x), shard_fns, params)
+        # fsdp-sharded leaves actually shard (not silently replicated)
+        emb_shards = sharded["embedding"].addressable_shards
+        assert len({str(s.index) for s in emb_shards}) == mesh.shape["fsdp"]
+        back = jax.tree.map(lambda f, x: jax.device_get(f(x)),
+                            gather_fns, sharded)
+        for pa, pb in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+            assert np.asarray(pa).tobytes() == np.asarray(pb).tobytes()
+
+
+def test_same_seed_init_invariant_across_mesh_layouts(cfg):
+    """ensure_sharding_invariant_rng: the same seed yields bitwise-equal
+    params whether the mesh is 1xN (fsdp=8) or Nx1 (data=8)."""
+    import jax
+
+    leaves = {}
+    for name, mc in [("1xN", MeshConfig(data=1, fsdp=8)),
+                     ("Nx1", MeshConfig(data=8, fsdp=1))]:
+        mesh = make_mesh(mc)
+        init, _, _, _ = make_spmd_train_step(cfg, mesh, donate=False)
+        leaves[name] = [np.asarray(x) for x in jax.tree.leaves(
+            jax.device_get(init(jax.random.PRNGKey(7))["params"]))]
+    for a, b in zip(leaves["1xN"], leaves["Nx1"]):
+        assert a.tobytes() == b.tobytes()
+
+
+def test_first_step_loss_invariant_across_mesh_layouts(cfg, tokens):
+    """Same seed + same batch → same first-step loss on 1xN vs Nx1."""
+    import jax
+
+    losses = []
+    for mc in [MeshConfig(data=1, fsdp=8), MeshConfig(data=8, fsdp=1)]:
+        mesh = make_mesh(mc)
+        init, step, ds, _ = make_spmd_train_step(cfg, mesh, donate=False)
+        state = init(jax.random.PRNGKey(7))
+        _, loss = step(state, jax.device_put(tokens, ds))
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=2e-3)
+
+
+# --------------------------------------------------------------------------- #
+# shard_map step: GSPMD parity, donation
+# --------------------------------------------------------------------------- #
+
+
+def test_spmd_step_matches_gspmd(cfg, tokens):
+    """The manual shard_map step and the GSPMD step are the same math:
+    same seed + same batch → same two-step loss trajectory."""
+    import jax
+
+    m1 = make_mesh(MeshConfig(data=1, fsdp=1), devices=jax.devices()[:1])
+    ginit, gstep, gds, _ = make_train_step(cfg, m1)
+    gstate = ginit(jax.random.PRNGKey(0))
+    gtoks = jax.device_put(tokens, gds)
+    gstate, g1 = gstep(gstate, gtoks)
+    _, g2 = gstep(gstate, gtoks)
+
+    for mc in [MeshConfig(data=8), MeshConfig(data=2, fsdp=4)]:
+        mesh = make_mesh(mc)
+        sinit, sstep, sds, _ = make_spmd_train_step(cfg, mesh, donate=False)
+        sstate = sinit(jax.random.PRNGKey(0))
+        stoks = jax.device_put(tokens, sds)
+        sstate, s1 = sstep(sstate, stoks)
+        _, s2 = sstep(sstate, stoks)
+        np.testing.assert_allclose(
+            [float(s1), float(s2)], [float(g1), float(g2)], rtol=3e-3)
+
+
+def test_spmd_step_learns_and_donates(cfg, tokens):
+    """Donated state: the input buffers die with the step (in-place
+    update), and the loss goes down over a few steps."""
+    import jax
+
+    mesh = make_mesh(MeshConfig(data=2, fsdp=4))
+    init, step, ds, _ = make_spmd_train_step(cfg, mesh, donate=True)
+    state = init(jax.random.PRNGKey(0))
+    first = None
+    for _ in range(5):
+        prev = state
+        state, loss = step(state, jax.device_put(tokens, ds))
+        if first is None:
+            first = float(loss)
+            # the donated previous state is gone — no second copy
+            assert jax.tree.leaves(prev)[0].is_deleted()
+    assert float(loss) < first, f"no learning: {first} -> {float(loss)}"
+
+
+def test_spmd_step_rejects_tensor_mesh(cfg):
+    mesh = make_mesh(MeshConfig(data=4, tensor=2))
+    with pytest.raises(ValueError, match="GSPMD"):
+        make_spmd_train_step(cfg, mesh)
+
+
+# --------------------------------------------------------------------------- #
+# sharded ingest
+# --------------------------------------------------------------------------- #
+
+
+def test_shard_device_put_matches_global_put(tokens):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.sharding import shard_device_put
+
+    mesh = make_mesh(MeshConfig(data=4, fsdp=2))
+    sh = NamedSharding(mesh, P(("data", "fsdp")))
+    placed = shard_device_put(tokens, sh)
+    assert np.array_equal(np.asarray(placed), tokens)
+    assert placed.sharding.is_equivalent_to(sh, tokens.ndim)
+    # every device holds exactly its 1/8 slice
+    assert len({str(s.index) for s in placed.addressable_shards}) == 8
+
+
+def test_to_jax_sharded_ingest(tokens):
+    """DataIterator.to_jax with a multi-device sharding rides the
+    per-shard placement path and yields value-identical batches."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.data.iterator import DataIterator
+
+    import ray_tpu
+
+    mesh = make_mesh(MeshConfig(data=8))
+    sh = NamedSharding(mesh, P("data"))
+    rows = np.arange(64, dtype=np.int64)
+    ray_tpu.init(num_cpus=1, num_tpus=0)
+    try:
+        refs = [ray_tpu.put([{"x": int(v)} for v in rows[i:i + 32]])
+                for i in (0, 32)]
+        it = DataIterator(lambda: iter(list(refs)))
+        batches = list(it.to_jax(batch_size=16, sharding=sh,
+                                 drop_last=True, prefetch_batches=2))
+    finally:
+        ray_tpu.shutdown()
+    got = np.concatenate([np.asarray(b["x"]) for b in batches])
+    assert np.array_equal(got, rows)
+    for b in batches:
+        assert len({str(s.index) for s in b["x"].addressable_shards}) == 8
+
+
+# --------------------------------------------------------------------------- #
+# config knobs + trainer smoke (satellite: tier-1-safe devices=1 path)
+# --------------------------------------------------------------------------- #
+
+
+def test_train_knobs_are_config_fields():
+    """RAY_TPU_TRAIN_MESH / _DONATE / _INGEST_PREFETCH resolve through
+    the Config registry (graftlint config-hygiene contract: no direct
+    env reads on the train path)."""
+    from ray_tpu.core.config import Config
+
+    cfg = Config()
+    assert cfg.train_mesh == ""
+    assert cfg.train_donate is True
+    assert cfg.train_ingest_prefetch == 2
+    import os
+
+    os.environ["RAY_TPU_TRAIN_MESH"] = "data=2"
+    os.environ["RAY_TPU_TRAIN_DONATE"] = "0"
+    os.environ["RAY_TPU_TRAIN_INGEST_PREFETCH"] = "5"
+    try:
+        cfg2 = Config()
+        assert cfg2.train_mesh == "data=2"
+        assert cfg2.train_donate is False
+        assert cfg2.train_ingest_prefetch == 5
+    finally:
+        for k in ("RAY_TPU_TRAIN_MESH", "RAY_TPU_TRAIN_DONATE",
+                  "RAY_TPU_TRAIN_INGEST_PREFETCH"):
+            os.environ.pop(k, None)
+
+
+def test_spmd_train_loop_smoke():
+    """devices=1-safe sharded-train smoke: the default loop runs the
+    same config on whatever devices exist (here the virtual mesh) and
+    reports decreasing loss — no cluster needed."""
+    from ray_tpu.train.session import TrainContext, set_context
+    from ray_tpu.train.spmd import spmd_train_loop
+
+    ctx = TrainContext(1, 0, 0, 1, 0)
+    set_context(ctx)
+    try:
+        # one repeated batch (distinct_batches=1) so the overfit
+        # assertion is deterministic
+        spmd_train_loop({"steps": 8, "batch_per_device": 1, "seq": 32,
+                         "mesh": "data=1", "report_every": 1,
+                         "lr": 0.05, "distinct_batches": 1})
+        reports = ctx._drain()
+    finally:
+        set_context(None)
+    assert len(reports) == 8
+    losses = [r.metrics["loss"] for r in reports]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+    assert reports[-1].metrics["devices"] == 1
+    assert reports[-1].metrics["tokens_per_sec_per_chip"] > 0
+
+
+def test_jax_trainer_default_loop_spmd():
+    """JaxTrainer with NO train loop runs the sharded default; the
+    train_overrides payload lands in the worker's Config."""
+    import ray_tpu
+    from ray_tpu.train import JaxBackend, JaxTrainer, RunConfig, ScalingConfig
+
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    try:
+        result = JaxTrainer(
+            train_loop_config={"steps": 3, "batch_per_device": 1,
+                               "seq": 32, "mesh": "data=1"},
+            scaling_config=ScalingConfig(num_workers=1),
+            backend=JaxBackend(train_overrides={"train_donate": False}),
+            run_config=RunConfig(name="spmd_smoke"),
+        ).fit()
+        assert result.error is None, result.error
+        assert np.isfinite(result.metrics["loss"])
+        assert result.metrics["step"] == 3
+    finally:
+        ray_tpu.shutdown()
