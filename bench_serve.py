@@ -1,21 +1,27 @@
-"""Serve request-path microbenchmark: instrumentation overhead on vs off.
+"""Serve dispatch-plane benchmark: compiled rings vs eager remote(),
+plus a sustained RPS ramp with autoscaling and load-shedding gates.
 
-Prints ONE JSON line (same convention as bench.py / bench_objects.py):
+Prints ONE JSON line (same convention as bench.py / bench_objects.py)
+and writes it to ``--out`` (BENCH_SERVE.json):
 
     {"bench": "serve",
-     "on":  {"handle_p50_ms": .., "handle_p99_ms": ..,
-             "http_p50_ms": .., "http_p99_ms": ..},
-     "off": {...},
-     "overhead_handle_p50_pct": .., "overhead_http_p50_pct": ..}
+     "dispatch": {"eager": {...}, "compiled": {...},
+                  "speedup_p50": ..},
+     "ramp": {"steps": [...], "max_p99_ms": .., "shed_total": ..,
+              "max_replicas_seen": .., "replicas_after_cooldown": ..}}
 
-Each mode runs in its OWN subprocess: the config snapshot
-(serve_observability_enabled) ships to replica workers at cluster init,
-so toggling it requires a fresh cluster. "off" sets
-``RAY_TPU_SERVE_OBSERVABILITY_ENABLED=0`` — no request ids, no stage
-histograms, no access logs — the uninstrumented baseline.
+Phases run in their OWN subprocess: the compiled-dispatch switch ships
+with the Config snapshot at cluster init, so toggling it requires a
+fresh cluster. Reps interleave modes (alternating which goes first) and
+the per-metric MIN of rounds is reported — scheduling luck on a shared
+box swings a single round far more than the dispatch cost under test.
 
-``--check`` exits non-zero when instrumentation regresses the handle-path
-p50 by more than the budget (default 5%, the PR acceptance bound).
+``--check`` gates (the PR acceptance bounds):
+  * compiled handle p50 >= ``--dispatch-gate`` (default 5x) lower than
+    the eager handle path on the same box
+  * RPS-ramp p99 bounded (<= ``--ramp-p99-budget-ms``) while replicas
+    scale out and back in (both transitions must be observed)
+  * zero requests shed below the concurrency budget, zero errors
 
 Runs under ``JAX_PLATFORMS=cpu`` (no accelerator needed).
 """
@@ -28,6 +34,7 @@ import os
 import statistics
 import subprocess
 import sys
+import threading
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -39,7 +46,10 @@ def _pct(samples, q):
     return round(s[idx] * 1000.0, 3)
 
 
-def run_phase(iters: int, port: int) -> dict:
+def run_dispatch_phase(iters: int, port: int) -> dict:
+    """One mode's request-path measurement (the mode itself — compiled
+    vs eager — was fixed by RAY_TPU_SERVE_COMPILED_DISPATCH before the
+    cluster came up)."""
     import urllib.request
 
     import ray_tpu
@@ -58,16 +68,13 @@ def run_phase(iters: int, port: int) -> dict:
 
     handle = serve.run(Echo.bind(), route_prefix="/echo")
 
-    # warmup: replica cold start, route/replica caches, jit of nothing
-    for _ in range(50):
+    # warmup: replica cold start, lane compile, route/replica caches
+    for _ in range(60):
         handle.direct.remote(1).result()
     url = f"http://127.0.0.1:{port}/echo"
     for _ in range(15):
         urllib.request.urlopen(url, timeout=30).read()
 
-    # several rounds per cluster, keep each round's p50, report the MIN:
-    # scheduling luck on a shared box swings a single round's p50 far
-    # more than the instrumentation cost being measured
     rounds = 3
     per = max(50, iters // rounds)
     handle_p50s, handle_p99s, handle_means = [], [], []
@@ -79,8 +86,7 @@ def run_phase(iters: int, port: int) -> dict:
             samples.append(time.perf_counter() - t0)
         handle_p50s.append(_pct(samples, 0.50))
         handle_p99s.append(_pct(samples, 0.99))
-        handle_means.append(
-            round(statistics.mean(samples) * 1000.0, 3))
+        handle_means.append(round(statistics.mean(samples) * 1000.0, 3))
     http_p50s, http_p99s = [], []
     for _ in range(rounds):
         samples = []
@@ -91,6 +97,10 @@ def run_phase(iters: int, port: int) -> dict:
         http_p50s.append(_pct(samples, 0.50))
         http_p99s.append(_pct(samples, 0.99))
 
+    from ray_tpu.serve import observability as obs
+
+    obs.drain_deferred()
+    planes = serve.status().get("Echo", {}).get("dispatch_planes", {})
     serve.shutdown()
     ray_tpu.shutdown()
     return {
@@ -99,25 +109,131 @@ def run_phase(iters: int, port: int) -> dict:
         "handle_mean_ms": min(handle_means),
         "http_p50_ms": min(http_p50s),
         "http_p99_ms": min(http_p99s),
+        "planes": planes,
     }
 
 
-def _spawn_phase(mode: str, iters: int, port: int) -> dict:
+def run_ramp_phase(port: int) -> dict:
+    """Sustained closed-loop RPS ramp against an autoscaling deployment
+    on the compiled plane: concurrency steps up and back down while the
+    controller scales replicas out and in. Collects per-step latency
+    percentiles, the shed counter (must stay 0 — offered concurrency
+    sits below the budget), and the replica-count trajectory."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    serve.start(serve.HTTPOptions(port=port))
+
+    @serve.deployment(max_inflight=4, concurrency_budget=64,
+                      autoscaling_config={
+                          "min_replicas": 1, "max_replicas": 3,
+                          "target_ongoing_requests": 2.0,
+                          "upscale_delay_s": 0.3,
+                          "downscale_delay_s": 1.0})
+    class Work:
+        def __call__(self, x):
+            time.sleep(0.02)  # ~a small model's step
+            return x
+
+    handle = serve.run(Work.bind(), route_prefix=None)
+    for _ in range(20):
+        handle.remote(1).result(timeout=60)
+
+    errors = [0]
+    max_replicas_seen = [1]
+
+    def replica_count() -> int:
+        try:
+            return serve.status().get("Work", {}).get("num_replicas", 0)
+        except Exception:
+            return 0
+
+    def run_step(concurrency: int, hold_s: float) -> dict:
+        latencies = []
+        lock = threading.Lock()
+        stop = time.monotonic() + hold_s
+
+        def worker():
+            while time.monotonic() < stop:
+                t0 = time.perf_counter()
+                try:
+                    handle.remote(1).result(timeout=60)
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        while any(t.is_alive() for t in threads):
+            max_replicas_seen[0] = max(max_replicas_seen[0],
+                                       replica_count())
+            time.sleep(0.1)
+        for t in threads:
+            t.join()
+        return {
+            "concurrency": concurrency,
+            "requests": len(latencies),
+            "p50_ms": _pct(latencies, 0.50) if latencies else None,
+            "p99_ms": _pct(latencies, 0.99) if latencies else None,
+        }
+
+    # ramp up, hold, ramp down — replicas scale out under the load and
+    # back in after it
+    steps = [run_step(c, 3.0) for c in (1, 2, 6, 2, 1)]
+
+    # cooldown: offered load is gone; the autoscaler must walk the
+    # deployment back to min_replicas (deadline on observable state)
+    deadline = time.monotonic() + 60
+    replicas_after = replica_count()
+    while time.monotonic() < deadline:
+        replicas_after = replica_count()
+        if replicas_after <= 1:
+            break
+        time.sleep(0.25)
+
+    from ray_tpu.serve import observability as obs
+
+    obs.drain_deferred()
+    st = serve.status().get("Work", {})
+    result = {
+        "steps": steps,
+        "errors": errors[0],
+        "shed_total": int(st.get("shed", 0)),
+        "budget": 64,
+        "max_replicas_seen": max_replicas_seen[0],
+        "replicas_after_cooldown": replicas_after,
+        "dispatch_planes": st.get("dispatch_planes", {}),
+        "max_p99_ms": max((s["p99_ms"] or 0.0) for s in steps),
+    }
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return result
+
+
+def _spawn_phase(phase: str, mode: str, iters: int, port: int) -> dict:
     env = dict(os.environ)
-    env["RAY_TPU_SERVE_OBSERVABILITY_ENABLED"] = \
-        "1" if mode == "on" else "0"
+    env["RAY_TPU_SERVE_COMPILED_DISPATCH"] = \
+        "1" if mode == "compiled" else "0"
     out = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--phase", mode,
-         "--iters", str(iters), "--port", str(port)],
-        env=env, capture_output=True, text=True, timeout=600)
+        [sys.executable, os.path.abspath(__file__), "--phase", phase,
+         "--mode", mode, "--iters", str(iters), "--port", str(port)],
+        env=env, capture_output=True, text=True, timeout=900)
     if out.returncode != 0:
         raise RuntimeError(
-            f"phase {mode} failed:\n{out.stdout}\n{out.stderr}")
+            f"phase {phase}/{mode} failed:\n{out.stdout}\n{out.stderr}")
     for line in reversed(out.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             return json.loads(line)
-    raise RuntimeError(f"phase {mode} printed no JSON:\n{out.stdout}")
+    raise RuntimeError(f"phase {phase}/{mode} printed no JSON:\n"
+                       f"{out.stdout}")
 
 
 def main() -> int:
@@ -127,58 +243,107 @@ def main() -> int:
                     help="interleaved repetitions per mode; per-metric "
                          "minimum is reported (noise-robust)")
     ap.add_argument("--port", type=int, default=18431)
-    ap.add_argument("--phase", choices=["on", "off"],
-                    help="internal: run one mode in-process and print it")
+    ap.add_argument("--phase", choices=["dispatch", "ramp"],
+                    help="internal: run one phase in-process and print it")
+    ap.add_argument("--mode", choices=["eager", "compiled"],
+                    default="compiled", help="internal: phase mode")
     ap.add_argument("--check", action="store_true",
-                    help="exit 1 when handle p50 overhead > --budget-pct")
-    ap.add_argument("--budget-pct", type=float, default=5.0)
+                    help="exit 1 when a gate fails")
+    ap.add_argument("--dispatch-gate", type=float, default=5.0,
+                    help="compiled handle p50 must be at least this "
+                         "many times lower than eager")
+    ap.add_argument("--ramp-p99-budget-ms", type=float, default=1500.0,
+                    help="every ramp step's p99 must stay under this "
+                         "(the scale-out step's tail includes a real "
+                         "replica cold start on this box)")
+    ap.add_argument("--skip-ramp", action="store_true")
     ap.add_argument("--out", help="also write the JSON result here")
     args = ap.parse_args()
 
-    if args.phase:
-        print(json.dumps(run_phase(args.iters, args.port)))
+    if args.phase == "dispatch":
+        print(json.dumps(run_dispatch_phase(args.iters, args.port)))
+        return 0
+    if args.phase == "ramp":
+        print(json.dumps(run_ramp_phase(args.port)))
         return 0
 
-    # interleave modes across reps (alternating which goes first, so
-    # cold-start bias can't land on one mode); per-metric min is the
-    # noise-robust stat for a shared CI box
-    runs = {"on": [], "off": []}
+    runs = {"eager": [], "compiled": []}
     port = args.port
     for rep in range(max(1, args.reps)):
-        order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+        order = ("compiled", "eager") if rep % 2 == 0 \
+            else ("eager", "compiled")
         for mode in order:
-            runs[mode].append(_spawn_phase(mode, args.iters, port))
+            runs[mode].append(
+                _spawn_phase("dispatch", mode, args.iters, port))
             port += 1
 
     def best(mode):
-        return {k: min(r[k] for r in runs[mode]) for k in runs[mode][0]}
+        keys = [k for k in runs[mode][0] if k != "planes"]
+        out = {k: min(r[k] for r in runs[mode]) for k in keys}
+        out["planes"] = runs[mode][-1]["planes"]
+        return out
 
-    on, off = best("on"), best("off")
+    eager, compiled = best("eager"), best("compiled")
+    speedup = (round(eager["handle_p50_ms"] / compiled["handle_p50_ms"],
+                     2)
+               if compiled["handle_p50_ms"] else None)
 
-    def overhead(key):
-        if not off[key]:
-            return None
-        return round((on[key] - off[key]) / off[key] * 100.0, 2)
+    ramp = None
+    if not args.skip_ramp:
+        ramp = _spawn_phase("ramp", "compiled", args.iters, port)
 
     result = {
         "bench": "serve",
         "iters": args.iters,
-        "on": on,
-        "off": off,
-        "overhead_handle_p50_pct": overhead("handle_p50_ms"),
-        "overhead_handle_p99_pct": overhead("handle_p99_ms"),
-        "overhead_http_p50_pct": overhead("http_p50_ms"),
-        "budget_pct": args.budget_pct,
+        "dispatch": {
+            "eager": eager,
+            "compiled": compiled,
+            "speedup_p50": speedup,
+            "gate_min_speedup": args.dispatch_gate,
+        },
+        "ramp": ramp,
+        "ramp_p99_budget_ms": args.ramp_p99_budget_ms,
     }
     print(json.dumps(result))
     if args.out:
         with open(args.out, "w") as f:
-            json.dump(result, f)
+            json.dump(result, f, indent=1)
+
     if args.check:
-        oh = result["overhead_handle_p50_pct"]
-        if oh is not None and oh > args.budget_pct:
-            print(f"FAIL: instrumentation handle p50 overhead {oh}% "
-                  f"> {args.budget_pct}% budget", file=sys.stderr)
+        failures = []
+        if speedup is None or speedup < args.dispatch_gate:
+            failures.append(
+                f"compiled dispatch speedup {speedup}x < "
+                f"{args.dispatch_gate}x gate (eager "
+                f"{eager['handle_p50_ms']}ms vs compiled "
+                f"{compiled['handle_p50_ms']}ms)")
+        if compiled["planes"].get("compiled", 0) < args.iters // 2:
+            failures.append(
+                f"compiled phase barely used the compiled plane: "
+                f"{compiled['planes']}")
+        if ramp is not None:
+            if ramp["max_p99_ms"] > args.ramp_p99_budget_ms:
+                failures.append(
+                    f"ramp p99 {ramp['max_p99_ms']}ms > "
+                    f"{args.ramp_p99_budget_ms}ms budget")
+            if ramp["shed_total"] != 0:
+                failures.append(
+                    f"{ramp['shed_total']} requests shed below the "
+                    f"concurrency budget (must be 0)")
+            if ramp["errors"] != 0:
+                failures.append(f"{ramp['errors']} request errors "
+                                f"during the ramp")
+            if ramp["max_replicas_seen"] < 2:
+                failures.append("autoscaler never scaled out under the "
+                                "ramp load")
+            if ramp["replicas_after_cooldown"] > 1:
+                failures.append(
+                    f"deployment still at "
+                    f"{ramp['replicas_after_cooldown']} replicas after "
+                    f"cooldown (never scaled back in)")
+        if failures:
+            for f_ in failures:
+                print(f"FAIL: {f_}", file=sys.stderr)
             return 1
     return 0
 

@@ -199,6 +199,28 @@ class Config:
     # @serve.deployment(slow_request_threshold_s=...); <= 0 disables
     serve_slow_request_threshold_s: float = 1.0
 
+    # ---- serve compiled dispatch plane (serve/compiled_dispatch.py) ----
+    # route unary requests over long-lived compiled graphs (one ring-pair
+    # lane per replica, microsecond dispatch) instead of eager remote();
+    # the eager handle path stays as automatic fallback (streaming,
+    # worker/client-side handles, oversized payloads, lane build failure)
+    serve_compiled_dispatch: bool = True
+    # per-replica admission window: ring slots per lane = bounded
+    # in-flight per replica = continuous-batch ceiling. Structural
+    # backpressure: a full window overflows to the eager path (within
+    # the budget) instead of queueing. Per-deployment override via
+    # @serve.deployment(max_inflight=...)
+    serve_max_inflight: int = 8
+    # per-deployment concurrency budget at the dispatching process:
+    # once this many requests are in flight AND every replica window is
+    # full, new requests shed with serve.BackPressureError instead of
+    # queueing without bound. 0 = unlimited (never shed). Override via
+    # @serve.deployment(concurrency_budget=...)
+    serve_concurrency_budget: int = 0
+    # ring slot size per lane message; requests/replies larger than this
+    # fall back to the eager path for that call
+    serve_channel_slot_bytes: int = 1 * 1024 * 1024
+
     # ---- fault injection (reference: testing_asio_delay_us :824) ----
     testing_delay_ms: str = ""  # "handler1=ms,handler2=ms" injected latency
     # artificially slow EVERY control RPC the head serves (ms/op). The

@@ -41,6 +41,16 @@ from .task_spec import TaskSpec
 from ray_tpu.experimental.channel import is_arraylike as _is_arraylike
 
 
+class _BatchErrPayload:
+    """Pre-serialized TAG_ERROR payload standing in a batch result slot
+    (the whole batch call failed: every item ships the same error)."""
+
+    __slots__ = ("payload",)
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
+
+
 class _ActorState:
     def __init__(self, instance, max_concurrency: int, is_async: bool):
         self.instance = instance
@@ -601,10 +611,26 @@ class WorkerRuntime:
         template = list(desc.get("args_template") or [("edge", 0)])
         device = bool(desc.get("device"))
         priority = int(desc.get("priority") or 0)
+        batch_max = int(desc.get("batch_max") or 0)
+        direct_call = bool(desc.get("direct_call"))
+        # backlog visibility hook (serve replicas): the instance can see
+        # its own in-edge occupancy, so queued-in-ring requests count in
+        # load signals (autoscaling) the same way eager in-flight does
+        hook = getattr(st.instance, "__compiled_channels_hook__", None)
+        if hook is not None:
+            try:
+                hook(desc["uid"], ins)
+            except Exception:
+                hook = None
 
         from ray_tpu.experimental.channel import TAG_STOP
 
         def close_all():
+            if hook is not None:
+                try:
+                    hook(desc["uid"], None)
+                except Exception:
+                    pass
             for ch in ins + outs:
                 ch.close()
 
@@ -622,7 +648,8 @@ class WorkerRuntime:
         def loop():
             try:
                 self._compiled_exec_loop(ins, outs, propagate, st, method,
-                                         template, device, priority)
+                                         template, device, priority,
+                                         batch_max, direct_call)
             finally:
                 close_all()
 
@@ -630,14 +657,97 @@ class WorkerRuntime:
                          name=f"compiled-exec-{desc['method']}").start()
 
     def _compiled_exec_loop(self, ins, outs, propagate, st, method,
-                            template, device, priority=0) -> None:
+                            template, device, priority=0, batch_max=0,
+                            direct_call=False) -> None:
         from ray_tpu.experimental.channel import (
             TAG_BYTES,
             TAG_ERROR,
             TAG_STOP,
             TAG_TENSOR,
+            BatchItemError,
             ChannelClosed,
         )
+
+        method_name = getattr(method, "__name__", "compiled")
+
+        def invoke(args):
+            """One method call on the right execution surface. The
+            ``dag.exec[.<fn>]`` chaos point fires first (crash = the
+            replica-death drill for the compiled serve plane)."""
+            fault_injection.fire("dag.exec", method_name)
+            if direct_call:
+                # opt-in per node: no pool handoff, no exec lock — the
+                # method declares itself safe against the actor's eager
+                # plane (serve replicas run sync methods concurrently
+                # on the eager plane already)
+                return method(*args)
+            # run on the actor's executor so compiled executions
+            # serialize with eager .remote() calls on the same
+            # instance (the single-threaded actor contract);
+            # async methods go through the actor's event loop
+            if st.is_async and asyncio.iscoroutinefunction(method):
+                return asyncio.run_coroutine_threadsafe(
+                    method(*args), st.loop).result()
+            if st.exec_lock is not None:
+                # serial-actor fast path: direct call on this loop's
+                # thread, mutually excluded with eager calls. The
+                # contract is one-method-at-a-time, NOT
+                # one-thread-forever: compiled executions run here,
+                # not on the pool thread (reference: do_exec_tasks
+                # loops own their thread too).
+                # Priority (the 1F1B scheduling rule): when a
+                # higher-priority loop on this actor has an input
+                # ready (backward microbatch), lower-priority loops
+                # (forward) yield the actor to it instead of racing
+                # for the lock — backward-over-forward is what keeps
+                # the pipeline's activation window at K instead of
+                # growing with the microbatch count.
+                if priority > 0:
+                    st.prio_waiting.append(1)
+                    try:
+                        with st.exec_lock:
+                            return method(*args)
+                    finally:
+                        st.prio_waiting.pop()
+                        with st.prio_cv:
+                            st.prio_cv.notify_all()
+                # park (never poll) while a backward holds the
+                # actor; bounded waits make a missed notify
+                # harmless. Advisory ordering: the re-check
+                # races a backward arriving right after, which
+                # only costs one forward running first.
+                while st.prio_waiting:
+                    with st.prio_cv:
+                        if st.prio_waiting:
+                            st.prio_cv.wait(0.05)
+                with st.exec_lock:
+                    return method(*args)
+            return st.pool.submit(method, *args).result()
+
+        def write_value(result):
+            if device and _is_arraylike(result):
+                for ch in outs:
+                    ch.write_array(result)
+            elif type(result) is bytes:
+                # raw-bytes results skip the serializer both ways
+                for ch in outs:
+                    ch.write(result, tag=TAG_BYTES)
+            else:
+                sobj = serialization.serialize(result)
+                for ch in outs:
+                    ch.write_serialized(sobj)
+
+        def error_payload(exc) -> bytes:
+            err = TaskError.from_exception(method_name, exc)
+            return serialization.serialize(err).to_bytes()
+
+        # batch_max >= 1 means the node DECLARED the list-in/list-out
+        # contract (with_batching) — it applies even at window 1
+        if batch_max >= 1 and len(ins) == 1:
+            self._compiled_batch_loop(ins[0], propagate, invoke,
+                                      write_value, error_payload,
+                                      batch_max, device, BatchItemError)
+            return
 
         while True:
             # one message per in-edge per execution (per-round joins;
@@ -666,66 +776,90 @@ class WorkerRuntime:
             try:
                 args = [edge_vals[t[1]] if t[0] == "edge" else t[1]
                         for t in template]
-                # run on the actor's executor so compiled executions
-                # serialize with eager .remote() calls on the same
-                # instance (the single-threaded actor contract);
-                # async methods go through the actor's event loop
-                if st.is_async and asyncio.iscoroutinefunction(method):
-                    result = asyncio.run_coroutine_threadsafe(
-                        method(*args), st.loop).result()
-                elif st.exec_lock is not None:
-                    # serial-actor fast path: direct call on this loop's
-                    # thread, mutually excluded with eager calls. The
-                    # contract is one-method-at-a-time, NOT
-                    # one-thread-forever: compiled executions run here,
-                    # not on the pool thread (reference: do_exec_tasks
-                    # loops own their thread too).
-                    # Priority (the 1F1B scheduling rule): when a
-                    # higher-priority loop on this actor has an input
-                    # ready (backward microbatch), lower-priority loops
-                    # (forward) yield the actor to it instead of racing
-                    # for the lock — backward-over-forward is what keeps
-                    # the pipeline's activation window at K instead of
-                    # growing with the microbatch count.
-                    if priority > 0:
-                        st.prio_waiting.append(1)
-                        try:
-                            with st.exec_lock:
-                                result = method(*args)
-                        finally:
-                            st.prio_waiting.pop()
-                            with st.prio_cv:
-                                st.prio_cv.notify_all()
-                    else:
-                        # park (never poll) while a backward holds the
-                        # actor; bounded waits make a missed notify
-                        # harmless. Advisory ordering: the re-check
-                        # races a backward arriving right after, which
-                        # only costs one forward running first.
-                        while st.prio_waiting:
-                            with st.prio_cv:
-                                if st.prio_waiting:
-                                    st.prio_cv.wait(0.05)
-                        with st.exec_lock:
-                            result = method(*args)
-                else:
-                    result = st.pool.submit(method, *args).result()
-                if device and _is_arraylike(result):
-                    for ch in outs:
-                        ch.write_array(result)
-                elif type(result) is bytes:
-                    # raw-bytes results skip the serializer both ways
-                    for ch in outs:
-                        ch.write(result, tag=TAG_BYTES)
-                else:
-                    sobj = serialization.serialize(result)
-                    for ch in outs:
-                        ch.write_serialized(sobj)
+                write_value(invoke(args))
             except Exception as e:  # noqa: BLE001 — ship to consumer
-                err = TaskError.from_exception(
-                    getattr(method, "__name__", "compiled"), e)
-                propagate(TAG_ERROR,
-                          serialization.serialize(err).to_bytes())
+                propagate(TAG_ERROR, error_payload(e))
+
+    def _compiled_batch_loop(self, ch, propagate, invoke, write_value,
+                             error_payload, batch_max, device,
+                             BatchItemError) -> None:
+        """Ring-fed batch rounds (serve continuous batching): block for
+        the first message, then admit everything ALREADY queued in the
+        ring — up to ``batch_max`` — into the same method call. Requests
+        that arrive while a batch executes are queued by the ring and
+        form the next batch, so under load batches fill with zero added
+        wait and when idle a single request runs immediately: the
+        admission window replaces the ``max_batch_wait`` timer. One
+        reply per item, in order; a BatchItemError result fails one
+        item without failing its batch-mates."""
+        from ray_tpu.experimental.channel import (
+            TAG_BYTES,
+            TAG_ERROR,
+            TAG_STOP,
+            TAG_TENSOR,
+            ChannelClosed,
+        )
+
+        while True:
+            entries = []  # ("val", value) | ("err", payload passthrough)
+            stop = False
+            while len(entries) < batch_max:
+                if entries:
+                    try:
+                        if not ch.readable():
+                            break  # batch = exactly the queued backlog
+                    except Exception:
+                        return  # channel closed (teardown race)
+                try:
+                    tag, payload = ch.read(timeout=None, to_device=device)
+                except ChannelClosed:
+                    stop = True
+                    break
+                except Exception:
+                    return  # channel unlinked (teardown race)
+                if tag == TAG_ERROR:
+                    entries.append(("err", payload))
+                elif tag == TAG_TENSOR or tag == TAG_BYTES:
+                    entries.append(("val", payload))
+                else:
+                    entries.append(("val",
+                                    serialization.deserialize(payload)))
+            vals = [v for kind, v in entries if kind == "val"]
+            results = []
+            if vals:
+                try:
+                    results = invoke([vals])
+                    if not isinstance(results, (list, tuple)) \
+                            or len(results) != len(vals):
+                        raise TypeError(
+                            f"batch method returned "
+                            f"{type(results).__name__} of length "
+                            f"{len(results) if isinstance(results, (list, tuple)) else 'n/a'} "
+                            f"for {len(vals)} inputs")
+                except Exception as e:  # noqa: BLE001 — fail every item
+                    pl = error_payload(e)
+                    results = [_BatchErrPayload(pl)] * len(vals)
+            # replies in arrival order: upstream-error passthroughs keep
+            # their slot, values take the next computed result
+            vi = 0
+            for kind, v in entries:
+                if kind == "err":
+                    propagate(TAG_ERROR, v)
+                    continue
+                r = results[vi]
+                vi += 1
+                if isinstance(r, _BatchErrPayload):
+                    propagate(TAG_ERROR, r.payload)
+                elif isinstance(r, BatchItemError):
+                    propagate(TAG_ERROR, error_payload(r.error))
+                else:
+                    try:
+                        write_value(r)
+                    except Exception as e:  # unserializable result etc.
+                        propagate(TAG_ERROR, error_payload(e))
+            if stop:
+                propagate(TAG_STOP)
+                return
 
     def _resolve_args(self, spec: TaskSpec):
         hints = spec.arg_hints or {}

@@ -97,9 +97,40 @@ class ClassMethodNode(DAGNode):
         # with a higher priority preempt lower ones for the actor's
         # exec slot when both have inputs ready (1F1B: backward > forward)
         self.priority = 0
+        # ring-fed batch mode (serve continuous batching): the exec loop
+        # drains up to batch_max ALREADY-QUEUED messages from this node's
+        # single in-edge per round and calls the method ONCE with the
+        # list, writing one reply per item in order. 0 = not a batch
+        # method (the list-in/list-out contract applies even at size 1)
+        self.batch_max = 0
+        # direct call: the exec loop invokes the method on its own thread
+        # with no pool handoff and no exec-lock, regardless of the
+        # actor's concurrency mode — the method must be thread-safe
+        # against the actor's eager calls (serve replicas are: their
+        # eager plane already runs sync methods concurrently)
+        self.direct_call = False
 
     def with_priority(self, priority: int) -> "ClassMethodNode":
         self.priority = int(priority)
+        return self
+
+    def with_batching(self, batch_max: int) -> "ClassMethodNode":
+        """Enable ring-fed batch mode on this node (requires exactly one
+        in-edge). The method receives a LIST of up to ``batch_max``
+        values — everything already queued in the ring when a round
+        starts — and must return a list of the same length (items may be
+        :class:`~ray_tpu.experimental.channel.BatchItemError` to fail
+        one request without failing its batch-mates)."""
+        if batch_max < 1:
+            raise ValueError(f"batch_max must be >= 1, got {batch_max}")
+        if len(self.upstreams) != 1:
+            raise ValueError(
+                "ring-fed batching requires exactly one in-edge")
+        self.batch_max = int(batch_max)
+        return self
+
+    def with_direct_call(self) -> "ClassMethodNode":
+        self.direct_call = True
         return self
 
     def experimental_compile(self, buffer_size_bytes: int = 4 * 1024 * 1024,
@@ -250,6 +281,12 @@ class CompiledDAG:
         self._next_seq = 0
         self._next_read = 0
         self._results: dict = {}
+        # seqs whose result will never be collected (an abandoned serve
+        # response): lock-free deque because discards arrive from
+        # __del__ inside the GC — _read_result folds it into a set and
+        # drops matching payloads instead of caching them forever
+        self._discard_queue: "collections.deque" = collections.deque()
+        self._discards: set = set()
         self._torn_down = False
         self._channels: List[ShmChannel] = []
         self._input_chans: List[ShmChannel] = []
@@ -468,6 +505,8 @@ class CompiledDAG:
                     "device": self._device,
                     "uid": uid,
                     "priority": getattr(task, "priority", 0),
+                    "batch_max": getattr(task, "batch_max", 0),
+                    "direct_call": getattr(task, "direct_call", False),
                 }))
             ray_tpu.get(acks, timeout=60)
         except BaseException:
@@ -695,15 +734,70 @@ class CompiledDAG:
         _m_executions.inc()
         return CompiledDAGRef(self, seq)
 
+    @property
+    def broken(self) -> Optional[BaseException]:
+        """The attributed error a detected executor death left behind
+        (None while healthy). ``restarting=True`` on it means the next
+        execute() may rebind to the restarted incarnation."""
+        return self._broken
+
+    @property
+    def torn_down(self) -> bool:
+        return self._torn_down
+
+    def inflight(self) -> int:
+        """Executions submitted but not yet drained from the output ring
+        — the per-DAG admission signal (rings + executor occupancy).
+        Racy by nature (lock-free reads); callers treat it as a hint."""
+        return self._next_seq - self._next_read
+
+    def input_writable(self) -> bool:
+        """True when every input edge has a free slot right now — a
+        non-blocking admission probe. The driver is the only writer, so
+        an observed free slot cannot vanish before this thread writes
+        (another submitter thread may take it: re-checked under
+        _submit_lock by execute())."""
+        if self._torn_down or self._broken is not None:
+            return False
+        try:
+            return all(ch.writable() for ch in self._input_chans)
+        except Exception:
+            return False  # mapping closed (teardown race)
+
+    def discard(self, seq: int) -> None:
+        """Mark one execution's result as never-to-be-collected (the ref
+        holder was dropped). GC-safe: only a lock-free deque append —
+        the next _read_result drains the queue and drops the payload
+        instead of caching it forever."""
+        self._discard_queue.append(seq)
+
+    _MISS = object()
+
+    def _apply_discards_locked(self) -> None:
+        while True:
+            try:
+                s = self._discard_queue.popleft()
+            except IndexError:
+                break
+            if self._results.pop(s, self._MISS) is self._MISS \
+                    and s >= self._next_read:
+                self._discards.add(s)
+
     def _read_result(self, seq: int, timeout: Optional[float]):
         import time as _time
 
         from ray_tpu.experimental.channel import TAG_TENSOR
 
         with self._read_lock:
+            self._apply_discards_locked()
             dead = getattr(self, "_dead_seqs", None)
             if dead and seq in dead:
                 raise dead.pop(seq)  # round died in a rebound ring
+            if self._torn_down and seq not in self._results:
+                # a reader arriving after teardown started must not
+                # touch rings teardown is draining/closing
+                raise self._broken or RuntimeError(
+                    "compiled DAG was torn down")
             if seq < self._next_read and seq not in self._results:
                 raise ValueError(
                     f"result for execution #{seq} was already consumed "
@@ -739,7 +833,10 @@ class CompiledDAG:
                         self._handle_executor_death(err, restartable)
                         raise err
                     raise
-                self._results[self._next_read] = (tag, payload)
+                if self._next_read in self._discards:
+                    self._discards.discard(self._next_read)
+                else:
+                    self._results[self._next_read] = (tag, payload)
                 self._next_read += 1
             tag, payload = self._results.pop(seq)
         if tag == TAG_TENSOR or tag == TAG_BYTES:
@@ -749,33 +846,47 @@ class CompiledDAG:
             raise value
         return value
 
+    def teardown_async(self) -> None:
+        """Enqueue teardown on the reaper thread (non-blocking). For
+        callers that must not pay the bounded sentinel round-trips on
+        their own thread (serve lane retirement on a refresh callback)."""
+        _ensure_teardown_reaper()
+        _teardown_queue.append(self.teardown)
+        _teardown_event.set()
+
     def teardown(self) -> None:
         with self._submit_lock:
             if self._torn_down:
                 return
             self._torn_down = True
         # push stop sentinels into every input edge, then drain the output
-        # until the sentinel comes out the far end; every step is bounded
+        # until the sentinel comes out the far end; every step is bounded.
+        # The drain holds _read_lock: the output ring is single-consumer,
+        # and a caller still blocked in _read_result (a serve lane being
+        # retired with requests in flight) must finish its read before
+        # teardown touches the same slots — two concurrent readers would
+        # double-ack and cross-wire results
         stop_sent = 0
-        for _ in range(self._next_seq + len(self._nodes) + 2):
-            while stop_sent < len(self._input_chans):
+        with self._read_lock:
+            for _ in range(self._next_seq + len(self._nodes) + 2):
+                while stop_sent < len(self._input_chans):
+                    try:
+                        self._input_chans[stop_sent].write(
+                            b"", tag=TAG_STOP, timeout=0.5)
+                        stop_sent += 1
+                    except ChannelTimeout:
+                        break  # slot full: drain below, retry
+                    except Exception:
+                        stop_sent += 1
                 try:
-                    self._input_chans[stop_sent].write(
-                        b"", tag=TAG_STOP, timeout=0.5)
-                    stop_sent += 1
-                except ChannelTimeout:
-                    break  # slot full: drain below, retry
+                    self._out.read(timeout=2.0)
+                except ChannelClosed:
+                    break  # sentinel arrived: all loops exited
                 except Exception:
-                    stop_sent += 1
-            try:
-                self._out.read(timeout=2.0)
-            except ChannelClosed:
-                break  # sentinel arrived: all loops exited
-            except Exception:
-                if stop_sent >= len(self._input_chans):
-                    break
-        for ch in self._channels:
-            ch.close(unlink=True)
+                    if stop_sent >= len(self._input_chans):
+                        break
+            for ch in self._channels:
+                ch.close(unlink=True)
 
     def __del__(self):
         # NEVER tear down synchronously: __del__ runs inside the GC, which

@@ -201,6 +201,18 @@ class ChannelClosed(Exception):
     pass
 
 
+class BatchItemError:
+    """Per-item error carrier for ring-fed batch mode: a batch-capable
+    compiled method returns one of these in its result list to fail ONE
+    request of the batch (the exec loop ships it as a TAG_ERROR reply in
+    that item's slot) without poisoning the batch-mates around it."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
 class ShmChannel:
     """One-directional single-producer single-consumer ring channel."""
 

@@ -22,6 +22,7 @@ from ray_tpu.serve.api import (  # noqa: F401
     status,
 )
 from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.compiled_dispatch import BackPressureError  # noqa: F401
 from ray_tpu.serve.config import (  # noqa: F401
     AutoscalingConfig,
     HTTPOptions,
@@ -47,5 +48,5 @@ __all__ = [
     "Request", "multiplexed", "get_multiplexed_model_id",
     "get_request_id", "serve_stats",
     "gRPCOptions", "get_grpc_ingress", "get_proxy_addresses",
-    "InputNode", "DAGNode", "DAGDriver",
+    "InputNode", "DAGNode", "DAGDriver", "BackPressureError",
 ]

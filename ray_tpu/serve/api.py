@@ -352,6 +352,16 @@ def delete(name: str) -> None:
 
 def shutdown() -> None:
     global _controller, _proxy, _grpc, _proxy_manager
+    # close compiled dispatch lanes FIRST, while the replicas are still
+    # alive: the teardown sentinels flow through the exec loops and the
+    # ring segments unlink deterministically (instead of at GC time,
+    # against executors the controller already killed)
+    try:
+        from .compiled_dispatch import shutdown_all as _cd_shutdown
+
+        _cd_shutdown(wait=True)
+    except Exception:
+        pass
     if _grpc is not None:
         _grpc.shutdown()
         _grpc = None

@@ -37,7 +37,8 @@ class _BatchQueue:
     def _ensure(self):
         if self._queue is None:
             self._queue = asyncio.Queue()
-            self._task = asyncio.get_event_loop().create_task(self._loop())
+            self._task = asyncio.get_running_loop().create_task(
+                self._loop())
 
     def _record_batch(self, batch) -> None:
         """Batch-assembly observability. Only the per-request context
@@ -129,6 +130,12 @@ def batch(_fn=None, *, max_batch_size: int = 8,
                 (item,) = args
                 key = 0
                 target = fn
+            # one queue per (owner, event loop): an asyncio.Queue and
+            # its flush task belong to ONE loop, and a replica serving
+            # both planes runs callables on two (the actor loop for
+            # eager calls, the compiled plane's private loop) — sharing
+            # a queue across them parks a waiter that never wakes
+            key = (key, id(asyncio.get_running_loop()))
             q = queues.get(key)
             if q is None:
                 q = queues[key] = _BatchQueue(target, max_batch_size,
@@ -136,6 +143,12 @@ def batch(_fn=None, *, max_batch_size: int = 8,
             return await q.submit(item)
 
         wrapper._is_serve_batch = True
+        # the compiled dispatch plane (serve/compiled_dispatch.py) calls
+        # the undecorated fn directly with the ring-drained backlog as
+        # the batch — continuous batching with no assembly timer — so it
+        # needs the raw fn and the size cap the user declared
+        wrapper._serve_batch_fn = fn
+        wrapper._serve_batch_max = max_batch_size
         return wrapper
 
     if _fn is not None:

@@ -114,7 +114,9 @@ class ServeController:
             rec = self._deployments.get(name)
             if rec is None:
                 return {"replicas": [], "retry_on_replica_failure": True,
-                        "slow_request_threshold_s": None}
+                        "slow_request_threshold_s": None,
+                        "max_inflight": None, "concurrency_budget": None,
+                        "compiled_dispatch": None}
             return {
                 "replicas": [r["actor"] for r in rec["replicas"]],
                 "retry_on_replica_failure": rec["config"].get(
@@ -123,6 +125,15 @@ class ServeController:
                 # default (serve_slow_request_threshold_s)
                 "slow_request_threshold_s": rec["config"].get(
                     "slow_request_threshold_s"),
+                # compiled dispatch plane knobs (None -> config default):
+                # the router re-syncs its lanes from these on every
+                # version bump, which is how a reconfigure/autoscale
+                # lands on the compiled plane
+                "max_inflight": rec["config"].get("max_inflight"),
+                "concurrency_budget": rec["config"].get(
+                    "concurrency_budget"),
+                "compiled_dispatch": rec["config"].get(
+                    "compiled_dispatch"),
             }
 
     def get_version(self) -> int:

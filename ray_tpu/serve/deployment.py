@@ -41,7 +41,10 @@ class Deployment:
                  stream: bool = False,
                  request_timeout_s: float = 60.0,
                  retry_on_replica_failure: bool = True,
-                 slow_request_threshold_s: Optional[float] = None):
+                 slow_request_threshold_s: Optional[float] = None,
+                 max_inflight: Optional[int] = None,
+                 concurrency_budget: Optional[int] = None,
+                 compiled_dispatch: Optional[bool] = None):
         self._target = target
         self.name = name
         if isinstance(autoscaling_config, dict):
@@ -59,6 +62,9 @@ class Deployment:
             request_timeout_s=request_timeout_s,
             retry_on_replica_failure=retry_on_replica_failure,
             slow_request_threshold_s=slow_request_threshold_s,
+            max_inflight=max_inflight,
+            concurrency_budget=concurrency_budget,
+            compiled_dispatch=compiled_dispatch,
         )
 
     def options(self, **overrides) -> "Deployment":
@@ -103,6 +109,13 @@ class Deployment:
             # the stage breakdown; None -> global config default
             "slow_request_threshold_s": self._opts.get(
                 "slow_request_threshold_s"),
+            # compiled dispatch plane (serve/compiled_dispatch.py):
+            # per-replica admission window, per-deployment shed budget,
+            # and the per-deployment plane toggle; None -> the
+            # RAY_TPU_SERVE_* config defaults
+            "max_inflight": self._opts.get("max_inflight"),
+            "concurrency_budget": self._opts.get("concurrency_budget"),
+            "compiled_dispatch": self._opts.get("compiled_dispatch"),
         }
 
     def __repr__(self):

@@ -86,7 +86,7 @@ class DeploymentResponse:
 
     def __init__(self, ref, router: "Router", replica_key: str,
                  redispatch=None, request_meta: Optional[dict] = None,
-                 deployment: str = ""):
+                 deployment: str = "", on_finish=None):
         self._ref = ref
         self._router = router
         self._replica_key = replica_key
@@ -94,6 +94,12 @@ class DeploymentResponse:
         self._redispatch = redispatch
         self._request_meta = request_meta
         self._deployment = deployment
+        # release hook for a compiled-router overflow grant: this eager
+        # request occupies one unit of the deployment's concurrency
+        # budget until it SETTLES — a timed-out poll is still in flight
+        # (freeing its slot early would let load past the budget)
+        self._on_finish = on_finish
+        self._budget_released = False
         self._recorded = False
         self._timeout_counted = False
         # caller-side timings (handle queue wait + e2e); the replica-side
@@ -108,6 +114,7 @@ class DeploymentResponse:
         # triple under retries.
         attempts = 3 if self._redispatch is not None else 1
         deadline = None if timeout is None else time.time() + timeout
+        timed_out = False
         try:
             for attempt in range(attempts):
                 remaining = (None if deadline is None
@@ -129,6 +136,7 @@ class DeploymentResponse:
             # Count the timeout signal once, but leave the outcome open;
             # marking error here would pin 100% error_rate on any caller
             # that polls with short timeouts.
+            timed_out = True
             if not self._timeout_counted:
                 self._timeout_counted = True
                 self._count_timeout()
@@ -137,7 +145,7 @@ class DeploymentResponse:
             self._record_failure(e)
             raise
         finally:
-            self._finish()
+            self._finish(release_budget=not timed_out)
 
     def _count_timeout(self) -> None:
         if self._request_meta is None:
@@ -175,10 +183,30 @@ class DeploymentResponse:
         obs.defer(obs.record_request_outcome, self._deployment, ingress,
                   "error", e2e, meta.get("handle_queue_wait_s"))
 
-    def _finish(self):
+    def _finish(self, release_budget: bool = True):
         if not self._done:
             self._done = True
             self._router._dec(self._replica_key)
+        # the budget slot outlives a timed-out poll (the request is
+        # still occupying a replica); it frees on the settling call
+        if release_budget and self._on_finish is not None \
+                and not self._budget_released:
+            self._budget_released = True
+            try:
+                self._on_finish()
+            except Exception:
+                pass
+
+    def __del__(self):
+        # an abandoned overflow response must not pin its budget slot
+        # forever. GC-safe: the release hook is deque ops only (no
+        # locks — the PR-2 gc-reentrancy contract)
+        try:
+            if self._on_finish is not None and not self._budget_released:
+                self._budget_released = True
+                self._on_finish()
+        except Exception:
+            pass
 
     @property
     def ref(self):
@@ -214,6 +242,11 @@ class Router:
         self.retry_on_replica_failure = True  # updated on refresh
         # None -> fall back to the global config default at emit time
         self.slow_request_threshold_s: Optional[float] = None
+        # compiled dispatch plane: the process-shared lane router for
+        # this deployment (serve/compiled_dispatch.py), fed the replica
+        # set + options on every refresh; None until first use
+        self._compiled = None
+        self._compiled_opts: Dict[str, Any] = {}
 
     def _on_longpoll(self) -> None:
         self._refresh(force=True)
@@ -254,10 +287,39 @@ class Router:
 
                     thr = global_config().serve_slow_request_threshold_s
                 self.slow_request_threshold_s = thr
+                self._compiled_opts = {
+                    "max_inflight": rset.get("max_inflight"),
+                    "concurrency_budget": rset.get("concurrency_budget"),
+                    "compiled_dispatch": rset.get("compiled_dispatch"),
+                }
                 keys = {self._key(r) for r in replicas}
                 self._inflight = {k: v for k, v in self._inflight.items()
                                   if k in keys}
+            # push the new set to the compiled lane router OUTSIDE the
+            # lock (lane retirement enqueues teardowns)
+            if self._compiled is not None:
+                self._compiled.update_replicas(
+                    replicas, self._key, self._compiled_opts)
         self._last_refresh = now
+
+    def compiled_router(self):
+        """The compiled dispatch plane for this deployment, or None when
+        unavailable (switch off, worker/client process, deployment
+        opt-out) — the caller then takes the eager path."""
+        from . import compiled_dispatch as cd
+
+        if not cd.available():
+            return None
+        self._refresh()
+        if self._compiled_opts.get("compiled_dispatch") is False:
+            return None
+        if self._compiled is None:
+            self._compiled = cd.get_router(self._controller, self._name)
+            with self._lock:
+                replicas = list(self._replicas)
+                opts = dict(self._compiled_opts)
+            self._compiled.update_replicas(replicas, self._key, opts)
+        return self._compiled
 
     @staticmethod
     def _key(replica) -> str:
@@ -374,9 +436,102 @@ class DeploymentHandle:
         return meta
 
     def remote(self, *args, **kwargs):
+        meta = self._build_request_meta()
+        t0 = time.perf_counter()
+        overflow_release = None
+        if not self._stream:
+            cr = self._router.compiled_router()
+            if cr is not None:
+                resp, overflow_release = self._try_compiled(
+                    cr, args, kwargs, meta, t0)
+                if resp is not None:
+                    return resp
+        try:
+            return self._eager_dispatch(args, kwargs, meta, t0,
+                                        overflow_release)
+        except BaseException:
+            # a routing failure must not strand the budget slot the
+            # compiled router granted for this overflow request
+            if overflow_release is not None:
+                overflow_release()
+            raise
+
+    def _try_compiled(self, cr, args, kwargs, meta, t0):
+        """One admission attempt on the compiled dispatch plane.
+        Returns ``(response, None)`` on admit, ``(None, release)`` on
+        overflow-to-eager (the release hook frees the granted budget
+        slot when the eager response settles), and raises
+        BackPressureError on shed."""
         from ray_tpu.util import tracing
 
-        meta = self._build_request_meta()
+        span = None
+        if meta is not None:
+            meta["dispatch_ts"] = time.time()
+            meta["handle_queue_wait_s"] = time.perf_counter() - t0
+            meta["slow_threshold_s"] = \
+                self._router.slow_request_threshold_s
+            parent_ctx = meta.get("trace_ctx") or tracing.current_context()
+            if parent_ctx is not None:
+                span = tracing.child_span(
+                    f"serve.handle.{self._name}", parent=parent_ctx,
+                    request_id=meta["request_id"])
+                # the replica parents its span under the handle span via
+                # the meta (there is no eager task span on this plane)
+                meta["handle_span_ctx"] = span.context
+        redispatch = (
+            (lambda eager_only=False: self._redispatch_request(
+                args, kwargs, meta, eager_only))
+            if self._router.retry_on_replica_failure else None)
+        try:
+            resp = cr.dispatch(self._method, args, kwargs,
+                               self._model_id, meta,
+                               redispatch=redispatch)
+        except BaseException:
+            if span is not None:
+                span.finish()
+            raise
+        if resp is not None:
+            if span is not None:
+                span.finish()
+            if meta is not None:
+                from . import observability as obs
+
+                obs.defer(obs.record_dispatch, self._name,
+                          time.perf_counter() - t0, "compiled")
+            return resp, None
+        # overflow to eager: drop the unadmitted attempt's span
+        # UNPUBLISHED (never finished) — the eager path opens the one
+        # real handle span for this request
+        if meta is not None:
+            meta.pop("handle_span_ctx", None)
+        return None, cr.admit_overflow()
+
+    def _redispatch_request(self, args, kwargs, meta, eager_only=False):
+        """Replica-failure retry: re-dispatch the whole request (the
+        router refreshed its set on the death) — compiled again if a
+        lane admits, else the eager path. ``eager_only`` skips the
+        compiled plane (an oversized REPLY just bounced off the ring
+        slot; re-admitting would bounce it identically)."""
+        if meta is not None:
+            meta["dispatch_ts"] = time.time()
+        if not eager_only:
+            self._router._refresh(force=True)
+            cr = self._router.compiled_router()
+            if cr is not None:
+                try:
+                    resp = cr.dispatch(self._method, args, kwargs,
+                                       self._model_id, meta,
+                                       redispatch=None)
+                except Exception:  # shed on retry: eager carries it
+                    resp = None
+                if resp is not None:
+                    return resp
+        return self._eager_dispatch(args, kwargs, meta,
+                                    time.perf_counter(), None)
+
+    def _eager_dispatch(self, args, kwargs, meta, t0, overflow_release):
+        from ray_tpu.util import tracing
+
         t_choose = time.perf_counter()
         try:
             replica, key = self._router.choose(model_id=self._model_id)
@@ -466,6 +621,11 @@ class DeploymentHandle:
         finally:
             if span is not None:
                 span.__exit__(None, None, None)
+        if meta is not None:
+            obs_dt = time.perf_counter() - t0
+            from . import observability as obs
+
+            obs.defer(obs.record_dispatch, self._name, obs_dt, "eager")
 
         def redispatch():
             r2, k2 = self._router.choose(model_id=self._model_id)
@@ -478,7 +638,8 @@ class DeploymentHandle:
         return DeploymentResponse(
             ref, self._router, key,
             redispatch if self._router.retry_on_replica_failure else None,
-            request_meta=meta, deployment=self._name)
+            request_meta=meta, deployment=self._name,
+            on_finish=overflow_release)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

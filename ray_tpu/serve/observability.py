@@ -80,6 +80,20 @@ BATCH_UTILIZATION = Gauge(
     "ray_tpu_serve_batch_utilization",
     "Realized batch size / max_batch_size of the most recent flush",
     tag_keys=("deployment",))
+# dispatch overhead spans ~30us compiled ring hops to ~ms eager remote()
+_DISPATCH_BUCKETS = [0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
+                     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.25, 1.0]
+
+DISPATCH_TIME = Histogram(
+    "ray_tpu_serve_dispatch_seconds",
+    "Time to hand a request to its transport (compiled ring write or "
+    "eager remote() submit) — the dispatch-plane overhead, per plane",
+    boundaries=_DISPATCH_BUCKETS, tag_keys=("deployment", "plane"))
+SHED = Counter(
+    "ray_tpu_serve_shed_total",
+    "Requests shed at the dispatching process: concurrency budget "
+    "exhausted with every replica admission window full",
+    tag_keys=("deployment",))
 REQUESTS = Counter(
     "ray_tpu_serve_requests_total",
     "Serve requests completed, by deployment/ingress/status",
@@ -136,6 +150,15 @@ def replica_key(deployment: str, replica: str) -> tuple:
     if v is None:
         v = _key_cache[k] = tags_key(
             {"deployment": deployment, "replica": replica})
+    return v
+
+
+def dep_plane_key(deployment: str, plane: str) -> tuple:
+    k = ("dp", deployment, plane)
+    v = _key_cache.get(k)
+    if v is None:
+        v = _key_cache[k] = tags_key(
+            {"deployment": deployment, "plane": plane})
     return v
 
 
@@ -267,6 +290,18 @@ def record_request_outcome(deployment: str, ingress: str, status: str,
         ERRORS.inc(tag_key=dep_key(deployment))
         if timed_out:
             TIMEOUTS.inc(tag_key=dep_key(deployment))
+
+
+def record_dispatch(deployment: str, seconds: float, plane: str) -> None:
+    """Dispatch-plane overhead sample (compiled ring write vs eager
+    remote() submit), invoked via :func:`defer` off the request path."""
+    DISPATCH_TIME.observe(seconds, tag_key=dep_plane_key(deployment,
+                                                         plane))
+
+
+def record_shed(deployment: str) -> None:
+    """One request refused by the proxy-side load shedder."""
+    SHED.inc(tag_key=dep_key(deployment))
 
 
 def record_timeout(deployment: str) -> None:
@@ -436,38 +471,57 @@ def serve_stats(percentiles=(0.5, 0.95, 0.99)) -> Dict[str, dict]:
 
     def ent(dep: str) -> dict:
         return out.setdefault(dep, {
-            "latency_ms": {}, "requests": 0, "errors": 0, "timeouts": 0,
-            "error_rate": 0.0, "queue_depth": 0.0})
+            "latency_ms": {}, "dispatch_ms": {}, "requests": 0,
+            "errors": 0, "timeouts": 0, "shed": 0, "error_rate": 0.0,
+            "queue_depth": 0.0})
 
-    # latency percentiles: merge bucket counts across ingress tags and
+    # latency/dispatch percentiles: merge bucket counts across tags and
     # sources per deployment, THEN take quantiles (percentiles of merged
     # buckets, not averages of per-source percentiles)
-    merged: Dict[str, dict] = {}
+    def merged_hist(name: str) -> Dict[str, dict]:
+        merged: Dict[str, dict] = {}
+        for tags, v in aggregate_histogram(name).items():
+            dep = dict(tags).get("deployment", "")
+            acc = merged.setdefault(dep,
+                                    {"sum": 0.0, "count": 0, "le": {}})
+            acc["sum"] += v["sum"]
+            acc["count"] += v["count"]
+            for b, c in v["le"].items():
+                acc["le"][b] = acc["le"].get(b, 0) + c
+        return merged
+
+    def fill_percentiles(row_key: str, name: str) -> None:
+        for dep, v in merged_hist(name).items():
+            row = ent(dep)
+            for q in percentiles:
+                label = ("p%g" % (q * 100)).replace(".", "_")
+                p = percentile_from_buckets(v["le"], v["count"], q)
+                row[row_key][label] = (round(p * 1000.0, 3)
+                                       if p is not None else None)
+            if v["count"]:
+                row[row_key]["avg"] = round(
+                    v["sum"] / v["count"] * 1000.0, 3)
+
+    fill_percentiles("latency_ms", "ray_tpu_serve_request_latency_seconds")
+    # dispatch-plane overhead (compiled ring write vs eager submit),
+    # merged across planes; per-plane counts ride alongside
+    fill_percentiles("dispatch_ms", "ray_tpu_serve_dispatch_seconds")
     for tags, v in aggregate_histogram(
-            "ray_tpu_serve_request_latency_seconds").items():
-        dep = dict(tags).get("deployment", "")
-        acc = merged.setdefault(dep, {"sum": 0.0, "count": 0, "le": {}})
-        acc["sum"] += v["sum"]
-        acc["count"] += v["count"]
-        for b, c in v["le"].items():
-            acc["le"][b] = acc["le"].get(b, 0) + c
-    for dep, v in merged.items():
-        row = ent(dep)
-        for q in percentiles:
-            label = ("p%g" % (q * 100)).replace(".", "_")
-            p = percentile_from_buckets(v["le"], v["count"], q)
-            row["latency_ms"][label] = (round(p * 1000.0, 3)
-                                        if p is not None else None)
-        if v["count"]:
-            row["latency_ms"]["avg"] = round(
-                v["sum"] / v["count"] * 1000.0, 3)
+            "ray_tpu_serve_dispatch_seconds").items():
+        t = dict(tags)
+        dep, plane = t.get("deployment", ""), t.get("plane", "")
+        if plane:
+            ent(dep).setdefault("dispatch_planes", {})
+            ent(dep)["dispatch_planes"][plane] = \
+                ent(dep)["dispatch_planes"].get(plane, 0) + v["count"]
 
     from ray_tpu.util.metrics import registry
 
     flat = aggregate_series(registry())
     for name, field in (("ray_tpu_serve_requests_total", "requests"),
                         ("ray_tpu_serve_errors_total", "errors"),
-                        ("ray_tpu_serve_timeouts_total", "timeouts")):
+                        ("ray_tpu_serve_timeouts_total", "timeouts"),
+                        ("ray_tpu_serve_shed_total", "shed")):
         for tags, value in flat.get(name, []):
             dep = dict(tags).get("deployment", "")
             ent(dep)[field] += value

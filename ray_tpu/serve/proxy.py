@@ -190,6 +190,14 @@ class HTTPProxy:
 
             result = await loop.run_in_executor(None, _call)
         except Exception as e:  # noqa: BLE001
+            from .compiled_dispatch import BackPressureError
+
+            if isinstance(e, BackPressureError):
+                # shed by the dispatch plane: overloaded, not broken —
+                # 503 tells the load balancer to back off / retry
+                return _respond(web.Response(
+                    status=503, text=str(e),
+                    headers={"retry-after": "1"}))
             return _respond(web.Response(status=500, text=str(e)))
         finally:
             if span is not None:

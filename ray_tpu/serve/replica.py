@@ -8,10 +8,12 @@ access logging).
 from __future__ import annotations
 
 import asyncio
+import functools
 import inspect
 import os
+import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import ray_tpu
 
@@ -84,6 +86,12 @@ class ServeReplica:
         self._is_class = inspect.isclass(target)
         self._deployment = deployment_name
         self._replica_tag = replica_tag or f"pid{os.getpid()}"
+        # compiled dispatch plane: in-ring channels per DAG uid (backlog
+        # visibility for load signals) and a private event loop for
+        # async user callables invoked from the compiled exec thread
+        self._compiled_chans = {}
+        self._compiled_loop = None
+        self._compiled_loop_lock = threading.Lock()
         if user_config is not None and hasattr(
                 self._callable, "reconfigure"):
             self._callable.reconfigure(user_config)
@@ -226,12 +234,241 @@ class ServeReplica:
                     obs._reset_request_ctx(rc_token)
             _model_id_ctx.reset(token)
 
+    # ------------------------------------------------ compiled dispatch
+    # The serve compiled-dispatch plane (serve/compiled_dispatch.py)
+    # binds handle_request_compiled_batch into a long-lived compiled DAG
+    # per replica: requests arrive as the ring backlog the exec loop
+    # drained this round (ring-fed continuous batching — under load the
+    # list fills with zero assembly wait; idle requests run alone,
+    # immediately), and one reply per item ships back in order.
+
+    def __compiled_channels_hook__(self, uid: str, chans) -> None:
+        """Called by the worker's compiled-exec installer with this
+        DAG's in-edge channels (None on loop exit): queued-in-ring
+        requests then count in the load signal the router/autoscaler
+        polls, exactly like eager in-flight requests do."""
+        if chans is None:
+            self._compiled_chans.pop(uid, None)
+        else:
+            self._compiled_chans[uid] = chans
+
+    def _compiled_backlog(self) -> int:
+        n = 0
+        for chans in list(self._compiled_chans.values()):
+            for ch in chans:
+                try:
+                    n += ch.occupancy()
+                except Exception:
+                    pass  # channel closed (rebind/teardown race)
+        return n
+
+    def _ensure_compiled_loop(self):
+        """Private event loop for async user callables reached from the
+        compiled exec thread — items of one batch gather CONCURRENTLY on
+        it, so composition like `await self.batched(x)` still assembles
+        real batches (the @serve.batch queue lives on this loop)."""
+        if self._compiled_loop is None:
+            with self._compiled_loop_lock:
+                if self._compiled_loop is None:
+                    loop = asyncio.new_event_loop()
+                    t = threading.Thread(target=loop.run_forever,
+                                         daemon=True,
+                                         name="serve-compiled-async")
+                    t.start()
+                    self._compiled_loop = loop
+        return self._compiled_loop
+
+    @staticmethod
+    def _is_async_callable(fn) -> bool:
+        return inspect.iscoroutinefunction(fn) or (
+            not inspect.isfunction(fn) and not inspect.ismethod(fn)
+            and inspect.iscoroutinefunction(
+                getattr(fn, "__call__", None)))
+
+    def handle_request_compiled_batch(self, requests: List[tuple]):
+        """One ring-fed batch round: ``requests`` is a list of
+        ``(method, args, kwargs, model_id, meta)`` tuples in arrival
+        order. Returns one result per item in order; per-item failures
+        come back as BatchItemError so one bad request cannot fail its
+        batch-mates."""
+        recv_ts = time.time()
+        out: List[Any] = []
+        i, n = 0, len(requests)
+        while i < n:
+            method, model_id = requests[i][0], requests[i][3]
+            j = i + 1
+            # contiguous same-(method, model) runs execute as one group
+            # — the order-preserving grouping rule
+            while j < n and requests[j][0] == method \
+                    and requests[j][3] == model_id:
+                j += 1
+            out.extend(self._compiled_group(method, model_id,
+                                            requests[i:j], recv_ts))
+            i = j
+        return out
+
+    def _compiled_group(self, method_name: str, model_id: str,
+                        group: List[tuple], recv_ts: float) -> List[Any]:
+        from ray_tpu.experimental.channel import BatchItemError
+        from ray_tpu.serve.multiplex import (_model_id_ctx,
+                                             _set_request_model_id)
+
+        try:
+            fn = self._resolve_fn(method_name)
+        except AttributeError as e:
+            return [BatchItemError(e)] * len(group)
+        self._ongoing += len(group)
+        self._total += len(group)
+        rcs = [self._request_begin(req[4], recv_ts) for req in group]
+        spans = self._compiled_spans(group)
+        token = _set_request_model_id(model_id)
+        t0 = time.perf_counter()
+        try:
+            try:
+                raw = getattr(fn, "_serve_batch_fn", None)
+                if raw is not None and all(
+                        len(req[1]) == 1 and not req[2] for req in group):
+                    results = self._run_ring_batches(
+                        fn, raw, group, BatchItemError)
+                elif self._is_async_callable(fn):
+                    results = self._run_async_group(
+                        fn, group, rcs, model_id, BatchItemError)
+                else:
+                    results = self._run_sync_group(fn, group, rcs,
+                                                   BatchItemError)
+            except Exception as e:  # noqa: BLE001 — never lose a reply
+                results = [BatchItemError(e)] * len(group)
+        finally:
+            exec_s = time.perf_counter() - t0
+            self._ongoing -= len(group)
+            _model_id_ctx.reset(token)
+            for span in spans:
+                if span is not None:
+                    span.finish()
+        for rc, res in zip(rcs, results):
+            if rc is None:
+                continue
+            status = "error" if isinstance(res, BatchItemError) else "ok"
+            # per-item exec time is the group's wall time: items of one
+            # continuous batch share the execution
+            self._request_end(rc, method_name, status, exec_s)
+        return results
+
+    def _compiled_spans(self, group):
+        """Replica-side spans joining the handle span (compiled dispatch
+        has no eager task span to join the trace for it)."""
+        from ray_tpu.serve import observability as obs
+
+        if not obs.enabled():
+            return [None] * len(group)
+        from ray_tpu.util import tracing
+
+        spans = []
+        for req in group:
+            meta = req[4]
+            ctx = meta.get("handle_span_ctx") if meta else None
+            if ctx is None:
+                spans.append(None)
+                continue
+            try:
+                spans.append(tracing.child_span(
+                    "serve.replica.handle_request_compiled",
+                    parent=ctx,
+                    request_id=meta.get("request_id", "")))
+            except Exception:
+                spans.append(None)
+        return spans
+
+    def _run_ring_batches(self, fn, raw, group,
+                          BatchItemError) -> List[Any]:
+        """@serve.batch target dispatched on the compiled plane: the
+        ring backlog IS the batch — the undecorated fn runs directly on
+        the drained items (chunked to the decorator's max_batch_size)
+        with no assembly timer at all."""
+        from ray_tpu.serve import observability as obs
+        from ray_tpu.serve.batching import _record_batch_metrics
+
+        bmax = max(1, int(getattr(fn, "_serve_batch_max", len(group))))
+        target = (functools.partial(raw, self._callable)
+                  if self._is_class else raw)
+        results: List[Any] = []
+        for start in range(0, len(group), bmax):
+            chunk = group[start:start + bmax]
+            items = [req[1][0] for req in chunk]
+            try:
+                res = target(items)
+                if asyncio.iscoroutine(res):
+                    res = asyncio.run_coroutine_threadsafe(
+                        res, self._ensure_compiled_loop()).result()
+                if not isinstance(res, (list, tuple)) \
+                        or len(res) != len(items):
+                    raise ValueError(
+                        f"batched fn returned "
+                        f"{len(res) if isinstance(res, (list, tuple)) else type(res).__name__} "
+                        f"results for {len(items)} inputs")
+                results.extend(res)
+            except Exception as e:  # noqa: BLE001 — fail this chunk only
+                results.extend([BatchItemError(e)] * len(items))
+            if obs.enabled():
+                obs.defer(_record_batch_metrics, self._deployment, [],
+                          len(chunk), bmax)
+        return results
+
+    def _run_async_group(self, fn, group, rcs, model_id,
+                         BatchItemError) -> List[Any]:
+        """Async callable: gather the whole group concurrently on the
+        private loop — composition through @serve.batch inside the
+        callable still forms real batches, and slow awaits overlap."""
+        from ray_tpu.serve import observability as obs
+        from ray_tpu.serve.multiplex import (_model_id_ctx,
+                                             _set_request_model_id)
+
+        async def one(req, rc):
+            # each gather task runs in its own context copy: the model
+            # id and request context stick to this item only
+            token = _set_request_model_id(model_id)
+            rc_token = obs._set_request_ctx(rc) if rc is not None else None
+            try:
+                return await fn(*req[1], **req[2])
+            finally:
+                if rc_token is not None:
+                    obs._reset_request_ctx(rc_token)
+                _model_id_ctx.reset(token)
+
+        async def gather():
+            return await asyncio.gather(
+                *(one(req, rc) for req, rc in zip(group, rcs)),
+                return_exceptions=True)
+
+        res = asyncio.run_coroutine_threadsafe(
+            gather(), self._ensure_compiled_loop()).result()
+        return [BatchItemError(r) if isinstance(r, BaseException) else r
+                for r in res]
+
+    def _run_sync_group(self, fn, group, rcs, BatchItemError) -> List[Any]:
+        from ray_tpu.serve import observability as obs
+
+        results = []
+        for req, rc in zip(group, rcs):
+            rc_token = obs._set_request_ctx(rc) if rc is not None else None
+            try:
+                results.append(fn(*req[1], **req[2]))
+            except Exception as e:  # noqa: BLE001
+                results.append(BatchItemError(e))
+            finally:
+                if rc_token is not None:
+                    obs._reset_request_ctx(rc_token)
+        return results
+
     def reconfigure(self, user_config) -> None:
         if hasattr(self._callable, "reconfigure"):
             self._callable.reconfigure(user_config)
 
     def get_num_ongoing_requests(self) -> int:
-        return self._ongoing
+        # the compiled plane's queued-in-ring requests are in flight on
+        # this replica just as much as eager ones: the pow-2 router and
+        # the autoscaler both read this
+        return self._ongoing + self._compiled_backlog()
 
     def stats(self) -> Dict[str, Any]:
         return {"ongoing": self._ongoing, "total": self._total,

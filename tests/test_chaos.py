@@ -590,3 +590,118 @@ class TestKillMatrix:
                 cfg.test_fault_spec = ""
                 fault_injection.reset()
                 ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------------------
+# serve compiled dispatch plane: replica death mid-RPS-ramp
+# --------------------------------------------------------------------------
+
+
+class TestServeCompiledChaos:
+    """The serve-plane chaos drill (ROADMAP "chaos-drill the SERVE
+    plane"): a replica hard-killed mid-traffic via the deterministic
+    fault spec must surface as an attributed ActorDiedError (never a
+    wedge, never a bare timeout), and the compiled lane must serve the
+    restarted incarnation again."""
+
+    def _planes(self, serve, name):
+        from ray_tpu.serve import observability as obs
+
+        obs.drain_deferred()
+        return serve.status().get(name, {}).get("dispatch_planes", {})
+
+    def test_replica_crash_surfaces_attributed_then_recovers(self):
+        cfg = global_config()
+        # the 6th compiled batch on any one incarnation dies mid-dispatch
+        cfg.test_fault_spec = "dag.exec.handle_request_compiled_batch=crash@6"
+        try:
+            ray_tpu.init(num_cpus=4, num_tpus=0)
+            from ray_tpu import serve
+
+            serve.start(serve.HTTPOptions(port=18572))
+
+            @serve.deployment(max_inflight=4, retry_on_replica_failure=False,
+                              ray_actor_options={"max_restarts": 3})
+            class M:
+                def work(self, x):
+                    return (x, os.getpid())
+
+            h = serve.run(M.bind(), route_prefix=None)
+            _, pid1 = h.work.remote(0).result(timeout=60)
+
+            def engaged():
+                h.work.remote(0).result(timeout=30)
+                return self._planes(serve, "M").get("compiled", 0) >= 1
+
+            wait_for(engaged, timeout=60, msg="compiled plane engaged")
+            # closed-loop ramp: every request gets a bounded reply — ok
+            # or an ATTRIBUTED error; a wedge would blow the per-request
+            # timeout (surfacing as TimeoutError = test failure)
+            died = 0
+            recovered_pid = None
+            deadline = time.monotonic() + 120
+            i = 0
+            while time.monotonic() < deadline and recovered_pid is None:
+                i += 1
+                try:
+                    _, pid = h.work.remote(i).result(timeout=30)
+                    if pid != pid1:
+                        recovered_pid = pid
+                except ActorDiedError as e:
+                    died += 1
+                    msg = str(e)
+                    assert "executor" in msg or "actor" in msg, msg
+                    assert "timed out" not in msg.lower()
+            assert died >= 1, "the crash never surfaced as ActorDiedError"
+            assert recovered_pid is not None, \
+                "the restarted replica never served"
+            # the recovered replica serves on the COMPILED plane again
+            # (the lane rebound to the new incarnation)
+            base = self._planes(serve, "M").get("compiled", 0)
+
+            def compiled_grows():
+                try:
+                    h.work.remote(999).result(timeout=30)
+                except ActorDiedError:
+                    pass  # racing a second scheduled crash: keep waiting
+                return self._planes(serve, "M").get("compiled", 0) > base
+
+            wait_for(compiled_grows, timeout=60,
+                     msg="compiled plane serving after restart")
+            serve.shutdown()
+        finally:
+            cfg.test_fault_spec = ""
+            fault_injection.reset()
+            ray_tpu.shutdown()
+
+    def test_retrying_deployment_loses_no_request(self):
+        """With replica-failure retry on (the default), the crash is
+        invisible to callers: every in-flight request either completed
+        or was redispatched — zero lost, zero errors."""
+        cfg = global_config()
+        cfg.test_fault_spec = "dag.exec.handle_request_compiled_batch=crash@5"
+        try:
+            ray_tpu.init(num_cpus=4, num_tpus=0)
+            from ray_tpu import serve
+
+            serve.start(serve.HTTPOptions(port=18573))
+
+            @serve.deployment(max_inflight=4,
+                              ray_actor_options={"max_restarts": 3})
+            class R:
+                def work(self, x):
+                    return (x, os.getpid())
+
+            h = serve.run(R.bind(), route_prefix=None)
+            pids = set()
+            for i in range(12):
+                v, pid = h.work.remote(i).result(timeout=120)
+                assert v == i
+                pids.add(pid)
+            assert len(pids) >= 2, \
+                "the fault spec should have crashed one incarnation"
+            serve.shutdown()
+        finally:
+            cfg.test_fault_spec = ""
+            fault_injection.reset()
+            ray_tpu.shutdown()

@@ -176,11 +176,22 @@ def test_batching(serve_instance):
             return self.batch_sizes
 
     handle = serve.run(BatchModel.bind(), route_prefix=None)
-    responses = [handle.remote(i) for i in range(8)]
-    results = sorted(r.result() for r in responses)
-    assert results == [i * 10 for i in range(8)]
-    sizes = handle.get_batch_sizes.remote().result()
-    assert max(sizes) > 1  # some batching happened
+    # deadline on observable state (ADVICE.md): one burst only overlaps
+    # inside the 0.2 s batch window when the 8 dispatches land close
+    # together — on a saturated CI box a single burst can straggle into
+    # 8 batches of 1 (a known tier-1 load flake). Fresh burst per round
+    # until a real batch is observed; correctness asserts every round.
+    deadline = time.time() + 60
+    while True:
+        responses = [handle.remote(i) for i in range(8)]
+        results = sorted(r.result(timeout=60) for r in responses)
+        assert results == [i * 10 for i in range(8)]
+        sizes = handle.get_batch_sizes.remote().result()
+        if max(sizes) > 1:  # some batching happened
+            break
+        assert time.time() < deadline, \
+            f"no batch formed before the deadline: {sizes}"
+        time.sleep(0.1)
 
 
 def test_autoscaling_scales_up(serve_instance):
@@ -193,16 +204,26 @@ def test_autoscaling_scales_up(serve_instance):
             return "ok"
 
     handle = serve.run(Slow.bind(), route_prefix=None)
-    # flood with concurrent requests to build queue depth
+    # deadline on observable state (ADVICE.md): a single 12-request
+    # burst can fully drain before the autoscaler's next load poll on a
+    # saturated CI box (a known tier-1 load flake — the old 15 s window
+    # then expired with nothing left to observe). Keep the offered load
+    # TOPPED UP until the scale-up is the observed state; the replica
+    # cold start alone can eat tens of seconds under full-suite load.
     responses = [handle.remote(None) for _ in range(12)]
-    deadline = time.time() + 15
+    deadline = time.time() + 120
     scaled = False
     while time.time() < deadline:
         st = serve.status()
         if st.get("Slow", {}).get("num_replicas", 0) >= 2:
             scaled = True
             break
+        # sustain queue depth: collect finished responses, resubmit
+        done, responses = responses[:4], responses[4:]
+        for r in done:
+            r.result(timeout=60)
+        responses.extend(handle.remote(None) for _ in range(4))
         time.sleep(0.2)
     for r in responses:
-        r.result()
+        r.result(timeout=60)
     assert scaled

@@ -79,6 +79,12 @@ def test_http_requests_yield_ids_logs_histograms_and_spans(serve_instance):
             return {"hello": serve.get_request_id()}
 
     serve.run(Greeter.bind(), route_prefix="/greet")
+    # DELTA-based histogram count: the driver-process registry outlives
+    # clusters, so a same-named deployment in an earlier test file
+    # (test_serve.py's Greeter) leaves counts behind — the exact shape
+    # of the serve-area tier-1 "load flake" from the PR-13 run (full
+    # suite ordering, passes in isolation)
+    base_count = _merged_latency_count("Greeter")
     N = 8
     header_ids, body_ids = [], []
     for _ in range(N):
@@ -108,7 +114,7 @@ def test_http_requests_yield_ids_logs_histograms_and_spans(serve_instance):
         assert "replica_queue_wait_ms" in l["timings_ms"]
 
     # e2e histogram (recorded proxy-side, head process): _count == N
-    assert _merged_latency_count("Greeter") == N
+    assert _merged_latency_count("Greeter") - base_count == N
 
     # replica-side stage histograms flush over the worker channel
     deadline = time.monotonic() + 15
