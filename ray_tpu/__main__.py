@@ -10,6 +10,7 @@ stop/list``, ``ray list tasks|actors|nodes``). Commands:
     job     status|logs|stop|list against a dashboard address
     list    tasks|actors|nodes|objects|placement_groups via dashboard
     memory  cluster memory/object ownership table (`ray memory` analog)
+    timeline  merged Perfetto trace / step-time attribution report
     lint    graftlint static analyzer (tools/lint; docs/static-analysis.md)
 """
 
@@ -142,6 +143,39 @@ def _cmd_memory(args) -> int:
     return 0
 
 
+def _cmd_timeline(args) -> int:
+    """Fetch (or load) a merged cluster trace; write Perfetto JSON
+    and/or print the where-did-my-step-time-go attribution report."""
+    from ray_tpu.util.flight_recorder import (attribute_trace,
+                                              format_attribution)
+
+    if args.input:
+        with open(args.input) as f:
+            events = json.load(f)
+    else:
+        import urllib.request
+
+        base = args.address
+        if not base.startswith("http"):
+            base = "http://" + base
+        with urllib.request.urlopen(f"{base}/api/timeline",
+                                    timeout=30) as resp:
+            events = json.loads(resp.read().decode())
+    if isinstance(events, dict):
+        # both Chrome-trace shapes are valid: bare event list or
+        # {"traceEvents": [...]} (what a --perfetto re-export or an
+        # object-format dump carries)
+        events = events.get("traceEvents", [])
+    if args.perfetto:
+        with open(args.perfetto, "w") as f:
+            json.dump(events, f)
+        print(f"wrote {len(events)} events to {args.perfetto} "
+              "(open in https://ui.perfetto.dev)")
+    if args.attribute or not args.perfetto:
+        print(format_attribution(attribute_trace(events)))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="python -m ray_tpu")
     sub = p.add_subparsers(dest="cmd")
@@ -191,6 +225,21 @@ def main(argv=None) -> int:
                      default="callsite", dest="group_by")
     mem.add_argument("--limit", type=int, default=50)
 
+    tl = sub.add_parser("timeline",
+                        help="merged cluster trace (flight recorder + "
+                             "task slices): --perfetto out.json writes "
+                             "Chrome/Perfetto JSON, --attribute prints "
+                             "the per-step time budget")
+    tl.add_argument("--address", default="http://127.0.0.1:8265",
+                    help="dashboard address serving /api/timeline")
+    tl.add_argument("--input", default=None,
+                    help="read a previously exported trace JSON instead "
+                         "of fetching from a dashboard")
+    tl.add_argument("--perfetto", default=None, metavar="OUT_JSON",
+                    help="write the merged trace to this file")
+    tl.add_argument("--attribute", action="store_true",
+                    help="print the step-time attribution report")
+
     up = sub.add_parser("up", help="launch a cluster from a YAML spec")
     up.add_argument("config", help="cluster YAML path")
     dn = sub.add_parser("down", help="tear down a launched cluster")
@@ -232,6 +281,8 @@ def main(argv=None) -> int:
         return _cmd_list(args)
     if args.cmd == "memory":
         return _cmd_memory(args)
+    if args.cmd == "timeline":
+        return _cmd_timeline(args)
     if args.cmd == "up":
         from ray_tpu.cluster_launcher import up as _up
 

@@ -198,6 +198,33 @@ class Config:
     # with the stage breakdown; per-deployment override via
     # @serve.deployment(slow_request_threshold_s=...); <= 0 disables
     serve_slow_request_threshold_s: float = 1.0
+    # flight recorder (util/flight_recorder.py): always-on per-process
+    # span rings behind `python -m ray_tpu timeline`. The hot path is a
+    # flag test when off and ~two clock reads + a tuple store when on
+    # (overhead bench-gated in BENCH_TRACE.json)
+    flight_recorder: bool = True
+    # ring capacity in span records per process (rounded up to a power
+    # of two; one record is one fixed-size tuple slot)
+    flight_recorder_events: int = 65536
+    # seconds of trailing spans a crash dump keeps (fault-injection
+    # crashes and attributed-death paths write
+    # session_dir/logs/flightrec/<proc>-<pid>-<ts>.json)
+    flight_recorder_dump_window_s: float = 10.0
+    # worker/daemon -> head span-drain cadence (rides the worker channel
+    # one-way like the metrics report; drops are harmless — the next
+    # drain re-ships nothing, spans are consumed on drain)
+    flight_recorder_report_interval_ms: int = 2000
+    # duration floor: spans shorter than this skip the ring, leaving
+    # only the clock reads on the hot path — what keeps the recorder
+    # inside the <=3% dag-bench overhead gate at microsecond dispatch
+    # rates. The default sits above the ring-wait jitter of an
+    # oversubscribed host (waits stretch into the hundreds of us there,
+    # and recording every one re-inflates the hot path exactly when the
+    # box is slowest); step-scale spans (pipeline fwd/bwd, SPMD phases,
+    # bubbles, batch drains) sit at ms scale, far above it, and the
+    # ring STALL COUNTERS still aggregate every wait regardless.
+    # Lower it (or set 0: record everything) to trace micro behavior.
+    flight_recorder_min_span_us: float = 500.0
 
     # ---- serve compiled dispatch plane (serve/compiled_dispatch.py) ----
     # route unary requests over long-lived compiled graphs (one ring-pair

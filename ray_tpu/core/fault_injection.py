@@ -180,7 +180,16 @@ def fire(point: str, detail: Optional[str] = None) -> Optional[str]:
         return None
     if matched.action == "crash":
         # hard process death, as close to kill -9 as Python allows: no
-        # atexit, no finally blocks, no flushes
+        # atexit, no finally blocks, no flushes. The one exception is
+        # the crash FLIGHT RECORDER: its whole job is a last-N-seconds
+        # span dump at exactly this kind of death, written synchronously
+        # here (bounded, best-effort) before the exit
+        try:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.dump(f"chaos:{matched.point}")
+        except Exception:
+            pass
         os._exit(13)
     if matched.action == "raise":
         raise FaultInjected(matched.point)

@@ -81,6 +81,8 @@ import time
 from typing import Callable, Dict, Optional, Tuple
 
 from ray_tpu.experimental.channel import (
+    RETRANSMITS,
+    STALLS,
     STATS,
     TAG_BYTES,
     TAG_DATA,
@@ -90,9 +92,17 @@ from ray_tpu.experimental.channel import (
     ChannelClosed,
     ChannelTimeout,
     _maybe_flush,
+    _sp_park,
+    _sp_wait_read,
+    _sp_wait_write,
     tensor_payload,
     parse_tensor,
 )
+from ray_tpu.util import flight_recorder as _fr
+
+# net-side retransmission instants (the shared RETRANSMITS counter cell
+# feeds the registry; the span gives each event a timeline position)
+_sp_retransmit = _fr.register_span("net.retransmit", tag_keys=("channel",))
 
 from .fault_injection import should_drop as _fault_should_drop
 
@@ -235,17 +245,34 @@ class _Endpoint:
         with self._lock:
             self._send = send
 
+    # set by each concrete end: which side of the ring stalls here
+    _wait_role = "read"
+
     def _wait(self, ready, timeout: Optional[float]) -> None:
         """Hybrid wait for ``ready()`` (called under no lock): bounded
         spin, then flag-RECHECK-sleep under the condition lock — the
         delivering rx thread notifies iff the flag is up."""
         if ready():
             return
+        # real wait: time it for the stall counter + flight-rec span
+        # (shared dicts with the shm channel layer — one flush path)
+        t0 = time.monotonic()
+        try:
+            self._wait_slow(ready, timeout)
+        finally:
+            dur = time.monotonic() - t0
+            key = (self._metric_name, self._wait_role)
+            STALLS[key] = STALLS.get(key, 0.0) + dur
+            (_sp_wait_write if self._wait_role == "write"
+             else _sp_wait_read).end_at(t0, dur, self._metric_name)
+
+    def _wait_slow(self, ready, timeout: Optional[float]) -> None:
         for i in range(_SPIN_ITERS):
             if ready():
                 return
             if i & 7 == 7:
                 os.sched_yield()
+        _sp_park.instant(self._metric_name, self._wait_role)
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while True:
@@ -292,6 +319,8 @@ class NetRingWriter(_Endpoint):
     covers it — the durable-slot contract the model's writer-restart
     recovery relies on. ``acked`` is a session-volatile cache rebuilt
     from (re-)acks."""
+
+    _wait_role = "write"
 
     def __init__(self, ring_id: str, n_slots: int, capacity: int,
                  send: Optional[Callable] = None):
@@ -433,6 +462,8 @@ class NetRingWriter(_Endpoint):
             send = self._send
         if send is None:
             return False
+        RETRANSMITS[0] += 1
+        _sp_retransmit.instant(self._metric_name)
         return _net_send(send, "nrd", seq, tag, payload)
 
     # ---- TCP session ----

@@ -1492,6 +1492,16 @@ class Node:
             elif tag == "metrics":
                 self.head.on_worker_metrics(
                     f"{self.hex[:6]}:{w.pid}", payload[0])
+            elif tag == "spans":
+                # worker flight-recorder batch -> head span store; the
+                # node stamps source AND its node hex (the head keys
+                # clock offsets by node)
+                try:
+                    self.head.on_worker_spans(
+                        f"{self.hex[:6]}:{w.pid}",
+                        dict(payload[0], node_hex=self.hex))
+                except Exception:
+                    pass
             elif tag == "cevents":
                 # worker cluster events -> head event ring (one-way)
                 try:

@@ -268,7 +268,10 @@ class RemoteHead:
                 # health probe (reference: gcs_health_check_manager.h) —
                 # answered from the handler pool, so a wedged daemon
                 # genuinely misses probes
-                self._send("pong", payload[0])
+                # the wall-clock echo feeds the head's min-RTT clock
+                # offset estimator (flight-recorder trace merge); old
+                # heads ignore the extra element
+                self._send("pong", payload[0], time.time())
             elif tag == "cluster_view":
                 # syncer broadcast (reference: RaySyncer RESOURCE_VIEW
                 # fan-out); versioned — drop stale reorderings
@@ -305,6 +308,9 @@ class RemoteHead:
 
     def on_worker_metrics(self, source_id: str, snapshot: dict) -> None:
         self._send("worker_metrics", source_id, snapshot)
+
+    def on_worker_spans(self, source_id: str, payload: dict) -> None:
+        self._send("spans", source_id, payload)
 
     def record_cluster_events(self, events: list) -> None:
         self._send("cevents", events)
@@ -615,6 +621,30 @@ def main(argv=None) -> int:
     from .syncer import NodeSyncer
 
     syncer = NodeSyncer(head, node)
+    # this daemon's own flight-recorder spans (net-ring waits run here)
+    # drain to the head on the report cadence, one-way and droppable
+    from ray_tpu.util import flight_recorder as _fr
+
+    _fr.adopt_config(cfg)
+    _fr.set_process_label("daemon")
+    _fr.set_dump_dir(session_dir)
+    if _fr.enabled():
+        def _span_report_loop():
+            period = max(0.25,
+                         cfg.flight_recorder_report_interval_ms / 1000.0)
+            src = f"{node.hex[:6]}:daemon"
+            while not head.stopped.is_set():
+                time.sleep(period)
+                try:
+                    pl = _fr.drain()
+                    if pl is not None:
+                        head.on_worker_spans(
+                            src, dict(pl, node_hex=node.hex))
+                except Exception:
+                    pass
+
+        threading.Thread(target=_span_report_loop, daemon=True,
+                         name="flightrec-report").start()
     if cfg.device_telemetry_enabled:
         from ray_tpu.util.device_telemetry import start_device_telemetry
 

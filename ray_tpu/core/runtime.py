@@ -109,6 +109,10 @@ class NodeProxy:
         self.pid = pid
         self.alive = True
         self.last_pong = time.monotonic()
+        # clock-offset estimation against this daemon's wall clock
+        # (flight-recorder trace merge); fed by stamped ping/pong pairs
+        self._ping_sent: Optional[tuple] = None
+        self.clock_est = None
 
     def _send(self, tag: str, *payload) -> bool:
         try:
@@ -245,6 +249,15 @@ class Head:
         self.direct_recover: Optional[Callable[[ObjectID], bool]] = None
         # fetch_local pulls in flight (dedup across concurrent waits)
         self._active_pulls: Set[ObjectID] = set()
+        # flight-recorder: reported span batches per source id
+        # ("<node6>:<pid>" / "<node6>:daemon" / "head:<label>"), merged
+        # into one Perfetto trace by flight_recorder.cluster_trace
+        from ray_tpu.util import flight_recorder as _fr
+
+        _fr.adopt_config(cfg0)
+        _fr.set_process_label("driver")
+        _fr.set_dump_dir(self.session_dir)
+        self.flight_spans: Dict[str, deque] = {}
         # memory observability: per-source worker ref-table reports
         # (source id = "<node6>:<pid>", same keying as worker metrics)
         # and pending head->daemon store_info requests
@@ -904,6 +917,10 @@ class Head:
                         pass
                     self.remove_node(p.hex)
                     continue
+                # stamp the send for clock-offset estimation: the pong
+                # echoes seq plus the daemon's wall clock, and the
+                # min-RTT midpoint estimator needs both endpoints' walls
+                p._ping_sent = (seq, time.time())
                 p._send("ping", seq)
 
     @property
@@ -1087,6 +1104,22 @@ class Head:
                         None)
             elif tag == "pong":
                 proxy.last_pong = time.monotonic()
+                # new daemons echo (seq, their wall clock): feed the
+                # min-RTT clock-offset estimator for trace merging.
+                # Old 1-tuple pongs (or an unstamped ping) just skip it.
+                if len(payload) >= 2:
+                    sent = getattr(proxy, "_ping_sent", None)
+                    if sent is not None and sent[0] == payload[0]:
+                        if proxy.clock_est is None:
+                            from ray_tpu.util.flight_recorder import (
+                                ClockOffsetEstimator,
+                            )
+
+                            proxy.clock_est = ClockOffsetEstimator()
+                        proxy.clock_est.add_ping(
+                            sent[1], time.time(), payload[1])
+            elif tag == "spans":
+                self.on_worker_spans(payload[0], payload[1])
             elif tag == "sync":
                 self.on_node_sync(proxy, payload[0])
             elif tag == "devents":
@@ -1996,6 +2029,14 @@ class Head:
         from ray_tpu.util.metrics import registry
 
         registry().merge(source_id, snapshot)
+
+    def on_worker_spans(self, source_id: str, payload: dict) -> None:
+        """A drained flight-recorder batch from a worker or daemon
+        (one-way, droppable — spans are observability, not state)."""
+        q = self.flight_spans.get(source_id)
+        if q is None:
+            q = self.flight_spans[source_id] = deque(maxlen=256)
+        q.append(payload)
 
     def on_worker_log(self, node_hex: str, pid: int, text: str) -> None:
         """Tail-to-driver (reference: log_monitor.py -> driver stdout)."""

@@ -39,6 +39,10 @@ from .task_spec import TaskSpec
 
 
 from ray_tpu.experimental.channel import is_arraylike as _is_arraylike
+from ray_tpu.util import flight_recorder as _fr
+
+_sp_dag_exec = _fr.register_span("dag.exec", tag_keys=("method",))
+_sp_batch_drain = _fr.register_span("dag.batch_drain", tag_keys=("method",))
 
 
 class _BatchErrPayload:
@@ -106,6 +110,10 @@ class WorkerRuntime:
         # their access logs under <session_dir>/logs/serve/
         self.session_dir: str = init_info.get("session_dir", "")
         set_global_config(Config.from_json(init_info["config"]))
+        _fr.adopt_config(global_config())
+        _fr.set_process_label(f"worker:{os.getpid()}")
+        if self.session_dir:
+            _fr.set_dump_dir(self.session_dir)
         # adopt the node's extra import roots (driver-side sys.path inserts)
         # so by-reference pickles of driver-loaded modules resolve here
         for p in init_info.get("sys_path", []):
@@ -510,6 +518,11 @@ class WorkerRuntime:
                 so = sys.modules.get("ray_tpu.serve.observability")
                 if so is not None:
                     so.flush_all()
+                # final flight-recorder drain: the periodic span report
+                # thread dies with os._exit, so push the tail now
+                pl = _fr.drain()
+                if pl is not None:
+                    self.channel.send("spans", pl)
             except Exception:
                 pass
             os._exit(0)
@@ -675,6 +688,13 @@ class WorkerRuntime:
             ``dag.exec[.<fn>]`` chaos point fires first (crash = the
             replica-death drill for the compiled serve plane)."""
             fault_injection.fire("dag.exec", method_name)
+            _t0 = _fr.now()
+            try:
+                return _invoke_inner(args)
+            finally:
+                _sp_dag_exec.end(_t0, method_name)
+
+        def _invoke_inner(args):
             if direct_call:
                 # opt-in per node: no pool handoff, no exec lock — the
                 # method declares itself safe against the actor's eager
@@ -746,7 +766,8 @@ class WorkerRuntime:
         if batch_max >= 1 and len(ins) == 1:
             self._compiled_batch_loop(ins[0], propagate, invoke,
                                       write_value, error_payload,
-                                      batch_max, device, BatchItemError)
+                                      batch_max, device, BatchItemError,
+                                      method_name)
             return
 
         while True:
@@ -782,7 +803,7 @@ class WorkerRuntime:
 
     def _compiled_batch_loop(self, ch, propagate, invoke, write_value,
                              error_payload, batch_max, device,
-                             BatchItemError) -> None:
+                             BatchItemError, method_name="batch") -> None:
         """Ring-fed batch rounds (serve continuous batching): block for
         the first message, then admit everything ALREADY queued in the
         ring — up to ``batch_max`` — into the same method call. Requests
@@ -803,6 +824,8 @@ class WorkerRuntime:
         while True:
             entries = []  # ("val", value) | ("err", payload passthrough)
             stop = False
+            _t0 = 0.0  # span starts at the FIRST admitted message: idle
+            #            park time before a round is not drain time
             while len(entries) < batch_max:
                 if entries:
                     try:
@@ -817,6 +840,8 @@ class WorkerRuntime:
                     break
                 except Exception:
                     return  # channel unlinked (teardown race)
+                if not _t0:
+                    _t0 = _fr.now()
                 if tag == TAG_ERROR:
                     entries.append(("err", payload))
                 elif tag == TAG_TENSOR or tag == TAG_BYTES:
@@ -857,6 +882,7 @@ class WorkerRuntime:
                         write_value(r)
                     except Exception as e:  # unserializable result etc.
                         propagate(TAG_ERROR, error_payload(e))
+            _sp_batch_drain.end(_t0, method_name)
             if stop:
                 propagate(TAG_STOP)
                 return
@@ -1167,6 +1193,25 @@ def worker_main(argv=None) -> None:
     start_report_thread(
         lambda snap: channel.send("metrics", snap),
         global_config().metrics_report_interval_ms / 1000.0)
+    # flight-recorder spans ride the worker channel one-way ("spans");
+    # the node stamps this worker's source id and forwards to the head
+    if _fr.enabled():
+
+        def _span_report_loop():
+            period = max(
+                0.25,
+                global_config().flight_recorder_report_interval_ms / 1000.0)
+            while True:
+                time.sleep(period)
+                try:
+                    pl = _fr.drain()
+                    if pl is not None:
+                        channel.send("spans", pl)
+                except Exception:
+                    pass  # node gone: serve_forever exits us shortly
+
+        threading.Thread(target=_span_report_loop, daemon=True,
+                         name="flightrec-report").start()
     # ref-table reports ride the same worker channel one-way ("refs");
     # the node stamps this worker's source id and forwards to the head
     ref_tracker.start_report(

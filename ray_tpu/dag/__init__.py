@@ -52,11 +52,15 @@ from ray_tpu.experimental.channel import (
     channel_path,
 )
 from ray_tpu.experimental.channel import is_arraylike as _is_arraylike
+from ray_tpu.util import flight_recorder as _fr
 from ray_tpu.util.metrics import Counter as _Counter
 
 _m_executions = _Counter(
     "ray_tpu_dag_executions_total",
     "Executions submitted to compiled graphs in this process")
+
+_sp_execute = _fr.register_span("dag.execute")
+_sp_read_result = _fr.register_span("dag.read_result")
 
 
 class DAGNode:
@@ -609,6 +613,7 @@ class CompiledDAG:
         parked reads, and — for a permanent death — the rings tear down
         via the reaper. The DAG object stays; a restartable death lets
         the next execute() rebind."""
+        _fr.dump(f"executor-death:{type(err).__name__}")
         self._broken = err
         self._poison_all()
         if not restartable:
@@ -682,6 +687,7 @@ class CompiledDAG:
         before the next submission."""
         import time as _time
 
+        _t0 = _fr.now()
         with self._submit_lock:
             if self._broken is not None and not self._torn_down:
                 # deliberate: rebinding under _submit_lock blocks other
@@ -732,6 +738,7 @@ class CompiledDAG:
             seq = self._next_seq
             self._next_seq += 1
         _m_executions.inc()
+        _sp_execute.end(_t0)
         return CompiledDAGRef(self, seq)
 
     @property
@@ -788,6 +795,7 @@ class CompiledDAG:
 
         from ray_tpu.experimental.channel import TAG_TENSOR
 
+        _t0 = _fr.now()
         with self._read_lock:
             self._apply_discards_locked()
             dead = getattr(self, "_dead_seqs", None)
@@ -839,6 +847,7 @@ class CompiledDAG:
                     self._results[self._next_read] = (tag, payload)
                 self._next_read += 1
             tag, payload = self._results.pop(seq)
+        _sp_read_result.end(_t0)
         if tag == TAG_TENSOR or tag == TAG_BYTES:
             return payload  # typed array / raw bytes: no serializer
         value = serialization.deserialize(payload)

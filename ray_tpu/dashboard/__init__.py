@@ -196,10 +196,11 @@ class DashboardServer:
             h._send(200, render_prometheus(registry()).encode(),
                     "text/plain; version=0.0.4")
         elif path == "/api/timeline":
-            from ray_tpu.util.timeline import (_build_chrome_trace,
-                                               raw_events_for_head)
+            # same builder as state.timeline(): task slices + the
+            # flight-recorder span plane with merged clocks
+            from ray_tpu.util.flight_recorder import cluster_trace
 
-            h._json(_build_chrome_trace(raw_events_for_head(self.head)))
+            h._json(cluster_trace(self.head))
         elif path == "/api/cluster":
             h._json({
                 "total": self.head.scheduler.total_resources(),

@@ -77,11 +77,21 @@ STATS = {"serialized_bytes": 0, "tensor_bytes": 0, "raw_bytes": 0,
          # fall back to joining, e.g. model-conformance harness sends)
          "tensor_copy_bytes": 0}
 
+# Backpressure/stall accounting, same discipline as STATS: the wait path
+# bumps this dict (GIL-atomic enough for monotonic accumulation — the
+# rare lost fraction of a concurrent add is noise against seconds-scale
+# stalls), keyed by (channel role-name, "read"|"write"). The net ring
+# shares both dicts so one flush covers every ring transport.
+STALLS: dict = {}
+# Go-Back-N retransmissions (core/net_ring.py bumps; flushed here)
+RETRANSMITS = [0]
+
 # Registry metrics (satellite: the channel accounting must be visible to
 # the standard observability surfaces, not just a module dict). Counter
 # increments take the registry lock, so the hot path only bumps STATS;
 # deltas are flushed at most every _METRICS_INTERVAL_S per process plus
 # on channel close / explicit flush_channel_metrics().
+from ray_tpu.util import flight_recorder as _fr
 from ray_tpu.util.metrics import Counter as _Counter
 from ray_tpu.util.metrics import Gauge as _Gauge
 
@@ -95,6 +105,22 @@ _m_occupancy = _Gauge(
     "ray_tpu_dag_ring_occupancy",
     "In-flight messages in a compiled-graph ring channel",
     tag_keys=("channel",))
+_m_ring_stall = _Counter(
+    "ray_tpu_dag_ring_stall_seconds_total",
+    "Seconds ring-channel endpoints spent blocked waiting (write = "
+    "backpressure stall on a full ring, read = waiting for data)",
+    tag_keys=("channel", "role"))
+_m_retransmits = _Counter(
+    "ray_tpu_net_ring_retransmits_total",
+    "Go-Back-N retransmissions on cross-host net-ring channels")
+
+# flight-recorder span plane for the same seams (one registration site
+# per name — graftlint metrics-hygiene checks this statically)
+_sp_wait_write = _fr.register_span("ring.wait_write",
+                                   tag_keys=("channel",))
+_sp_wait_read = _fr.register_span("ring.wait_read",
+                                  tag_keys=("channel",))
+_sp_park = _fr.register_span("ring.park", tag_keys=("channel", "role"))
 
 _METRICS_INTERVAL_S = 0.25
 # hybrid-wait spin budget (checks before parking on the doorbell);
@@ -110,9 +136,14 @@ import threading as _threading
 _flush_lock = _threading.Lock()
 
 
+_flushed_stalls: dict = {}
+_flushed_retransmits = [0]
+
+
 def flush_channel_metrics() -> None:
-    """Push STATS deltas into the registry counters (tensor counter also
-    covers TAG_BYTES traffic: both bypass the serialization layer)."""
+    """Push STATS/STALLS/RETRANSMITS deltas into the registry counters
+    (tensor counter also covers TAG_BYTES traffic: both bypass the
+    serialization layer)."""
     with _flush_lock:
         d = STATS["serialized_bytes"] - _flushed["serialized_bytes"]
         if d:
@@ -124,6 +155,16 @@ def flush_channel_metrics() -> None:
             _m_tensor.inc(d)
             _flushed["tensor_bytes"] = STATS["tensor_bytes"]
             _flushed["raw_bytes"] = STATS["raw_bytes"]
+        for key, v in list(STALLS.items()):
+            d = v - _flushed_stalls.get(key, 0.0)
+            if d > 0:
+                _m_ring_stall.inc(d, tags={"channel": key[0],
+                                           "role": key[1]})
+                _flushed_stalls[key] = v
+        d = RETRANSMITS[0] - _flushed_retransmits[0]
+        if d:
+            _m_retransmits.inc(d)
+            _flushed_retransmits[0] = RETRANSMITS[0]
 
 
 def _maybe_flush(chan: "ShmChannel") -> None:
@@ -299,6 +340,22 @@ class ShmChannel:
               timeout: Optional[float]) -> None:
         if ready():
             return
+        # the wait is real: time it from here (the fast path above stays
+        # untimed) — the stall feeds the per-(channel, role) counter and
+        # a flight-recorder span, including on timeout
+        role = "write" if flag_off == _OFF_WRITER_PARKED else "read"
+        t0 = time.monotonic()
+        try:
+            self._wait_slow(ready, bell_fd, flag_off, timeout, role)
+        finally:
+            dur = time.monotonic() - t0
+            key = (self._metric_name, role)
+            STALLS[key] = STALLS.get(key, 0.0) + dur
+            (_sp_wait_write if role == "write" else _sp_wait_read) \
+                .end_at(t0, dur, self._metric_name)
+
+    def _wait_slow(self, ready, bell_fd: int, flag_off: int,
+                   timeout: Optional[float], role: str) -> None:
         # Hybrid wait: a bounded spin first — when the peer is actively
         # producing, the reply lands within microseconds and a futex-free
         # check loop beats the ~100us doorbell wakeup — yielding the core
@@ -311,6 +368,7 @@ class ShmChannel:
                 return
             if i & 7 == 7:
                 os.sched_yield()
+        _sp_park.instant(self._metric_name, role)
         deadline = None if timeout is None else time.monotonic() + timeout
         try:
             while True:

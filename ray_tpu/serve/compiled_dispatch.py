@@ -54,6 +54,10 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu.core.config import global_config
 from ray_tpu.core.exceptions import ActorDiedError, RayTpuError
 from ray_tpu.experimental.channel import ChannelTimeout
+from ray_tpu.util import flight_recorder as _fr
+
+_sp_dispatch = _fr.register_span("serve.dispatch",
+                                 tag_keys=("deployment",))
 
 logger = logging.getLogger("ray_tpu.serve")
 
@@ -486,6 +490,7 @@ class CompiledRouter:
         budget AND every window are exhausted (the shed line)."""
         if not self._enabled():
             return None
+        _t0 = _fr.now()
         lanes = self._ensure_lanes()
         payload = (method, args, kwargs, model_id, meta)
         chosen: Optional[_ReplicaLane] = None
@@ -515,6 +520,7 @@ class CompiledRouter:
                     if model_id:
                         self._model_affinity[model_id] = lane.key
                     self._take_slot()
+                    _sp_dispatch.end(_t0, self._name)
                     return CompiledServeResponse(
                         self, lane, ref, meta, self._name,
                         redispatch=redispatch)

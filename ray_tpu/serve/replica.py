@@ -16,6 +16,10 @@ import time
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.util import flight_recorder as _fr
+
+_sp_serve_batch = _fr.register_span("serve.batch_drain",
+                                    tag_keys=("deployment",))
 
 
 def _record_request(rc, deployment: str, replica_tag: str,
@@ -292,6 +296,7 @@ class ServeReplica:
         come back as BatchItemError so one bad request cannot fail its
         batch-mates."""
         recv_ts = time.time()
+        _t0 = _fr.now()
         out: List[Any] = []
         i, n = 0, len(requests)
         while i < n:
@@ -305,6 +310,7 @@ class ServeReplica:
             out.extend(self._compiled_group(method, model_id,
                                             requests[i:j], recv_ts))
             i = j
+        _sp_serve_batch.end(_t0, self._deployment)
         return out
 
     def _compiled_group(self, method_name: str, model_id: str,

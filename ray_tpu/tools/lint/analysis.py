@@ -58,6 +58,12 @@ HANDLER_PARAMS = {"op", "tag"}
 
 METRIC_CTORS = {"Counter", "Gauge", "Histogram"}
 
+# Flight-recorder span registrations (util/flight_recorder.register_span)
+# share the metrics-hygiene vocabulary: one name, one tag set, registered
+# exactly once — a span name is a trace-vocabulary entry the same way a
+# metric name is a time-series entry.
+SPAN_CTORS = {"register_span"}
+
 # OS-backed resource constructors (leaf callable name -> kind).  Every
 # acquisition must reach a matching release on all paths — the
 # resource-lifecycle check's ground truth.
@@ -191,7 +197,7 @@ class EnvRead:
 @dataclass
 class MetricReg:
     name: str
-    mtype: str             # counter | gauge | histogram
+    mtype: str             # counter | gauge | histogram | span
     tag_keys: Optional[Tuple[str, ...]]  # None when not statically known
     line: int
 
@@ -649,7 +655,7 @@ class _ModuleCollector:
         fn = call.func
         name = fn.attr if isinstance(fn, ast.Attribute) else (
             fn.id if isinstance(fn, ast.Name) else "")
-        if name not in METRIC_CTORS:
+        if name not in METRIC_CTORS and name not in SPAN_CTORS:
             return
         if not (call.args and isinstance(call.args[0], ast.Constant)
                 and isinstance(call.args[0].value, str)):
@@ -665,8 +671,9 @@ class _ModuleCollector:
                 else:
                     tag_keys = None
         self.mod.metrics.append(MetricReg(
-            name=call.args[0].value, mtype=name.lower(), tag_keys=tag_keys,
-            line=call.lineno))
+            name=call.args[0].value,
+            mtype="span" if name in SPAN_CTORS else name.lower(),
+            tag_keys=tag_keys, line=call.lineno))
 
     def _maybe_weakref(self, call: ast.Call, fi: Optional[FunctionInfo]):
         if fi is None:

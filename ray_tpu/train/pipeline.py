@@ -58,6 +58,13 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 import ray_tpu
+from ray_tpu.util import flight_recorder as _fr
+
+_sp_fwd = _fr.register_span("pipe.fwd", tag_keys=("stage", "chunk", "mb"))
+_sp_bwd = _fr.register_span("pipe.bwd", tag_keys=("stage", "chunk", "mb"))
+_sp_loss_bwd = _fr.register_span("pipe.loss_bwd",
+                                 tag_keys=("stage", "chunk", "mb"))
+_sp_step = _fr.register_span("pipe.step")
 
 __all__ = [
     "MPMDPipelineTrainer",
@@ -220,6 +227,7 @@ class _Chunk:
         self.stash_max = 0
         self.grad_sum = None
         self.nmb = 0
+        self.fwd_seq = 0  # forward-microbatch index within the step
         self.loss_sum = 0.0
         if kind == "mlp":
             self.params = [(jnp.asarray(w), jnp.asarray(b))
@@ -267,6 +275,7 @@ class _Chunk:
         loss = (self.loss_sum / self.nmb) if self.is_last else None
         self.grad_sum = None
         self.nmb = 0
+        self.fwd_seq = 0
         self.loss_sum = 0.0
         return loss
 
@@ -275,6 +284,7 @@ class _Chunk:
         self.stash_max = 0
         self.grad_sum = None
         self.nmb = 0
+        self.fwd_seq = 0
         self.loss_sum = 0.0
 
 
@@ -290,9 +300,11 @@ class PipelineStageActor:
     count lands (1F1B overlap — ``set_step_microbatches``)."""
 
     def __init__(self, kind: str, spec_meta, chunk_params: Dict[int, Any],
-                 first_cid: int, last_cid: int, lr: float):
+                 first_cid: int, last_cid: int, lr: float,
+                 stage: int = 0):
         self.kind = kind
         self.lr = lr
+        self._stage = stage  # flight-recorder span tag
         self.chunks: Dict[int, _Chunk] = {
             cid: _Chunk(kind, spec_meta, cp, cid,
                         cid == first_cid, cid == last_cid)
@@ -320,13 +332,17 @@ class PipelineStageActor:
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
+        _t = _fr.now()
         ch = self.chunks[next(iter(self.chunks)) if cid is None else cid]
+        mb = ch.fwd_seq
+        ch.fwd_seq += 1
         x = jnp.asarray(x)
         ch.stash.append(x)
         ch.stash_max = max(ch.stash_max, len(ch.stash))
         out = ch.jfwd(ch.params, x)
         out.block_until_ready()
         self._busy_s += time.perf_counter() - t0
+        _sp_fwd.end(_t, self._stage, ch.cid, mb)
         return out
 
     def fwd_first(self, inp, cid: int = None):
@@ -339,20 +355,25 @@ class PipelineStageActor:
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
+        _t = _fr.now()
         ch = self.chunks[next(iter(self.chunks)) if cid is None else cid]
+        mb = ch.nmb
         x = ch.stash.popleft()
         gparams, gx = ch.jvjp(ch.params, x, jnp.asarray(g))
         ch.accum(gparams)
         gx.block_until_ready()
         self._microbatch_done(ch)
         self._busy_s += time.perf_counter() - t0
+        _sp_bwd.end(_t, self._stage, ch.cid, mb)
         return gx
 
     def loss_bwd(self, a, inp):
         import jax.numpy as jnp
 
         t0 = time.perf_counter()
+        _t = _fr.now()
         ch = self.chunks[self._last_cid]
+        mb = ch.nmb
         a = jnp.asarray(a)
         y = jnp.asarray(inp if self.kind == "llama" else inp[1])
         loss, (gparams, ga) = ch.jloss(ch.params, a, y)
@@ -361,6 +382,7 @@ class PipelineStageActor:
         ga.block_until_ready()
         self._microbatch_done(ch)
         self._busy_s += time.perf_counter() - t0
+        _sp_loss_bwd.end(_t, self._stage, ch.cid, mb)
         return ga
 
     # ---- eager control-plane methods (between pipeline flushes) ----
@@ -504,7 +526,7 @@ class MPMDPipelineTrainer:
             own = {c: chunk_params[c] for c in range(num_chunks)
                    if chunk_actor[c] == s}
             self.stages.append(cls.remote(
-                kind, meta, own, 0, num_chunks - 1, lr))
+                kind, meta, own, 0, num_chunks - 1, lr, s))
         self._num_chunks = num_chunks
         self._chunk_actor = chunk_actor
         # constructor barrier: compile only against live actors
@@ -590,6 +612,7 @@ class MPMDPipelineTrainer:
             self._warmup(mbs[0], timeout)
         self._arm(num_microbatches)
         t0 = time.perf_counter()
+        _t = _fr.now()
         # sliding window: at most ``window`` microbatches outstanding.
         # The output ring (max_inflight >= window deep) can always
         # absorb every in-flight result — the driver never holds the
@@ -602,6 +625,7 @@ class MPMDPipelineTrainer:
         while pending:
             pending.popleft().get(timeout=timeout)
         self._pipeline_wall_s += time.perf_counter() - t0
+        _sp_step.end(_t)
         self._microbatches_run += num_microbatches
         if self.schedule == "1f1b":
             # updates already applied stage-locally during the drain;

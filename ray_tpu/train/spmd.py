@@ -53,6 +53,11 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ray_tpu.util import flight_recorder as _fr
+
+_sp_ingest = _fr.register_span("spmd.ingest_wait")
+_sp_compute = _fr.register_span("spmd.compute")
+
 __all__ = [
     "match_partition_rules",
     "make_shard_and_gather_fns",
@@ -502,10 +507,18 @@ def spmd_train_loop(config: Optional[Dict[str, Any]] = None):
     tokens_done = 0
     loss = None
     for i in range(steps):
+        _t = _fr.now()
         toks = next_tokens()
+        _sp_ingest.end(_t)
         if toks is None:
             break
+        _t = _fr.now()
         state, loss = step_fn(state, toks)
+        if _t:
+            # recorder on: close the span at data-ready, not dispatch
+            # (the loop syncs on float(loss) at report time anyway)
+            jax.block_until_ready(loss)
+        _sp_compute.end(_t)
         tokens_done += int(toks.shape[0]) * (int(toks.shape[1]) - 1)
         if (i + 1) % report_every == 0 or i == steps - 1:
             lf = float(loss)

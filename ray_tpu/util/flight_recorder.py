@@ -1,0 +1,582 @@
+"""Always-on flight recorder: per-process lock-free span rings + the
+cross-host trace merge behind ``python -m ray_tpu timeline``.
+
+Every process (driver/head, node daemon, worker) keeps ONE preallocated
+ring of fixed-size span records. The hot path is two monotonic-clock
+reads and one tuple store (~100 ns): ``itertools.count().__next__`` is
+GIL-atomic, so concurrent emitters never lock, and a slot store is a
+single list assignment — a torn read on the drain side is detected by
+the seq stamped inside the record. Instrumented seams: ring-channel
+waits (``experimental/channel.py``, ``core/net_ring.py``), compiled-DAG
+driver dispatch and executor loops (``dag/__init__.py``,
+``core/worker_runtime.py``), per-microbatch pipeline spans
+(``train/pipeline.py``), SPMD step phases (``train/spmd.py``), and the
+serve compiled lane (``serve/compiled_dispatch.py``,
+``serve/replica.py``).
+
+Cross-host merge: timestamps are process-local ``time.monotonic()``
+plus a per-process ``(anchor_mono, anchor_wall)`` pair captured at
+import, so any record converts to wall time locally; the head then
+subtracts a per-node wall-clock offset estimated over the health-prober
+pings (:class:`ClockOffsetEstimator`, min-RTT midpoint — NTP's
+classic estimator) before emitting one Chrome/Perfetto trace
+(:func:`build_span_events` / :func:`cluster_trace`).
+
+Span names are REGISTERED, not free-form: :func:`register_span` is a
+static registration site graftlint's metrics-hygiene check indexes
+(one name, one tag set, registered once), keeping tag cardinality and
+the trace vocabulary reviewable.
+
+The recorder doubles as a crash flight recorder: :func:`dump` writes
+the last N seconds of spans to ``session_dir/logs/flightrec/`` and is
+called from the chaos harness (``fault_injection.fire``) and the
+compiled-graph attributed-death path, so every ``ActorDiedError``
+comes with a timeline. Gated by the ``RAY_TPU_FLIGHT_RECORDER`` config
+knob; spans shorter than ``flight_recorder_min_span_us`` (default
+500 us) stop at the duration compare so microsecond-rate dispatch pays
+only the clock reads — the on/off overhead is bench-gated in
+BENCH_TRACE.json (``bench_core.py --trace-bench``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "ClockOffsetEstimator",
+    "attribute_trace",
+    "build_span_events",
+    "cluster_span_payloads",
+    "cluster_trace",
+    "configure",
+    "drain",
+    "dump",
+    "enabled",
+    "now",
+    "register_span",
+    "set_dump_dir",
+    "set_process_label",
+    "snapshot_payload",
+]
+
+# kinds stored in a record slot
+KIND_SPAN = 0
+KIND_INSTANT = 1
+
+# per-process wall anchors: monotonic is the recording clock (immune to
+# wall steps); the pair converts any record to wall time at export
+_ANCHOR_MONO = time.monotonic()
+_ANCHOR_WALL = time.time()
+
+_DEF_LOCK = threading.Lock()
+_DEFS: Dict[str, "Span"] = {}
+
+# the ring: preallocated slots, GIL-atomic seq allocation. _hi is a
+# store-only high-water mark (reading itertools.count would consume).
+_DEFAULT_CAPACITY = 65536
+_capacity = _DEFAULT_CAPACITY
+_mask = _capacity - 1
+_slots: List[Optional[tuple]] = [None] * _capacity
+_seq = itertools.count()
+_hi = [-1]
+_drained = [0]
+_on = [None]  # None = resolve lazily from config/env on first use
+_proc_label = [f"pid{os.getpid()}"]
+_dump_dir: List[Optional[str]] = [None]
+_dump_window_s = [10.0]
+# duration floor (seconds): sub-floor spans cost only the clock reads.
+# Stall COUNTERS (channel.STALLS) still see every wait; instants are
+# exempt (parks already imply a ms-scale spin elapsed).
+_min_dur = [500e-6]
+
+
+def _resolve_enabled() -> bool:
+    """Lazy gate: the config may not exist yet at import time (the
+    channel layer imports this module before ``init()``), so the flag
+    resolves from the global Config on first use and is cached. The
+    ``RAY_TPU_FLIGHT_RECORDER`` env override rides the Config field
+    (Config.__post_init__ applies RAY_TPU_* per field), so the snapshot
+    stays authoritative cluster-wide."""
+    if _on[0] is None:
+        try:
+            from ray_tpu.core.config import global_config
+
+            _on[0] = bool(global_config().flight_recorder)
+        except Exception:
+            _on[0] = True
+    return _on[0]
+
+
+def enabled() -> bool:
+    on = _on[0]
+    return _resolve_enabled() if on is None else on
+
+
+def configure(enabled: Optional[bool] = None,
+              capacity: Optional[int] = None,
+              dump_window_s: Optional[float] = None,
+              min_span_us: Optional[float] = None) -> None:
+    """Runtime (re)configuration — also the adoption hook when a daemon
+    or worker receives the cluster Config. Changing capacity rebuilds
+    the ring (drops unread records; callers do this at startup)."""
+    global _capacity, _mask, _slots
+    if enabled is not None:
+        _on[0] = bool(enabled)
+    if dump_window_s is not None:
+        _dump_window_s[0] = float(dump_window_s)
+    if min_span_us is not None:
+        _min_dur[0] = float(min_span_us) / 1e6
+    if capacity is not None and capacity != _capacity:
+        cap = 1
+        while cap < max(1024, int(capacity)):
+            cap <<= 1
+        with _DEF_LOCK:
+            _capacity = cap
+            _mask = cap - 1
+            _slots = [None] * cap
+            _drained[0] = max(0, _hi[0] + 1)
+
+
+def adopt_config(cfg) -> None:
+    """Apply the relevant knobs of a (possibly remote) Config."""
+    try:
+        configure(enabled=bool(cfg.flight_recorder),
+                  capacity=int(cfg.flight_recorder_events),
+                  dump_window_s=float(cfg.flight_recorder_dump_window_s),
+                  min_span_us=float(cfg.flight_recorder_min_span_us))
+    except Exception:
+        pass
+
+
+def set_process_label(label: str) -> None:
+    _proc_label[0] = str(label)
+
+
+def set_dump_dir(session_dir: Optional[str]) -> None:
+    """Arm crash dumps: faults write to <session_dir>/logs/flightrec/."""
+    if session_dir:
+        _dump_dir[0] = os.path.join(session_dir, "logs", "flightrec")
+
+
+# bound once: skips the module-attribute lookup on every hot-path call
+_mono = time.monotonic
+
+
+def now(_mono=_mono) -> float:
+    """Span start stamp; 0.0 when the recorder is off so a disabled
+    begin/end pair costs one flag test per side."""
+    on = _on[0]
+    if on is None:
+        on = _resolve_enabled()
+    return _mono() if on else 0.0
+
+
+def _record(sid: int, kind: int, t0: float, dur: float,
+            tags: tuple) -> None:
+    i = next(_seq)
+    _slots[i & _mask] = (i, sid, kind, t0, dur, tags)
+    _hi[0] = i
+
+
+class Span:
+    """One registered span name. ``end(t0, *tags)`` records a duration
+    span closed now; ``end_at`` takes a caller-measured duration (the
+    ring-wait paths time their stall anyway for the stall counters);
+    ``instant`` records a point event."""
+
+    __slots__ = ("name", "tag_keys", "sid")
+
+    def __init__(self, name: str, tag_keys: Tuple[str, ...], sid: int):
+        self.name = name
+        self.tag_keys = tag_keys
+        self.sid = sid
+
+    def end(self, t0: float, *tags, _mono=_mono) -> None:
+        # _record() inlined and the clock bound as a default: this and
+        # end_at are THE hot path against the <=3% bench-gated budget.
+        # Sub-floor spans stop at the duration compare: at microsecond
+        # dispatch rates the clock reads are all the recorder may cost.
+        if t0 and _on[0]:
+            dur = _mono() - t0
+            if dur >= _min_dur[0]:
+                i = next(_seq)
+                _slots[i & _mask] = (i, self.sid, KIND_SPAN, t0, dur,
+                                     tags)
+                _hi[0] = i
+
+    def end_at(self, t0: float, dur: float, *tags) -> None:
+        on = _on[0]
+        if on is None:
+            on = _resolve_enabled()
+        if on and dur >= _min_dur[0]:
+            i = next(_seq)
+            _slots[i & _mask] = (i, self.sid, KIND_SPAN, t0, dur, tags)
+            _hi[0] = i
+
+    def instant(self, *tags) -> None:
+        on = _on[0]
+        if on is None:
+            on = _resolve_enabled()
+        if on:
+            _record(self.sid, KIND_INSTANT, time.monotonic(), 0.0, tags)
+
+
+def _sid_for(name: str) -> int:
+    """Stable span id derived from the NAME, identical in every
+    process. Registration order must not matter: actor classes can be
+    cloudpickled by value, shipping the defining module's Span objects
+    inside method globals — an order-based sid minted in the driver
+    would collide with a different name in the executing worker's
+    table. crc32 of the name is order-free; :func:`register_span`
+    rejects the (vanishingly unlikely) cross-name collision."""
+    return zlib.crc32(name.encode())
+
+
+def register_span(name: str, tag_keys: Tuple[str, ...] = ()) -> Span:
+    """Register one span name with its (fixed) tag key set. Idempotent
+    for an identical re-registration (module reload); a conflicting tag
+    set raises — one name, one tag set, registered once (enforced
+    statically by graftlint metrics-hygiene as well)."""
+    tag_keys = tuple(tag_keys)
+    with _DEF_LOCK:
+        have = _DEFS.get(name)
+        if have is not None:
+            if have.tag_keys != tag_keys:
+                raise ValueError(
+                    f"span {name!r} already registered with tag_keys="
+                    f"{have.tag_keys!r} (got {tag_keys!r})")
+            return have
+        sid = _sid_for(name)
+        for sp in _DEFS.values():
+            if sp.sid == sid:
+                raise ValueError(
+                    f"span id collision: {name!r} vs {sp.name!r}")
+        sp = Span(name, tag_keys, sid)
+        _DEFS[name] = sp
+        return sp
+
+
+# --------------------------------------------------------------------------- #
+# Drain / snapshot / payloads
+# --------------------------------------------------------------------------- #
+
+
+def _collect(lo: int, hi: int) -> List[tuple]:
+    out = []
+    for i in range(max(lo, hi - _mask), hi + 1):
+        rec = _slots[i & _mask]
+        if rec is not None and rec[0] == i:  # torn/overwritten guard
+            out.append(rec)
+    return out
+
+
+def _names_table() -> Dict[int, dict]:
+    with _DEF_LOCK:
+        return {sp.sid: {"name": sp.name, "tag_keys": list(sp.tag_keys)}
+                for sp in _DEFS.values()}
+
+
+def _payload(events: List[tuple]) -> dict:
+    return {
+        "pid": os.getpid(),
+        "proc": _proc_label[0],
+        "anchor_mono": _ANCHOR_MONO,
+        "anchor_wall": _ANCHOR_WALL,
+        "names": _names_table(),
+        "events": [list(r) for r in events],
+    }
+
+
+def drain() -> Optional[dict]:
+    """Consume records since the last drain (the worker/daemon report
+    path). None when nothing new."""
+    hi = _hi[0]
+    if hi < _drained[0]:
+        return None
+    events = _collect(_drained[0], hi)
+    _drained[0] = hi + 1
+    if not events:
+        return None
+    return _payload(events)
+
+
+def snapshot_payload(window_s: Optional[float] = None) -> dict:
+    """Non-consuming view of everything still in the ring (the export
+    path for the local process); optionally clipped to the last
+    ``window_s`` seconds."""
+    events = _collect(0, _hi[0])
+    if window_s is not None:
+        cutoff = time.monotonic() - window_s
+        events = [r for r in events if r[3] + r[4] >= cutoff]
+    return _payload(events)
+
+
+def reset_for_tests() -> None:
+    global _seq
+    _seq = itertools.count()
+    _hi[0] = -1
+    _drained[0] = 0
+    for i in range(len(_slots)):
+        _slots[i] = None
+
+
+# --------------------------------------------------------------------------- #
+# Crash flight recorder
+# --------------------------------------------------------------------------- #
+
+
+def dump(reason: str, window_s: Optional[float] = None) -> Optional[str]:
+    """Write the last N seconds of local spans to
+    ``<session_dir>/logs/flightrec/`` (armed via :func:`set_dump_dir`).
+    Best-effort by contract: the callers are death paths."""
+    d = _dump_dir[0]
+    if d is None or not enabled():
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        payload = snapshot_payload(window_s or _dump_window_s[0])
+        payload["reason"] = reason
+        payload["wall_ts"] = time.time()
+        path = os.path.join(
+            d, f"{_proc_label[0].replace(':', '_').replace('/', '_')}"
+               f"-{os.getpid()}-{int(time.time() * 1000)}.json")
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+    except Exception:
+        return None
+
+
+# --------------------------------------------------------------------------- #
+# Clock-offset estimation (head side, over the health-prober pings)
+# --------------------------------------------------------------------------- #
+
+
+class ClockOffsetEstimator:
+    """Min-RTT wall-clock offset of one remote node against this
+    process. Each ping round contributes ``offset = remote_wall -
+    (send_wall + recv_wall) / 2`` with its RTT; the estimate is the
+    offset of the minimum-RTT sample in a sliding window (asymmetric
+    queueing inflates RTT, so the tightest round is the most trusted —
+    its error is bounded by rtt/2). Re-estimated continuously: a
+    stepped/drifting remote clock ages out with the window."""
+
+    def __init__(self, window: int = 64):
+        self._samples: deque = deque(maxlen=max(2, int(window)))
+
+    def add(self, offset_s: float, rtt_s: float) -> None:
+        self._samples.append((float(offset_s), max(0.0, float(rtt_s))))
+
+    def add_ping(self, send_wall: float, recv_wall: float,
+                 remote_wall: float) -> None:
+        self.add(remote_wall - (send_wall + recv_wall) / 2.0,
+                 recv_wall - send_wall)
+
+    def offset(self) -> float:
+        if not self._samples:
+            return 0.0
+        return min(self._samples, key=lambda s: s[1])[0]
+
+    def rtt(self) -> Optional[float]:
+        if not self._samples:
+            return None
+        return min(s[1] for s in self._samples)
+
+    def error_bound(self) -> Optional[float]:
+        """Half the best RTT: the classic bound on the midpoint
+        estimator's error under asymmetric path delay."""
+        r = self.rtt()
+        return None if r is None else r / 2.0
+
+
+# --------------------------------------------------------------------------- #
+# Trace export: payloads -> Chrome/Perfetto events -> attribution
+# --------------------------------------------------------------------------- #
+
+
+def build_span_events(payloads: List[dict]) -> List[Dict[str, Any]]:
+    """Chrome-trace events from collected span payloads. Each payload
+    carries its process anchors plus ``source`` / ``node_hex`` /
+    ``offset_s`` stamped by the collector; the per-node offset merges
+    every clock onto the head's wall timeline. Tracks: one pid per
+    node, one tid per (process, span-or-channel)."""
+    events: List[Dict[str, Any]] = []
+    for p in payloads:
+        names = {int(k): v for k, v in (p.get("names") or {}).items()}
+        base = (p.get("anchor_wall", 0.0) - p.get("anchor_mono", 0.0)
+                - p.get("offset_s", 0.0))
+        pid = f"node:{(p.get('node_hex') or 'head')[:6]}"
+        proc = p.get("proc") or f"pid{p.get('pid', '?')}"
+        for rec in p.get("events") or ():
+            seq, sid, kind, t0, dur, tags = rec
+            d = names.get(sid)
+            if d is None:
+                continue
+            name = d["name"]
+            args = dict(zip(d.get("tag_keys") or (), tags or ()))
+            # channels get their own track (per-channel lanes make
+            # backpressure visible); everything else tracks per span
+            # name within the process
+            chan = args.get("channel")
+            tid = (f"{proc} {name} {chan}" if chan
+                   else f"{proc} {name}")
+            ev = {"cat": "span", "name": name,
+                  "ts": (t0 + base) * 1e6,
+                  "pid": pid, "tid": tid,
+                  "args": dict(args, source=p.get("source", proc))}
+            if kind == KIND_INSTANT:
+                ev.update({"ph": "i", "s": "t"})
+            else:
+                ev.update({"ph": "X", "dur": max(0.0, dur * 1e6)})
+            events.append(ev)
+    return events
+
+
+def cluster_span_payloads(head) -> List[dict]:
+    """Head-side collection: the local (driver/head) snapshot plus every
+    buffered worker/daemon payload, each stamped with its node's
+    estimated clock offset (0 for head-host sources — CLOCK_MONOTONIC
+    differs per process but the wall anchors already line same-host
+    processes up)."""
+    head_hex = getattr(getattr(head, "head_node", None), "hex", None)
+    offsets: Dict[str, float] = {}
+    for proxy in list(getattr(head, "nodes", {}).values()):
+        est = getattr(proxy, "clock_est", None)
+        hx = getattr(proxy, "hex", None)
+        if est is not None and hx:
+            offsets[hx] = est.offset()
+    out: List[dict] = []
+    local = snapshot_payload()
+    local.update({"source": f"head:{_proc_label[0]}",
+                  "node_hex": head_hex, "offset_s": 0.0})
+    out.append(local)
+    for source, chunks in list(getattr(head, "flight_spans",
+                                       {}).items()):
+        for p in list(chunks):
+            hx = p.get("node_hex")
+            q = dict(p)
+            q["source"] = source
+            q["offset_s"] = offsets.get(hx, 0.0) \
+                if hx and hx != head_hex else 0.0
+            out.append(q)
+    return out
+
+
+def cluster_trace(head, include_tasks: bool = True) -> List[Dict[str, Any]]:
+    """ONE merged Chrome-trace event list for the whole cluster: task
+    slices via the same ``util.timeline`` builder ``state.timeline()``
+    uses (single source of truth for task events) plus the span plane."""
+    from ray_tpu.util.timeline import _build_chrome_trace, raw_events_for_head
+
+    events: List[Dict[str, Any]] = []
+    if include_tasks:
+        try:
+            events.extend(_build_chrome_trace(raw_events_for_head(head)))
+        except Exception:
+            pass
+    events.extend(build_span_events(cluster_span_payloads(head)))
+    return events
+
+
+# span-name groups the attribution folds over
+_PIPE_BUSY = ("pipe.fwd", "pipe.bwd", "pipe.loss_bwd")
+_RING_WAIT = ("ring.wait_read", "ring.wait_write")
+
+
+def attribute_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a merged trace into a per-step budget: where did the step
+    time go. Pipeline busy/bubble mirrors ``pipeline_stats()`` exactly
+    — busy is the sum of fwd/bwd/loss_bwd span durations inside the
+    stepped window, wall is the ``pipe.step`` driver spans, stages are
+    the distinct ``stage`` tags — so the reported bubble_fraction is
+    the *explained* version of the measured one."""
+    by_name: Dict[str, List[dict]] = {}
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("cat") == "span":
+            by_name.setdefault(ev["name"], []).append(ev)
+
+    def total_s(names) -> float:
+        return sum(ev.get("dur", 0.0) for n in names
+                   for ev in by_name.get(n, ())) / 1e6
+
+    steps = by_name.get("pipe.step", [])
+    wall_s = sum(ev.get("dur", 0.0) for ev in steps) / 1e6
+    # clip stage busy to the stepped window: warmup/compile microbatches
+    # run before the first pipe.step begins and are not in the stats
+    t_lo = min((ev["ts"] for ev in steps), default=None)
+    busy_s = 0.0
+    per_stage: Dict[str, float] = {}
+    for n in _PIPE_BUSY:
+        for ev in by_name.get(n, ()):
+            if t_lo is not None and ev["ts"] < t_lo - 1e3:
+                continue
+            d = ev.get("dur", 0.0) / 1e6
+            busy_s += d
+            stage = str((ev.get("args") or {}).get("stage", "?"))
+            per_stage[stage] = per_stage.get(stage, 0.0) + d
+    k = len([s for s in per_stage if s != "?"]) or len(per_stage) or 1
+    eff = busy_s / (k * wall_s) if wall_s > 0 else 0.0
+
+    ring_stall_s = total_s(_RING_WAIT)
+    ingest_s = total_s(("spmd.ingest_wait",))
+    spmd_compute_s = total_s(("spmd.compute",))
+    exec_s = total_s(("dag.exec",))
+    serve_s = total_s(("serve.batch_drain",))
+    denom = wall_s or (spmd_compute_s + ingest_s) or None
+    report: Dict[str, Any] = {
+        "step_wall_s": round(wall_s, 6),
+        "steps": len(steps),
+        "num_stages": k if per_stage else 0,
+        "pipeline_busy_s": round(busy_s, 6),
+        "per_stage_busy_s": {s: round(v, 6)
+                             for s, v in sorted(per_stage.items())},
+        "pipeline_efficiency": round(eff, 4) if per_stage else None,
+        "bubble_fraction": round(1.0 - eff, 4) if per_stage else None,
+        "ring_stall_s": round(ring_stall_s, 6),
+        "ingest_wait_s": round(ingest_s, 6),
+        "spmd_compute_s": round(spmd_compute_s, 6),
+        "dag_exec_s": round(exec_s, 6),
+        "serve_batch_s": round(serve_s, 6),
+    }
+    if denom:
+        report["compute_pct"] = round(100.0 * eff, 2) if per_stage else \
+            round(100.0 * spmd_compute_s / denom, 2)
+        report["ring_stall_pct"] = round(
+            100.0 * ring_stall_s / (k * denom), 2)
+        report["ingest_pct"] = round(100.0 * ingest_s / denom, 2)
+    return report
+
+
+def format_attribution(report: Dict[str, Any]) -> str:
+    """Human-readable ``timeline --attribute`` rendering."""
+    lines = ["where did my step time go", "-" * 26]
+    if report.get("steps"):
+        lines.append(f"steps observed     : {report['steps']} "
+                     f"({report['step_wall_s']:.4f}s wall)")
+    if report.get("bubble_fraction") is not None:
+        lines.append(f"pipeline stages    : {report['num_stages']}")
+        lines.append(f"pipeline busy      : {report['pipeline_busy_s']:.4f}s"
+                     f"  (efficiency {report['pipeline_efficiency']:.2%})")
+        lines.append(f"bubble fraction    : {report['bubble_fraction']:.4f}")
+        for s, v in report.get("per_stage_busy_s", {}).items():
+            lines.append(f"  stage {s:<12}: {v:.4f}s busy")
+    for key, label in (("compute_pct", "compute %"),
+                       ("ring_stall_pct", "ring-stall %"),
+                       ("ingest_pct", "ingest %")):
+        if report.get(key) is not None:
+            lines.append(f"{label:<19}: {report[key]:.2f}%")
+    lines.append(f"ring stall         : {report['ring_stall_s']:.4f}s")
+    if report.get("ingest_wait_s"):
+        lines.append(f"ingest wait        : {report['ingest_wait_s']:.4f}s")
+    if report.get("dag_exec_s"):
+        lines.append(f"dag executor busy  : {report['dag_exec_s']:.4f}s")
+    if report.get("serve_batch_s"):
+        lines.append(f"serve batch drain  : {report['serve_batch_s']:.4f}s")
+    return "\n".join(lines)

@@ -30,14 +30,24 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     if rt is None:
         raise RuntimeError("ray_tpu.init() has not been called")
     if hasattr(rt, "head"):
-        raw = raw_events_for_head(rt.head)
+        # ONE export path with ``python -m ray_tpu timeline --perfetto``
+        # and GET /api/timeline: cluster_trace builds the task slices
+        # through _build_chrome_trace below plus the flight-recorder
+        # span plane (merged clocks) — identical slices everywhere
+        from ray_tpu.util import flight_recorder
+
+        events = flight_recorder.cluster_trace(rt.head)
     else:  # worker / client driver: the "task_events" state kind returns
         # the FULL event log (RUNNING + terminal pairs), so durations here
-        # match the head path exactly
+        # match the head path exactly; local spans ride along (offset 0)
+        from ray_tpu.util import flight_recorder
         from ray_tpu.util.state import _state_query
 
         raw = _state_query("task_events", 100000)
-    events = _build_chrome_trace(raw)
+        events = _build_chrome_trace(raw)
+        local = flight_recorder.snapshot_payload()
+        local.update({"source": "local", "offset_s": 0.0})
+        events.extend(flight_recorder.build_span_events([local]))
     if filename:
         with open(filename, "w") as f:
             json.dump(events, f)

@@ -1,0 +1,25 @@
+"""Planted bug for ``metrics-hygiene``'s flight-recorder half: a span
+name registered twice with different tag sets (the cross-process sid is
+derived from the NAME, so both sites would write records claiming the
+same vocabulary entry with incompatible tags), and another span name
+double-registered outright (the registry raises at runtime only if both
+sites actually execute in one process — the lint catches the split
+across modules/processes statically).
+
+Never imported or executed; parsed by tests/test_static_analysis.py.
+"""
+
+
+def register_span(name, tag_keys=()):  # noqa: N802 (AST stub)
+    pass
+
+
+sp1 = register_span("fixture.pipe_fwd", tag_keys=("stage", "chunk"))
+# BUG: same span name, different tag set
+sp2 = register_span("fixture.pipe_fwd", tag_keys=("stage",))
+
+sp3 = register_span("fixture.ring_wait", tag_keys=("channel",))
+# BUG: same span name registered a second time (share the instance)
+sp4 = register_span("fixture.ring_wait", tag_keys=("channel",))
+
+ok = register_span("fixture.step")

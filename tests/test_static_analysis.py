@@ -180,6 +180,17 @@ def test_metrics_hygiene_flags_conflicts():
     assert not any("fixture_healthy_total" in d for d in details)
 
 
+def test_metrics_hygiene_covers_flight_recorder_spans():
+    """register_span sites share the metrics vocabulary rules: one
+    name, one tag set, registered exactly once."""
+    report = lint_fixture("flightrec")
+    found = by_check(report, "metrics-hygiene")
+    details = {f.detail for f in found}
+    assert "tag-conflict:fixture.pipe_fwd" in details
+    assert "duplicate:fixture.ring_wait" in details
+    assert not any("fixture.step" in d for d in details)
+
+
 def test_suppressions_inline_and_line_above():
     report = lint_fixture("suppress")
     found = by_check(report, "blocking-under-lock")
