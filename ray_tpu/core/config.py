@@ -290,6 +290,12 @@ class Config:
     # (per-shard device_put double-buffering: host→device transfer of
     # batch N+1 overlaps compute on batch N)
     train_ingest_prefetch: int = 2
+    # fsdp param gather schedule for the shard_map step: "streamed"
+    # gathers each scanned layer inside the scan, prefetching layer i+1
+    # while layer i computes (ZeRO-3 prefetch; O(tree/L) peak param
+    # residency); "upfront" bulk-gathers the whole tree first. Folds to
+    # upfront on meshes without an fsdp axis.
+    train_gather: str = "streamed"
 
     def __post_init__(self):
         for f in fields(self):

@@ -313,25 +313,29 @@ def forward(cfg: LlamaConfig, params, tokens, mesh=None):
     return (x.astype(cfg.dtype) @ _head(cfg, params).astype(cfg.dtype))
 
 
-def loss_fn(cfg: LlamaConfig, params, tokens, mesh=None):
-    """Next-token cross-entropy; fp32 log-softmax. tokens [B, T+1].
-
-    The lm_head matmul + log-softmax run CHUNKED over the sequence under
-    ``jax.checkpoint``: fp32 logits exist only per-chunk ([B, C, vocab]
-    instead of [B, T, vocab] — the round-1 OOM at batch 32), recomputed in
-    the backward pass. Costs one extra head matmul per chunk; frees GBs.
-    """
-    inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    x = _backbone(cfg, params, inputs, mesh)
-    head = _head(cfg, params)
-    B, T, d = x.shape
-    C = cfg.loss_chunk
+def _plain_chunk_nll(cfg: LlamaConfig, head):
+    """Per-chunk next-token NLL against a full-width head [d, vocab]:
+    fp32 log-softmax over the whole vocab."""
 
     def chunk_nll(x_c, t_c):
         logits = (x_c.astype(cfg.dtype)
                   @ head.astype(cfg.dtype)).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         return -jnp.take_along_axis(logp, t_c[..., None], axis=-1)[..., 0]
+
+    return chunk_nll
+
+
+def chunked_nll_mean(cfg: LlamaConfig, x, targets, chunk_nll):
+    """Mean NLL with the lm_head matmul + softmax CHUNKED over the
+    sequence under ``jax.checkpoint``: fp32 logits exist only per-chunk
+    ([B, C, vocab] instead of [B, T, vocab] — the round-1 OOM at batch
+    32), recomputed in the backward pass. Costs one extra head matmul
+    per chunk; frees GBs. ``chunk_nll(x_c, t_c) -> [B, C]`` supplies
+    the head — full-width (:func:`_plain_chunk_nll`) or vocab-parallel
+    (:func:`vp_chunk_nll`)."""
+    B, T, d = x.shape
+    C = cfg.loss_chunk
 
     if not C or T <= C:
         return chunk_nll(x, targets).mean()
@@ -349,6 +353,15 @@ def loss_fn(cfg: LlamaConfig, params, tokens, mesh=None):
     if rem:
         total = total + chunk_nll(x[:, n * C:], targets[:, n * C:]).sum()
     return total / (B * T)
+
+
+def loss_fn(cfg: LlamaConfig, params, tokens, mesh=None):
+    """Next-token cross-entropy; fp32 log-softmax. tokens [B, T+1].
+    See :func:`chunked_nll_mean` for the chunked-head memory story."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    x = _backbone(cfg, params, inputs, mesh)
+    return chunked_nll_mean(cfg, x, targets,
+                            _plain_chunk_nll(cfg, _head(cfg, params)))
 
 
 # --------------------------------------------------------------------------- #
@@ -424,39 +437,125 @@ def make_train_step(cfg: LlamaConfig, mesh, optimizer=None, rules=None):
 
 
 # --------------------------------------------------------------------------- #
+# Tensor-parallel collectives (manual/Megatron style)
+# --------------------------------------------------------------------------- #
+
+
+def tp_psum_pair(axis):
+    """Megatron 'f'/'g' collective pair for EXACT grads when
+    ``value_and_grad`` runs INSIDE a shard_map body with replication
+    checking off: check-off autodiff transposes a raw ``psum`` back to a
+    ``psum``, which re-sums the already-replicated cotangent axis-size
+    times (factor-T grad inflation on every upstream leaf). The pair
+    writes the correct per-device backward explicitly — ``f`` (identity
+    fwd / psum bwd) enters a column-parallel region, ``g`` (psum fwd /
+    identity bwd) leaves a row-parallel one. The pipeline step
+    differentiates OUTSIDE shard_map and keeps the raw psum."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None),
+             lambda _, ct: (jax.lax.psum(ct, axis),))
+
+    @jax.custom_vjp
+    def g(x):
+        return jax.lax.psum(x, axis)
+
+    g.defvjp(lambda x: (jax.lax.psum(x, axis), None),
+             lambda _, ct: (ct,))
+
+    return f, g
+
+
+def vp_embed(cfg: LlamaConfig, emb_local, tokens, axis, gp):
+    """Vocab-parallel embedding lookup on a local shard [V/t, dim]:
+    masked local take + psum over ``axis`` assembles each token's row
+    from whichever device owns its id. ``gp`` is the psum-fwd /
+    identity-bwd half of :func:`tp_psum_pair`, so the backward
+    scatter-adds straight into the local rows."""
+    vloc = emb_local.shape[0]
+    off = jax.lax.axis_index(axis) * vloc
+    local = tokens - off
+    ok = (local >= 0) & (local < vloc)
+    rows = emb_local.astype(cfg.dtype)[jnp.clip(local, 0, vloc - 1)]
+    return gp(jnp.where(ok[..., None], rows, 0))
+
+
+def vp_chunk_nll(cfg: LlamaConfig, head_local, axis, gp):
+    """Per-chunk NLL against a vocab-sharded head [d, V/t] (Megatron
+    vocab-parallel cross-entropy): replicated logsumexp from
+    pmax-of-local-max plus psum of the local sum-exp; the target logit
+    by masked local take + psum. ``stop_gradient`` sits on the pmax
+    OPERAND because pmax has no transpose rule — the shift is the usual
+    gradient-free logsumexp stabilizer anyway."""
+    vloc = head_local.shape[-1]
+
+    def chunk_nll(x_c, t_c):
+        logits = (x_c.astype(cfg.dtype)
+                  @ head_local.astype(cfg.dtype)).astype(jnp.float32)
+        m = jax.lax.pmax(jax.lax.stop_gradient(jnp.max(logits, -1)), axis)
+        lse = jnp.log(gp(jnp.sum(jnp.exp(logits - m[..., None]), -1))) + m
+        off = jax.lax.axis_index(axis) * vloc
+        local = t_c - off
+        ok = (local >= 0) & (local < vloc)
+        tlogit = gp(jnp.where(
+            ok,
+            jnp.take_along_axis(logits,
+                                jnp.clip(local, 0, vloc - 1)[..., None],
+                                axis=-1)[..., 0],
+            0.0))
+        return lse - tlogit
+
+    return chunk_nll
+
+
+# --------------------------------------------------------------------------- #
 # Pipeline-parallel train step (pipe [+ tensor/data] mesh axes)
 # --------------------------------------------------------------------------- #
 
 
-def _pp_layer(cfg: LlamaConfig, x, p, positions, tensor_axis=None):
-    """One decoder layer on *local* shards inside the pipeline shard_map.
+def _pp_layer(cfg: LlamaConfig, x, p, positions, tensor_axis=None,
+              collectives=None):
+    """One decoder layer on *local* shards inside a manual shard_map.
 
     Head/mlp counts come from the shard shapes (Megatron-style manual TP:
     q/k/v/gate/up column-parallel — no comm; wo/down row-parallel — psum
-    over ``tensor_axis``). Norm weights are full-width (replicated)."""
+    over ``tensor_axis``). Norm weights are full-width (replicated).
+    ``collectives``: optional ``(f, g)`` pair from :func:`tp_psum_pair`,
+    required when the caller differentiates INSIDE the shard_map body
+    (train/spmd.py); the pipeline path differentiates outside shard_map
+    and leaves it None for the raw psum."""
     from ray_tpu.ops.flash_attention import flash_attention
 
+    fi, gp = collectives if collectives is not None else (None, None)
+    col_in = fi if fi is not None else (lambda h: h)
+    if not tensor_axis:
+        row_out = lambda y: y
+    elif gp is not None:
+        row_out = gp
+    else:
+        row_out = lambda y: jax.lax.psum(y, tensor_axis)
     cd = cfg.dtype
     B, T, d = x.shape
     hd = cfg.head_dim
     nq = p["wq"].shape[-1] // hd
     nkv = p["wk"].shape[-1] // hd
-    h = rms_norm(x, p["attn_norm"], cfg.norm_eps).astype(cd)
+    h = col_in(rms_norm(x, p["attn_norm"], cfg.norm_eps).astype(cd))
     q = (h @ p["wq"].astype(cd)).reshape(B, T, nq, hd)
     kk = (h @ p["wk"].astype(cd)).reshape(B, T, nkv, hd)
     vv = (h @ p["wv"].astype(cd)).reshape(B, T, nkv, hd)
     q, kk = rotary_embedding(q, kk, positions, cfg.rope_theta)
     attn = flash_attention(q, kk, vv, causal=True)
     o = attn.reshape(B, T, nq * hd) @ p["wo"].astype(cd)
-    if tensor_axis:
-        o = jax.lax.psum(o, tensor_axis)
+    o = row_out(o)
     x = x + o.astype(x.dtype)
-    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps).astype(cd)
+    h = col_in(rms_norm(x, p["mlp_norm"], cfg.norm_eps).astype(cd))
     g = jax.nn.silu(h @ p["w_gate"].astype(cd))
     u = h @ p["w_up"].astype(cd)
     y = (g * u) @ p["w_down"].astype(cd)
-    if tensor_axis:
-        y = jax.lax.psum(y, tensor_axis)
+    y = row_out(y)
     return x + y.astype(x.dtype)
 
 

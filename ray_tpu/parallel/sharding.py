@@ -172,3 +172,62 @@ def shard_device_put(x, sharding):
               for d, idx in index_map.items()]
     return jax.make_array_from_single_device_arrays(
         x.shape, sharding, shards)
+
+
+def param_residency_bytes(params, specs, mesh, mode: str = "upfront",
+                          scan_key: str = "layers", window: int = 2):
+    """Analytic peak per-device LIVE param bytes inside the shard_map
+    train step (train/spmd.py) — the resident shards plus the
+    fsdp-gathered working copies the gather schedule keeps alive.
+
+    ``"upfront"`` gathers the whole tree before the first layer, so
+    every leaf's fsdp-full copy is simultaneously live. ``"streamed"``
+    keeps the scanned stack (the top-level ``scan_key`` subtree, leaves
+    shaped [L, ...]) sharded and holds at most ``window`` fsdp-full
+    layers (current + prefetched next); non-scanned leaves still gather
+    up front. Tensor-sharded dims stay sharded under both schedules.
+    ``params`` may be an ``eval_shape`` tree. Returns
+    ``{"mode", "shard_bytes", "gathered_bytes", "peak_bytes"}`` —
+    analytic, so it gates identically on CPU and TPU.
+    """
+    import jax
+    import numpy as np
+    from jax.tree_util import tree_flatten_with_path
+
+    def nbytes(leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dt = np.dtype(getattr(leaf, "dtype", np.float32))
+        return int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+
+    def div(spec, only=None):
+        d = 1
+        for ax in spec:
+            for a in (ax if isinstance(ax, tuple) else (ax,)):
+                if a is None or a not in mesh.axis_names:
+                    continue
+                if only is None or a in only:
+                    d *= mesh.shape[a]
+        return d
+
+    def isspec(x):
+        return isinstance(x, jax.sharding.PartitionSpec)
+
+    spec_by_path = {path: s for path, s in
+                    tree_flatten_with_path(specs, is_leaf=isspec)[0]}
+    shard_bytes = 0
+    gathered_bytes = 0
+    for path, leaf in tree_flatten_with_path(params)[0]:
+        spec = spec_by_path[path]
+        b = nbytes(leaf)
+        shard_bytes += b // div(spec)
+        # fsdp-gathered working copy: only tensor dims stay sharded
+        g = b // div(spec, only=("tensor",))
+        key0 = str(getattr(path[0], "key", getattr(path[0], "idx", path[0])))
+        if mode == "streamed" and key0 == scan_key:
+            L = max(1, int(getattr(leaf, "shape", (1,))[0]))
+            gathered_bytes += min(window, L) * (g // L)
+        else:
+            gathered_bytes += g
+    return {"mode": mode, "shard_bytes": int(shard_bytes),
+            "gathered_bytes": int(gathered_bytes),
+            "peak_bytes": int(shard_bytes + gathered_bytes)}

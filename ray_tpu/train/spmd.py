@@ -15,15 +15,22 @@ collective from output shardings, the parallelism is written down —
   fully-replicated array for checkpointing. Round-tripping a tree
   through shard→gather is byte-identical per leaf (tested).
 - **The shard_map train step** (``make_spmd_train_step``) runs the
-  per-device program explicitly: each device all-gathers the param
-  shards it needs (``fsdp`` axis), computes loss/grad on its batch
-  shard with plain single-device model code (``mesh=None`` — no nested
-  GSPMD), and the cross-replica gradient reduction rides the
-  ``collective`` package's in-program psum/pmean (which go through the
-  ``util.jax_compat`` shims, so the step runs on both shard_map
-  spellings). fsdp-sharded leaves reduce-scatter their grads back to
-  shards (ZeRO-3: optimizer state stays sharded); replicated leaves
-  psum. The jit step donates the carried state, so XLA aliases every
+  per-device program explicitly. Two gather schedules for the
+  fsdp-sharded scanned layers: ``"upfront"`` all-gathers the whole
+  param tree before the first layer; ``"streamed"`` (default) keeps the
+  layer stack sharded and gathers each layer INSIDE the ``lax.scan`` —
+  layer *i+1*'s all-gather is issued before layer *i*'s matmuls so XLA
+  overlaps the collective with compute (the ZeRO-3 prefetch analog),
+  and the backward re-gathers per layer and ``psum_scatter``s the layer
+  grad straight back to shards, so full-tree param residency never
+  materializes. A live ``tensor`` axis is handled Megatron-style:
+  heads/mlp/vocab dims stay sharded through compute with the exact-grad
+  ``tp_psum_pair`` collectives at block boundaries plus vocab-parallel
+  embedding/cross-entropy, numerically matched against the GSPMD step.
+  Cross-replica gradient reduction rides the ``collective`` package's
+  in-program psum/pmean; fsdp-sharded leaves hold scatter shards
+  (ZeRO-3: optimizer state stays sharded); replicated leaves psum. The
+  jit step donates the carried state, so XLA aliases every
   param/optimizer buffer to its output and updates in place instead of
   writing a second copy of the training state per step.
 - **Sharded ingest** (``data/iterator.py to_jax`` +
@@ -38,7 +45,8 @@ defaults to pure data-parallel over all local devices; with one device
 every collective folds to the identity.
 
 Supported mesh axes here: the batch axes (``slice``/``data``) plus
-``fsdp`` (param + optimizer-state sharding). Tensor/sequence/pipeline
+``fsdp`` (param + optimizer-state sharding) plus ``tensor``
+(head/mlp/vocab sharding through compute). Sequence/pipeline
 parallelism stay on the GSPMD/pipeline paths (``make_train_step`` /
 ``make_pipeline_train_step``), which this step matches numerically
 (same-seed loss parity is tested — both draw init through
@@ -47,6 +55,7 @@ parallelism stay on the GSPMD/pipeline paths (``make_train_step`` /
 
 from __future__ import annotations
 
+import difflib
 import re
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -57,12 +66,18 @@ from ray_tpu.util import flight_recorder as _fr
 
 _sp_ingest = _fr.register_span("spmd.ingest_wait")
 _sp_compute = _fr.register_span("spmd.compute")
+# one-shot probe timings of the step's collective seams (see
+# make_collective_probes) — you cannot time an op inside the fused jit
+_sp_gather = _fr.register_span("spmd.gather")
+_sp_scatter = _fr.register_span("spmd.scatter")
 
 __all__ = [
     "match_partition_rules",
     "make_shard_and_gather_fns",
     "llama_partition_rules",
+    "spmd_param_specs",
     "make_spmd_train_step",
+    "make_collective_probes",
     "spmd_train_loop",
     "tree_paths",
 ]
@@ -103,7 +118,13 @@ def match_partition_rules(rules, params, sep: str = "/"):
         for rule, spec in rules:
             if re.search(rule, name) is not None:
                 return spec
-        raise ValueError(f"no partition rule matches param {name!r}")
+        patterns = [r for r, _ in rules]
+        near = difflib.get_close_matches(name, patterns, n=3, cutoff=0.0)
+        raise ValueError(
+            f"no partition rule matches param path {name!r} "
+            f"(shape {shape}); nearest rule patterns: "
+            + ", ".join(repr(p) for p in near)
+            + " — add a (regex, PartitionSpec) entry for it")
 
     names = tree_paths(params, sep)
     return jax.tree.map(spec_for, names, params)
@@ -203,30 +224,70 @@ def make_shard_and_gather_fns(partition_specs, mesh, dtype_specs=None):
 
 
 # --------------------------------------------------------------------------- #
-# shard_map train step (manual DP + fsdp ZeRO-3)
+# shard_map train step (manual DP + fsdp ZeRO-3 + tensor)
 # --------------------------------------------------------------------------- #
 
 
+def _is_spec(x):
+    import jax
+
+    return isinstance(x, jax.sharding.PartitionSpec)
+
+
+def spmd_param_specs(cfg, mesh, rules=None):
+    """(abstract param tree, PartitionSpec tree) for ``cfg`` on ``mesh``
+    — the rule table matched and restricted to the mesh's live axes.
+    Shared by the train step, the collective probes, and bench's
+    analytic residency accounting."""
+    import jax
+
+    from ray_tpu.models.llama import init_params
+
+    sample = jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+    specs = jax.tree.map(
+        lambda s: _restrict_spec(s, mesh),
+        match_partition_rules(rules or llama_partition_rules(), sample),
+        is_leaf=_is_spec)
+    return sample, specs
+
+
 def make_spmd_train_step(cfg, mesh, optimizer=None, rules=None,
-                         donate: bool = True):
+                         donate: bool = True, gather: str = "streamed"):
     """Build (init, step, data_sharding, state_shardings) with the SPMD
     program written out in shard_map, matching ``make_train_step``'s
-    contract and numerics.
+    contract and numerics (rtol 3e-3 vs the GSPMD step, tested).
 
-    Per device: all-gather fsdp param shards → single-device
-    loss/grad (``loss_fn(..., mesh=None)``) on the local batch shard →
-    grad reduction via ``collective.pmean_tree`` (psum through the
-    jax_compat shims) with fsdp leaves reduce-scattered back to shards
-    → optax update on the shards (ZeRO-3).
+    ``gather`` picks the fsdp schedule for the scanned layer stack:
 
-    A caller-supplied ``optimizer`` runs INSIDE shard_map on the fsdp
-    shards, so per-leaf elementwise transforms (adam/adamw moments,
-    per-leaf clipping, weight decay) are exact, but transforms that
-    mix leaves or need a GLOBAL statistic — ``clip_by_global_norm``,
+    - ``"upfront"``: all-gather every fsdp leaf before the first layer
+      (full-tree residency, one bulk collective).
+    - ``"streamed"`` (default): non-scanned leaves (embed/head) gather
+      up front; each LAYER's shards gather inside the ``lax.scan``,
+      with layer *i+1*'s all-gather issued before layer *i*'s matmuls
+      (prefetch-in-carry) so XLA overlaps the collective with compute —
+      the ZeRO-3 prefetch analog. The backward is a ``custom_vjp``
+      whose residuals are the input activation + the SHARDS: it
+      re-gathers the layer and recomputes its vjp (inherent per-layer
+      remat), then ``psum_scatter``s the layer grad straight back to
+      shards. At most two fsdp-full layers (current + prefetched) are
+      ever live, so peak param residency stays O(tree/L), not O(tree).
+      Folds to ``"upfront"`` when the mesh has no live fsdp axis.
+
+    A live ``tensor`` axis shards heads/mlp/vocab THROUGH compute
+    (Megatron manual TP via ``_pp_layer`` + ``tp_psum_pair`` — exact
+    grads under value_and_grad inside shard_map), with vocab-parallel
+    embedding and cross-entropy; tensor-sharded dims are never
+    gathered. ``seq``/``pipe``/``expert`` still route to the GSPMD /
+    pipeline steps.
+
+    A caller-supplied ``optimizer`` runs INSIDE shard_map on the
+    fsdp/tensor shards, so per-leaf elementwise transforms (adam/adamw
+    moments, per-leaf clipping, weight decay) are exact, but transforms
+    that mix leaves or need a GLOBAL statistic — ``clip_by_global_norm``,
     lamb's trust ratio — would compute it over each device's shard
     only and silently diverge from the GSPMD step. Use
     ``make_train_step`` for those, or reduce the statistic explicitly
-    (psum over the fsdp axis) in a custom transform.
+    (psum over the fsdp/tensor axes) in a custom transform.
 
     ``donate=True`` donates the carried state (params + optimizer
     moments + step), so XLA aliases every param/moment input buffer to
@@ -238,15 +299,25 @@ def make_spmd_train_step(cfg, mesh, optimizer=None, rules=None,
     the data path instead (fresh per-shard ``device_put`` buffers,
     double-buffered — see ``DataIterator.to_jax``). Callers that
     re-feed one token buffer every step (benches) work unchanged.
-    Toggle via the ``RAY_TPU_TRAIN_DONATE`` Config knob when comparing
-    (``spmd_train_loop`` threads it through)."""
+    Toggle via the ``RAY_TPU_TRAIN_DONATE`` Config knob when comparing;
+    pick the gather schedule via ``RAY_TPU_TRAIN_GATHER``
+    (``spmd_train_loop`` threads both through)."""
     import jax
     import jax.numpy as jnp
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ray_tpu.collective import pmean_tree
-    from ray_tpu.models.llama import init_params, loss_fn
+    from ray_tpu.models.llama import (
+        _plain_chunk_nll,
+        _pp_layer,
+        chunked_nll_mean,
+        init_params,
+        tp_psum_pair,
+        vp_chunk_nll,
+        vp_embed,
+    )
+    from ray_tpu.ops.layers import rms_norm
     from ray_tpu.parallel.sharding import opt_state_shardings
     from ray_tpu.util.jax_compat import (
         axis_size,
@@ -254,12 +325,27 @@ def make_spmd_train_step(cfg, mesh, optimizer=None, rules=None,
         shard_map,
     )
 
-    for ax in ("tensor", "seq", "pipe", "expert"):
+    for ax in ("seq", "pipe", "expert"):
         if ax in mesh.axis_names and mesh.shape[ax] > 1:
             raise ValueError(
-                f"make_spmd_train_step shards over batch axes + fsdp only; "
-                f"mesh has live {ax!r} axis — use make_train_step (GSPMD) "
-                f"or make_pipeline_train_step for that layout")
+                f"make_spmd_train_step shards over batch axes + fsdp + "
+                f"tensor; mesh has live {ax!r} axis — use make_train_step "
+                f"(GSPMD) or make_pipeline_train_step for that layout")
+    if gather not in ("streamed", "upfront"):
+        raise ValueError(
+            f"gather must be 'streamed' or 'upfront', got {gather!r}")
+
+    tensor = ("tensor" if "tensor" in mesh.axis_names
+              and mesh.shape["tensor"] > 1 else None)
+    if tensor is not None:
+        t = mesh.shape["tensor"]
+        for what, n in (("n_heads", cfg.n_heads),
+                        ("n_kv_heads", cfg.n_kv_heads),
+                        ("mlp_dim", cfg.mlp_dim),
+                        ("vocab_size", cfg.vocab_size)):
+            if n % t:
+                raise ValueError(
+                    f"tensor axis size {t} does not divide cfg.{what}={n}")
 
     ensure_sharding_invariant_rng()
     optimizer = optimizer or optax.adamw(3e-4, b1=0.9, b2=0.95,
@@ -269,21 +355,16 @@ def make_spmd_train_step(cfg, mesh, optimizer=None, rules=None,
 
     batch_axes = data_axes(mesh)  # the canonical ("slice","data","fsdp")
     fsdp = "fsdp" if "fsdp" in mesh.axis_names else None
+    # no fsdp axis → nothing to stream; fold so the scan stays simple
+    gather_mode = gather if fsdp is not None else "upfront"
     dp_axes = tuple(a for a in batch_axes if a != "fsdp")
     repl = NamedSharding(mesh, P())
     data_sharding = batch_sharding(mesh)
     data_spec = data_sharding.spec
 
-    sample_params = jax.eval_shape(
-        lambda: init_params(cfg, jax.random.PRNGKey(0)))
-    param_specs = jax.tree.map(
-        lambda s: _restrict_spec(s, mesh),
-        match_partition_rules(rules or llama_partition_rules(),
-                              sample_params),
-        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+    sample_params, param_specs = spmd_param_specs(cfg, mesh, rules)
     param_shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), param_specs,
-        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec))
+        lambda s: NamedSharding(mesh, s), param_specs, is_leaf=_is_spec)
 
     def init_state(key):
         params = init_params(cfg, key)
@@ -302,39 +383,144 @@ def make_spmd_train_step(cfg, mesh, optimizer=None, rules=None,
     state_specs = jax.tree.map(lambda s: s.spec, state_shardings,
                                is_leaf=lambda x: isinstance(x, NamedSharding))
 
+    def spec_axes(ax):
+        return ax if isinstance(ax, tuple) else (ax,)
+
     def gather_leaf(p, spec):
-        """Local shard → full leaf (the fsdp all-gather)."""
+        """Local shard → fsdp-full leaf. Tensor-sharded dims stay local
+        — they go THROUGH compute sharded."""
         for dim, ax in enumerate(spec):
-            if ax is not None:
-                p = jax.lax.all_gather(p, ax, axis=dim, tiled=True)
+            for a in spec_axes(ax):
+                if a is not None and a != tensor:
+                    p = jax.lax.all_gather(p, a, axis=dim, tiled=True)
         return p
 
+    def scatter_leaf(g, spec):
+        """fsdp-full grad → reduce-scattered shard (all_gather's
+        transpose, written out for the streamed backward)."""
+        for dim, ax in enumerate(spec):
+            if fsdp in spec_axes(ax):
+                return jax.lax.psum_scatter(g, fsdp, scatter_dimension=dim,
+                                            tiled=True)
+        return g
+
     def reduce_leaf(g, spec):
-        """Full local grad → globally-reduced shard: mean over every
-        batch axis; fsdp leaves keep only their scatter shard (the
-        all-gather's transpose)."""
+        """Locally-reduced grad shard → global mean. psum over the pure
+        data axes always; over fsdp only for leaves WITHOUT an fsdp dim
+        (gathered leaves already got their fsdp sum+scatter from the
+        all-gather's autodiff transpose / the streamed scatter). No
+        tensor reduction: tensor-sharded leaves carry exact per-shard
+        grads and tensor-replicated leaves identical ones (the
+        tp_psum_pair contract)."""
         for ax in dp_axes:
             g = jax.lax.psum(g, ax)
-        if fsdp is not None:
-            dims = [d for d, ax in enumerate(spec)
-                    if ax is not None and (ax == fsdp or fsdp in (
-                        ax if isinstance(ax, tuple) else (ax,)))]
-            if dims:
-                g = jax.lax.psum_scatter(g, fsdp, scatter_dimension=dims[0],
-                                         tiled=True)
-            else:
-                g = jax.lax.psum(g, fsdp)
+        if fsdp is not None and not any(
+                fsdp in spec_axes(ax) for ax in spec):
+            g = jax.lax.psum(g, fsdp)
         denom = 1
         for ax in batch_axes:
             denom = denom * axis_size(ax)
         return g / denom
 
+    # ---- per-layer machinery -------------------------------------------- #
+    lspecs = param_specs["layers"]
+    # one layer (scan dim sliced off) -> spec dims shift left by one
+    lspecs1 = jax.tree.map(lambda sp: P(*sp[1:]), lspecs, is_leaf=_is_spec)
+    collectives = tp_psum_pair(tensor) if tensor is not None else None
+    fi, gp = collectives if collectives is not None else (None, None)
+
+    def layer_fn(x, lp):
+        B, T, _ = x.shape
+        positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+        return _pp_layer(cfg, x, lp, positions, tensor_axis=tensor,
+                         collectives=collectives)
+
+    def gather_layer(shards):
+        return jax.tree.map(gather_leaf, shards, lspecs1)
+
+    def _make_streamed_apply():
+        """One layer with ZeRO-3 residency: forward consumes the
+        PREFETCHED fsdp-full layer from the scan carry but saves only
+        (activation, shards) as residuals — the carried full layer gets
+        a zero cotangent, so no gathered layer ever becomes a scan
+        residual. The backward re-gathers the layer from its shards,
+        recomputes the layer vjp (inherent per-layer remat), and
+        reduce-scatters the layer grad back to shards."""
+
+        def apply_fn(x, cur_full, shards):
+            return layer_fn(x, cur_full)
+
+        def fwd(x, cur_full, shards):
+            return layer_fn(x, cur_full), (x, shards)
+
+        def bwd(res, ct):
+            x, shards = res
+            cur = gather_layer(shards)
+            _, vjp = jax.vjp(layer_fn, x, cur)
+            dx, dfull = vjp(ct)
+            dshards = jax.tree.map(scatter_leaf, dfull, lspecs1)
+            return dx, jax.tree.map(jnp.zeros_like, cur), dshards
+
+        ap = jax.custom_vjp(apply_fn)
+        ap.defvjp(fwd, bwd)
+        return ap
+
+    streamed_apply = _make_streamed_apply()
+
+    def run_layers(x, layer_shards):
+        if gather_mode == "streamed":
+            first = gather_layer(
+                jax.tree.map(lambda a: a[0], layer_shards))
+            # xs pairs each layer's shards with the NEXT layer's (rolled
+            # by -1); the wrap-around gather of layer 0 at the last step
+            # feeds a dead carry and DCEs away
+            xs = (layer_shards,
+                  jax.tree.map(lambda a: jnp.roll(a, -1, axis=0),
+                               layer_shards))
+
+            def body(carry, xs_i):
+                h, cur = carry
+                cur_sh, nxt_sh = xs_i
+                # issue layer i+1's gather FIRST: XLA schedules the
+                # collective to overlap layer i's matmuls
+                nxt = gather_layer(nxt_sh)
+                h = streamed_apply(h, cur, cur_sh)
+                return (h, nxt), None
+
+            (x, _), _ = jax.lax.scan(body, (x, first), xs)
+            return x
+        full = jax.tree.map(gather_leaf, layer_shards, lspecs)
+        body = jax.checkpoint(layer_fn) if cfg.remat else layer_fn
+        x, _ = jax.lax.scan(lambda c, lp: (body(c, lp), None), x, full)
+        return x
+
+    def local_loss(shards, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        emb_local = gather_leaf(shards["embedding"],
+                                param_specs["embedding"])
+        if tensor is not None:
+            x = vp_embed(cfg, emb_local, inputs, tensor, gp)
+        else:
+            x = emb_local.astype(cfg.dtype)[inputs]
+        x = run_layers(x, shards["layers"])
+        x = rms_norm(x, shards["final_norm"], cfg.norm_eps)
+        if cfg.tie_embeddings:
+            head_local = emb_local.T
+        else:
+            head_local = gather_leaf(shards["lm_head"],
+                                     param_specs["lm_head"])
+        if tensor is not None:
+            return chunked_nll_mean(
+                cfg, fi(x), targets,
+                vp_chunk_nll(cfg, head_local, tensor, gp))
+        return chunked_nll_mean(cfg, x, targets,
+                                _plain_chunk_nll(cfg, head_local))
+
     def sm_step(state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: local_loss(p, tokens))(state["params"])
         # params-major maps: the array tree's structure governs, so the
         # PartitionSpec leaves (tuple subclasses) are passed whole
-        full_params = jax.tree.map(gather_leaf, state["params"], param_specs)
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(cfg, p, tokens, mesh=None))(full_params)
         grads = jax.tree.map(reduce_leaf, grads, param_specs)
         loss = pmean_tree(loss, batch_axes)
         updates, new_opt = optimizer.update(grads, state["opt_state"],
@@ -356,6 +542,75 @@ def make_spmd_train_step(cfg, mesh, optimizer=None, rules=None,
         donate_argnums=(0,) if donate else (),
     )
     return init_jit, train_step, data_sharding, state_shardings
+
+
+def make_collective_probes(cfg, mesh, rules=None):
+    """Jitted probe programs that price the step's collective seams
+    OUTSIDE the fused step (an op inside a jit cannot be timed):
+    ``gather_probe(params)`` all-gathers every fsdp-sharded leaf — the
+    upfront schedule's full-tree gather — and ``scatter_probe(params)``
+    reduce-scatters a same-shaped full tree — the backward's
+    psum_scatter. Each returns a scalar that depends on every
+    collective's output so nothing constant-folds or DCEs away.
+    ``spmd_train_loop`` times them once per run into the
+    ``spmd.gather``/``spmd.scatter`` spans; ``timeline --attribute``
+    then shows whether the schedule hides that cost inside
+    ``spmd.compute`` (streamed) or pays it serially (upfront)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.util.jax_compat import shard_map
+
+    _, specs = spmd_param_specs(cfg, mesh, rules)
+    fsdp = "fsdp" if "fsdp" in mesh.axis_names else None
+
+    def fsdp_dim(spec):
+        for dim, ax in enumerate(spec):
+            if fsdp is not None and fsdp in (
+                    ax if isinstance(ax, tuple) else (ax,)):
+                return dim
+        return None
+
+    def gather_body(shards):
+        acc = [jnp.zeros((), jnp.float32)]
+
+        def one(leaf, spec):
+            d = fsdp_dim(spec)
+            if d is not None:
+                full = jax.lax.all_gather(leaf, fsdp, axis=d, tiled=True)
+                acc.append(full.reshape(-1)[0].astype(jnp.float32))
+            return leaf
+
+        jax.tree.map(one, shards, specs)
+        return sum(acc)
+
+    def scatter_body(shards):
+        acc = [jnp.zeros((), jnp.float32)]
+
+        def one(leaf, spec):
+            d = fsdp_dim(spec)
+            if d is not None:
+                shape = list(leaf.shape)
+                shape[d] = shape[d] * mesh.shape[fsdp]
+                # seed from the input so the full buffer can't fold to
+                # a constant before the collective
+                seed = leaf.reshape(-1)[0]
+                full = jnp.ones(shape, leaf.dtype) * seed
+                sh = jax.lax.psum_scatter(full, fsdp, scatter_dimension=d,
+                                          tiled=True)
+                acc.append(sh.reshape(-1)[0].astype(jnp.float32))
+            return leaf
+
+        jax.tree.map(one, shards, specs)
+        return sum(acc)
+
+    def build(body):
+        return jax.jit(shard_map(body, mesh=mesh, in_specs=(specs,),
+                                 out_specs=P(), check=False))
+
+    return build(gather_body), build(scatter_body)
+
 
 
 # --------------------------------------------------------------------------- #
@@ -419,6 +674,26 @@ def _synthetic_token_batches(vocab_size: int, batch: int, seq: int,
         i += 1
 
 
+def _prefetched_synthetic(host, data_sharding, depth: int):
+    """Synthetic-batch fallback with the SAME prefetch discipline as
+    ``to_jax`` (the ``train_ingest_prefetch`` knob): keep ``depth``
+    placed batches in flight ahead of the consumer so H2D transfer
+    overlaps compute, instead of the old hardcoded 1-deep buffer."""
+    from collections import deque
+
+    from ray_tpu.parallel.sharding import shard_device_put
+
+    depth = max(1, int(depth))
+    pending = deque(shard_device_put(next(host), data_sharding)
+                    for _ in range(depth))
+
+    def next_tokens():
+        pending.append(shard_device_put(next(host), data_sharding))
+        return pending.popleft()
+
+    return next_tokens
+
+
 def spmd_train_loop(config: Optional[Dict[str, Any]] = None):
     """Default ``train_loop_per_worker`` for :class:`JaxTrainer` —
     sharded llama training that runs the SAME config at devices=1 and
@@ -428,7 +703,8 @@ def spmd_train_loop(config: Optional[Dict[str, Any]] = None):
     default "debug") or ``llama_config`` (a LlamaConfig), ``steps``,
     ``batch_per_device``, ``seq``, ``seed``, ``lr``, ``mesh`` (axis
     spec, else the ``RAY_TPU_TRAIN_MESH`` Config knob), ``donate``
-    (else ``RAY_TPU_TRAIN_DONATE``), ``report_every``. With a
+    (else ``RAY_TPU_TRAIN_DONATE``), ``gather`` (else
+    ``RAY_TPU_TRAIN_GATHER``), ``report_every``. With a
     ``datasets={"train": ds}`` trainer dataset, batches come from the
     shard's ``to_jax`` (sharded, double-buffered ingest) reading the
     ``tokens`` column; otherwise a synthetic token stream feeds the
@@ -439,7 +715,6 @@ def spmd_train_loop(config: Optional[Dict[str, Any]] = None):
 
     from ray_tpu.core.config import global_config
     from ray_tpu.models.llama import LlamaConfig
-    from ray_tpu.parallel.sharding import shard_device_put
     from ray_tpu.train import session
 
     config = dict(config or {})
@@ -462,6 +737,7 @@ def spmd_train_loop(config: Optional[Dict[str, Any]] = None):
             "SPMD over jax.distributed gangs is not wired up yet "
             "(see ROADMAP: SPMD training)")
     donate = bool(config.get("donate", knobs.train_donate))
+    gather = str(config.get("gather", knobs.train_gather))
     batch = int(config.get("batch_per_device", 2)) * mesh.size
 
     optimizer = None
@@ -469,8 +745,23 @@ def spmd_train_loop(config: Optional[Dict[str, Any]] = None):
         optimizer = optax.adamw(float(config["lr"]), b1=0.9, b2=0.95,
                                 weight_decay=0.1)
     init, step_fn, data_sharding, _ = make_spmd_train_step(
-        cfg, mesh, optimizer=optimizer, donate=donate)
+        cfg, mesh, optimizer=optimizer, donate=donate, gather=gather)
     state = init(jax.random.PRNGKey(seed))
+
+    if _fr.enabled() and "fsdp" in mesh.axis_names:
+        # price the collective seams once per run (outside the fused
+        # step) so `timeline --attribute` can compare spmd.gather /
+        # spmd.scatter against spmd.compute; pure read of the params —
+        # the loop's state and step count are untouched
+        gather_probe, scatter_probe = make_collective_probes(cfg, mesh)
+        jax.block_until_ready(gather_probe(state["params"]))   # compile
+        _t = _fr.now()
+        jax.block_until_ready(gather_probe(state["params"]))
+        _sp_gather.end(_t)
+        jax.block_until_ready(scatter_probe(state["params"]))  # compile
+        _t = _fr.now()
+        jax.block_until_ready(scatter_probe(state["params"]))
+        _sp_scatter.end(_t)
 
     try:
         shard = session.get_dataset_shard("train")
@@ -493,15 +784,8 @@ def spmd_train_loop(config: Optional[Dict[str, Any]] = None):
         host = _synthetic_token_batches(
             cfg.vocab_size, batch, seq, seed,
             distinct=int(config.get("distinct_batches", 8)))
-        pending = shard_device_put(next(host), data_sharding)
-
-        def next_tokens():
-            # same double-buffer discipline as to_jax: place N+1 before
-            # handing N to the step, so H2D overlaps compute
-            nonlocal pending
-            out = pending
-            pending = shard_device_put(next(host), data_sharding)
-            return out
+        next_tokens = _prefetched_synthetic(
+            host, data_sharding, knobs.train_ingest_prefetch)
 
     t0 = time.perf_counter()
     tokens_done = 0

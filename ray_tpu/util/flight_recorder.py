@@ -527,6 +527,8 @@ def attribute_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     ring_stall_s = total_s(_RING_WAIT)
     ingest_s = total_s(("spmd.ingest_wait",))
     spmd_compute_s = total_s(("spmd.compute",))
+    spmd_gather_s = total_s(("spmd.gather",))
+    spmd_scatter_s = total_s(("spmd.scatter",))
     exec_s = total_s(("dag.exec",))
     serve_s = total_s(("serve.batch_drain",))
     denom = wall_s or (spmd_compute_s + ingest_s) or None
@@ -542,9 +544,23 @@ def attribute_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "ring_stall_s": round(ring_stall_s, 6),
         "ingest_wait_s": round(ingest_s, 6),
         "spmd_compute_s": round(spmd_compute_s, 6),
+        "spmd_gather_s": round(spmd_gather_s, 6),
+        "spmd_scatter_s": round(spmd_scatter_s, 6),
         "dag_exec_s": round(exec_s, 6),
         "serve_batch_s": round(serve_s, 6),
     }
+    # spmd.gather/spmd.scatter are ONE-SHOT probe timings of the full
+    # param-tree collectives (train/spmd.py make_collective_probes),
+    # not per-step accumulations: compare them against ONE mean compute
+    # span. A streamed schedule keeps that cost overlapped inside
+    # spmd.compute instead of extending it.
+    n_spmd = len(by_name.get("spmd.compute", ()))
+    if n_spmd and (spmd_gather_s or spmd_scatter_s) and spmd_compute_s:
+        report["spmd_steps"] = n_spmd
+        report["spmd_collective_probe_s"] = round(
+            spmd_gather_s + spmd_scatter_s, 6)
+        report["spmd_collective_vs_step"] = round(
+            (spmd_gather_s + spmd_scatter_s) / (spmd_compute_s / n_spmd), 4)
     if denom:
         report["compute_pct"] = round(100.0 * eff, 2) if per_stage else \
             round(100.0 * spmd_compute_s / denom, 2)
@@ -575,6 +591,14 @@ def format_attribution(report: Dict[str, Any]) -> str:
     lines.append(f"ring stall         : {report['ring_stall_s']:.4f}s")
     if report.get("ingest_wait_s"):
         lines.append(f"ingest wait        : {report['ingest_wait_s']:.4f}s")
+    if report.get("spmd_gather_s"):
+        lines.append(f"param gather probe : {report['spmd_gather_s']:.4f}s")
+    if report.get("spmd_scatter_s"):
+        lines.append(f"grad scatter probe : {report['spmd_scatter_s']:.4f}s")
+    if report.get("spmd_collective_vs_step") is not None:
+        lines.append(
+            f"collectives/step   : {report['spmd_collective_vs_step']:.2f}x "
+            f"one compute span (probe cost; streamed hides it in compute)")
     if report.get("dag_exec_s"):
         lines.append(f"dag executor busy  : {report['dag_exec_s']:.4f}s")
     if report.get("serve_batch_s"):

@@ -66,6 +66,22 @@ def test_match_partition_rules_unmatched_leaf_raises():
     assert out["s"] == P()
 
 
+def test_match_partition_rules_error_names_path_and_candidates():
+    """The unmatched-leaf error carries the '/'-joined path AND the
+    nearest rule patterns — the first thing a new model hits."""
+    from jax.sharding import PartitionSpec as P
+
+    tree = {"blocks": {"wq_new": np.zeros((4, 4), np.float32)}}
+    rules = ((r"layers/w(q|k|v)$", P(None, "fsdp", "tensor")),
+             (r"norm$", P()))
+    with pytest.raises(ValueError) as e:
+        match_partition_rules(rules, tree)
+    msg = str(e.value)
+    assert "blocks/wq_new" in msg          # the full path, not a leaf name
+    assert "layers/w(q|k|v)$" in msg       # nearest-rule candidate
+    assert "add a (regex, PartitionSpec)" in msg
+
+
 def test_parse_mesh_spec_and_build():
     assert parse_mesh_spec("data=4,fsdp=2") == {"data": 4, "fsdp": 2}
     assert parse_mesh_spec("") == {}
@@ -135,6 +151,38 @@ def test_first_step_loss_invariant_across_mesh_layouts(cfg, tokens):
     np.testing.assert_allclose(losses[0], losses[1], rtol=2e-3)
 
 
+def test_same_seed_init_invariant_across_tensor_layouts(cfg):
+    """Tensor-mesh mirror of the 1xN/Nx1 invariance: the same seed
+    yields bitwise-equal params on data×tensor vs fsdp×tensor."""
+    import jax
+
+    leaves = {}
+    for name, mc in [("dxt", MeshConfig(data=4, tensor=2)),
+                     ("fxt", MeshConfig(fsdp=4, tensor=2))]:
+        mesh = make_mesh(mc)
+        init, _, _, _ = make_spmd_train_step(cfg, mesh, donate=False)
+        leaves[name] = [np.asarray(x) for x in jax.tree.leaves(
+            jax.device_get(init(jax.random.PRNGKey(7))["params"]))]
+    for a, b in zip(leaves["dxt"], leaves["fxt"]):
+        assert a.tobytes() == b.tobytes()
+
+
+def test_first_step_loss_invariant_across_tensor_layouts(cfg, tokens):
+    """Same seed + same batch → same first-step loss on data×tensor vs
+    fsdp×tensor (the two layouts run different collective programs:
+    pure-DP replicas vs fsdp gathers, same math)."""
+    import jax
+
+    losses = []
+    for mc in [MeshConfig(data=4, tensor=2), MeshConfig(fsdp=4, tensor=2)]:
+        mesh = make_mesh(mc)
+        init, step, ds, _ = make_spmd_train_step(cfg, mesh, donate=False)
+        state = init(jax.random.PRNGKey(7))
+        _, loss = step(state, jax.device_put(tokens, ds))
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses[0], losses[1], rtol=2e-3)
+
+
 # --------------------------------------------------------------------------- #
 # shard_map step: GSPMD parity, donation
 # --------------------------------------------------------------------------- #
@@ -163,6 +211,59 @@ def test_spmd_step_matches_gspmd(cfg, tokens):
             [float(s1), float(s2)], [float(g1), float(g2)], rtol=3e-3)
 
 
+def test_spmd_step_matches_gspmd_both_gather_schedules(cfg, tokens):
+    """Streamed per-layer gathers are the SAME math as the upfront bulk
+    gather: both schedules reproduce the GSPMD two-step trajectory on a
+    data×fsdp mesh (rtol 3e-3, the PR-14 contract)."""
+    import jax
+
+    m1 = make_mesh(MeshConfig(data=1, fsdp=1), devices=jax.devices()[:1])
+    ginit, gstep, gds, _ = make_train_step(cfg, m1)
+    gstate = ginit(jax.random.PRNGKey(0))
+    gtoks = jax.device_put(tokens, gds)
+    gstate, g1 = gstep(gstate, gtoks)
+    _, g2 = gstep(gstate, gtoks)
+
+    mesh = make_mesh(MeshConfig(data=2, fsdp=4))
+    for gather in ("upfront", "streamed"):
+        sinit, sstep, sds, _ = make_spmd_train_step(
+            cfg, mesh, donate=False, gather=gather)
+        sstate = sinit(jax.random.PRNGKey(0))
+        stoks = jax.device_put(tokens, sds)
+        sstate, s1 = sstep(sstate, stoks)
+        _, s2 = sstep(sstate, stoks)
+        np.testing.assert_allclose(
+            [float(s1), float(s2)], [float(g1), float(g2)], rtol=3e-3,
+            err_msg=f"gather={gather}")
+
+
+def test_spmd_step_matches_gspmd_tensor_mesh(cfg, tokens):
+    """Tensor-axis parity (the old ValueError pointer, removed): the
+    manual Megatron program — vocab-parallel embed/xent, tp_psum_pair
+    block collectives, sharded heads/mlp — reproduces the GSPMD
+    trajectory on an fsdp×tensor mesh under BOTH gather schedules."""
+    import jax
+
+    m1 = make_mesh(MeshConfig(data=1, fsdp=1), devices=jax.devices()[:1])
+    ginit, gstep, gds, _ = make_train_step(cfg, m1)
+    gstate = ginit(jax.random.PRNGKey(0))
+    gtoks = jax.device_put(tokens, gds)
+    gstate, g1 = gstep(gstate, gtoks)
+    _, g2 = gstep(gstate, gtoks)
+
+    mesh = make_mesh(MeshConfig(fsdp=4, tensor=2))
+    for gather in ("upfront", "streamed"):
+        sinit, sstep, sds, _ = make_spmd_train_step(
+            cfg, mesh, donate=False, gather=gather)
+        sstate = sinit(jax.random.PRNGKey(0))
+        stoks = jax.device_put(tokens, sds)
+        sstate, s1 = sstep(sstate, stoks)
+        _, s2 = sstep(sstate, stoks)
+        np.testing.assert_allclose(
+            [float(s1), float(s2)], [float(g1), float(g2)], rtol=3e-3,
+            err_msg=f"gather={gather}")
+
+
 def test_spmd_step_learns_and_donates(cfg, tokens):
     """Donated state: the input buffers die with the step (in-place
     update), and the loss goes down over a few steps."""
@@ -182,10 +283,43 @@ def test_spmd_step_learns_and_donates(cfg, tokens):
     assert float(loss) < first, f"no learning: {first} -> {float(loss)}"
 
 
-def test_spmd_step_rejects_tensor_mesh(cfg):
-    mesh = make_mesh(MeshConfig(data=4, tensor=2))
+def test_spmd_step_rejects_seq_mesh_and_bad_gather(cfg):
+    mesh = make_mesh(MeshConfig(data=4, seq=2))
     with pytest.raises(ValueError, match="GSPMD"):
         make_spmd_train_step(cfg, mesh)
+    mesh = make_mesh(MeshConfig(data=8))
+    with pytest.raises(ValueError, match="streamed"):
+        make_spmd_train_step(cfg, mesh, gather="eager")
+
+
+def test_spmd_step_rejects_indivisible_tensor_axis(cfg):
+    """A tensor axis that does not divide heads/mlp/vocab fails fast
+    with a named-config error, not a shard-shape crash."""
+    import dataclasses
+
+    bad = dataclasses.replace(cfg, n_kv_heads=3, n_heads=3)
+    mesh = make_mesh(MeshConfig(fsdp=4, tensor=2))
+    with pytest.raises(ValueError, match="does not divide"):
+        make_spmd_train_step(bad, mesh)
+
+
+def test_param_residency_bytes_streamed_below_upfront(cfg):
+    """The analytic residency model (the bench gate): streamed holds
+    only a 2-layer gather window, so its peak is strictly below upfront
+    whenever n_layers > 2; both exceed the bare shard bytes."""
+    import dataclasses
+
+    from ray_tpu.parallel.sharding import param_residency_bytes
+    from ray_tpu.train.spmd import spmd_param_specs
+
+    deep = dataclasses.replace(cfg, n_layers=6)
+    mesh = make_mesh(MeshConfig(fsdp=4, tensor=2))
+    sample, specs = spmd_param_specs(deep, mesh)
+    up = param_residency_bytes(sample, specs, mesh, mode="upfront")
+    st = param_residency_bytes(sample, specs, mesh, mode="streamed")
+    assert st["shard_bytes"] == up["shard_bytes"]
+    assert st["peak_bytes"] < up["peak_bytes"]
+    assert up["peak_bytes"] > up["shard_bytes"]
 
 
 # --------------------------------------------------------------------------- #
@@ -241,29 +375,61 @@ def test_to_jax_sharded_ingest(tokens):
 
 
 def test_train_knobs_are_config_fields():
-    """RAY_TPU_TRAIN_MESH / _DONATE / _INGEST_PREFETCH resolve through
-    the Config registry (graftlint config-hygiene contract: no direct
-    env reads on the train path)."""
+    """RAY_TPU_TRAIN_MESH / _DONATE / _INGEST_PREFETCH / _GATHER resolve
+    through the Config registry (graftlint config-hygiene contract: no
+    direct env reads on the train path)."""
     from ray_tpu.core.config import Config
 
     cfg = Config()
     assert cfg.train_mesh == ""
     assert cfg.train_donate is True
     assert cfg.train_ingest_prefetch == 2
+    assert cfg.train_gather == "streamed"
     import os
 
     os.environ["RAY_TPU_TRAIN_MESH"] = "data=2"
     os.environ["RAY_TPU_TRAIN_DONATE"] = "0"
     os.environ["RAY_TPU_TRAIN_INGEST_PREFETCH"] = "5"
+    os.environ["RAY_TPU_TRAIN_GATHER"] = "upfront"
     try:
         cfg2 = Config()
         assert cfg2.train_mesh == "data=2"
         assert cfg2.train_donate is False
         assert cfg2.train_ingest_prefetch == 5
+        assert cfg2.train_gather == "upfront"
     finally:
         for k in ("RAY_TPU_TRAIN_MESH", "RAY_TPU_TRAIN_DONATE",
-                  "RAY_TPU_TRAIN_INGEST_PREFETCH"):
+                  "RAY_TPU_TRAIN_INGEST_PREFETCH", "RAY_TPU_TRAIN_GATHER"):
             os.environ.pop(k, None)
+
+
+def test_synthetic_fallback_honors_prefetch_depth():
+    """The synthetic-batch fallback keeps `train_ingest_prefetch`
+    batches in flight (the to_jax discipline), not a hardcoded 1-deep
+    buffer: with depth N, the host generator is N batches ahead of the
+    consumer at every point."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.train.spmd import _prefetched_synthetic
+
+    sh = NamedSharding(make_mesh(MeshConfig(data=1),
+                                 devices=jax.devices()[:1]), P())
+    pulled = [0]
+
+    def host():
+        while True:
+            pulled[0] += 1
+            yield np.full((2, 9), pulled[0], np.int32)
+
+    for depth in (1, 3):
+        pulled[0] = 0
+        next_tokens = _prefetched_synthetic(host(), sh, depth)
+        assert pulled[0] == depth  # primed `depth` ahead
+        for i in range(1, 4):
+            batch = np.asarray(next_tokens())
+            assert batch[0, 0] == i  # FIFO order preserved
+            assert pulled[0] == depth + i  # stays `depth` ahead
 
 
 def test_spmd_train_loop_smoke():
