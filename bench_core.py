@@ -816,6 +816,181 @@ def _trace_bench(reps: int, check: bool) -> int:
 
 
 # --------------------------------------------------------------------------- #
+# Goodput-observatory overhead bench (BENCH_GOODPUT.json)
+#
+# The observability claim: the health monitor (badput ledger fold +
+# straggler/regression/TTRT detectors, Head._health_monitor_loop) must
+# cost <= 1% on an SPMD step loop it is watching. Same estimator as the
+# trace bench: the monitor thread is toggled IN-PROCESS between
+# back-to-back (off, on) round pairs with alternating order, per-pair
+# delta, median pair per child, median child across subprocess reps.
+# The bench ticks the monitor every 100 ms — 50x the default 5 s
+# cadence — so the gate holds with a wide margin at the real cadence.
+# The child also proves the watch is live (ticks > 0, a non-vacuous
+# ledger with steps and a goodput fraction) so the gate can't pass
+# with the monitor accidentally off.
+# --------------------------------------------------------------------------- #
+
+GOODPUT_STEPS = 300       # spmd steps per measured round
+GOODPUT_ROUNDS = 8        # back-to-back (off, on) round pairs per child
+GOODPUT_TICK_S = 0.1      # monitor cadence under test (default is 5 s)
+
+
+def _goodput_bench_child() -> dict:
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu.core.config import global_config
+    from ray_tpu.core.runtime import get_current_runtime
+    from ray_tpu.train.health import HealthMonitor
+    from ray_tpu.train.spmd import _sp_compute
+    from ray_tpu.util import flight_recorder
+    from ray_tpu.util.goodput import goodput_report
+
+    # the bench owns the tick cadence: park the head's own monitor
+    global_config().health_monitor_enabled = False
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    head = get_current_runtime().head
+    flight_recorder.configure(enabled=True)
+
+    k = jax.jit(lambda m: m @ m)
+    x = jnp.zeros((512, 512), jnp.float32)
+    k(x).block_until_ready()               # compile outside the timing
+
+    def step():
+        t0 = flight_recorder.now()
+        k(x).block_until_ready()
+        _sp_compute.end(t0)
+
+    def round_step_s():
+        t0 = time.perf_counter()
+        for _ in range(GOODPUT_STEPS):
+            step()
+        return (time.perf_counter() - t0) / GOODPUT_STEPS
+
+    monitor = HealthMonitor(head)
+    ticks = [0]
+
+    def meas(on: bool) -> float:
+        if not on:
+            return round_step_s()
+        stop = threading.Event()
+
+        def tick_loop():
+            while not stop.wait(GOODPUT_TICK_S):
+                monitor.tick()
+                ticks[0] += 1
+
+        t = threading.Thread(target=tick_loop, daemon=True,
+                             name="goodput-bench-ticker")
+        t.start()
+        try:
+            return round_step_s()
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+    step()                                  # warm both planes
+    deltas, offs = [], []
+    for r in range(GOODPUT_ROUNDS):
+        if r % 2 == 0:
+            off = meas(False)
+            on = meas(True)
+        else:
+            on = meas(True)
+            off = meas(False)
+        deltas.append(on - off)
+        offs.append(off)
+
+    def med(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2]
+
+    ledger = goodput_report(head)           # proof of a live ledger
+    out = {
+        "step_off_us": round(med(offs) * 1e6, 2),
+        "delta_us": round(med(deltas) * 1e6, 2),
+        "overhead_frac": round(max(0.0, med(deltas)) / med(offs), 4),
+        "monitor_ticks": ticks[0],
+        "ledger_steps": ledger["steps"],
+        "goodput_fraction": ledger["goodput_fraction"],
+    }
+    ray_tpu.shutdown()
+    print(json.dumps(out))
+    return out
+
+
+def _goodput_bench(reps: int, check: bool) -> int:
+    runs = []
+    for rep in range(reps):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--goodput-bench-child"],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+        if p.returncode != 0 or not line:
+            print(p.stdout[-2000:], file=sys.stderr)
+            print(p.stderr[-2000:], file=sys.stderr)
+            raise RuntimeError("goodput-bench child failed")
+        rec = json.loads(line[-1])
+        runs.append(rec)
+        print(f"# rep={rep} step_off={rec['step_off_us']}us "
+              f"delta={rec['delta_us']}us "
+              f"overhead={rec['overhead_frac']} "
+              f"(ticks {rec['monitor_ticks']}, "
+              f"ledger steps {rec['ledger_steps']})",
+              file=sys.stderr)
+
+    def med(key):
+        vals = sorted(r[key] for r in runs)
+        return vals[len(vals) // 2]
+
+    result = {
+        "method": f"{reps} subprocess reps; inside each child the health "
+                  "monitor thread (100 ms cadence, 50x default) is "
+                  "toggled between back-to-back round pairs, median pair "
+                  "delta (drift-immune), then median across reps "
+                  "(ADVICE.md)",
+        "steps_per_round": GOODPUT_STEPS,
+        "round_pairs_per_child": GOODPUT_ROUNDS,
+        "monitor_tick_s": GOODPUT_TICK_S,
+        "step_off_us": min(r["step_off_us"] for r in runs),
+        "delta_us": med("delta_us"),
+        "overhead_frac": med("overhead_frac"),
+        "monitor_ticks_min": min(r["monitor_ticks"] for r in runs),
+        "ledger_steps_min": min(r["ledger_steps"] for r in runs),
+    }
+    gates = {
+        # the observatory acceptance gate: watching costs <= 1% of the
+        # step loop it watches (at 50x the production tick cadence)
+        "monitor_overhead_le_1pct": result["overhead_frac"] <= 0.01,
+        # no vacuous pass: the monitor actually ticked and the ledger
+        # actually folded the run's spans
+        "monitor_actually_ticked": result["monitor_ticks_min"] > 0,
+        "ledger_not_vacuous":
+            result["ledger_steps_min"] > 0
+            and all(r["goodput_fraction"] is not None for r in runs),
+    }
+    result["check"] = gates
+    result["check_passed"] = all(gates.values())
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_GOODPUT.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=2)
+    print(json.dumps(result, indent=2))
+    if check and not result["check_passed"]:
+        print("GOODPUT BENCH CHECK FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+# --------------------------------------------------------------------------- #
 # Fault-tolerance bench (BENCH_FT.json)
 #
 # Steady direct actor traffic against a daemon-hosted actor while the head
@@ -826,6 +1001,17 @@ def _trace_bench(reps: int, check: bool) -> int:
 # sealed before the bounce (driver store + daemon store) must still
 # resolve afterwards. Methodology per ADVICE.md: subprocess per rep,
 # min-of-rounds for the latency numbers, worst-of-rounds for the gates.
+#
+# Second drill (same BENCH_FT.json): the TTRT chaos ramp. An SPMD-style
+# step loop feeds from a restartable ingest actor while the daemon
+# HOSTING that actor is SIGKILLed mid-run; the actor fails over to the
+# surviving daemon (max_restarts) and the in-flight batch replays
+# (max_task_retries). The goodput observatory must measure the whole
+# story on its own: the death event opens a TTRT record against the
+# pre-fault throughput baseline, the record closes when tokens/s is
+# back within ttrt_recovery_fraction, and the ledger attributes the
+# outage as recovery badput. Gates: TTRT recovered in every rep and
+# bounded, recovery badput attributed.
 # --------------------------------------------------------------------------- #
 
 FT_WARM_CALLS = 30
@@ -934,6 +1120,114 @@ def _chaos_bench_child() -> dict:
     return out
 
 
+FT_TTRT_PRE_S = 2.5      # steady steps before the kill
+FT_TTRT_TOKENS = 1024    # tokens per step (fixed: rate = tokens/dt)
+FT_TTRT_DEADLINE_S = 90  # ramp abandons if throughput never recovers
+
+
+def _chaos_ttrt_child() -> dict:
+    import signal as _signal
+
+    import jax
+    import jax.numpy as jnp
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.core.config import global_config
+    from ray_tpu.train.spmd import _g_tokens_per_sec, _sp_compute
+    from ray_tpu.util import flight_recorder
+    from ray_tpu.util.goodput import goodput_report
+    from ray_tpu.util.metrics import registry
+
+    cfg = global_config()
+    cfg.flight_recorder_report_interval_ms = 300
+    cfg.health_check_period_ms = 300        # fast fault detection
+    cfg.health_monitor_interval_ms = 3_600_000   # the ramp drives ticks
+    cfg.metrics_history_interval_ms = 3_600_000  # ...and the sampling
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    # both daemons carry the ingest resource so the failover has a home
+    cluster.add_node(num_cpus=1, resources={"ftpool": 2},
+                     separate_process=True)
+    cluster.add_node(num_cpus=1, resources={"ftpool": 2},
+                     separate_process=True)
+    head = cluster.head
+    monitor = head.health_monitor
+
+    @ray_tpu.remote(resources={"ftpool": 1}, max_restarts=1,
+                    max_task_retries=1)
+    class BenchIngest:
+        def batch(self, i):
+            return i
+
+    ingest = BenchIngest.remote()
+    ray_tpu.get(ingest.batch.remote(0), timeout=60)
+    # ground truth for the kill: which daemon hosts the ingest actor
+    # (class_name is qualified, e.g. "BenchIngest.__init__")
+    host_hex = next(a["node_hex"]
+                    for a in head.state_list("actors")
+                    if "BenchIngest" in str(a["class_name"])
+                    and a["node_hex"])
+    victim = next(n for n in head.nodes.values() if n.hex == host_hex)
+
+    k = jax.jit(lambda m: m @ m)
+    x = jnp.zeros((256, 256), jnp.float32)
+    k(x).block_until_ready()
+
+    last_tick = [0.0]
+
+    def step(i):
+        """One SPMD-style step: ingest fetch + compute span + the
+        throughput sample the TTRT tracker watches."""
+        t_wall = time.perf_counter()
+        ray_tpu.get(ingest.batch.remote(i), timeout=FT_TTRT_DEADLINE_S)
+        t0 = flight_recorder.now()
+        k(x).block_until_ready()
+        _sp_compute.end(t0)
+        dt = max(time.perf_counter() - t_wall, 1e-9)
+        _g_tokens_per_sec.set(FT_TTRT_TOKENS / dt, tags={"loop": "spmd"})
+        head.metrics_history.sample(registry(), now=time.time())
+        if time.monotonic() - last_tick[0] > 0.25:
+            last_tick[0] = time.monotonic()
+            monitor.tick()
+        return dt
+
+    i, end = 0, time.monotonic() + FT_TTRT_PRE_S
+    while time.monotonic() < end:
+        step(i)
+        i += 1
+    pre_steps = i
+
+    os.kill(victim.pid, _signal.SIGKILL)
+    killed_at = time.monotonic()
+    blip_s = 0.0
+    deadline = time.monotonic() + FT_TTRT_DEADLINE_S
+    recovered = None
+    while time.monotonic() < deadline and recovered is None:
+        blip_s = max(blip_s, step(i))
+        i += 1
+        recovered = next((r for r in monitor.ttrt.summary()
+                          if r["recovered_ts"] is not None), None)
+    monitor.tick()
+    ledger = goodput_report(head)
+    out = {
+        "pre_steps": pre_steps,
+        "post_steps": i - pre_steps,
+        "blip_s": round(blip_s, 3),
+        "wall_after_kill_s": round(time.monotonic() - killed_at, 3),
+        "ttrt_recovered": recovered is not None,
+        "ttrt_s": recovered["ttrt_s"] if recovered else None,
+        "ttrt_baseline": round(recovered["baseline"], 1)
+        if recovered else None,
+        "recovery_badput_s": ledger["badput_s"]["recovery"],
+        "recovery_gap_entities":
+            sorted({g["entity"] for g in ledger.get("recovery_gaps", ())}),
+        "victim": victim.hex[:8],
+    }
+    cluster.shutdown()
+    print(json.dumps(out))
+    return out
+
+
 def _chaos_bench(reps: int, check: bool) -> int:
     runs = []
     for rep in range(reps):
@@ -957,6 +1251,27 @@ def _chaos_bench(reps: int, check: bool) -> int:
               f"rejoin={rec['rejoin_s']}s lost={rec['objects_lost']}",
               file=sys.stderr)
 
+    ttrt_runs = []
+    for rep in range(reps):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--chaos-ttrt-child"],
+            env=env, capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+        if p.returncode != 0 or not line:
+            print(p.stdout[-2000:], file=sys.stderr)
+            print(p.stderr[-2000:], file=sys.stderr)
+            raise RuntimeError("chaos-ttrt child failed")
+        rec = json.loads(line[-1])
+        ttrt_runs.append(rec)
+        print(f"# ttrt rep={rep} recovered={rec['ttrt_recovered']} "
+              f"ttrt={rec['ttrt_s']}s blip={rec['blip_s']}s "
+              f"recovery_badput={rec['recovery_badput_s']}s",
+              file=sys.stderr)
+
     result = {
         "method": f"{reps} subprocess reps; latency = min-of-rounds, "
                   "gates = worst-of-rounds (ADVICE.md)",
@@ -966,6 +1281,9 @@ def _chaos_bench(reps: int, check: bool) -> int:
         "p99_post_ms": min(r["p99_post_ms"] for r in runs),
         "rejoin_s_worst": max(r["rejoin_s"] for r in runs),
         "objects_lost_total": sum(r["objects_lost"] for r in runs),
+        "ttrt_s_worst": max((r["ttrt_s"] for r in ttrt_runs
+                             if r["ttrt_s"] is not None), default=None),
+        "ttrt_runs": ttrt_runs,
         "runs": runs,
     }
     result["blip_ratio"] = round(
@@ -982,6 +1300,20 @@ def _chaos_bench(reps: int, check: bool) -> int:
         # steady state fully recovers (min-of-rounds, 3x headroom for the
         # 1-core box's scheduling noise)
         "post_p99_within_3x": result["post_recovery_ratio"] <= 3.0,
+        # the TTRT ramp: every rep's daemon-kill measured a closed
+        # time-to-recovered-throughput, bounded (detection 300 ms +
+        # actor failover; 30 s is ample even on a loaded 1-core box)
+        "ttrt_recovered_all_reps":
+            all(r["ttrt_recovered"] for r in ttrt_runs),
+        "ttrt_within_30s": all(
+            r["ttrt_s"] is not None and r["ttrt_s"] <= 30.0
+            for r in ttrt_runs),
+        # ...and the outage shows up in the ledger as attributed
+        # recovery badput against the killed node
+        "recovery_badput_attributed": all(
+            r["recovery_badput_s"] > 0
+            and r["victim"] in r["recovery_gap_entities"]
+            for r in ttrt_runs),
     }
     result["check"] = gates
     result["check_passed"] = all(gates.values())
@@ -1026,16 +1358,25 @@ def main():
                     "<=3% overhead gate")
     ap.add_argument("--trace-bench-child", action="store_true",
                     help=argparse.SUPPRESS)
+    ap.add_argument("--goodput-bench", action="store_true",
+                    help="health-monitor overhead A/B (BENCH_GOODPUT.json): "
+                    "spmd step loop with the monitor ticking vs off, "
+                    "<=1% overhead gate")
+    ap.add_argument("--goodput-bench-child", action="store_true",
+                    help=argparse.SUPPRESS)
     ap.add_argument("--chaos-bench", action="store_true",
                     help="fault-tolerance bench (BENCH_FT.json): p99 blip "
                     "across an injected head bounce under steady actor "
-                    "traffic, daemon rejoin time, objects-lost==0 gate")
+                    "traffic, daemon rejoin time, objects-lost==0 gate, "
+                    "plus the daemon-kill TTRT ramp")
     ap.add_argument("--chaos-bench-child", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--chaos-ttrt-child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--check", action="store_true",
-                    help="exit 1 when the actor-/dag-/chaos-bench gates "
-                    "fail")
+                    help="exit 1 when the actor-/dag-/trace-/goodput-/"
+                    "chaos-bench gates fail")
     args = ap.parse_args()
 
     if args.actor_bench_child:
@@ -1053,8 +1394,16 @@ def main():
         return {}
     if args.trace_bench:
         raise SystemExit(_trace_bench(args.reps, args.check))
+    if args.goodput_bench_child:
+        _goodput_bench_child()
+        return {}
+    if args.goodput_bench:
+        raise SystemExit(_goodput_bench(args.reps, args.check))
     if args.chaos_bench_child:
         _chaos_bench_child()
+        return {}
+    if args.chaos_ttrt_child:
+        _chaos_ttrt_child()
         return {}
     if args.chaos_bench:
         raise SystemExit(_chaos_bench(args.reps, args.check))

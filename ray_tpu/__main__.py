@@ -11,6 +11,8 @@ stop/list``, ``ray list tasks|actors|nodes``). Commands:
     list    tasks|actors|nodes|objects|placement_groups via dashboard
     memory  cluster memory/object ownership table (`ray memory` analog)
     timeline  merged Perfetto trace / step-time attribution report
+    goodput   goodput fraction + badput ledger + detector state
+    stack     cluster-wide collapsed-stack dump (wedged-gang companion)
     lint    graftlint static analyzer (tools/lint; docs/static-analysis.md)
 """
 
@@ -173,6 +175,56 @@ def _cmd_timeline(args) -> int:
               "(open in https://ui.perfetto.dev)")
     if args.attribute or not args.perfetto:
         print(format_attribution(attribute_trace(events)))
+    if args.goodput:
+        # the badput-ledger view over the SAME fetched trace (no
+        # cluster events client-side: recovery gaps need /api/goodput)
+        from ray_tpu.util.goodput import classify_badput, format_goodput
+
+        print(format_goodput(classify_badput(events)))
+    return 0
+
+
+def _cmd_goodput(args) -> int:
+    """Render the goodput observatory report from /api/goodput."""
+    import urllib.request
+
+    from ray_tpu.util.goodput import format_goodput
+
+    base = args.address
+    if not base.startswith("http"):
+        base = "http://" + base
+    with urllib.request.urlopen(f"{base}/api/goodput", timeout=30) as resp:
+        ledger = json.loads(resp.read().decode())
+    if args.json:
+        print(json.dumps(ledger, indent=2))
+    else:
+        print(format_goodput(ledger))
+    return 0
+
+
+def _cmd_stack(args) -> int:
+    """Cluster-wide collapsed-stack dump from /api/stacks: one bounded
+    sampling round per process, printed per-process (or merged with
+    --merge for one flamegraph input)."""
+    import urllib.request
+
+    base = args.address
+    if not base.startswith("http"):
+        base = "http://" + base
+    url = f"{base}/api/stacks"
+    if args.duration_ms:
+        url += f"?duration_ms={args.duration_ms}"
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        stacks = json.loads(resp.read().decode())
+    if args.merge:
+        for source in sorted(stacks):
+            for line in stacks[source].splitlines():
+                print(f"{source};{line}")
+        return 0
+    for source in sorted(stacks):
+        print(f"==> {source} <==")
+        print(stacks[source] or "(no samples)")
+        print()
     return 0
 
 
@@ -239,6 +291,30 @@ def main(argv=None) -> int:
                     help="write the merged trace to this file")
     tl.add_argument("--attribute", action="store_true",
                     help="print the step-time attribution report")
+    tl.add_argument("--goodput", action="store_true",
+                    help="also print the badput-ledger view of the "
+                         "same trace (full report: `goodput`)")
+
+    gp = sub.add_parser("goodput",
+                        help="goodput fraction + badput breakdown + "
+                             "straggler/regression/TTRT detector state")
+    gp.add_argument("--address", default="http://127.0.0.1:8265",
+                    help="dashboard address serving /api/goodput")
+    gp.add_argument("--json", action="store_true",
+                    help="print the raw ledger JSON")
+
+    st = sub.add_parser("stack",
+                        help="cluster-wide collapsed-stack dump (one "
+                             "bounded sample round per process)")
+    st.add_argument("--address", default="http://127.0.0.1:8265",
+                    help="dashboard address serving /api/stacks")
+    st.add_argument("--duration-ms", type=int, default=0,
+                    dest="duration_ms",
+                    help="per-process sample duration (default: the "
+                         "stack_dump_duration_ms Config knob)")
+    st.add_argument("--merge", action="store_true",
+                    help="prefix every line with its process and merge "
+                         "into one collapsed stream (flamegraph input)")
 
     up = sub.add_parser("up", help="launch a cluster from a YAML spec")
     up.add_argument("config", help="cluster YAML path")
@@ -283,6 +359,10 @@ def main(argv=None) -> int:
         return _cmd_memory(args)
     if args.cmd == "timeline":
         return _cmd_timeline(args)
+    if args.cmd == "goodput":
+        return _cmd_goodput(args)
+    if args.cmd == "stack":
+        return _cmd_stack(args)
     if args.cmd == "up":
         from ray_tpu.cluster_launcher import up as _up
 

@@ -214,6 +214,36 @@ class Config:
     # one-way like the metrics report; drops are harmless — the next
     # drain re-ships nothing, spans are consumed on drain)
     flight_recorder_report_interval_ms: int = 2000
+    # goodput observatory (util/goodput.py + train/health.py): a head
+    # service folds the span/metrics planes into a badput ledger and
+    # runs the straggler/regression/TTRT detectors on this cadence
+    health_monitor_enabled: bool = True
+    health_monitor_interval_ms: int = 5_000
+    # straggler detector: a host (or MPMD stage) whose mean step-span
+    # duration exceeds the cluster median by trigger_x raises an
+    # edge-triggered WARNING; it clears below clear_x. The gap between
+    # the two is the hysteresis band — a host oscillating across one
+    # threshold cannot flap events. min_spans is the evidence floor.
+    straggler_trigger_x: float = 1.5
+    straggler_clear_x: float = 1.2
+    straggler_min_spans: int = 4
+    # regression detector: recent-window mean vs rolling baseline on
+    # the head's metrics-history rings (train step time, tokens/s,
+    # serve dispatch latency). trigger/clear are degradation factors
+    # with the same hysteresis contract as the straggler knobs;
+    # min_samples points must exist before a series is judged and the
+    # last `window` of them form the recent mean.
+    regression_trigger_x: float = 1.3
+    regression_clear_x: float = 1.1
+    regression_min_samples: int = 8
+    regression_window: int = 3
+    # time-to-recovered-throughput: after a death event, throughput is
+    # "recovered" once back within this fraction of the pre-fault
+    # rolling baseline (0.2 = within 20%)
+    ttrt_recovery_fraction: float = 0.2
+    # cluster stack dump (`python -m ray_tpu stack`): how long each
+    # process samples its threads for the one-shot collapsed dump
+    stack_dump_duration_ms: int = 200
     # duration floor: spans shorter than this skip the ring, leaving
     # only the clock reads on the hot path — what keeps the recorder
     # inside the <=3% dag-bench overhead gate at microsecond dispatch

@@ -76,6 +76,10 @@ class Node:
         self._workers: Dict[WorkerID, WorkerHandle] = {}
         self._idle: deque = deque()
         self._local_queue: deque = deque()  # (spec, binding) waiting for a worker
+        # pending node->worker stack-dump rounds (collect_worker_stacks):
+        # req_id -> [event, reply, worker_id]
+        self._stack_seq = 0
+        self._stack_pending: Dict[int, list] = {}
         from .lock_debug import tracked_rlock
 
         self._lock = tracked_rlock("Node._lock")
@@ -1516,6 +1520,13 @@ class Node:
                                             payload[0])
                 except Exception:
                     pass
+            elif tag == "stack_rep":
+                # worker's collapsed-stack reply to a "stack" round
+                req_id, text = payload
+                slot = self._stack_pending.get(req_id)
+                if slot is not None:
+                    slot[1] = text
+                    slot[0].set()
             elif tag == "unstaged":
                 # worker handed back a staged-unstarted task: requeue it
                 tid = payload[0]
@@ -1656,6 +1667,7 @@ class Node:
             self._workers.pop(w.worker_id, None)
             lost = self._drop_actor_direct_locked(w)
         self._fail_worker_ssubs(w.worker_id, w.pid)
+        self._fail_worker_stack_waiters(w.worker_id)
         # head first (same reasoning as _on_worker_dead): owners failing
         # these calls read the FSM for the attributed death cause
         self.head.on_worker_exit(self, w)
@@ -1704,6 +1716,7 @@ class Node:
             lost_actor = self._drop_actor_direct_locked(w)
         w.channel.close()
         self._fail_worker_ssubs(w.worker_id, w.pid)
+        self._fail_worker_stack_waiters(w.worker_id)
         head_assigned = [e for e in assigned if e[0].task_id not in direct_ids]
         # head FIRST, owner replies second: the owner's failure handling
         # (possibly inline on THIS thread for an in-process driver)
@@ -1842,6 +1855,48 @@ class Node:
     def num_workers(self) -> int:
         with self._lock:
             return len(self._workers)
+
+    def collect_worker_stacks(self, duration_s: float,
+                              timeout: float = 3.0) -> Dict[str, str]:
+        """One bounded ``stack`` round per live worker: each samples its
+        own threads for ``duration_s`` and replies one-way. Returns
+        {"<node6>:<pid>": collapsed text}; dead/slow workers are simply
+        absent (their pending slots are failed by _on_worker_dead)."""
+        waiters = []
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            with self._lock:
+                self._stack_seq += 1
+                req_id = self._stack_seq
+                slot = [threading.Event(), None, w.worker_id]
+                self._stack_pending[req_id] = slot
+            try:
+                w.channel.send("stack", req_id,
+                               int(duration_s * 1000))
+            except OSError:
+                self._stack_pending.pop(req_id, None)
+                continue
+            waiters.append((w, req_id, slot))
+        out: Dict[str, str] = {}
+        deadline = time.monotonic() + timeout + duration_s
+        for w, req_id, slot in waiters:
+            slot[0].wait(max(0.0, deadline - time.monotonic()))
+            self._stack_pending.pop(req_id, None)
+            if slot[1] is not None:
+                out[f"{self.hex[:6]}:{w.pid}"] = slot[1]
+        return out
+
+    def _fail_worker_stack_waiters(self, worker_id) -> None:
+        """Death path for the stack round: a dead worker's pending
+        collectors wake now with no reply."""
+        with self._lock:
+            gone = [(rid, s) for rid, s in self._stack_pending.items()
+                    if len(s) > 2 and s[2] == worker_id]
+            for rid, _s in gone:
+                self._stack_pending.pop(rid, None)
+        for _rid, slot in gone:
+            slot[0].set()
 
     def shutdown(self) -> None:
         self.alive = False

@@ -272,6 +272,13 @@ class RemoteHead:
                 # offset estimator (flight-recorder trace merge); old
                 # heads ignore the extra element
                 self._send("pong", payload[0], time.time())
+            elif tag == "stack_dump":
+                # cluster stack dump: sampling blocks for duration_ms,
+                # so it runs off the handler — pings must keep flowing
+                # while this daemon profiles itself and its workers
+                threading.Thread(
+                    target=self._stack_dump, args=(payload[0], payload[1]),
+                    daemon=True, name="stack-dump").start()
             elif tag == "cluster_view":
                 # syncer broadcast (reference: RaySyncer RESOURCE_VIEW
                 # fan-out); versioned — drop stale reorderings
@@ -281,6 +288,25 @@ class RemoteHead:
                     self.cluster_view = view
         except Exception:
             pass  # node dying; the head recovers via channel EOF
+
+    def _stack_dump(self, req_id: int, duration_ms: int) -> None:
+        """Sample this daemon + its workers, reply one-way. Best-effort:
+        a missing reply just leaves this node absent from the dump (the
+        head's collector has its own deadline)."""
+        from ray_tpu.util import sampling_profiler
+
+        stacks: dict = {}
+        try:
+            dur = max(0.0, duration_ms / 1000.0)
+            stacks[f"{self.node.hex[:6]}:daemon"] = \
+                sampling_profiler.collect_stacks(dur)
+            stacks.update(self.node.collect_worker_stacks(dur))
+        except Exception:
+            pass  # partial dump beats none; reply what we have
+        try:
+            self._send("stack_rep", req_id, stacks)
+        except Exception:
+            pass  # node dying; the head's deadline covers it
 
     # ------------------------------------------- Head API consumed by Node
 

@@ -36,12 +36,16 @@ from .fault_injection import should_drop as _fault_should_drop
 # tags/payload shapes — mixed-version clusters fail fast with a clear
 # error instead of unpickling garbage (the pickle-schema analog of the
 # reference's versioned protobuf wire format, src/ray/protobuf/).
-PROTOCOL_VERSION = 11  # v11: flight-recorder span plane. ADDED the
+PROTOCOL_VERSION = 12  # v12: cluster stack dump. ADDED the "stack"
+# request (head -> worker/daemon: one bounded sampling-profiler round,
+# duration_ms) and its one-way "stack_rep" reply (collapsed-stack text
+# per process) behind `python -m ray_tpu stack` / GET /api/stacks.
+# (v11: flight-recorder span plane. ADDED the
 # one-way "spans" tag (worker/daemon -> head: drained flight-recorder
 # ring payloads for the cluster timeline, util/flight_recorder.py) and
 # EXTENDED the health-prober pong payload to (seq, wall_time) so the
 # head can estimate per-host clock offsets (min-RTT midpoint) when
-# merging traces.
+# merging traces.)
 # (v10: zero-copy net-ring tensor bodies. ADDED
 # "nrdv" (data-with-raw-body: header (nrdv, seq, tag, nbytes) followed
 # by one raw mpc frame carrying the writev'd segment body; the serve

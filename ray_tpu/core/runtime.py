@@ -264,6 +264,10 @@ class Head:
         self._ref_reports: Dict[str, dict] = {}
         self._store_info_seq = 0
         self._store_info_pending: Dict[int, list] = {}
+        # pending head->daemon cluster stack-dump requests (same
+        # request/reply shape as store_info: slot = [event, reply, hex])
+        self._stack_seq = 0
+        self._stack_pending: Dict[int, list] = {}
         # (monotonic_ts, rows) — memory_table joins are cached briefly so
         # a dashboard polling /api/objects doesn't pay a store_info
         # round-trip to every daemon per request
@@ -280,6 +284,12 @@ class Head:
         if self.metrics_history is not None:
             self._spawn_service(self._metrics_history_loop,
                                 "metrics-history")
+        # goodput observatory (train/health.py): badput ledger +
+        # straggler/regression/TTRT detectors on their own cadence
+        self.health_monitor = None
+        if cfg0.health_monitor_enabled:
+            self._spawn_service(self._health_monitor_loop,
+                                "health-monitor")
         # restart recovery: re-create durable placements + detached
         # actors, retire owner-bound ones (must run after head_node is up)
         self._recover_durable_state()
@@ -379,6 +389,7 @@ class Head:
             self.nodes.pop(proxy.hex, None)
             self._rejoin_pending.add(proxy.hex)
         self._fail_store_info_waiters(proxy.hex)
+        self._fail_stack_waiters(proxy.hex)
         try:
             proxy.channel.close()
         except Exception:
@@ -439,6 +450,7 @@ class Head:
         it), purge the node's directory entries, fail/retry its RUNNING
         head-path tasks, and fail over its actors per max_restarts."""
         self._fail_store_info_waiters(node_hex)
+        self._fail_stack_waiters(node_hex)
         retry_deletes = []
         with self._lock:
             self.node_loads.pop(node_hex, None)
@@ -539,6 +551,72 @@ class Head:
                 self._store_info_pending.pop(rid, None)
         for _rid, slot in gone:
             slot[0].set()  # slot[1] stays None: the node is simply absent
+
+    def collect_stacks(self, timeout: float = 5.0,
+                       duration_ms: Optional[int] = None) -> Dict[str, str]:
+        """Cluster-wide collapsed-stack dump (`python -m ray_tpu stack`):
+        one bounded sampling round per process — this head directly,
+        local nodes' workers over their channels, remote daemons (and
+        their workers) via a ``stack_dump`` round-trip. Returns
+        {source: collapsed-stack text}; unreachable processes are
+        simply absent."""
+        from ray_tpu.util import sampling_profiler
+
+        dur_ms = global_config().stack_dump_duration_ms \
+            if duration_ms is None else duration_ms
+        dur = max(0.0, dur_ms / 1000.0)
+        out: Dict[str, str] = {}
+        waiters = []
+        with self._lock:
+            nodes = list(self.nodes.items())
+        for h, n in nodes:
+            if self._is_local(n):
+                continue  # local workers gathered below, off the clock
+            if getattr(n, "alive", False):
+                with self._lock:
+                    self._stack_seq += 1
+                    req_id = self._stack_seq
+                    slot = [threading.Event(), None, h]
+                    self._stack_pending[req_id] = slot
+                if n._send("stack_dump", req_id, dur_ms):
+                    waiters.append((req_id, slot))
+                else:
+                    self._stack_pending.pop(req_id, None)
+        # sample this process while the daemons sample theirs
+        out[f"head:{os.getpid()}"] = sampling_profiler.collect_stacks(dur)
+        for h, n in nodes:
+            if self._is_local(n):
+                out.update(n.collect_worker_stacks(dur, timeout=timeout))
+        deadline = time.monotonic() + timeout
+        for req_id, slot in waiters:
+            slot[0].wait(max(0.0, deadline - time.monotonic()))
+            self._stack_pending.pop(req_id, None)
+            if slot[1] is not None:
+                out.update(slot[1])
+        return out
+
+    def _fail_stack_waiters(self, node_hex: str) -> None:
+        """Same death path as store_info: wake stack collectors parked
+        on a daemon that just died."""
+        with self._lock:
+            gone = [(rid, s) for rid, s in self._stack_pending.items()
+                    if len(s) > 2 and s[2] == node_hex]
+            for rid, _s in gone:
+                self._stack_pending.pop(rid, None)
+        for _rid, slot in gone:
+            slot[0].set()
+
+    def _health_monitor_loop(self) -> None:
+        from ray_tpu.train.health import HealthMonitor
+
+        self.health_monitor = HealthMonitor(self)
+        period = max(0.05,
+                     global_config().health_monitor_interval_ms / 1000.0)
+        while not self._stop_event.wait(period):
+            try:
+                self.health_monitor.tick()
+            except Exception:
+                pass  # observability must never take the head down
 
     def memory_table(self, limit: int = 100_000,
                      timeout: float = 1.0) -> List[dict]:
@@ -1134,6 +1212,12 @@ class Head:
                 if slot is not None:
                     slot[1] = infos
                     slot[0].set()
+            elif tag == "stack_rep":
+                req_id, stacks = payload
+                slot = self._stack_pending.get(req_id)
+                if slot is not None:
+                    slot[1] = stacks
+                    slot[0].set()
             elif tag == "sealed_payload":
                 self.on_sealed_payload(*payload)
             elif tag == "pub1":
@@ -1314,6 +1398,7 @@ class Head:
         for p in proxies:
             p.alive = False
             self._fail_store_info_waiters(p.hex)
+            self._fail_stack_waiters(p.hex)
             try:
                 p.channel.close()
             except Exception:

@@ -478,6 +478,14 @@ class WorkerRuntime:
                     self._dispatch_exec(spec, binding)
                 elif tag == "cancel":
                     self._cancelled.add(payload[0])
+                elif tag == "stack":
+                    # cluster stack dump: sampling blocks for the dump
+                    # duration, so it runs off the reader thread and
+                    # replies one-way (the node's collector has a
+                    # deadline; a dead worker's slot is failed there)
+                    threading.Thread(
+                        target=self._reply_stacks, args=payload,
+                        daemon=True, name="stack-dump").start()
                 elif tag == "node_ip":
                     # node learned its routable IP after this worker
                     # registered (head-node prestart race)
@@ -1136,6 +1144,21 @@ class WorkerRuntime:
             self.channel.send("srep", req_id, rep)
         except (OSError, EOFError):
             pass  # node gone: the subscriber's round times out
+
+    def _reply_stacks(self, req_id: int, duration_ms: int) -> None:
+        """One bounded self-sample for the cluster stack dump, replied
+        one-way over the node channel ("stack_rep")."""
+        from ray_tpu.util import sampling_profiler
+
+        try:
+            text = sampling_profiler.collect_stacks(
+                max(0.0, duration_ms / 1000.0))
+        except Exception:
+            text = ""  # sampler failure still replies (empty dump)
+        try:
+            self.channel.send("stack_rep", req_id, text)
+        except (OSError, EOFError):
+            pass  # node gone: the collector's deadline covers it
 
     def _send_error(self, spec: TaskSpec, exc: Exception) -> None:
         if isinstance(exc, TaskError):

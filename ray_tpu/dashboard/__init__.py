@@ -21,6 +21,9 @@ control plane — with:
     GET  /api/memory?group_by=callsite|node|task
                                 cluster memory/object ownership summary
     GET  /api/metrics/history?name=   sampled metric time-series rings
+                                (name may be a prefix* or regex -> multi)
+    GET  /api/goodput           badput ledger + straggler/regression/TTRT
+    GET  /api/stacks?duration_ms=     cluster collapsed-stack dump
     GET  /api/pubsub?channel=&cursor=&timeout=   poll a pubsub channel
     GET  /api/nodes/<hex>/logs[/<name>]     per-node agent: log browse/tail
     GET  /api/nodes/<hex>/metrics           per-node agent: metrics snapshot
@@ -246,7 +249,10 @@ class DashboardServer:
                      "objects": rows[:min(limit, 100)]})
         elif path == "/api/metrics/history":
             # sampled metric time-series: /api/metrics/history?name=
-            # (no name -> the list of sampled series names)
+            # (no name -> the list of sampled series names). An exact
+            # name keeps the single-series shape; a prefix (trailing *)
+            # or regex returns every matching series in one response
+            # under "matches".
             mh = getattr(self.head, "metrics_history", None)
             if mh is None:
                 h._json({"error": "metrics history disabled"}, 404)
@@ -254,9 +260,26 @@ class DashboardServer:
                 from urllib.parse import unquote
 
                 name = unquote(params["name"])
-                h._json({"name": name, "series": mh.query(name)})
+                series = mh.query(name)
+                if series:
+                    h._json({"name": name, "series": series})
+                else:
+                    h._json({"pattern": name,
+                             "matches": mh.query_pattern(name)})
             else:
                 h._json({"names": mh.names()})
+        elif path == "/api/goodput":
+            # the goodput observatory: badput ledger + detector state
+            # (same dict `python -m ray_tpu goodput` renders)
+            from ray_tpu.util.goodput import goodput_report
+
+            h._json(goodput_report(self.head))
+        elif path == "/api/stacks":
+            # cluster-wide collapsed-stack dump (`python -m ray_tpu
+            # stack`): blocks for the sample duration + daemon round
+            dur = params.get("duration_ms")
+            h._json(self.head.collect_stacks(
+                duration_ms=int(dur) if dur else None))
         elif path == "/api/jobs" or path == "/api/jobs/":
             h._json([j.to_dict() for j in self._jm().list_jobs()])
         elif path == "/api/serve":

@@ -11,8 +11,50 @@ import contextlib
 import os
 import shutil
 import tempfile
+import time
 import uuid
 from typing import Iterator, Optional
+
+from ray_tpu.util import flight_recorder as _fr
+from ray_tpu.util.metrics import Counter
+
+# Checkpoint observability: the single registration site for the ckpt
+# span/metric names — orbax_checkpoint.py (and any other checkpoint
+# format layered on top) imports record_checkpoint_io() from here so
+# the names register exactly once.
+_sp_save = _fr.register_span("ckpt.save")
+_sp_restore = _fr.register_span("ckpt.restore")
+_ckpt_bytes = Counter("ray_tpu_checkpoint_bytes_total",
+                      "Bytes written (op=save) / read (op=restore) by "
+                      "checkpoint I/O", tag_keys=("op",))
+_ckpt_seconds = Counter("ray_tpu_checkpoint_seconds_total",
+                        "Wall seconds spent in checkpoint I/O",
+                        tag_keys=("op",))
+
+
+def directory_bytes(path: str) -> int:
+    """Total size of all regular files under ``path`` (0 if missing)."""
+    total = 0
+    for root, _dirs, names in os.walk(path):
+        for n in names:
+            try:
+                total += os.path.getsize(os.path.join(root, n))
+            except OSError:
+                pass
+    return total
+
+
+def record_checkpoint_io(op: str, t0_span, t0_wall: float, path: str):
+    """Account one checkpoint save/restore: span + byte/second counters.
+
+    ``t0_span`` is ``flight_recorder.now()`` taken before the I/O and
+    ``t0_wall`` the matching ``time.perf_counter()``; ``path`` is the
+    checkpoint directory (walked for its on-disk byte size).
+    """
+    (_sp_save if op == "save" else _sp_restore).end(t0_span)
+    _ckpt_seconds.inc(max(time.perf_counter() - t0_wall, 0.0),
+                      tags={"op": op})
+    _ckpt_bytes.inc(directory_bytes(path), tags={"op": op})
 
 
 class Checkpoint:
@@ -28,7 +70,9 @@ class Checkpoint:
         dest = path or os.path.join(tempfile.gettempdir(),
                                     f"ckpt_{uuid.uuid4().hex[:8]}")
         if os.path.abspath(dest) != self.path:
+            _t, _w = _fr.now(), time.perf_counter()
             shutil.copytree(self.path, dest, dirs_exist_ok=True)
+            record_checkpoint_io("restore", _t, _w, dest)
         return dest
 
     @contextlib.contextmanager

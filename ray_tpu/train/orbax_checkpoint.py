@@ -28,7 +28,11 @@ Usage inside a Train worker::
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional
+
+from ray_tpu.util import flight_recorder as _fr
+from ray_tpu.train.checkpoint import record_checkpoint_io
 
 
 def _checkpointer():
@@ -43,7 +47,9 @@ def save_jax_state(path: str, state: Any) -> str:
     Sharded jax.Arrays are written distributed (every process must
     call this — orbax coordinates via jax.distributed)."""
     target = os.path.join(os.path.abspath(path), "state")
+    _t, _w = _fr.now(), time.perf_counter()
     _checkpointer().save(target, state, force=True)
+    record_checkpoint_io("save", _t, _w, target)
     return target
 
 def restore_jax_state(path: str, target: Optional[Any] = None) -> Any:
@@ -56,10 +62,14 @@ def restore_jax_state(path: str, target: Optional[Any] = None) -> Any:
     import orbax.checkpoint as ocp
 
     src = os.path.join(os.path.abspath(path), "state")
+    _t, _w = _fr.now(), time.perf_counter()
     if target is None:
-        return _checkpointer().restore(src)
-    restore_args = jax.tree.map(
-        lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding)
-        if isinstance(x, jax.Array) and hasattr(x, "sharding")
-        else ocp.RestoreArgs(), target)
-    return _checkpointer().restore(src, restore_args=restore_args)
+        out = _checkpointer().restore(src)
+    else:
+        restore_args = jax.tree.map(
+            lambda x: ocp.ArrayRestoreArgs(sharding=x.sharding)
+            if isinstance(x, jax.Array) and hasattr(x, "sharding")
+            else ocp.RestoreArgs(), target)
+        out = _checkpointer().restore(src, restore_args=restore_args)
+    record_checkpoint_io("restore", _t, _w, src)
+    return out

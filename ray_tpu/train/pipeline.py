@@ -66,6 +66,11 @@ _sp_loss_bwd = _fr.register_span("pipe.loss_bwd",
                                  tag_keys=("stage", "chunk", "mb"))
 _sp_step = _fr.register_span("pipe.step")
 
+# Regression-detector feed: the MPMD loop publishes its step time under
+# the same gauge name the SPMD loop uses (registered there), tagged
+# loop=pipeline, so the health monitor watches one series family.
+from ray_tpu.train.spmd import _g_step_seconds  # noqa: E402  (shared gauge)
+
 __all__ = [
     "MPMDPipelineTrainer",
     "init_mlp_params",
@@ -626,6 +631,8 @@ class MPMDPipelineTrainer:
             pending.popleft().get(timeout=timeout)
         self._pipeline_wall_s += time.perf_counter() - t0
         _sp_step.end(_t)
+        _g_step_seconds.set(time.perf_counter() - t0,
+                            tags={"loop": "pipeline"})
         self._microbatches_run += num_microbatches
         if self.schedule == "1f1b":
             # updates already applied stage-locally during the drain;

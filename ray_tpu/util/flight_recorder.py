@@ -318,6 +318,14 @@ def snapshot_payload(window_s: Optional[float] = None) -> dict:
     return _payload(events)
 
 
+def snapshot_payload_since(seq: int) -> dict:
+    """Non-consuming view of local records with seq >= ``seq``. The
+    incremental-fold path: a periodic reader (the health monitor)
+    remembers the highest seq it folded and pays O(new records) per
+    tick instead of O(ring)."""
+    return _payload(_collect(max(0, seq), _hi[0]))
+
+
 def reset_for_tests() -> None:
     global _seq
     _seq = itertools.count()
@@ -439,12 +447,20 @@ def build_span_events(payloads: List[dict]) -> List[Dict[str, Any]]:
     return events
 
 
-def cluster_span_payloads(head) -> List[dict]:
+def cluster_span_payloads(head,
+                          since: Optional[Dict[str, int]] = None
+                          ) -> List[dict]:
     """Head-side collection: the local (driver/head) snapshot plus every
     buffered worker/daemon payload, each stamped with its node's
     estimated clock offset (0 for head-host sources — CLOCK_MONOTONIC
     differs per process but the wall anchors already line same-host
-    processes up)."""
+    processes up).
+
+    ``since`` maps source label -> highest seq already consumed; when
+    given, payloads carry only records past each cursor (seqs are
+    monotonic per recording process, and retained worker chunks are
+    drained batches in seq order), so a periodic caller pays for new
+    records only."""
     head_hex = getattr(getattr(head, "head_node", None), "hex", None)
     offsets: Dict[str, float] = {}
     for proxy in list(getattr(head, "nodes", {}).values()):
@@ -453,13 +469,22 @@ def cluster_span_payloads(head) -> List[dict]:
         if est is not None and hx:
             offsets[hx] = est.offset()
     out: List[dict] = []
-    local = snapshot_payload()
-    local.update({"source": f"head:{_proc_label[0]}",
+    local_src = f"head:{_proc_label[0]}"
+    local = snapshot_payload() if since is None \
+        else snapshot_payload_since(since.get(local_src, -1) + 1)
+    local.update({"source": local_src,
                   "node_hex": head_hex, "offset_s": 0.0})
     out.append(local)
     for source, chunks in list(getattr(head, "flight_spans",
                                        {}).items()):
+        cur = since.get(source, -1) if since is not None else -1
         for p in list(chunks):
+            evs = p.get("events") or []
+            if cur >= 0:
+                if not evs or evs[-1][0] <= cur:
+                    continue  # chunk fully consumed (records seq-sorted)
+                if evs[0][0] <= cur:
+                    p = dict(p, events=[r for r in evs if r[0] > cur])
             hx = p.get("node_hex")
             q = dict(p)
             q["source"] = source
@@ -531,6 +556,8 @@ def attribute_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     spmd_scatter_s = total_s(("spmd.scatter",))
     exec_s = total_s(("dag.exec",))
     serve_s = total_s(("serve.batch_drain",))
+    compile_s = total_s(("spmd.compile",))
+    ckpt_s = total_s(("ckpt.save", "ckpt.restore"))
     denom = wall_s or (spmd_compute_s + ingest_s) or None
     report: Dict[str, Any] = {
         "step_wall_s": round(wall_s, 6),
@@ -548,6 +575,8 @@ def attribute_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "spmd_scatter_s": round(spmd_scatter_s, 6),
         "dag_exec_s": round(exec_s, 6),
         "serve_batch_s": round(serve_s, 6),
+        "compile_s": round(compile_s, 6),
+        "checkpoint_s": round(ckpt_s, 6),
     }
     # spmd.gather/spmd.scatter are ONE-SHOT probe timings of the full
     # param-tree collectives (train/spmd.py make_collective_probes),
@@ -599,6 +628,10 @@ def format_attribution(report: Dict[str, Any]) -> str:
         lines.append(
             f"collectives/step   : {report['spmd_collective_vs_step']:.2f}x "
             f"one compute span (probe cost; streamed hides it in compute)")
+    if report.get("compile_s"):
+        lines.append(f"compile (1st step) : {report['compile_s']:.4f}s")
+    if report.get("checkpoint_s"):
+        lines.append(f"checkpoint io      : {report['checkpoint_s']:.4f}s")
     if report.get("dag_exec_s"):
         lines.append(f"dag executor busy  : {report['dag_exec_s']:.4f}s")
     if report.get("serve_batch_s"):

@@ -403,6 +403,29 @@ class MetricsHistory:
             return [{"tags": dict(tags), "points": [list(p) for p in ring]}
                     for tags, ring in by_tags.items()]
 
+    def query_pattern(self, pattern: str) -> Dict[str, List[Dict]]:
+        """Every series whose metric name matches ``pattern``, in one
+        response: an exact name, a prefix (trailing ``*``), or a regex
+        (fullmatch; a pattern that does not compile falls back to exact
+        match). ``{name: [{"tags": ..., "points": ...}]}`` sorted by
+        name — the multi-series form behind
+        ``/api/metrics/history?name=ray_tpu_train_*``."""
+        import re
+
+        with self._lock:
+            names = sorted(self._series)
+        if pattern.endswith("*") and not pattern.endswith(".*"):
+            prefix = pattern[:-1]
+            sel = [n for n in names if n.startswith(prefix)]
+        else:
+            try:
+                rx = re.compile(pattern)
+            except re.error:
+                sel = [n for n in names if n == pattern]
+            else:
+                sel = [n for n in names if rx.fullmatch(n)]
+        return {n: self.query(n) for n in sel}
+
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._series)

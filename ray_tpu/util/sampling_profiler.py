@@ -34,6 +34,37 @@ def _frame_name(f) -> str:
     return name.replace(";", ":").replace(" ", "_")
 
 
+def collect_stacks(duration_s: float = 0.2, interval_s: float = 0.005,
+                   depth: int = 16) -> str:
+    """One bounded, in-line collapsed-stack sample of this process.
+
+    Samples every thread except the caller's for ``duration_s`` and
+    returns the collapsed-stack text (same format ``start()`` dumps at
+    exit). This is the one-shot primitive behind ``python -m ray_tpu
+    stack``: the caller blocks for ``duration_s`` — run it off the
+    channel reader thread.
+    """
+    samples: collections.Counter = collections.Counter()
+    me = threading.get_ident()
+    deadline = time.monotonic() + max(0.0, duration_s)
+    while True:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None and len(stack) < depth:
+                stack.append(_frame_name(f))
+                f = f.f_back
+            samples[tuple(stack)] += 1
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(interval_s)
+    return "\n".join(
+        ";".join(reversed(stack)) + f" {count}"
+        for stack, count in sorted(samples.items(), key=lambda kv: -kv[1]))
+
+
 def start(path: str, interval_s: float = 0.002, depth: int = 8):
     # key: tuple of frames, leaf-first (the natural f_back walk order)
     samples: collections.Counter = collections.Counter()

@@ -1,0 +1,764 @@
+"""Goodput observatory: badput classifier, health detectors, stacks.
+
+Four layers of coverage. (1) Pure classifier math on synthetic span
+sets — SPMD and pipeline ledgers, multi-host averaging, recovery-gap
+folding from death/rejoin events, gauge publication. (2) Detector
+units with deterministic inputs — straggler and regression hysteresis
+(trigger once, no flapping, clear), TTRT baseline/recovery, the
+histogram-derived mean-latency series. (3) Surface plumbing — the
+history pattern query, the collapsed-stack sampler, the timeline
+``--goodput`` flag. (4) End to end — a real SPMD run whose goodput
+fraction agrees across ``goodput_report``, the registry gauges, and
+the dashboard API; an MPMD run with bubble attribution; and the chaos
+drill: a daemon SIGKILLed mid span-emitting loop must yield an
+attributed recovery gap, a TTRT record that closes when throughput
+returns, and straggler/regression events — all edge-triggered.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.core.config import global_config
+from ray_tpu.util import events as events_mod
+from ray_tpu.util import flight_recorder as fr
+from ray_tpu.util import goodput as gp
+from ray_tpu.util.metrics import Gauge, MetricsHistory, registry
+
+
+def wait_for(cond, timeout=60.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def span(name, ts_s, dur_s, src="node:aaaa", **tags):
+    """One merged Chrome-trace span event in classify_badput's shape."""
+    return {"ph": "X", "cat": "span", "name": name, "ts": ts_s * 1e6,
+            "dur": dur_s * 1e6, "pid": "node:aaaa", "tid": name,
+            "args": dict(tags, source=src)}
+
+
+def _death(ts, entity):
+    return {"ts": ts, "severity": "WARNING", "source": "NODE",
+            "entity_id": entity, "message": f"node {entity[:8]} dead",
+            "attrs": {}}
+
+
+def _alive(ts, entity):
+    return {"ts": ts, "severity": "INFO", "source": "NODE",
+            "entity_id": entity,
+            "message": f"node {entity[:8]} alive (daemon pid=1, rejoined)",
+            "attrs": {}}
+
+
+@pytest.fixture()
+def event_capture():
+    """Route cluster events to a local list; set_sink first drains any
+    pre-parked process-wide events, so assertions filter by content."""
+    captured = []
+    events_mod.set_sink(captured.extend, flush_interval_s=0.05)
+    yield captured
+    events_mod.clear_sink()
+
+
+# --------------------------------------------------------------------------- #
+# Badput classifier on synthetic spans
+# --------------------------------------------------------------------------- #
+
+
+class TestClassifier:
+    def test_spmd_ledger_decomposes_wall_clock(self):
+        """2 s compile + 10 steps of (0.1 ingest + 0.8 compute) over an
+        11 s window: every second is attributed, idle residual 0."""
+        events = [span("spmd.compile", 0.0, 2.0)]
+        t = 2.0
+        for _ in range(10):
+            events.append(span("spmd.ingest_wait", t, 0.1))
+            events.append(span("spmd.compute", t + 0.1, 0.8))
+            t += 0.9
+        led = gp.classify_badput(events)
+        assert led["window"]["wall_s"] == pytest.approx(11.0)
+        assert led["steps"] == 10
+        assert led["sources"] == 1
+        assert led["goodput_s"] == pytest.approx(8.0)
+        assert led["goodput_fraction"] == pytest.approx(8.0 / 11.0,
+                                                        abs=1e-3)
+        bp = led["badput_s"]
+        assert bp["ingest"] == pytest.approx(1.0)
+        assert bp["compile"] == pytest.approx(2.0)
+        assert bp["idle"] == pytest.approx(0.0, abs=1e-6)
+        assert bp["recovery"] == 0.0 and bp["bubble"] == 0.0
+
+    def test_multi_host_columns_average_not_sum(self):
+        """Two hosts each stalling 1 s on ingest is a 1 s column (the
+        run waited once), not 2 s — per-source sums are averaged."""
+        events = []
+        for src in ("n1:10", "n2:20"):
+            for i in range(4):
+                events.append(span("spmd.ingest_wait", i, 0.25, src=src))
+                events.append(span("spmd.compute", i + 0.25, 0.5,
+                                   src=src))
+        led = gp.classify_badput(events)
+        assert led["sources"] == 2
+        assert led["badput_s"]["ingest"] == pytest.approx(1.0)
+        assert led["goodput_s"] == pytest.approx(2.0)
+
+    def test_pipeline_bubble_is_k_normalized(self):
+        """1 s stepped wall, 2 stages each 0.4 s busy: productive is
+        busy/K = 0.4 s, bubble the other 0.6 s — the same accounting
+        as pipeline_stats()/attribute_trace."""
+        events = [
+            span("pipe.step", 0.0, 1.0),
+            span("pipe.fwd", 0.0, 0.25, stage=0),
+            span("pipe.bwd", 0.3, 0.15, stage=0),
+            span("pipe.fwd", 0.2, 0.2, stage=1),
+            span("pipe.loss_bwd", 0.5, 0.2, stage=1),
+        ]
+        led = gp.classify_badput(events)
+        assert led["steps"] == 1
+        assert led["goodput_s"] == pytest.approx(0.4)
+        assert led["badput_s"]["bubble"] == pytest.approx(0.6)
+        assert led["goodput_fraction"] == pytest.approx(0.4)
+
+    def test_recovery_gap_folds_death_and_rejoin(self):
+        events = [span("spmd.compute", float(i), 0.5) for i in range(10)]
+        rows = [_death(3.0, "ab" * 16), _alive(5.0, "ab" * 16)]
+        led = gp.classify_badput(events, rows)
+        assert led["badput_s"]["recovery"] == pytest.approx(2.0)
+        gaps = led["recovery_gaps"]
+        assert len(gaps) == 1
+        assert gaps[0]["entity"] == "abababab"
+        assert gaps[0]["gap_s"] == pytest.approx(2.0)
+
+    def test_unmatched_death_clips_to_window_end(self):
+        """A node that never rejoined bleeds recovery until the end of
+        the observed window; overlapping gaps union, not double-count."""
+        events = [span("spmd.compute", float(i), 0.5) for i in range(10)]
+        rows = [_death(4.0, "aa" * 16), _death(5.0, "bb" * 16)]
+        led = gp.classify_badput(events, rows)
+        # window end = 9.5; union of [4, 9.5] and [5, 9.5] is 5.5 s
+        assert led["badput_s"]["recovery"] == pytest.approx(5.5)
+        assert {g["entity"] for g in led["recovery_gaps"]} == \
+            {"aaaaaaaa", "bbbbbbbb"}
+
+    def test_empty_span_set_yields_null_fraction(self):
+        led = gp.classify_badput([])
+        assert led["goodput_fraction"] is None
+        assert led["window"]["wall_s"] == 0.0
+        text = gp.format_goodput(led)
+        assert "no train-plane spans" in text
+
+    def test_format_and_gauges_agree_with_ledger(self):
+        events = [span("spmd.compile", 0.0, 1.0),
+                  span("spmd.compute", 1.0, 3.0)]
+        led = gp.classify_badput(events, [_death(2.0, "cd" * 16)])
+        gp.publish_ledger(led)
+        snap = registry().snapshot()
+        frac = list(snap["ray_tpu_goodput_fraction"]["values"].values())
+        assert frac[0] == pytest.approx(led["goodput_fraction"])
+        badput = snap["ray_tpu_badput_seconds"]["values"]
+        assert sum(badput.values()) == pytest.approx(
+            sum(led["badput_s"].values()))
+        text = gp.format_goodput(led)
+        assert "goodput" in text and "compile" in text
+        assert "recovery gap" in text and "cdcdcdcd" in text
+
+
+class TestRecoveryIntervals:
+    def test_pairs_by_entity(self):
+        rows = [_death(1.0, "a" * 32), _death(2.0, "b" * 32),
+                _alive(4.0, "b" * 32), _alive(9.0, "a" * 32)]
+        got = gp.recovery_intervals(rows)
+        assert sorted(got) == [(1.0, 9.0, "a" * 32), (2.0, 4.0, "b" * 32)]
+
+    def test_open_death_uses_end_ts_never_negative(self):
+        rows = [_death(10.0, "a" * 32)]
+        assert gp.recovery_intervals(rows, end_ts=14.0) == \
+            [(10.0, 14.0, "a" * 32)]
+        # end_ts before the death must clamp, not go negative
+        assert gp.recovery_intervals(rows, end_ts=5.0) == \
+            [(10.0, 10.0, "a" * 32)]
+        assert gp.recovery_intervals(rows) == [(10.0, 10.0, "a" * 32)]
+
+    def test_ignores_non_node_rows(self):
+        rows = [{"ts": 1.0, "severity": "WARNING", "source": "TRAIN",
+                 "entity_id": "x", "message": "worker dead"}]
+        assert gp.recovery_intervals(rows) == []
+
+
+# --------------------------------------------------------------------------- #
+# Straggler detector hysteresis
+# --------------------------------------------------------------------------- #
+
+
+def _host_events(mean_by_src, n=4):
+    evs = []
+    for src, dur in mean_by_src.items():
+        for i in range(n):
+            evs.append(span("spmd.compute", float(i), dur, src=src))
+            evs.append(span("spmd.ingest_wait", float(i) + 0.5, dur / 10,
+                            src=src))
+    return evs
+
+
+class TestStragglerHysteresis:
+    def test_trigger_once_hold_clear(self, event_capture):
+        from ray_tpu.train.health import StragglerDetector
+
+        det = StragglerDetector()          # defaults: 1.5x / 1.2x / 4
+        # c at 2.0x the median: one trigger, with its span breakdown
+        ch = det.update(_host_events({"a": 0.1, "b": 0.1, "c": 0.2}))
+        assert [c["state"] for c in ch] == ["triggered"]
+        assert ch[0]["key"] == "host:c"
+        assert det.active == {"host:c": pytest.approx(2.0)}
+        warn = [e for e in event_capture
+                if "straggler" in e["message"]]
+        assert len(warn) == 1 and warn[0]["severity"] == "WARNING"
+        assert warn[0]["attrs"]["span_breakdown_s"]["spmd.compute"] == \
+            pytest.approx(0.2)
+        # same skew again: still active, NO second event (no flapping)
+        assert det.update(_host_events({"a": 0.1, "b": 0.1,
+                                        "c": 0.2})) == []
+        # between clear and trigger: holds silently
+        assert det.update(_host_events({"a": 0.1, "b": 0.1,
+                                        "c": 0.13})) == []
+        assert "host:c" in det.active
+        # below the clear threshold: exactly one INFO clear
+        ch = det.update(_host_events({"a": 0.1, "b": 0.1, "c": 0.11}))
+        assert [c["state"] for c in ch] == ["cleared"]
+        assert det.active == {}
+        clears = [e for e in event_capture
+                  if "straggler cleared" in e["message"]]
+        assert len(clears) == 1 and clears[0]["severity"] == "INFO"
+
+    def test_needs_two_peers_and_min_spans(self, event_capture):
+        from ray_tpu.train.health import StragglerDetector
+
+        det = StragglerDetector()
+        assert det.update(_host_events({"only": 0.5})) == []
+        assert det.update(_host_events({"a": 0.1, "c": 0.9}, n=2)) == []
+
+    def test_pipeline_stage_plane(self, event_capture):
+        from ray_tpu.train.health import StragglerDetector
+
+        det = StragglerDetector()
+        evs = []
+        for stage, dur in ((0, 0.1), (1, 0.1), (2, 0.2)):
+            for i in range(4):
+                evs.append(span("pipe.fwd", float(i), dur, stage=stage))
+        ch = det.update(evs)
+        assert [c["key"] for c in ch] == ["stage:2"]
+        assert ch[0]["state"] == "triggered"
+
+
+# --------------------------------------------------------------------------- #
+# Regression detector hysteresis + histogram-derived series
+# --------------------------------------------------------------------------- #
+
+
+class _FakeHistory:
+    def __init__(self, series):
+        self._s = series                    # name -> [series dict]
+
+    def query(self, name):
+        return [dict(s, points=[list(p) for p in s["points"]])
+                for s in self._s.get(name, [])]
+
+
+def _series(points, **tags):
+    return {"tags": dict(tags), "points": points}  # live reference
+
+
+class TestRegressionHysteresis:
+    def test_step_time_trigger_no_flap_clear(self, event_capture):
+        from ray_tpu.train.health import RegressionDetector
+
+        det = RegressionDetector()   # defaults: 1.3x / 1.1x / 8 / 3
+        pts = [[float(i), 0.1] for i in range(10)]
+        hist = _FakeHistory({"ray_tpu_train_step_seconds":
+                             [_series(pts, loop="spmd")]})
+        assert det.update(hist) == []       # healthy baseline
+        pts.extend([[10.0, 0.3], [11.0, 0.3], [12.0, 0.3]])
+        ch = det.update(hist, attribution="ingest")
+        assert [c["state"] for c in ch] == ["triggered"]
+        key = ch[0]["key"]
+        assert key == "ray_tpu_train_step_seconds{loop=spmd}"
+        warn = [e for e in event_capture if "regression:" in e["message"]]
+        assert len(warn) == 1
+        assert warn[0]["attrs"]["grew"] == "ingest"
+        assert "(grew: ingest)" in warn[0]["message"]
+        # still degraded: no re-emit
+        assert det.update(hist) == []
+        # recovery: recent back at baseline clears exactly once
+        pts.extend([[13.0, 0.1], [14.0, 0.1], [15.0, 0.1]])
+        ch = det.update(hist)
+        assert [c["state"] for c in ch] == ["cleared"]
+        assert det.active == {}
+        assert det.update(hist) == []
+        clears = [e for e in event_capture
+                  if "regression cleared" in e["message"]]
+        assert len(clears) == 1
+
+    def test_throughput_watches_downward(self, event_capture):
+        from ray_tpu.train.health import RegressionDetector
+
+        det = RegressionDetector()
+        pts = [[float(i), 100.0] for i in range(10)] + \
+            [[10.0, 40.0], [11.0, 40.0], [12.0, 40.0]]
+        hist = _FakeHistory({"ray_tpu_train_tokens_per_sec":
+                             [_series(pts, loop="spmd")]})
+        ch = det.update(hist)
+        assert [c["state"] for c in ch] == ["triggered"]
+        assert ch[0]["ratio"] == pytest.approx(2.5)
+
+    def test_histogram_mean_series_derivation(self, event_capture):
+        """serve dispatch latency rides _count/_sum rings only; the
+        watch derives the per-interval mean and triggers on it."""
+        from ray_tpu.train.health import (RegressionDetector,
+                                          _hist_mean_series)
+
+        counts, sums, total = [], [], 0.0
+        for i in range(16):
+            lat = 0.1 if i < 13 else 0.5
+            total += lat
+            counts.append([float(i), float(i + 1)])
+            sums.append([float(i), total])
+        hist = _FakeHistory({
+            "ray_tpu_serve_dispatch_seconds_count":
+                [_series(counts, deployment="m")],
+            "ray_tpu_serve_dispatch_seconds_sum":
+                [_series(sums, deployment="m")],
+        })
+        series = _hist_mean_series(hist, "ray_tpu_serve_dispatch_seconds")
+        assert len(series) == 1
+        means = [v for _ts, v in series[0]["points"]]
+        assert len(means) == 15             # first sample has no delta
+        assert means[0] == pytest.approx(0.1)
+        assert means[-1] == pytest.approx(0.5)
+        det = RegressionDetector()
+        ch = det.update(hist)
+        assert [c["state"] for c in ch] == ["triggered"]
+        assert ch[0]["key"] == \
+            "ray_tpu_serve_dispatch_seconds{deployment=m}"
+
+
+# --------------------------------------------------------------------------- #
+# TTRT tracker
+# --------------------------------------------------------------------------- #
+
+
+class TestTTRT:
+    def test_baseline_then_recovery(self, event_capture):
+        from ray_tpu.train.health import TTRTTracker
+
+        t = TTRTTracker()                   # recovery_fraction 0.2
+        pre = [(float(i), 100.0) for i in range(10)]
+        t.on_fault("de" * 16, 10.0, pre)
+        t.on_fault("de" * 16, 10.5, pre)    # one open record per entity
+        assert len(t.records) == 1
+        assert t.records[0]["baseline"] == pytest.approx(100.0)
+        assert t.update(pre) == []          # no post-fault points yet
+        # dip below the 80% floor does not recover; 85 does
+        pts = pre + [(12.0, 10.0), (15.0, 50.0), (25.0, 85.0)]
+        ch = t.update(pts)
+        assert len(ch) == 1
+        assert ch[0]["ttrt_s"] == pytest.approx(15.0)
+        assert t.update(pts) == []          # closed records stay closed
+        rec = t.summary()[0]
+        assert rec["recovered_ts"] == pytest.approx(25.0)
+        evs = [e for e in event_capture
+               if "throughput recovered" in e["message"]]
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["ttrt_s"] == pytest.approx(15.0)
+
+    def test_no_baseline_never_recovers(self, event_capture):
+        from ray_tpu.train.health import TTRTTracker
+
+        t = TTRTTracker()
+        t.on_fault("ab" * 16, 10.0, [])     # nothing pre-fault
+        assert t.records[0]["baseline"] == 0.0
+        assert t.update([(11.0, 50.0)]) == []
+
+
+# --------------------------------------------------------------------------- #
+# History pattern query + stack sampler + CLI flag
+# --------------------------------------------------------------------------- #
+
+
+G_PAT_A = Gauge("goodput_test_alpha", "pattern-query test series")
+G_PAT_B = Gauge("goodput_test_beta", "pattern-query test series")
+
+
+class TestPatternQuery:
+    def _hist(self):
+        G_PAT_A.set(1.0)
+        G_PAT_B.set(2.0)
+        mh = MetricsHistory(max_samples=8)
+        mh.sample(now=100.0)
+        return mh
+
+    def test_prefix_regex_exact_and_bad_pattern(self):
+        mh = self._hist()
+        got = mh.query_pattern("goodput_test_*")
+        assert {"goodput_test_alpha", "goodput_test_beta"} <= set(got)
+        assert got["goodput_test_alpha"][0]["points"] == [[100.0, 1.0]]
+        got = mh.query_pattern("goodput_test_(alpha|beta)")
+        assert set(got) == {"goodput_test_alpha", "goodput_test_beta"}
+        # exact name still works through the regex path
+        assert set(mh.query_pattern("goodput_test_alpha")) == \
+            {"goodput_test_alpha"}
+        # an uncompilable pattern degrades to exact match, not a 500
+        assert mh.query_pattern("goodput_test_(") == {}
+        assert mh.query_pattern("no_such_metric_*") == {}
+
+
+def test_collect_stacks_collapsed_format():
+    """The sampler sees a parked named thread and renders every line as
+    'frame;frame;... count' with the sampling thread itself excluded."""
+    from ray_tpu.util import sampling_profiler
+
+    stop = threading.Event()
+
+    def _goodput_test_parkbench():
+        stop.wait(5.0)
+
+    th = threading.Thread(target=_goodput_test_parkbench,
+                          name="gp-parkbench", daemon=True)
+    th.start()
+    try:
+        text = sampling_profiler.collect_stacks(duration_s=0.1)
+    finally:
+        stop.set()
+        th.join(timeout=5.0)
+    lines = [ln for ln in text.splitlines() if ln]
+    assert lines
+    for ln in lines:
+        stack, count = ln.rsplit(" ", 1)
+        assert stack and count.isdigit() and int(count) >= 1
+    assert any("_goodput_test_parkbench" in ln for ln in lines)
+    assert "collect_stacks" not in text     # caller thread excluded
+
+
+def test_timeline_goodput_flag(tmp_path, capsys):
+    """`timeline --input trace.json --goodput` folds an exported trace
+    offline into the same ledger rendering."""
+    from ray_tpu.__main__ import main as cli_main
+
+    evs = [span("spmd.compile", 0.0, 1.0),
+           span("spmd.compute", 1.0, 3.0)]
+    f = tmp_path / "trace.json"
+    f.write_text(json.dumps(evs))
+    rc = cli_main(["timeline", "--input", str(f), "--goodput"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "goodput" in out and "75.00%" in out
+
+
+# --------------------------------------------------------------------------- #
+# End to end: SPMD run -> CLI / API / metrics agree
+# --------------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def quiet_monitor_cfg():
+    """Fast span reporting, background samplers effectively off so the
+    tests drive monitor ticks and history sampling deterministically."""
+    cfg = global_config()
+    saved = (cfg.flight_recorder_min_span_us,
+             cfg.flight_recorder_report_interval_ms,
+             cfg.health_check_period_ms,
+             cfg.health_monitor_interval_ms,
+             cfg.metrics_history_interval_ms)
+    cfg.flight_recorder_min_span_us = 0.0
+    cfg.flight_recorder_report_interval_ms = 300
+    cfg.health_check_period_ms = 300
+    cfg.health_monitor_interval_ms = 3_600_000
+    cfg.metrics_history_interval_ms = 3_600_000
+    saved_min = fr._min_dur[0]
+    fr.configure(min_span_us=0.0)
+    yield cfg
+    (cfg.flight_recorder_min_span_us,
+     cfg.flight_recorder_report_interval_ms,
+     cfg.health_check_period_ms,
+     cfg.health_monitor_interval_ms,
+     cfg.metrics_history_interval_ms) = saved
+    fr.configure(min_span_us=saved_min)
+
+
+def _get_json(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        assert r.status == 200
+        return json.loads(r.read().decode())
+
+
+def test_spmd_goodput_agrees_cli_api_metrics(quiet_monitor_cfg):
+    """A real SPMD train loop: goodput_report, the registry gauges, and
+    GET /api/goodput all report the same fraction; /api/metrics/history
+    serves the goodput series through the pattern form; /api/stacks
+    strict-parses with the head process present."""
+    from ray_tpu.core.runtime import get_current_runtime
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.train.session import TrainContext, set_context
+    from ray_tpu.train.spmd import spmd_train_loop
+
+    ray_tpu.init(num_cpus=2, num_tpus=0)
+    dash = None
+    try:
+        fr.reset_for_tests()
+        fr.configure(enabled=True, min_span_us=0.0)
+        set_context(TrainContext(1, 0, 0, 1, 0))
+        try:
+            spmd_train_loop({"steps": 4, "batch_per_device": 1,
+                             "seq": 32, "mesh": "fsdp=2",
+                             "report_every": 2, "distinct_batches": 1})
+        finally:
+            set_context(None)
+        head = get_current_runtime().head
+        assert head.health_monitor is not None   # on by default
+        rep = gp.goodput_report(head)
+        assert rep["steps"] >= 3
+        assert rep["goodput_s"] > 0
+        assert 0.0 < rep["goodput_fraction"] <= 1.0
+        assert rep["badput_s"]["compile"] > 0    # first step = compile
+        assert "health" in rep
+        text = gp.format_goodput(rep)
+        assert "goodput" in text and "compile" in text
+        # the metrics plane carries the same numbers
+        snap = registry().snapshot()
+        frac = list(snap["ray_tpu_goodput_fraction"]["values"].values())
+        assert frac[0] == pytest.approx(rep["goodput_fraction"])
+        head.sample_metrics_history()
+        assert "ray_tpu_goodput_fraction" in \
+            head.metrics_history.query_pattern("ray_tpu_goodput_*")
+
+        dash = start_dashboard(port=0, with_jobs=False)
+        base = f"http://127.0.0.1:{dash.address[1]}"
+        api = _get_json(base, "/api/goodput")
+        assert api["goodput_fraction"] == pytest.approx(
+            rep["goodput_fraction"], abs=1e-6)
+        assert set(api["badput_s"]) == set(gp.BADPUT_CATEGORIES)
+        hist = _get_json(base, "/api/metrics/history?name=ray_tpu_goodput_*")
+        assert hist["pattern"] == "ray_tpu_goodput_*"
+        assert "ray_tpu_goodput_fraction" in hist["matches"]
+        # exact-name form keeps the original single-series shape
+        one = _get_json(base,
+                        "/api/metrics/history?name=ray_tpu_goodput_fraction")
+        assert one["name"] == "ray_tpu_goodput_fraction"
+        assert one["series"][0]["points"]
+        stacks = _get_json(base, "/api/stacks?duration_ms=100")
+        assert any(src.startswith("head:") for src in stacks)
+        assert all(isinstance(v, str) for v in stacks.values())
+    finally:
+        if dash is not None:
+            dash.stop()
+        ray_tpu.shutdown()
+
+
+def test_mpmd_run_attributes_bubble(quiet_monitor_cfg):
+    """A 2-stage MPMD run lands pipeline productive time AND a bubble
+    column in the ledger with a non-null fraction."""
+    from ray_tpu.core.runtime import get_current_runtime
+    from ray_tpu.train.pipeline import MPMDPipelineTrainer
+
+    rng = np.random.RandomState(11)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randn(16, 8).astype(np.float32)
+    steps, mb = 3, 2
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        fr.reset_for_tests()
+        trainer = MPMDPipelineTrainer([8, 16, 8], num_stages=2, lr=0.05,
+                                      seed=5)
+        try:
+            trainer.fit(x, y, steps=steps, num_microbatches=mb)
+            head = get_current_runtime().head
+
+            def pipe_spans():
+                n = 0
+                for chunks in head.flight_spans.values():
+                    for p in chunks:
+                        tbl = {int(k): v["name"]
+                               for k, v in p["names"].items()}
+                        n += sum(1 for r in p["events"]
+                                 if tbl.get(r[1], "").startswith("pipe."))
+                return n
+
+            wait_for(lambda: pipe_spans() >= 3 * steps * mb, timeout=30,
+                     msg="pipeline spans reported to head")
+            rep = gp.goodput_report(head)
+            assert rep["steps"] == steps
+            assert rep["goodput_s"] > 0
+            assert rep["goodput_fraction"] is not None
+            assert rep["badput_s"]["bubble"] >= 0.0
+            # the stage-busy seconds landed as pipeline productive time
+            # and the rendering carries the step count
+            assert f"{steps} steps" in gp.format_goodput(rep)
+        finally:
+            trainer.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------------------- #
+# Chaos drill: daemon kill mid span-emitting loop
+# --------------------------------------------------------------------------- #
+
+
+@ray_tpu.remote(resources={"gfast": 1})
+class _FastStepper:
+    def steps(self, n, dur):
+        from ray_tpu.train.spmd import _sp_compute
+        from ray_tpu.util import flight_recorder as wfr
+
+        for _ in range(n):
+            _sp_compute.end_at(wfr.now(), dur)
+        return os.getpid()
+
+
+@ray_tpu.remote(resources={"gslow": 1})
+class _SlowStepper:
+    def steps(self, n, dur):
+        from ray_tpu.train.spmd import _sp_compute
+        from ray_tpu.util import flight_recorder as wfr
+
+        for _ in range(n):
+            _sp_compute.end_at(wfr.now(), dur)
+        return os.getpid()
+
+
+def test_chaos_daemon_kill_yields_attributed_recovery_and_ttrt(
+        quiet_monitor_cfg):
+    """The acceptance drill: two daemons emit real spmd.compute spans
+    (one 5x slower -> straggler WARNING); the head's history rings get
+    a deterministic throughput/step-time series (-> regression WARNING
+    with a grown-category attribution); then one daemon is SIGKILLed
+    mid-run. The next ledger attributes a recovery gap to that node,
+    the TTRT tracker opens on the death event and closes once
+    throughput returns within 20% of baseline, and collect_stacks
+    still completes with the node gone (failed-waiter path)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train.spmd import _g_step_seconds, _g_tokens_per_sec
+
+    c = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        c.add_node(num_cpus=1, resources={"gfast": 1},
+                   separate_process=True)
+        c.add_node(num_cpus=1, resources={"gslow": 1},
+                   separate_process=True)
+        head = c.head
+        monitor = head.health_monitor
+        assert monitor is not None
+
+        def compute_spans():
+            n = 0
+            for chunks in head.flight_spans.values():
+                for p in chunks:
+                    tbl = {int(k): v["name"]
+                           for k, v in p["names"].items()}
+                    n += sum(1 for r in p["events"]
+                             if tbl.get(r[1]) == "spmd.compute")
+            return n
+
+        fast, slow = _FastStepper.remote(), _SlowStepper.remote()
+        slow_pid = ray_tpu.get(slow.steps.remote(6, 0.05), timeout=60)
+        ray_tpu.get(fast.steps.remote(6, 0.01), timeout=60)
+        assert slow_pid > 0
+        wait_for(lambda: compute_spans() >= 12, timeout=30,
+                 msg="worker compute spans reported to head")
+
+        # deterministic history series driven by the test, not the
+        # background sampler (quiet_monitor_cfg parks it)
+        t0 = time.time() - 60.0
+        for i in range(10):
+            _g_tokens_per_sec.set(100.0, tags={"loop": "spmd"})
+            _g_step_seconds.set(0.1, tags={"loop": "spmd"})
+            head.metrics_history.sample(registry(), now=t0 + i)
+        for i in range(3):
+            _g_step_seconds.set(0.4, tags={"loop": "spmd"})
+            head.metrics_history.sample(registry(), now=t0 + 10 + i)
+
+        ledger = monitor.tick()
+        assert ledger["goodput_s"] > 0
+        # straggler: the slow daemon's host key triggered exactly once
+        assert len(monitor.straggler.active) == 1
+        (skey,) = monitor.straggler.active
+        assert skey.startswith("host:")
+        # regression: step time degraded 4x vs rolling baseline
+        assert any(k.startswith("ray_tpu_train_step_seconds")
+                   for k in monitor.regression.active)
+        rows = head.state_list("cluster_events", 10_000)
+        assert any("straggler" in r["message"] for r in rows)
+        assert any("regression" in r["message"] for r in rows)
+
+        # SIGKILL the slow daemon; the health checker reports the death
+        slow_proxy = next(
+            n for n in head.nodes.values()
+            if getattr(n, "pid", None) is not None
+            and not hasattr(n, "store")
+            and (getattr(n, "resources_total", None) or {}).get("gslow"))
+        os.kill(slow_proxy.pid, signal.SIGKILL)
+
+        def dead_rows():
+            return [r for r in head.state_list("cluster_events", 10_000)
+                    if r["source"] == "NODE"
+                    and r["severity"] == "WARNING"
+                    and "dead" in r["message"]]
+
+        wait_for(lambda: dead_rows(), timeout=60,
+                 msg="node death event recorded")
+        death_ts = dead_rows()[0]["ts"]
+
+        # survivor keeps stepping: the span window now extends past the
+        # death, so the gap lands inside the observed run
+        before = compute_spans()
+        ray_tpu.get(fast.steps.remote(6, 0.01), timeout=60)
+        wait_for(lambda: compute_spans() >= before + 6, timeout=30,
+                 msg="post-fault spans reported")
+
+        # throughput dips, then recovers within 20% of baseline
+        _g_tokens_per_sec.set(10.0, tags={"loop": "spmd"})
+        head.metrics_history.sample(registry(), now=death_ts + 1.0)
+        ledger = monitor.tick()
+        assert ledger["badput_s"]["recovery"] > 0
+        assert any(g["entity"] == slow_proxy.hex[:8]
+                   for g in ledger["recovery_gaps"])
+        open_recs = [r for r in monitor.ttrt.summary()
+                     if r["recovered_ts"] is None]
+        assert open_recs and \
+            open_recs[0]["baseline"] == pytest.approx(100.0)
+
+        _g_tokens_per_sec.set(95.0, tags={"loop": "spmd"})
+        head.metrics_history.sample(registry(), now=death_ts + 4.0)
+        monitor.tick()
+        rec = next(r for r in monitor.ttrt.summary()
+                   if r["entity"] == slow_proxy.hex)
+        assert rec["recovered_ts"] is not None
+        assert rec["ttrt_s"] == pytest.approx(4.0, abs=1.5)
+        rows = head.state_list("cluster_events", 10_000)
+        assert any("throughput recovered" in r["message"] for r in rows)
+
+        # the full report renders every chapter of the story
+        rep = gp.goodput_report(head)
+        text = gp.format_goodput(rep)
+        assert "recovery gap" in text and "ttrt" in text
+        assert "straggler" in text
+
+        # stack collection survives the dead node: bounded, no hang
+        stacks = head.collect_stacks(timeout=10.0, duration_ms=100)
+        assert any(src.startswith("head:") for src in stacks)
+        assert slow_proxy.hex[:6] not in "".join(stacks)
+    finally:
+        c.shutdown()
