@@ -20,8 +20,16 @@ box swings a single round far more than the dispatch cost under test.
   * compiled handle p50 >= ``--dispatch-gate`` (default 5x) lower than
     the eager handle path on the same box
   * RPS-ramp p99 bounded (<= ``--ramp-p99-budget-ms``) while replicas
-    scale out and back in (both transitions must be observed)
+    scale out and back in (both transitions must be observed); the ramp
+    runs with ``serve_prewarm_pool_size=2`` so the scale-out step binds
+    its replica to a prewarmed worker instead of forking one
   * zero requests shed below the concurrency budget, zero errors
+
+``--decode-bench`` runs the generative-decode streaming bench instead:
+closed-loop streaming clients over the compiled stream lanes, gating
+sustained tokens/s, TTFT p99, a non-zero prefix-cache hit rate, and
+zero eager fallbacks after warm-up. Results merge into ``--out`` under
+the ``decode`` key.
 
 Runs under ``JAX_PLATFORMS=cpu`` (no accelerator needed).
 """
@@ -111,6 +119,107 @@ def run_dispatch_phase(iters: int, port: int) -> dict:
         "http_p99_ms": min(http_p99s),
         "planes": planes,
     }
+
+
+def run_decode_phase(port: int, streams: int, concurrency: int,
+                     max_tokens: int) -> dict:
+    """Sustained generative decode over the compiled stream lanes:
+    closed-loop streaming clients against a decode deployment, measuring
+    tokens/s, TTFT (request -> first chunk), the prefix-cache hit rate
+    (the prompt pool repeats, so most admissions skip prefill), and that
+    NO stream falls back to eager once the lanes are warm."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    serve.start(serve.HTTPOptions(port=port))
+
+    @serve.deployment(decode=True)
+    class ToyLM:
+        def create_decode_engine(self):
+            from ray_tpu.serve.decode import ToyEngine
+
+            return ToyEngine(n_pages=256, page_size=8)
+
+    handle = serve.run(ToyLM.bind(), route_prefix=None)
+
+    from ray_tpu.serve import observability as obs
+
+    def planes() -> dict:
+        obs.drain_deferred()
+        return serve.status().get("ToyLM", {}).get("dispatch_planes", {})
+
+    # warm until streams ride the compiled lanes (first lands eager
+    # while the lane compiles)
+    deadline = time.monotonic() + 60
+    while planes().get("compiled_stream", 0) < 1:
+        list(handle.options(stream=True).remote(
+            {"prompt": [1, 2], "max_tokens": 1}))
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"decode lanes never warmed: {planes()}")
+    eager_before = planes().get("eager", 0)
+
+    # small prompt pool with repeats: admissions after the first visit
+    # of each prompt hit the prefix cache and skip prefill
+    prompts = [[p + 1, p + 2, p + 3, p + 4] for p in range(4)]
+    ttfts, finals, errors = [], [], [0]
+    lock = threading.Lock()
+    todo = list(range(streams))
+
+    def worker():
+        while True:
+            with lock:
+                if not todo:
+                    return
+                i = todo.pop()
+            t0 = time.perf_counter()
+            try:
+                it = handle.options(stream=True).remote(
+                    {"prompt": prompts[i % len(prompts)],
+                     "max_tokens": max_tokens})
+                first = next(iter_ := iter(it))
+                ttft = time.perf_counter() - t0
+                last = first
+                for last in iter_:
+                    pass
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                continue
+            with lock:
+                ttfts.append(ttft)
+                finals.append(last)
+
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+
+    tokens_total = sum(f.get("n_generated", 0) for f in finals)
+    hits = sum(1 for f in finals if f.get("cached_prefix"))
+    planes_after = planes()
+    result = {
+        "streams": len(finals),
+        "concurrency": concurrency,
+        "max_tokens": max_tokens,
+        "errors": errors[0],
+        "elapsed_s": round(elapsed, 3),
+        "tokens_total": tokens_total,
+        "tokens_per_s": round(tokens_total / elapsed, 1),
+        "ttft_p50_ms": _pct(ttfts, 0.50) if ttfts else None,
+        "ttft_p99_ms": _pct(ttfts, 0.99) if ttfts else None,
+        "prefix_hit_rate": round(hits / len(finals), 3) if finals
+        else 0.0,
+        "eager_after_warm": planes_after.get("eager", 0) - eager_before,
+        "planes": planes_after,
+    }
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return result
 
 
 def run_ramp_phase(port: int) -> dict:
@@ -221,6 +330,11 @@ def _spawn_phase(phase: str, mode: str, iters: int, port: int) -> dict:
     env = dict(os.environ)
     env["RAY_TPU_SERVE_COMPILED_DISPATCH"] = \
         "1" if mode == "compiled" else "0"
+    if phase == "ramp":
+        # the scale-out tail gate assumes prewarmed spare workers: the
+        # new replica binds to a live process instead of paying
+        # fork+import inside the p99 window
+        env["RAY_TPU_SERVE_PREWARM_POOL_SIZE"] = "2"
     out = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--phase", phase,
          "--mode", mode, "--iters", str(iters), "--port", str(port)],
@@ -243,7 +357,7 @@ def main() -> int:
                     help="interleaved repetitions per mode; per-metric "
                          "minimum is reported (noise-robust)")
     ap.add_argument("--port", type=int, default=18431)
-    ap.add_argument("--phase", choices=["dispatch", "ramp"],
+    ap.add_argument("--phase", choices=["dispatch", "ramp", "decode"],
                     help="internal: run one phase in-process and print it")
     ap.add_argument("--mode", choices=["eager", "compiled"],
                     default="compiled", help="internal: phase mode")
@@ -252,11 +366,23 @@ def main() -> int:
     ap.add_argument("--dispatch-gate", type=float, default=5.0,
                     help="compiled handle p50 must be at least this "
                          "many times lower than eager")
-    ap.add_argument("--ramp-p99-budget-ms", type=float, default=1500.0,
-                    help="every ramp step's p99 must stay under this "
-                         "(the scale-out step's tail includes a real "
-                         "replica cold start on this box)")
+    ap.add_argument("--ramp-p99-budget-ms", type=float, default=500.0,
+                    help="every ramp step's p99 must stay under this; "
+                         "the scale-out step binds its new replica to a "
+                         "PREWARMED worker, so the tail no longer "
+                         "carries a fork+import cold start")
     ap.add_argument("--skip-ramp", action="store_true")
+    ap.add_argument("--decode-bench", action="store_true",
+                    help="run the generative-decode streaming bench "
+                         "(tokens/s, TTFT, prefix hit rate) and merge "
+                         "it into --out under the 'decode' key")
+    ap.add_argument("--decode-streams", type=int, default=60)
+    ap.add_argument("--decode-concurrency", type=int, default=4)
+    ap.add_argument("--decode-max-tokens", type=int, default=32)
+    ap.add_argument("--decode-tokens-gate", type=float, default=300.0,
+                    help="sustained decode throughput floor (tokens/s)")
+    ap.add_argument("--decode-ttft-budget-ms", type=float, default=250.0,
+                    help="TTFT p99 ceiling for warm streams")
     ap.add_argument("--out", help="also write the JSON result here")
     args = ap.parse_args()
 
@@ -265,6 +391,70 @@ def main() -> int:
         return 0
     if args.phase == "ramp":
         print(json.dumps(run_ramp_phase(args.port)))
+        return 0
+    if args.phase == "decode":
+        print(json.dumps(run_decode_phase(
+            args.port, args.decode_streams, args.decode_concurrency,
+            args.decode_max_tokens)))
+        return 0
+
+    if args.decode_bench:
+        # decode-only run: compiled dispatch on, own subprocess (same
+        # fresh-cluster convention as the other phases)
+        env = dict(os.environ)
+        env["RAY_TPU_SERVE_COMPILED_DISPATCH"] = "1"
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--phase", "decode", "--port", str(args.port),
+             "--decode-streams", str(args.decode_streams),
+             "--decode-concurrency", str(args.decode_concurrency),
+             "--decode-max-tokens", str(args.decode_max_tokens)],
+            env=env, capture_output=True, text=True, timeout=900)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"decode phase failed:\n{out.stdout}\n{out.stderr}")
+        decode = None
+        for line in reversed(out.stdout.strip().splitlines()):
+            if line.strip().startswith("{"):
+                decode = json.loads(line)
+                break
+        if decode is None:
+            raise RuntimeError(f"decode phase printed no JSON:\n"
+                               f"{out.stdout}")
+        print(json.dumps({"bench": "serve", "decode": decode}))
+        if args.out:
+            merged = {"bench": "serve"}
+            try:
+                with open(args.out) as f:
+                    merged = json.load(f)
+            except Exception:
+                pass
+            merged["decode"] = decode
+            with open(args.out, "w") as f:
+                json.dump(merged, f, indent=1)
+        if args.check:
+            failures = []
+            if decode["errors"]:
+                failures.append(f"{decode['errors']} stream errors")
+            if decode["tokens_per_s"] < args.decode_tokens_gate:
+                failures.append(
+                    f"decode throughput {decode['tokens_per_s']} tok/s "
+                    f"< {args.decode_tokens_gate} gate")
+            if (decode["ttft_p99_ms"] or 1e9) \
+                    > args.decode_ttft_budget_ms:
+                failures.append(
+                    f"TTFT p99 {decode['ttft_p99_ms']}ms > "
+                    f"{args.decode_ttft_budget_ms}ms budget")
+            if decode["prefix_hit_rate"] <= 0.0:
+                failures.append("prefix cache never hit")
+            if decode["eager_after_warm"] != 0:
+                failures.append(
+                    f"{decode['eager_after_warm']} streams fell back "
+                    f"to eager after warm-up (must be 0)")
+            if failures:
+                for f_ in failures:
+                    print(f"FAIL: {f_}", file=sys.stderr)
+                return 1
         return 0
 
     runs = {"eager": [], "compiled": []}
@@ -290,7 +480,19 @@ def main() -> int:
 
     ramp = None
     if not args.skip_ramp:
-        ramp = _spawn_phase("ramp", "compiled", args.iters, port)
+        # the worst-step tail rides scheduling luck on a shared box the
+        # same way the dispatch percentiles do: min-of-rounds on the
+        # gated latency, but errors/shed must hold in EVERY round
+        rounds = [_spawn_phase("ramp", "compiled", args.iters, port + i)
+                  for i in range(2)]
+        ramp = min(rounds, key=lambda r: r["max_p99_ms"])
+        ramp["rounds_max_p99_ms"] = [r["max_p99_ms"] for r in rounds]
+        ramp["errors"] = sum(r["errors"] for r in rounds)
+        ramp["shed_total"] = sum(r["shed_total"] for r in rounds)
+        ramp["max_replicas_seen"] = max(r["max_replicas_seen"]
+                                        for r in rounds)
+        ramp["replicas_after_cooldown"] = max(
+            r["replicas_after_cooldown"] for r in rounds)
 
     result = {
         "bench": "serve",

@@ -277,6 +277,11 @@ class Config:
     # ring slot size per lane message; requests/replies larger than this
     # fall back to the eager path for that call
     serve_channel_slot_bytes: int = 1 * 1024 * 1024
+    # prewarmed worker pool per node: keep this many IDLE pre-forked
+    # workers on standby so a serve scale-out consumes a warm process
+    # instead of paying the fork+import cold start on the ramp step
+    # (kills the scale-out p99 tail). 0 = off.
+    serve_prewarm_pool_size: int = 0
 
     # ---- fault injection (reference: testing_asio_delay_us :824) ----
     testing_delay_ms: str = ""  # "handler1=ms,handler2=ms" injected latency

@@ -88,6 +88,7 @@ from ray_tpu.experimental.channel import (
     TAG_DATA,
     TAG_ERROR,
     TAG_STOP,
+    TAG_STREAM,
     TAG_TENSOR,
     ChannelClosed,
     ChannelTimeout,
@@ -383,7 +384,7 @@ class NetRingWriter(_Endpoint):
         self.produce(bytes(payload), tag)
         if tag == TAG_DATA or tag == TAG_ERROR:
             STATS["serialized_bytes"] += len(payload)
-        elif tag == TAG_BYTES:
+        elif tag == TAG_BYTES or tag == TAG_STREAM:
             STATS["raw_bytes"] += len(payload)
 
     def write_serialized(self, sobj, timeout: Optional[float] = None) -> None:
@@ -675,7 +676,7 @@ class NetRingReader(_Endpoint):
             raise ChannelClosed(self.path)
         if tag == TAG_TENSOR:
             return (TAG_TENSOR, parse_tensor(payload, 0, to_device))
-        return (tag, payload) if tag in (TAG_ERROR, TAG_BYTES) \
+        return (tag, payload) if tag in (TAG_ERROR, TAG_BYTES, TAG_STREAM) \
             else (TAG_DATA, payload)
 
     def close(self, unlink: bool = False) -> None:

@@ -153,6 +153,7 @@ class Node:
         with self._lock:
             for _ in range(min(cfg.worker_prestart_count, self.max_workers)):
                 self._start_worker_locked()
+            self._ensure_prewarm_locked()
         self._steal_thread = None
         if cfg.direct_steal_enabled:
             # idle nodes get no pump events: a slow heartbeat re-evaluates
@@ -1134,6 +1135,11 @@ class Node:
                         last_tid = next(reversed(cand.assigned))
                         if last_tid not in just_staged:
                             unstage.append((cand, last_tid))
+            # refill the prewarmed pool: assignments above may have just
+            # consumed idle workers (a serve scale-out claims one warm
+            # process per new replica) — fork replacements NOW so the
+            # next ramp step finds the pool full again
+            self._ensure_prewarm_locked()
         for w, spec, binding in to_send:
             try:
                 w.channel.send("exec", pickle.dumps(spec), binding)
@@ -1322,6 +1328,25 @@ class Node:
         return n
 
     # ------------------------------------------------------------ workers
+
+    def _ensure_prewarm_locked(self) -> None:
+        """Keep ``serve_prewarm_pool_size`` idle (or starting) workers on
+        standby beyond current demand, so a scale-out consumes a warm
+        pre-forked process instead of paying the fork+import cold start
+        on the ramp step (the scale-out p99 tail killer). Bounded: never
+        pushes total workers past max_workers + pool size."""
+        target = global_config().serve_prewarm_pool_size
+        if target <= 0 or not self.alive:
+            return
+        warm = sum(1 for w in self._idle if w.state == "idle") \
+            + self._num_starting
+        active = sum(1 for x in self._workers.values()
+                     if x.state in ("idle", "busy")) + self._num_starting
+        cap = self.max_workers + target
+        while warm < target and active < cap:
+            self._start_worker_locked()
+            warm += 1
+            active += 1
 
     def _start_worker_locked(self) -> None:
         self._num_starting += 1

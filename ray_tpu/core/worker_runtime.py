@@ -634,6 +634,7 @@ class WorkerRuntime:
         priority = int(desc.get("priority") or 0)
         batch_max = int(desc.get("batch_max") or 0)
         direct_call = bool(desc.get("direct_call"))
+        stream_replies = bool(desc.get("stream_replies"))
         # backlog visibility hook (serve replicas): the instance can see
         # its own in-edge occupancy, so queued-in-ring requests count in
         # load signals (autoscaling) the same way eager in-flight does
@@ -670,7 +671,8 @@ class WorkerRuntime:
             try:
                 self._compiled_exec_loop(ins, outs, propagate, st, method,
                                          template, device, priority,
-                                         batch_max, direct_call)
+                                         batch_max, direct_call,
+                                         stream_replies)
             finally:
                 close_all()
 
@@ -679,7 +681,7 @@ class WorkerRuntime:
 
     def _compiled_exec_loop(self, ins, outs, propagate, st, method,
                             template, device, priority=0, batch_max=0,
-                            direct_call=False) -> None:
+                            direct_call=False, stream_replies=False) -> None:
         from ray_tpu.experimental.channel import (
             TAG_BYTES,
             TAG_ERROR,
@@ -768,6 +770,14 @@ class WorkerRuntime:
         def error_payload(exc) -> bytes:
             err = TaskError.from_exception(method_name, exc)
             return serialization.serialize(err).to_bytes()
+
+        # stream-reply mode (with_stream_batching): iteration-level
+        # continuous batching with many TAG_STREAM frames per request
+        if stream_replies and len(ins) == 1:
+            self._compiled_stream_loop(ins[0], outs, propagate, invoke,
+                                       error_payload, max(1, batch_max),
+                                       device, method_name)
+            return
 
         # batch_max >= 1 means the node DECLARED the list-in/list-out
         # contract (with_batching) — it applies even at window 1
@@ -891,6 +901,106 @@ class WorkerRuntime:
                     except Exception as e:  # unserializable result etc.
                         propagate(TAG_ERROR, error_payload(e))
             _sp_batch_drain.end(_t0, method_name)
+            if stop:
+                propagate(TAG_STOP)
+                return
+
+    def _compiled_stream_loop(self, ch, outs, propagate, invoke,
+                              error_payload, batch_max, device,
+                              method_name="stream") -> None:
+        """Iteration-level continuous batching (the Orca/vLLM admission
+        model): the method owns a RUNNING batch of multi-step requests.
+        Each round drains newly-arrived requests from the ring backlog —
+        BETWEEN model steps, not at batch boundaries — and calls the
+        method once with the new ``(corr, value)`` pairs (possibly none
+        while a batch is still decoding). The method returns
+        ``(replies, active)``: replies are ``(corr, kind, payload)``
+        frames shipped back as TAG_STREAM slots (kind "chunk" | "final"
+        | "error" — one request answers with MANY frames over its
+        lifetime), and ``active`` asks for an immediate re-invoke (a
+        decode step is pending) instead of parking for input.
+
+        Correlation needs no input framing: the lane in-edge is SPSC and
+        the driver assigns execution seqs in ring-write order under its
+        submit lock, so the arrival counter here IS the driver seq."""
+        from ray_tpu.experimental.channel import (
+            STREAM_F_ERROR,
+            STREAM_F_FINAL,
+            STREAM_F_RAW,
+            TAG_BYTES,
+            TAG_ERROR,
+            TAG_STOP,
+            TAG_STREAM,
+            TAG_TENSOR,
+            ChannelClosed,
+            pack_stream_frame,
+        )
+
+        def send(corr, flags, payload: bytes) -> None:
+            frame = pack_stream_frame(corr, flags, payload)
+            for out in outs:
+                try:
+                    out.write(frame, tag=TAG_STREAM)
+                except Exception:
+                    pass  # ring closed (teardown race)
+
+        corr_counter = 0
+        active = False
+        while True:
+            entries = []      # (corr, value) newly admitted this round
+            stop = False
+            while len(entries) < batch_max:
+                if entries or active:
+                    # a batch is running (or this round already admitted
+                    # work): take only what is ALREADY queued — never
+                    # stall a pending decode step waiting for arrivals
+                    try:
+                        if not ch.readable():
+                            break
+                    except Exception:
+                        return  # channel closed (teardown race)
+                try:
+                    tag, payload = ch.read(timeout=None, to_device=device)
+                except ChannelClosed:
+                    stop = True
+                    break
+                except Exception:
+                    return  # channel unlinked (teardown race)
+                corr = corr_counter
+                corr_counter += 1
+                if tag == TAG_ERROR:
+                    # upstream error passthrough: the request dies before
+                    # admission, but its stream must still complete
+                    send(corr, STREAM_F_FINAL | STREAM_F_ERROR, payload)
+                elif tag == TAG_TENSOR or tag == TAG_BYTES:
+                    entries.append((corr, payload))
+                else:
+                    entries.append((corr,
+                                    serialization.deserialize(payload)))
+            if stop and not active and not entries:
+                propagate(TAG_STOP)
+                return
+            try:
+                replies, active = invoke([entries])
+            except Exception as e:  # noqa: BLE001 — ship to consumers
+                # scheduler-step failure: fail the requests admitted THIS
+                # round (the method owns bookkeeping for older ones, and
+                # a dead process is handled by the driver's FSM probe)
+                pl = error_payload(e)
+                for corr, _ in entries:
+                    send(corr, STREAM_F_FINAL | STREAM_F_ERROR, pl)
+                replies, active = [], False
+            for corr, kind, payload in replies:
+                if kind == "error":
+                    send(corr, STREAM_F_FINAL | STREAM_F_ERROR,
+                         error_payload(payload))
+                    continue
+                flags = STREAM_F_FINAL if kind == "final" else 0
+                if type(payload) is bytes:
+                    flags |= STREAM_F_RAW
+                else:
+                    payload = serialization.serialize(payload).to_bytes()
+                send(corr, flags, payload)
             if stop:
                 propagate(TAG_STOP)
                 return

@@ -43,6 +43,9 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.core import serialization
 from ray_tpu.experimental.channel import (
+    STREAM_F_ERROR,
+    STREAM_F_FINAL,
+    STREAM_F_RAW,
     TAG_BYTES,
     TAG_ERROR,
     TAG_STOP,
@@ -50,6 +53,7 @@ from ray_tpu.experimental.channel import (
     ChannelTimeout,
     ShmChannel,
     channel_path,
+    unpack_stream_frame,
 )
 from ray_tpu.experimental.channel import is_arraylike as _is_arraylike
 from ray_tpu.util import flight_recorder as _fr
@@ -113,6 +117,10 @@ class ClassMethodNode(DAGNode):
         # against the actor's eager calls (serve replicas are: their
         # eager plane already runs sync methods concurrently)
         self.direct_call = False
+        # stream-reply mode (generative decode): the exec loop feeds the
+        # method (corr, value) pairs and the method answers each request
+        # with MANY TAG_STREAM frames over time (see with_stream_batching)
+        self.stream_replies = False
 
     def with_priority(self, priority: int) -> "ClassMethodNode":
         self.priority = int(priority)
@@ -135,6 +143,25 @@ class ClassMethodNode(DAGNode):
 
     def with_direct_call(self) -> "ClassMethodNode":
         self.direct_call = True
+        return self
+
+    def with_stream_batching(self, batch_max: int) -> "ClassMethodNode":
+        """Enable stream-reply batch mode (iteration-level continuous
+        batching): the exec loop drains newly-arrived requests from the
+        single in-edge BETWEEN invocations and calls the method with a
+        list of ``(corr, value)`` pairs (possibly empty while a batch is
+        still RUNNING). The method returns ``(replies, active)`` where
+        ``replies`` is a list of ``(corr, kind, payload)`` frames
+        (kind: "chunk" | "final" | "error") written back as TAG_STREAM
+        slots, and ``active`` asks the loop to call again immediately
+        (decode in progress) instead of blocking for new input."""
+        if batch_max < 1:
+            raise ValueError(f"batch_max must be >= 1, got {batch_max}")
+        if len(self.upstreams) != 1:
+            raise ValueError(
+                "stream batching requires exactly one in-edge")
+        self.batch_max = int(batch_max)
+        self.stream_replies = True
         return self
 
     def experimental_compile(self, buffer_size_bytes: int = 4 * 1024 * 1024,
@@ -227,6 +254,64 @@ class CompiledDAGRef:
         return self._value
 
 
+class CompiledStreamRef:
+    """Handle for one execution on a stream-reply DAG: an iterator of
+    reply frames. Frames for DIFFERENT executions interleave on the one
+    output ring; the DAG demuxes them into per-seq buffers (whichever
+    waiting reader can take the read lock pumps for everyone), so readers
+    consume their own stream independently and in order.
+
+    ``next()`` never wedges on a dead executor: pump rounds are bounded
+    and probe the actor FSM, so a replica killed mid-stream surfaces as
+    an attributed ActorDiedError on every open stream."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._finished = False
+        self._error: Optional[BaseException] = None
+
+    @property
+    def seq(self) -> int:
+        return self._seq
+
+    @property
+    def finished(self) -> bool:
+        return self._finished
+
+    def next(self, timeout: Optional[float] = 30.0):
+        """Return the next ``(flags, body)`` frame for this execution.
+        Raises StopIteration after the FINAL frame was returned,
+        ChannelTimeout if no frame arrives in time (retryable), or the
+        stream's terminal error (cached: re-raised on every later call)."""
+        if self._error is not None:
+            raise self._error
+        if self._finished:
+            raise StopIteration
+        try:
+            frame = self._dag._next_stream_frame(self._seq, timeout)
+        except ChannelTimeout:
+            raise  # frame may still arrive: stay retryable
+        except Exception as e:
+            self._error = e
+            raise
+        if frame is None:  # buffer drained after FINAL already consumed
+            self._finished = True
+            raise StopIteration
+        flags, body = frame
+        if flags & STREAM_F_FINAL:
+            self._finished = True
+        return flags, body
+
+    def __del__(self):
+        # GC-safe: only a lock-free deque append (see CompiledDAG.discard)
+        try:
+            if not self._finished and self._error is None:
+                self._dag.discard_stream(self._seq)
+        except Exception:
+            pass
+
+
 class CompiledDAG:
     def __init__(self, output_node: ClassMethodNode, buffer_size: int,
                  device_channels: bool = False, max_inflight: int = 4):
@@ -294,6 +379,16 @@ class CompiledDAG:
         self._torn_down = False
         self._channels: List[ShmChannel] = []
         self._input_chans: List[ShmChannel] = []
+        # stream-reply demux state (see CompiledStreamRef): per-seq frame
+        # buffers + completion set, guarded by _stream_cv. Readers that
+        # cannot take _read_lock (someone else is pumping) wait here.
+        self._stream = bool(getattr(output_node, "stream_replies", False))
+        self._stream_bufs: Dict[int, collections.deque] = {}
+        self._stream_done: set = set()
+        self._stream_completed = 0
+        self._stream_discard_queue: "collections.deque" = collections.deque()
+        self._stream_discards: set = set()
+        self._stream_cv = threading.Condition()
         self._build()
 
     @staticmethod
@@ -511,6 +606,8 @@ class CompiledDAG:
                     "priority": getattr(task, "priority", 0),
                     "batch_max": getattr(task, "batch_max", 0),
                     "direct_call": getattr(task, "direct_call", False),
+                    "stream_replies": getattr(task, "stream_replies",
+                                              False),
                 }))
             ray_tpu.get(acks, timeout=60)
         except BaseException:
@@ -615,6 +712,10 @@ class CompiledDAG:
         the next execute() rebind."""
         _fr.dump(f"executor-death:{type(err).__name__}")
         self._broken = err
+        # stream readers parked on the demux condition must observe the
+        # death NOW, not after their wait times out
+        with self._stream_cv:
+            self._stream_cv.notify_all()
         self._poison_all()
         if not restartable:
             self._torn_down = True
@@ -755,7 +856,11 @@ class CompiledDAG:
     def inflight(self) -> int:
         """Executions submitted but not yet drained from the output ring
         — the per-DAG admission signal (rings + executor occupancy).
-        Racy by nature (lock-free reads); callers treat it as a hint."""
+        Racy by nature (lock-free reads); callers treat it as a hint.
+        Stream mode counts an execution in flight until its FINAL frame
+        is demuxed (not merely until the first reply arrives)."""
+        if self._stream:
+            return self._next_seq - self._stream_completed
         return self._next_seq - self._next_read
 
     def input_writable(self) -> bool:
@@ -777,6 +882,125 @@ class CompiledDAG:
         the next _read_result drains the queue and drops the payload
         instead of caching it forever."""
         self._discard_queue.append(seq)
+
+    # ------------------------------------------------------ stream replies
+
+    def execute_stream(self, value: Any,
+                       timeout: Optional[float] = 60.0) -> CompiledStreamRef:
+        """Submit one execution on a stream-reply DAG and return the
+        frame iterator for its replies. Same all-or-nothing input
+        semantics as :meth:`execute`."""
+        if not self._stream:
+            raise ValueError("execute_stream requires a DAG compiled from "
+                             "a with_stream_batching() node")
+        ref = self.execute(value, timeout)
+        with self._stream_cv:
+            self._stream_bufs.setdefault(ref._seq, collections.deque())
+        return CompiledStreamRef(self, ref._seq)
+
+    def discard_stream(self, seq: int) -> None:
+        """Abandon a stream mid-flight (ref holder dropped). GC-safe:
+        lock-free append; the pump drops this seq's remaining frames and
+        counts it complete when its FINAL frame passes through."""
+        self._stream_discard_queue.append(seq)
+
+    def _apply_stream_discards_cv(self) -> None:
+        # caller holds _stream_cv
+        while True:
+            try:
+                s = self._stream_discard_queue.popleft()
+            except IndexError:
+                break
+            buf = self._stream_bufs.pop(s, None)
+            if s in self._stream_done:
+                self._stream_done.discard(s)
+            elif buf is not None or s < self._next_seq:
+                self._stream_discards.add(s)
+
+    def _pump_stream_locked(self, round_t: float) -> None:
+        """Read ONE message off the shared output ring (caller holds
+        _read_lock) and demux it into the per-seq buffers. A timeout
+        round probes the executor FSM so a killed replica attributes
+        instead of wedging every reader."""
+        try:
+            tag, payload = self._out.read(round_t)
+        except ChannelTimeout:
+            err, restartable = self._probe_dead()
+            if err is not None:
+                self._handle_executor_death(err, restartable)
+                raise err
+            return  # caller re-checks its deadline
+        except ChannelClosed:
+            err, restartable = self._probe_dead()
+            if err is not None:
+                self._handle_executor_death(err, restartable)
+                raise err
+            raise
+        corr, flags, body = unpack_stream_frame(payload)
+        with self._stream_cv:
+            self._apply_stream_discards_cv()
+            final = bool(flags & STREAM_F_FINAL)
+            if corr in self._stream_discards:
+                if final:
+                    self._stream_discards.discard(corr)
+                    self._stream_completed += 1
+            else:
+                self._stream_bufs.setdefault(
+                    corr, collections.deque()).append((flags, body))
+                if final:
+                    self._stream_done.add(corr)
+                    self._stream_completed += 1
+            self._stream_cv.notify_all()
+
+    def _next_stream_frame(self, seq: int, timeout: Optional[float]):
+        """Next buffered frame for ``seq`` (None = stream already fully
+        consumed). Whichever reader finds its buffer empty and can take
+        _read_lock pumps the shared ring for everyone; readers that lose
+        the lock race wait on the condition instead of contending."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            with self._stream_cv:
+                self._apply_stream_discards_cv()
+                buf = self._stream_bufs.get(seq)
+                if buf:
+                    frame = buf.popleft()
+                    if not buf and seq in self._stream_done:
+                        del self._stream_bufs[seq]
+                        self._stream_done.discard(seq)
+                    return frame
+                if seq in self._stream_done:
+                    self._stream_bufs.pop(seq, None)
+                    self._stream_done.discard(seq)
+                    return None
+                if self._broken is not None:
+                    raise self._broken
+                if self._torn_down:
+                    raise RuntimeError("compiled DAG was torn down")
+            remaining = (None if deadline is None
+                         else deadline - _time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise ChannelTimeout(
+                    f"no stream frame for execution #{seq} "
+                    f"within {timeout}s")
+            round_t = 1.0 if remaining is None else min(1.0, remaining)
+            # deliberate: the winning reader performs one bounded ring
+            # read under _read_lock on behalf of every stream — the ring
+            # is single-consumer, so the read MUST be exclusive
+            # graftlint: ignore[blocking-under-lock]
+            if self._read_lock.acquire(timeout=0.05):
+                try:
+                    self._pump_stream_locked(round_t)
+                finally:
+                    self._read_lock.release()
+            else:
+                # someone else is pumping: wait for their demux notify
+                with self._stream_cv:
+                    if not self._stream_bufs.get(seq) \
+                            and seq not in self._stream_done \
+                            and self._broken is None:
+                        self._stream_cv.wait(timeout=min(0.2, round_t))
 
     _MISS = object()
 

@@ -64,6 +64,31 @@ TAG_STOP = 1
 TAG_ERROR = 2
 TAG_TENSOR = 3  # typed array payload: no serialization layer at all
 TAG_BYTES = 4   # raw bytes payload: serializer skipped entirely
+TAG_STREAM = 5  # one frame of a multi-reply stream (see stream_frame)
+
+# ---------------------------------------------------------------- stream
+# Multi-reply framing for TAG_STREAM slots. A streaming node answers one
+# request with MANY ring slots; each slot carries a fixed header binding
+# the frame to its request (``corr`` — on an SPSC lane the driver assigns
+# input seqs in ring-write order, so the worker's arrival counter IS the
+# driver seq) plus flag bits. Framing rides INSIDE the slot payload: the
+# ring publish/consume protocol itself is unchanged (same model as
+# tools/lint/ring_model.py — no new ordering states).
+_STREAM_HDR = struct.Struct("<QB")
+STREAM_F_FINAL = 1   # last frame for this corr; completes the request
+STREAM_F_ERROR = 2   # body is a serialized TaskError (implies FINAL)
+STREAM_F_RAW = 4     # body is raw bytes (serializer skipped); else
+#                      body is serializer output
+
+
+def pack_stream_frame(corr: int, flags: int, body: bytes) -> bytes:
+    return _STREAM_HDR.pack(corr, flags) + body
+
+
+def unpack_stream_frame(payload: bytes):
+    """-> (corr, flags, body)"""
+    corr, flags = _STREAM_HDR.unpack_from(payload, 0)
+    return corr, flags, payload[_STREAM_HDR.size:]
 
 # per-process transfer accounting (the "host-copy metric": serialized
 # bytes went through the pickle layer; tensor/raw bytes moved
@@ -447,7 +472,7 @@ class ShmChannel:
         self._publish(len(payload), tag, timeout, fill)
         if tag == TAG_DATA or tag == TAG_ERROR:
             STATS["serialized_bytes"] += len(payload)
-        elif tag == TAG_BYTES:
+        elif tag == TAG_BYTES or tag == TAG_STREAM:
             STATS["raw_bytes"] += len(payload)
 
     def write_serialized(self, sobj, timeout: Optional[float] = None) -> None:
@@ -508,7 +533,7 @@ class ShmChannel:
             self._ring(self._bell_free)
         if tag == TAG_STOP:
             raise ChannelClosed(self.path)
-        return (tag, payload) if tag in (TAG_ERROR, TAG_BYTES) \
+        return (tag, payload) if tag in (TAG_ERROR, TAG_BYTES, TAG_STREAM) \
             else (TAG_DATA, payload)
 
     def _read_tensor(self, off: int, to_device: bool):

@@ -365,6 +365,202 @@ def loss_fn(cfg: LlamaConfig, params, tokens, mesh=None):
 
 
 # --------------------------------------------------------------------------- #
+# Generative decode (paged KV cache — serve/kv_cache.py owns the pages)
+# --------------------------------------------------------------------------- #
+
+
+def _gqa_repeat(cfg: LlamaConfig, k, v):
+    rep = cfg.n_heads // cfg.n_kv_heads
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def _layer_kv(cfg: LlamaConfig, x, p, positions):
+    """One decoder layer that also RETURNS its (rotated) k/v — the
+    prefill path of the KV cache. Single-host (mesh=None), plain fp32
+    attention: decode numerics never depend on prefill matching a fused
+    kernel, only on the cached k/v bytes themselves."""
+    cd = cfg.dtype
+    B, T, d = x.shape
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps).astype(cd)
+    q = (h @ p["wq"].astype(cd)).reshape(B, T, cfg.n_heads, cfg.head_dim)
+    kk = (h @ p["wk"].astype(cd)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    vv = (h @ p["wv"].astype(cd)).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q, kk = rotary_embedding(q, kk, positions, cfg.rope_theta)
+    kr, vr = _gqa_repeat(cfg, kk, vv)
+    attn = plain_attention(q, kr, vr, causal=True)
+    attn = attn.reshape(B, T, cfg.n_heads * cfg.head_dim)
+    x = x + (attn @ p["wo"].astype(cd)).astype(x.dtype)
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps).astype(cd)
+    g = jax.nn.silu(h @ p["w_gate"].astype(cd))
+    u = h @ p["w_up"].astype(cd)
+    x = x + ((g * u) @ p["w_down"].astype(cd)).astype(x.dtype)
+    return x, kk, vv
+
+
+def prefill_with_cache(cfg: LlamaConfig, params, tokens):
+    """tokens [1, T] int32 (right-padded is fine: causal masking keeps
+    pad garbage out of real positions) -> (logits [1, T, vocab] fp32,
+    k [L, 1, T, n_kv, head_dim], v [...]) — k/v are post-RoPE, i.e. the
+    bytes the paged cache stores."""
+    B, T = tokens.shape
+    x = embed_tokens(cfg, params, tokens, None)
+    positions = jnp.arange(T, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+
+    def body(carry, layer_params):
+        h, kk, vv = _layer_kv(cfg, carry, layer_params, positions)
+        return h, (kk, vv)
+
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x.astype(cfg.dtype)
+              @ _head(cfg, params).astype(cfg.dtype)).astype(jnp.float32)
+    return logits, ks, vs
+
+
+def _layer_decode(cfg: LlamaConfig, x, p, positions, k_cache, v_cache,
+                  length):
+    """One decoder layer for a single new token against a gathered,
+    page-padded KV view. ``k_cache``/``v_cache``: [Tpad, n_kv, head_dim]
+    (positions >= ``length`` are pad garbage, masked out). Returns the
+    residual stream plus the new token's k/v for the cache write."""
+    cd = cfg.dtype
+    h = rms_norm(x, p["attn_norm"], cfg.norm_eps).astype(cd)
+    q = (h @ p["wq"].astype(cd)).reshape(1, 1, cfg.n_heads, cfg.head_dim)
+    kk = (h @ p["wk"].astype(cd)).reshape(1, 1, cfg.n_kv_heads,
+                                          cfg.head_dim)
+    vv = (h @ p["wv"].astype(cd)).reshape(1, 1, cfg.n_kv_heads,
+                                          cfg.head_dim)
+    q, kk = rotary_embedding(q, kk, positions, cfg.rope_theta)
+    Tpad = k_cache.shape[0]
+    K = jnp.concatenate([k_cache.astype(cd)[None], kk], axis=1)
+    V = jnp.concatenate([v_cache.astype(cd)[None], vv], axis=1)
+    K, V = _gqa_repeat(cfg, K, V)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   K.astype(jnp.float32)) * scale
+    idx = jnp.arange(Tpad + 1)
+    valid = (idx < length) | (idx == Tpad)  # history + the token itself
+    s = jnp.where(valid[None, None, None, :], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                      V.astype(jnp.float32)).astype(cd)
+    attn = attn.reshape(1, 1, cfg.n_heads * cfg.head_dim)
+    x = x + (attn @ p["wo"].astype(cd)).astype(x.dtype)
+    h = rms_norm(x, p["mlp_norm"], cfg.norm_eps).astype(cd)
+    g = jax.nn.silu(h @ p["w_gate"].astype(cd))
+    u = h @ p["w_up"].astype(cd)
+    x = x + ((g * u) @ p["w_down"].astype(cd)).astype(x.dtype)
+    return x, kk[:, 0], vv[:, 0]
+
+
+def decode_step_with_cache(cfg: LlamaConfig, params, token, pos, k_cache,
+                           v_cache):
+    """One decode step. token [1] int32; pos: scalar int32 (the KV write
+    position = tokens so far); k/v_cache [L, Tpad, n_kv, head_dim]
+    page-padded views -> (logits [vocab] fp32, k_new [L, n_kv, head_dim],
+    v_new [...]). pos is traced, so one compilation covers every step at
+    a given padded length — recompiles are bounded by the page count."""
+    x = embed_tokens(cfg, params, token[None, :], None)
+    positions = jnp.full((1, 1), pos, dtype=jnp.int32)
+
+    def body(carry, xs):
+        p, kc, vc = xs
+        h, kn, vn = _layer_decode(cfg, carry, p, positions, kc, vc, pos)
+        return h, (kn, vn)
+
+    x, (kns, vns) = jax.lax.scan(body, x,
+                                 (params["layers"], k_cache, v_cache))
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    logits = (x.astype(cfg.dtype)
+              @ _head(cfg, params).astype(cfg.dtype)).astype(jnp.float32)
+    return logits[0, 0], kns[:, 0], vns[:, 0]
+
+
+class LlamaDecodeEngine:
+    """Paged-KV decode engine over the functional llama model — the
+    engine protocol :class:`ray_tpu.serve.decode.DecodeScheduler` drives
+    (prefill/decode/copy_page + pool/prefix_cache/page_size).
+
+    Physical pages live in two numpy stores indexed by pool page id:
+    ``[n_pages, page_size, L, n_kv, head_dim]``. prefill scatters the
+    scan's k/v into pages; decode gathers the sequence's page table into
+    a contiguous page-padded view (positions beyond the true length are
+    masked inside the kernel, so padded-length compilations are reused
+    across sequences and steps)."""
+
+    def __init__(self, cfg: Optional[LlamaConfig] = None, params=None, *,
+                 n_pages: int = 64, page_size: int = 8, seed: int = 0):
+        from ray_tpu.serve.kv_cache import PagePool, PrefixCache
+
+        self.cfg = cfg or LlamaConfig.debug()
+        if params is None:
+            params = init_params(self.cfg, jax.random.PRNGKey(seed))
+        self.params = params
+        self.page_size = int(page_size)
+        self.pool = PagePool(n_pages, page_size)
+        self.prefix_cache = PrefixCache(self.pool)
+        c = self.cfg
+        shape = (n_pages, page_size, c.n_layers, c.n_kv_heads, c.head_dim)
+        import numpy as np
+
+        self._np = np
+        self.k_store = np.zeros(shape, np.float32)
+        self.v_store = np.zeros(shape, np.float32)
+        self._prefill_fn = jax.jit(partial(prefill_with_cache, self.cfg))
+        self._decode_fn = jax.jit(partial(decode_step_with_cache, self.cfg))
+        self.prefill_calls = 0
+        self.decode_calls = 0
+
+    def prefill(self, tokens, pages):
+        np = self._np
+        self.prefill_calls += 1
+        T = len(tokens)
+        tpad = len(pages) * self.page_size
+        toks = np.zeros((1, tpad), np.int32)
+        toks[0, :T] = tokens
+        logits, ks, vs = self._prefill_fn(self.params, jnp.asarray(toks))
+        ks = np.asarray(ks, np.float32)  # [L, 1, Tpad, nkv, hd]
+        vs = np.asarray(vs, np.float32)
+        for pi, page in enumerate(pages):
+            lo = pi * self.page_size
+            hi = min(lo + self.page_size, T)
+            if hi <= lo:
+                break
+            # [L, span, nkv, hd] -> store layout [span, L, nkv, hd]
+            self.k_store[page, :hi - lo] = np.transpose(
+                ks[:, 0, lo:hi], (1, 0, 2, 3))
+            self.v_store[page, :hi - lo] = np.transpose(
+                vs[:, 0, lo:hi], (1, 0, 2, 3))
+        return np.asarray(logits, np.float32)[0, T - 1].copy()
+
+    def decode(self, pos, token, pages):
+        np = self._np
+        self.decode_calls += 1
+        tpad = len(pages) * self.page_size
+        # gather [n_seq_pages, page_size, L, nkv, hd] -> [L, Tpad, nkv, hd]
+        kc = np.transpose(
+            self.k_store[pages].reshape(tpad, *self.k_store.shape[2:]),
+            (1, 0, 2, 3))
+        vc = np.transpose(
+            self.v_store[pages].reshape(tpad, *self.v_store.shape[2:]),
+            (1, 0, 2, 3))
+        logits, kn, vn = self._decode_fn(
+            self.params, jnp.asarray([int(token)], jnp.int32),
+            jnp.int32(pos), jnp.asarray(kc), jnp.asarray(vc))
+        pg, off = divmod(pos, self.page_size)
+        self.k_store[pages[pg], off] = np.asarray(kn, np.float32)
+        self.v_store[pages[pg], off] = np.asarray(vn, np.float32)
+        return np.asarray(logits, np.float32).copy()
+
+    def copy_page(self, src: int, dst: int) -> None:
+        self.k_store[dst] = self.k_store[src]
+        self.v_store[dst] = self.v_store[src]
+
+
+# --------------------------------------------------------------------------- #
 # Train step (GSPMD)
 # --------------------------------------------------------------------------- #
 

@@ -44,11 +44,12 @@ lane build failure (a cooldown retries later).
 
 from __future__ import annotations
 
+import json
 import logging
 import random
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.core.config import global_config
@@ -156,7 +157,11 @@ class _ReplicaLane:
         if dag.broken is None and not self.can_admit():
             return None
         try:
-            return dag.execute(payload, timeout=0.25)
+            # the write grace only needs to absorb a submitter race on
+            # the last slot (ring ops are ~µs); anything longer turns
+            # "window full" into a blocking wait at exec-time scale,
+            # which is exactly what overflow-to-eager exists to avoid
+            return dag.execute(payload, timeout=0.01)
         except ChannelTimeout:
             return None  # raced another submitter to the last slot
         except ValueError:
@@ -172,6 +177,142 @@ class _ReplicaLane:
                 pass
         else:
             self.dag.teardown_async()
+
+
+class _DecodeLane:
+    """One replica's generative-decode lane: a stream-reply compiled DAG
+    (``with_stream_batching``) over ``handle_request_decode``. The
+    replica's exec loop drains new requests from this lane's in-ring
+    BETWEEN decode iterations and ships every token back as its own
+    TAG_STREAM frame — iteration-level continuous batching with
+    ring-lane token streaming, no per-token RPCs."""
+
+    def __init__(self, replica, key: str, deployment: str, window: int,
+                 slot_bytes: int):
+        from ray_tpu.dag import InputNode
+
+        self.replica = replica
+        self.key = key
+        self.deployment = deployment
+        self.window = window
+        with InputNode() as inp:
+            node = replica.handle_request_decode.bind(inp)
+        node.with_stream_batching(window).with_direct_call()
+        self.dag = node.experimental_compile(
+            buffer_size_bytes=slot_bytes, max_inflight=window)
+
+    def can_admit(self) -> bool:
+        return (self.dag.broken is None and not self.dag.torn_down
+                and self.dag.inflight() < self.window
+                and self.dag.input_writable())
+
+    def try_dispatch(self, payload):
+        """Admit one decode request: returns a CompiledStreamRef, or
+        None (window full / lane transiently broken) — the caller then
+        falls back to the eager decode generator."""
+        dag = self.dag
+        if dag.torn_down:
+            return None
+        if dag.broken is None and not self.can_admit():
+            return None
+        try:
+            return dag.execute_stream(payload, timeout=0.25)
+        except ChannelTimeout:
+            return None  # raced another submitter to the last slot
+        except ValueError:
+            return None  # payload exceeds the ring slot: eager carries it
+        except Exception:
+            return None  # dead/restarting executor: eager until rebound
+
+    def close(self, wait: bool = False) -> None:
+        if wait:
+            try:
+                self.dag.teardown()
+            except Exception:
+                pass
+        else:
+            self.dag.teardown_async()
+
+
+class CompiledStreamResponse:
+    """Iterator over one decode request's token frames on a stream lane.
+    Each item is the JSON dict the replica emitted (``{"token": t, "i":
+    n}`` chunks, then the ``{"done": True, ...}`` summary). A replica
+    killed mid-stream surfaces as the DAG's attributed ActorDiedError
+    from the iterator — there is NO mid-stream redispatch (streamed
+    tokens cannot be un-sent); callers retry the whole request, and a
+    retried prefill lands on a survivor's prefix cache."""
+
+    def __init__(self, router: "CompiledRouter", lane: _DecodeLane, ref,
+                 meta: Optional[dict], deployment: str,
+                 item_timeout_s: Optional[float] = None):
+        self._router = router
+        self._lane = lane
+        self._ref = ref
+        self._meta = meta
+        self._deployment = deployment
+        self._item_timeout_s = item_timeout_s
+        self._released = False
+        self._recorded = False
+        self.plane = "compiled_stream"
+
+    def _release(self) -> None:
+        # idempotent and lock-free (reached from generator finalization
+        # in the GC — same contract as CompiledServeResponse)
+        if not self._released:
+            self._released = True
+            self._router._release_slot()
+
+    def _record(self, status: str) -> None:
+        meta = self._meta
+        if meta is None or self._recorded:
+            return
+        self._recorded = True
+        from . import observability as obs
+
+        e2e = max(0.0, time.time() - meta.get("ingress_ts", time.time()))
+        obs.defer(obs.record_request_outcome, self._deployment,
+                  meta.get("ingress", "handle"), status, e2e,
+                  meta.get("handle_queue_wait_s"))
+
+    def __iter__(self):
+        from ray_tpu.core import serialization
+        from ray_tpu.experimental.channel import (STREAM_F_ERROR,
+                                                  STREAM_F_RAW)
+
+        timeout = self._item_timeout_s or 60.0
+        status = "ok"
+        try:
+            while True:
+                try:
+                    flags, body = self._ref.next(timeout=timeout)
+                except StopIteration:
+                    break
+                except ChannelTimeout:
+                    raise TimeoutError(
+                        f"decode stream from {self._deployment!r}: no "
+                        f"frame within {timeout}s (request still in "
+                        "flight)") from None
+                if flags & STREAM_F_ERROR:
+                    err = serialization.deserialize(bytes(body))
+                    raise err if isinstance(err, BaseException) \
+                        else RuntimeError(str(err))
+                if flags & STREAM_F_RAW:
+                    yield json.loads(bytes(body))
+                else:
+                    yield serialization.deserialize(bytes(body))
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            self._release()
+            self._record(status)
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:
+            pass
 
 
 class CompiledServeResponse:
@@ -197,6 +338,7 @@ class CompiledServeResponse:
         self._released = False
         self._recorded = False
         self._timeout_counted = False
+        self.plane = "compiled"  # dispatch-plane label for the metrics
         self.timings: Optional[Dict[str, float]] = None
 
     # -- bookkeeping ------------------------------------------------------
@@ -377,9 +519,27 @@ class CompiledRouter:
         self._slots: deque = deque()
         self._broken_until = 0.0
         self._build_warned = False
+        # lane sets with a background build in flight (keyed by the
+        # live-attr name): scale-out lane compiles run off the dispatch
+        # path so no request ever blocks behind experimental_compile
+        self._bg_builds: set = set()
         # multiplex stickiness: model id -> lane key (the replica whose
         # LRU cache holds the model) — survives replica-set refreshes
         self._model_affinity: Dict[str, str] = {}
+        # decode plane: stream lanes (separate DAG instances — the unary
+        # lane's batch contract and the stream lane's multi-reply
+        # contract cannot share rings), plus cache-aware routing state
+        self._decode_lanes: Dict[str, _DecodeLane] = {}
+        self._live_decode: Optional[List[_DecodeLane]] = None
+        # prompt-hash -> lane key: the replica whose prefix cache holds
+        # this prompt's KV (bounded LRU — the router-side half of
+        # cache-hit-aware routing)
+        self._prefix_affinity: "OrderedDict[int, str]" = OrderedDict()
+        # replica load signals (kv occupancy / hit rate) polled at <=1Hz,
+        # fire-and-collect so dispatch never blocks on the RPC
+        self._load_signals: Dict[str, dict] = {}
+        self._signals_ts = 0.0
+        self._signal_refs: Optional[List[Tuple[str, Any]]] = None
 
     # -- replica-set sync (driven by the eager Router's refresh) ---------
     def update_replicas(self, replicas: List[Any], key_fn,
@@ -391,7 +551,10 @@ class CompiledRouter:
             keys = {k for k, _ in desired}
             dead = [k for k in self._lanes if k not in keys]
             closing = [self._lanes.pop(k) for k in dead]
+            dead_d = [k for k in self._decode_lanes if k not in keys]
+            closing += [self._decode_lanes.pop(k) for k in dead_d]
             self._live_lanes = None  # re-derive on next dispatch
+            self._live_decode = None
         for lane in closing:
             lane.close()
 
@@ -415,43 +578,101 @@ class CompiledRouter:
         lanes = self._live_lanes
         if lanes is not None:
             return lanes  # steady state: no locks on the hot path
+        return self._build_lane_set(self._lanes, "_live_lanes",
+                                    _ReplicaLane)
+
+    def _ensure_decode_lanes(self) -> List[_DecodeLane]:
+        lanes = self._live_decode
+        if lanes is not None:
+            return lanes
+        return self._build_lane_set(self._decode_lanes, "_live_decode",
+                                    _DecodeLane)
+
+    def _build_lane_set(self, lane_map: Dict[str, Any], live_attr: str,
+                        lane_cls) -> List[Any]:
         with self._lock:
             targets = list(self._targets)
-            missing = [(k, a) for k, a in targets if k not in self._lanes]
+            missing = [(k, a) for k, a in targets if k not in lane_map]
+            have_live = any(k in lane_map for k, _ in targets)
         if missing and time.monotonic() >= self._broken_until:
-            cfg = global_config()
-            with self._build_lock:
-                for key, actor in missing:
-                    with self._lock:
-                        if key in self._lanes:
-                            continue
-                    if not _actor_alive(actor):
-                        continue  # record not up yet: retry next dispatch
-                    try:
-                        lane = _ReplicaLane(actor, key, self._name,
-                                            self._window(),
-                                            cfg.serve_channel_slot_bytes)
-                    except Exception as e:  # noqa: BLE001
-                        # lane build failure must never fail the request
-                        # — eager carries it; retry after a cooldown
-                        self._broken_until = (time.monotonic()
-                                              + self._BUILD_COOLDOWN_S)
-                        if not self._build_warned:
-                            self._build_warned = True
-                            logger.warning(
-                                "compiled serve lane build failed for "
-                                "%r (falling back to eager dispatch, "
-                                "retrying in %.0fs): %r", self._name,
-                                self._BUILD_COOLDOWN_S, e)
-                        break
-                    with self._lock:
-                        self._lanes[key] = lane
+            if have_live:
+                # scale-out: existing lanes carry traffic while the new
+                # replica's lane compiles in the BACKGROUND — a lane
+                # build on the dispatch path would stall every request
+                # behind experimental_compile (the old scale-out p99
+                # tail)
+                self._spawn_builder(lane_map, live_attr, lane_cls)
+            else:
+                # initial bring-up: nothing to route to yet, so the
+                # first dispatch pays the build inline as before
+                self._build_missing(lane_map, lane_cls)
         with self._lock:
             live = {k for k, _ in self._targets}
-            lanes = [ln for k, ln in self._lanes.items() if k in live]
+            lanes = [ln for k, ln in lane_map.items() if k in live]
             if live and len(lanes) == len(live):
-                self._live_lanes = lanes  # complete: cache until change
+                setattr(self, live_attr, lanes)  # complete: cache
             return lanes
+
+    def _spawn_builder(self, lane_map: Dict[str, Any], live_attr: str,
+                       lane_cls) -> None:
+        """Kick off (at most one per lane set) a daemon thread building
+        the missing lanes."""
+        with self._lock:
+            if live_attr in self._bg_builds:
+                return
+            self._bg_builds.add(live_attr)
+
+        def run():
+            try:
+                self._build_missing(lane_map, lane_cls)
+            finally:
+                with self._lock:
+                    self._bg_builds.discard(live_attr)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"serve-lane-build-{self._name}").start()
+
+    def _build_missing(self, lane_map: Dict[str, Any], lane_cls) -> None:
+        cfg = global_config()
+        with self._lock:
+            missing = [(k, a) for k, a in self._targets
+                       if k not in lane_map]
+        with self._build_lock:
+            for key, actor in missing:
+                with self._lock:
+                    if key in lane_map:
+                        continue
+                if not _actor_alive(actor):
+                    continue  # record not up yet: retry next dispatch
+                try:
+                    lane = lane_cls(actor, key, self._name,
+                                    self._window(),
+                                    cfg.serve_channel_slot_bytes)
+                except Exception as e:  # noqa: BLE001
+                    # lane build failure must never fail the request
+                    # — eager carries it; retry after a cooldown
+                    self._broken_until = (time.monotonic()
+                                          + self._BUILD_COOLDOWN_S)
+                    if not self._build_warned:
+                        self._build_warned = True
+                        logger.warning(
+                            "compiled serve lane build failed for "
+                            "%r (falling back to eager dispatch, "
+                            "retrying in %.0fs): %r", self._name,
+                            self._BUILD_COOLDOWN_S, e)
+                    break
+                with self._lock:
+                    lane_map[key] = lane
+
+    def warm_keys(self) -> set:
+        """Keys of replicas with a built lane. Lane compile round-trips
+        through the replica's mailbox (``__compiled_setup__``), so a
+        built lane proves the replica finished ``__init__`` and is
+        serving — the eager router prefers these during scale-out so an
+        overflow request never queues behind a cold replica's init (the
+        scale-out p99 tail)."""
+        with self._lock:
+            return set(self._lanes) | set(self._decode_lanes)
 
     # -- admission accounting --------------------------------------------
     def outstanding(self) -> int:
@@ -492,7 +713,16 @@ class CompiledRouter:
             return None
         _t0 = _fr.now()
         lanes = self._ensure_lanes()
-        payload = (method, args, kwargs, model_id, meta)
+        # bytes fast lane: a raw-bytes __call__ rides TAG_BYTES end to
+        # end (proxy -> ring -> replica) with the serializer skipped
+        # entirely; the replica re-tuples it. The meta stays driver-side
+        # (outcome metrics record here; no replica access-log line).
+        raw_bytes = (method == "__call__" and len(args) == 1
+                     and not kwargs and not model_id
+                     and isinstance(args[0],
+                                    (bytes, bytearray, memoryview)))
+        payload = (bytes(args[0]) if raw_bytes
+                   else (method, args, kwargs, model_id, meta))
         chosen: Optional[_ReplicaLane] = None
         if lanes:
             if model_id:
@@ -521,13 +751,121 @@ class CompiledRouter:
                         self._model_affinity[model_id] = lane.key
                     self._take_slot()
                     _sp_dispatch.end(_t0, self._name)
-                    return CompiledServeResponse(
+                    resp = CompiledServeResponse(
                         self, lane, ref, meta, self._name,
                         redispatch=redispatch)
+                    resp.plane = ("compiled_bytes" if raw_bytes
+                                  else "compiled")
+                    return resp
         budget = self._budget()
         if budget > 0 and self.outstanding() >= budget:
             self._shed(meta, len(lanes))
         return None  # overflow: the eager path is the bounded queue
+
+    # -- the decode stream path ------------------------------------------
+    def dispatch_stream(self, value, meta: Optional[dict],
+                        item_timeout_s: Optional[float] = None):
+        """Admit one decode request onto a stream lane. Returns a
+        CompiledStreamResponse (iterator of token dicts), or None when
+        the caller should fall back to the eager decode generator, or
+        raises BackPressureError on shed. Routing is cache-hit-aware:
+        prefix affinity first (the lane whose replica's prefix cache
+        holds this prompt's KV), then pow-2 on per-lane in-flight with
+        the replicas' polled KV hit rate as the tiebreak."""
+        if not self._enabled() or not self._opts.get("decode"):
+            return None
+        _t0 = _fr.now()
+        lanes = self._ensure_decode_lanes()
+        pkey = self._prompt_key(value)
+        if lanes:
+            self._refresh_load_signals(lanes)
+            chosen: Optional[_DecodeLane] = None
+            if pkey is not None:
+                want = self._prefix_affinity.get(pkey)
+                if want is not None:
+                    for ln in lanes:
+                        if ln.key == want:
+                            chosen = ln
+                            break
+            if chosen is None:
+                if len(lanes) == 1:
+                    chosen = lanes[0]
+                else:
+                    a, b = random.sample(lanes, 2)
+                    chosen = min((a, b), key=self._lane_load_key)
+            order = [chosen] + [ln for ln in lanes if ln is not chosen]
+            for lane in order:
+                ref = lane.try_dispatch(value)
+                if ref is not None:
+                    if pkey is not None:
+                        self._remember_prefix(pkey, lane.key)
+                    self._take_slot()
+                    _sp_dispatch.end(_t0, self._name)
+                    return CompiledStreamResponse(
+                        self, lane, ref, meta, self._name,
+                        item_timeout_s=item_timeout_s)
+        budget = self._budget()
+        if budget > 0 and self.outstanding() >= budget:
+            self._shed(meta, len(lanes))
+        return None
+
+    @staticmethod
+    def _prompt_key(value) -> Optional[int]:
+        """Stable hash of the request's prompt tokens (the prefix-cache
+        key replica-side) — None when unparseable (the replica will
+        reject it with an attributed error frame)."""
+        try:
+            if isinstance(value, (bytes, bytearray, memoryview)):
+                value = json.loads(bytes(value))
+            prompt = value.get("prompt")
+            return hash(tuple(int(t) for t in prompt)) if prompt else None
+        except Exception:
+            return None
+
+    def _remember_prefix(self, pkey: int, lane_key: str) -> None:
+        aff = self._prefix_affinity
+        aff[pkey] = lane_key
+        aff.move_to_end(pkey)
+        while len(aff) > 4096:
+            aff.popitem(last=False)
+
+    def _lane_load_key(self, lane: _DecodeLane) -> Tuple[int, float]:
+        sig = self._load_signals.get(lane.key, {})
+        return (lane.dag.inflight(),
+                -float(sig.get("kv_hit_rate", 0.0) or 0.0))
+
+    def _refresh_load_signals(self, lanes: List[_DecodeLane]) -> None:
+        """Collect/launch get_load_signal polls at <=1Hz. Fire-and-
+        collect: refs launched on one dispatch are harvested on a later
+        one, so the dispatch path never blocks on the RPC."""
+        import ray_tpu
+
+        refs = self._signal_refs
+        if refs is not None:
+            try:
+                done, _ = ray_tpu.wait([r for _, r in refs],
+                                       num_returns=len(refs), timeout=0)
+            except Exception:
+                self._signal_refs = None
+                return
+            if len(done) == len(refs):
+                self._signal_refs = None
+                for key, ref in refs:
+                    try:
+                        sig = ray_tpu.get(ref, timeout=0.5)
+                        if isinstance(sig, dict):
+                            self._load_signals[key] = sig
+                    except Exception:
+                        pass
+        now = time.monotonic()
+        if now - self._signals_ts >= 1.0 and self._signal_refs is None:
+            self._signals_ts = now
+            try:
+                self._signal_refs = [
+                    (ln.key, ln.replica.get_load_signal.remote())
+                    for ln in lanes]
+            except Exception:
+                self._signal_refs = None
 
     def _shed(self, meta: Optional[dict], n_lanes: int) -> None:
         from . import observability as obs
@@ -545,8 +883,12 @@ class CompiledRouter:
 
     def close(self, wait: bool = False) -> None:
         with self._lock:
-            lanes, self._lanes = list(self._lanes.values()), {}
+            lanes = list(self._lanes.values()) \
+                + list(self._decode_lanes.values())
+            self._lanes = {}
+            self._decode_lanes = {}
             self._targets = []
             self._live_lanes = None
+            self._live_decode = None
         for lane in lanes:
             lane.close(wait=wait)

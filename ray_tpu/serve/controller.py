@@ -116,7 +116,7 @@ class ServeController:
                 return {"replicas": [], "retry_on_replica_failure": True,
                         "slow_request_threshold_s": None,
                         "max_inflight": None, "concurrency_budget": None,
-                        "compiled_dispatch": None}
+                        "compiled_dispatch": None, "decode": False}
             return {
                 "replicas": [r["actor"] for r in rec["replicas"]],
                 "retry_on_replica_failure": rec["config"].get(
@@ -134,6 +134,9 @@ class ServeController:
                     "concurrency_budget"),
                 "compiled_dispatch": rec["config"].get(
                     "compiled_dispatch"),
+                # generative decode: the handle streams tokens over the
+                # compiled stream lanes instead of the eager path
+                "decode": bool(rec["config"].get("decode")),
             }
 
     def get_version(self) -> int:
@@ -159,6 +162,11 @@ class ServeController:
                     "name": name,
                     "stream": bool(cfg.get("stream")),
                     "timeout": float(cfg.get("request_timeout_s", 60.0)),
+                    # decode routes stream server-sent events; bytes_body
+                    # routes hand the raw body to __call__ (TAG_BYTES
+                    # fast lane on the compiled plane)
+                    "decode": bool(cfg.get("decode")),
+                    "bytes_body": bool(cfg.get("bytes_body")),
                 }
             return out
 

@@ -44,7 +44,9 @@ class Deployment:
                  slow_request_threshold_s: Optional[float] = None,
                  max_inflight: Optional[int] = None,
                  concurrency_budget: Optional[int] = None,
-                 compiled_dispatch: Optional[bool] = None):
+                 compiled_dispatch: Optional[bool] = None,
+                 decode: bool = False,
+                 bytes_body: bool = False):
         self._target = target
         self.name = name
         if isinstance(autoscaling_config, dict):
@@ -65,6 +67,8 @@ class Deployment:
             max_inflight=max_inflight,
             concurrency_budget=concurrency_budget,
             compiled_dispatch=compiled_dispatch,
+            decode=decode,
+            bytes_body=bytes_body,
         )
 
     def options(self, **overrides) -> "Deployment":
@@ -116,6 +120,13 @@ class Deployment:
             "max_inflight": self._opts.get("max_inflight"),
             "concurrency_budget": self._opts.get("concurrency_budget"),
             "compiled_dispatch": self._opts.get("compiled_dispatch"),
+            # generative decode plane (serve/decode.py): the callable
+            # provides create_decode_engine(); requests stream tokens
+            # over compiled stream lanes with iteration-level batching
+            "decode": self._opts.get("decode", False),
+            # hand the raw HTTP body to __call__ as bytes (TAG_BYTES
+            # fast lane: serializer skipped proxy->ring->replica)
+            "bytes_body": self._opts.get("bytes_body", False),
         }
 
     def __repr__(self):

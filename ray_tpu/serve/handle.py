@@ -240,6 +240,9 @@ class Router:
         self._last_refresh = 0.0
         self._poller_started = False
         self.retry_on_replica_failure = True  # updated on refresh
+        # deployment serves generative decode (token streams ride the
+        # compiled stream lanes; eager fallback is the decode generator)
+        self.decode = False
         # None -> fall back to the global config default at emit time
         self.slow_request_threshold_s: Optional[float] = None
         # compiled dispatch plane: the process-shared lane router for
@@ -287,10 +290,12 @@ class Router:
 
                     thr = global_config().serve_slow_request_threshold_s
                 self.slow_request_threshold_s = thr
+                self.decode = bool(rset.get("decode"))
                 self._compiled_opts = {
                     "max_inflight": rset.get("max_inflight"),
                     "concurrency_budget": rset.get("concurrency_budget"),
                     "compiled_dispatch": rset.get("compiled_dispatch"),
+                    "decode": rset.get("decode"),
                 }
                 keys = {self._key(r) for r in replicas}
                 self._inflight = {k: v for k, v in self._inflight.items()
@@ -360,6 +365,18 @@ class Router:
                         chosen = r
                         break
         if chosen is None:
+            if len(replicas) > 1 and self._compiled is not None:
+                # scale-out: prefer replicas with a built compiled lane —
+                # a built lane proves the replica is past __init__, so
+                # eager overflow never queues behind a cold replica's
+                # init (the scale-out p99 tail). With no lanes yet
+                # (initial bring-up / opt-out) the full set stands.
+                warm = self._compiled.warm_keys()
+                if warm:
+                    warm_rs = [r for r in replicas
+                               if self._key(r) in warm]
+                    if warm_rs:
+                        replicas = warm_rs
             if len(replicas) == 1:
                 chosen = replicas[0]
             else:
@@ -446,6 +463,13 @@ class DeploymentHandle:
                     cr, args, kwargs, meta, t0)
                 if resp is not None:
                     return resp
+        else:
+            # decode deployments stream tokens over the compiled plane
+            # (TAG_STREAM ring frames); eager is the fallback, not the
+            # rule — streaming no longer implies eager dispatch
+            it = self._try_compiled_stream(args, kwargs, meta, t0)
+            if it is not None:
+                return it
         try:
             return self._eager_dispatch(args, kwargs, meta, t0,
                                         overflow_release)
@@ -497,7 +521,8 @@ class DeploymentHandle:
                 from . import observability as obs
 
                 obs.defer(obs.record_dispatch, self._name,
-                          time.perf_counter() - t0, "compiled")
+                          time.perf_counter() - t0,
+                          getattr(resp, "plane", "compiled"))
             return resp, None
         # overflow to eager: drop the unadmitted attempt's span
         # UNPUBLISHED (never finished) — the eager path opens the one
@@ -505,6 +530,33 @@ class DeploymentHandle:
         if meta is not None:
             meta.pop("handle_span_ctx", None)
         return None, cr.admit_overflow()
+
+    def _try_compiled_stream(self, args, kwargs, meta, t0):
+        """One admission attempt on the compiled decode stream plane.
+        Returns an iterator of token dicts, or None -> the eager decode
+        generator carries it (not a decode deployment, no lanes, every
+        window full); raises BackPressureError on shed."""
+        if kwargs or len(args) != 1:
+            return None
+        self._router._refresh()
+        if not self._router.decode:
+            return None
+        cr = self._router.compiled_router()
+        if cr is None:
+            return None
+        if meta is not None:
+            meta["dispatch_ts"] = time.time()
+            meta["handle_queue_wait_s"] = time.perf_counter() - t0
+        resp = cr.dispatch_stream(
+            args[0], meta, item_timeout_s=self._stream_item_timeout_s)
+        if resp is None:
+            return None
+        if meta is not None:
+            from . import observability as obs
+
+            obs.defer(obs.record_dispatch, self._name,
+                      time.perf_counter() - t0, "compiled_stream")
+        return iter(resp)
 
     def _redispatch_request(self, args, kwargs, meta, eager_only=False):
         """Replica-failure retry: re-dispatch the whole request (the
@@ -573,26 +625,48 @@ class DeploymentHandle:
         if self._stream:
             # items stream incrementally (streaming generators); the
             # in-flight count drops when the generator is exhausted
+            decode = (self._router.decode and len(args) == 1
+                      and not kwargs)
             try:
                 if span is not None:
                     span.__enter__()
-                gen = replica.handle_request_stream.options(
-                    num_returns="streaming").remote(
-                    self._method, args, kwargs, self._model_id, meta)
+                if decode:
+                    # eager decode fallback: the replica-side generator
+                    # drives the SAME scheduler as the compiled lane, so
+                    # both planes continuous-batch together
+                    gen = replica.handle_request_decode_stream.options(
+                        num_returns="streaming").remote(
+                        args[0], self._model_id, meta)
+                else:
+                    gen = replica.handle_request_stream.options(
+                        num_returns="streaming").remote(
+                        self._method, args, kwargs, self._model_id, meta)
             finally:
                 if span is not None:
                     span.__exit__(None, None, None)
             item_timeout = self._stream_item_timeout_s
             stream_meta, name = meta, self._name
+            if meta is not None:
+                from . import observability as obs
+
+                obs.defer(obs.record_dispatch, self._name,
+                          time.perf_counter() - t0, "eager")
 
             def iterate():
+                import json as _json
+
                 status = "ok"
                 timed_out = False
                 try:
                     for ref in gen:
                         # bounded per-item wait: a hung replica must not
                         # pin the consumer (and its executor thread) forever
-                        yield ray_tpu.get(ref, timeout=item_timeout)
+                        item = ray_tpu.get(ref, timeout=item_timeout)
+                        if decode:
+                            # (kind, payload) frames -> the same dicts
+                            # the compiled stream plane yields
+                            item = _json.loads(bytes(item[1]))
+                        yield item
                 except BaseException as e:
                     status = "error"
                     timed_out = isinstance(e, TimeoutError)

@@ -141,9 +141,13 @@ class HTTPProxy:
                 resp.headers["x-request-id"] = req_id
             return resp
 
-        if match.get("stream"):
+        if match.get("stream") or match.get("decode"):
             # dispatch BEFORE sending headers: a routing failure (e.g. no
             # replicas) must surface as a 5xx, not a truncated 200
+            sse = bool(match.get("decode"))
+            # decode routes take the raw JSON body — it rides TAG_BYTES
+            # to the replica un-pickled (parse_decode_request handles it)
+            stream_arg = body if sse else req
             try:
                 try:
                     it = await loop.run_in_executor(
@@ -151,11 +155,16 @@ class HTTPProxy:
                             stream=True,
                             stream_item_timeout_s=match.get("timeout",
                                                             60.0),
-                        ).remote(req))
+                        ).remote(stream_arg))
                 except Exception as e:  # noqa: BLE001
                     return _respond(web.Response(status=503, text=str(e)))
                 # streaming response: chunks flow as the replica yields
                 resp = web.StreamResponse()
+                if sse:
+                    # server-sent events: one `data:` record per token
+                    # chunk, flushed as it is decoded
+                    resp.headers["content-type"] = "text/event-stream"
+                    resp.headers["cache-control"] = "no-cache"
                 if req_id:
                     resp.headers["x-request-id"] = req_id
                 await resp.prepare(request)
@@ -165,7 +174,10 @@ class HTTPProxy:
                             None, lambda: next(it, _STREAM_END))
                         if chunk is _STREAM_END:
                             break
-                        if isinstance(chunk, str):
+                        if sse:
+                            chunk = (b"data: " + json.dumps(chunk).encode()
+                                     + b"\n\n")
+                        elif isinstance(chunk, str):
                             chunk = chunk.encode()
                         await resp.write(chunk)
                 except Exception:
@@ -182,11 +194,15 @@ class HTTPProxy:
                 if span is not None:
                     span.finish()
         timeout = match.get("timeout", 60.0)
+        # bytes-body fast lane: hand the raw request body to __call__ —
+        # over the compiled plane it rides a TAG_BYTES slot end to end
+        # with the serializer skipped in both directions
+        unary_arg = body if match.get("bytes_body") else req
         try:
             # handle.remote() can spin in Router.choose() waiting for
             # replicas — run it off the event loop too
             def _call():
-                return handle.remote(req).result(timeout=timeout)
+                return handle.remote(unary_arg).result(timeout=timeout)
 
             result = await loop.run_in_executor(None, _call)
         except Exception as e:  # noqa: BLE001

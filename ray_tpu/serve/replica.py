@@ -8,6 +8,7 @@ access logging).
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import functools
 import inspect
 import os
@@ -96,6 +97,12 @@ class ServeReplica:
         self._compiled_chans = {}
         self._compiled_loop = None
         self._compiled_loop_lock = threading.Lock()
+        self._sync_pool = None  # lazy; see _run_sync_group
+        # generative-decode plane: one scheduler per replica, built
+        # lazily from the callable's engine factory (serve/decode.py)
+        self._decode_sched = None
+        self._decode_lock = threading.Lock()
+        self._decode_eager_seq = 0
         if user_config is not None and hasattr(
                 self._callable, "reconfigure"):
             self._callable.reconfigure(user_config)
@@ -297,6 +304,11 @@ class ServeReplica:
         batch-mates."""
         recv_ts = time.time()
         _t0 = _fr.now()
+        # TAG_BYTES fast lane: raw body bytes arrive un-tupled — they are
+        # __call__(payload) requests by construction (proxy bytes_body)
+        requests = [("__call__", (bytes(r),), {}, "", None)
+                    if isinstance(r, (bytes, bytearray, memoryview))
+                    else r for r in requests]
         out: List[Any] = []
         i, n = 0, len(requests)
         while i < n:
@@ -454,17 +466,138 @@ class ServeReplica:
     def _run_sync_group(self, fn, group, rcs, BatchItemError) -> List[Any]:
         from ray_tpu.serve import observability as obs
 
-        results = []
-        for req, rc in zip(group, rcs):
+        def one(req, rc):
             rc_token = obs._set_request_ctx(rc) if rc is not None else None
             try:
-                results.append(fn(*req[1], **req[2]))
+                return fn(*req[1], **req[2])
             except Exception as e:  # noqa: BLE001
-                results.append(BatchItemError(e))
+                return BatchItemError(e)
             finally:
                 if rc_token is not None:
                     obs._reset_request_ctx(rc_token)
-        return results
+
+        if len(group) == 1:
+            return [one(group[0], rcs[0])]
+        # items of one ring drain overlap in a thread pool, exactly like
+        # the eager plane's run_in_executor path runs concurrent sync
+        # requests — a serial loop here made every batch-mate wait out
+        # the whole round (compiled-plane tail ≈ batch size × exec time
+        # under load, which eager never exhibits). copy_context at
+        # submit time: the group's model-id contextvar must be visible
+        # in the pool threads. Replies keep arrival order.
+        import contextvars
+
+        if self._sync_pool is None:
+            with self._compiled_loop_lock:
+                if self._sync_pool is None:
+                    self._sync_pool = \
+                        concurrent.futures.ThreadPoolExecutor(
+                            max_workers=16,
+                            thread_name_prefix="serve-sync-batch")
+        futs = [self._sync_pool.submit(
+                    contextvars.copy_context().run, one, req, rc)
+                for req, rc in zip(group, rcs)]
+        return [f.result() for f in futs]
+
+    # ----------------------------------------------------- decode plane
+    # Generative decode (serve/decode.py): the compiled stream lane
+    # binds handle_request_decode with with_stream_batching — the exec
+    # loop drains new requests from the ring BETWEEN decode iterations
+    # and calls back in while any sequence is running, which is exactly
+    # the Orca iteration-level admission loop.
+
+    def _decode_scheduler(self):
+        """Lazily build the scheduler from the callable's engine factory
+        (a deployment is decode-capable iff its callable defines
+        ``create_decode_engine()``)."""
+        sched = self._decode_sched
+        if sched is None:
+            with self._decode_lock:
+                sched = self._decode_sched
+                if sched is None:
+                    from ray_tpu.serve.decode import DecodeScheduler
+
+                    factory = getattr(self._callable,
+                                      "create_decode_engine", None)
+                    if factory is None:
+                        raise TypeError(
+                            f"deployment {self._deployment!r} is not "
+                            "decode-capable: its callable has no "
+                            "create_decode_engine()")
+                    sched = DecodeScheduler(
+                        factory(), deployment=self._deployment,
+                        max_batch=int(getattr(
+                            self._callable, "decode_max_batch", 8)))
+                    self._decode_sched = sched
+        return sched
+
+    def handle_request_decode(self, entries: List[tuple]):
+        """One stream-exec round on the decode plane: submit this
+        round's drained ring entries ``(corr, value)``, run ONE
+        scheduling iteration, return ``(replies, active)`` — the
+        worker's stream loop ships each reply as a TAG_STREAM frame and
+        keeps calling back (without blocking on the ring) while
+        ``active``."""
+        sched = self._decode_scheduler()
+        replies: List[tuple] = []
+        for corr, value in entries:
+            self._total += 1
+            err = sched.submit(corr, value)
+            if err is not None:
+                replies.append(err)
+        out, active = sched.step()
+        replies.extend(out)
+        return replies, active
+
+    def handle_request_decode_stream(self, value,
+                                     multiplexed_model_id: str = "",
+                                     request_meta: Optional[dict] = None):
+        """Eager fallback for decode: a generator driving the SAME
+        scheduler (so eager and compiled sequences continuous-batch
+        together), yielding ``(kind, payload)`` frames. Errors raise."""
+        sched = self._decode_scheduler()
+        with self._decode_lock:
+            self._decode_eager_seq += 1
+            corr = f"eager-{self._replica_tag}-{self._decode_eager_seq}"
+        err = sched.submit(corr, value, eager=True)
+        if err is not None:
+            exc = err[2]
+            raise exc if isinstance(exc, BaseException) \
+                else RuntimeError(str(exc))
+        self._ongoing += 1
+        self._total += 1
+        try:
+            done = False
+            while not done:
+                sched.step()
+                frames = sched.drain_eager(corr)
+                if not frames:
+                    # pool pressure is holding admission back; don't spin
+                    time.sleep(0.001)
+                    continue
+                for _corr, kind, payload in frames:
+                    if kind == "error":
+                        raise payload if isinstance(payload, BaseException) \
+                            else RuntimeError(str(payload))
+                    yield (kind, payload)
+                    if kind == "final":
+                        done = True
+        finally:
+            sched.forget_eager(corr)
+            self._ongoing -= 1
+
+    def get_load_signal(self) -> Dict[str, Any]:
+        """Router-facing load: ongoing count plus — on decode-capable
+        replicas — KV-cache occupancy and prefix hit rate, so the pow-2
+        router can prefer the cache-warm replica."""
+        sig: Dict[str, Any] = {
+            "ongoing": self.get_num_ongoing_requests(),
+            "replica_tag": self._replica_tag,
+        }
+        sched = self._decode_sched
+        if sched is not None:
+            sig.update(sched.stats())
+        return sig
 
     def reconfigure(self, user_config) -> None:
         if hasattr(self._callable, "reconfigure"):
@@ -474,7 +607,12 @@ class ServeReplica:
         # the compiled plane's queued-in-ring requests are in flight on
         # this replica just as much as eager ones: the pow-2 router and
         # the autoscaler both read this
-        return self._ongoing + self._compiled_backlog()
+        n = self._ongoing + self._compiled_backlog()
+        sched = self._decode_sched
+        if sched is not None:
+            st = sched.stats()
+            n += st["running"] + st["waiting"]
+        return n
 
     def stats(self) -> Dict[str, Any]:
         return {"ongoing": self._ongoing, "total": self._total,

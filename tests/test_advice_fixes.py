@@ -10,6 +10,7 @@ import pytest
 import ray_tpu
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_streaming_split_equal_rows(ray_start_regular):
     """equal=True: every split yields the same row count per epoch
     (unequal splits hang gang-scheduled SPMD consumers)."""
@@ -29,6 +30,7 @@ def test_streaming_split_equal_rows(ray_start_regular):
     assert counts[0] > 0
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_streaming_split_locality_hints_honored_quietly(ray_start_regular):
     """locality_hints is a real knob now (PR 4): accepted without warning
     and all rows still arrive exactly once."""

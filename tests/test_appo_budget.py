@@ -19,6 +19,7 @@ class TestAPPO:
                 .training(**base)
                 .debugging(seed=0))
 
+    @pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
     def test_appo_learns_cartpole(self):
         from ray_tpu.rllib import APPO
 

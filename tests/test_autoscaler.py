@@ -55,6 +55,7 @@ def autoscaling_cluster():
 
 
 class TestAutoscalerE2E:
+    @pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
     def test_scale_up_runs_pending_then_scale_down(self, autoscaling_cluster):
         head, scaler = autoscaling_cluster
 

@@ -153,6 +153,7 @@ class TestNewDatasources:
         assert len(rows) == 10
         assert rows[0]["v"] == "v0"
 
+    @pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
     def test_from_torch(self, ray_start_regular):
         import torch
         from torch.utils.data import Dataset as TorchDataset

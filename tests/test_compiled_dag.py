@@ -455,6 +455,7 @@ def test_multi_consumer_fanout(ray_start_regular):
         compiled.teardown()
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_device_channel_zero_serialization(ray_start_regular):
     """Device-resident edges: jax results cross actor boundaries via the
     typed tensor channel with ZERO serialization-layer bytes (reference:

@@ -7,6 +7,7 @@ serves its own logs/metrics/profile; the head dashboard proxies
 """
 
 import json
+import pytest
 import time
 import urllib.error
 import urllib.request
@@ -29,6 +30,7 @@ def _post(url, body, timeout=90):
         return json.loads(r.read())
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_node_agent_logs_metrics_profile_across_daemons():
     cluster = Cluster(head_node_args={"num_cpus": 1})
     daemons = [cluster.add_node(num_cpus=1, separate_process=True)

@@ -60,6 +60,7 @@ def test_map_and_filter_and_flat_map(ray_init):
     assert len(vals) == 2 * n_even
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_actor_pool_map(ray_init):
     class AddConst:
         def __init__(self, c):
@@ -199,6 +200,7 @@ def test_from_numpy_to_numpy(ray_init):
     np.testing.assert_array_equal(np.sort(out["x"]), arr)
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_parquet_roundtrip(ray_init, tmp_path):
     ds = rd.range(100, parallelism=4)
     path = str(tmp_path / "pq")
@@ -226,6 +228,7 @@ def test_json_roundtrip(ray_init, tmp_path):
     assert sorted(r["a"] for r in back.take_all()) == list(range(10))
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_split(ray_init):
     splits = rd.range(100, parallelism=4).split(3)
     counts = [s.count() for s in splits]
@@ -239,6 +242,7 @@ def test_split_equal(ray_init):
     assert counts == [33, 33, 33]
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_streaming_split(ray_init):
     its = rd.range(100, parallelism=4).streaming_split(2)
     rows0 = list(its[0].iter_rows())
@@ -313,6 +317,7 @@ def test_lazy_no_execute_on_transform(ray_init):
     assert isinstance(ds, rd.Dataset)
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_range_tensor(ray_init):
     ds = rd.range_tensor(8, shape=(2, 2))
     batch = ds.take_batch(8, batch_format="numpy")

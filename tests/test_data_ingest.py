@@ -81,6 +81,7 @@ class TestOperatorFusion:
         assert [o.name for o in ex.ops] == [
             "Read", "MapBatches", "Map", "Filter", "FlatMap"]
 
+    @pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
     def test_fused_unfused_same_rows_same_order(self, ray_init, fusion_ctx):
         expected = _expected_pipeline_rows()
         fusion_ctx.enable_fusion = True

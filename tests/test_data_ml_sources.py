@@ -89,6 +89,7 @@ def test_tfrecords_roundtrip(cluster, tmp_path):
         assert list(np.asarray(r["ids"])) == [i, i * 2, -i]
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_tfrecords_wire_compatible_with_tensorflow(cluster, tmp_path):
     """Our dependency-free codec must parse records written by TF itself
     (and vice versa) — proof of wire-format compatibility."""

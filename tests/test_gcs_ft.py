@@ -210,6 +210,7 @@ def probed_cluster():
 
 
 class TestHealthProberAndSyncer:
+    @pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
     def test_wedged_daemon_declared_dead(self, probed_cluster):
         c = probed_cluster
         c.add_node(num_cpus=1, resources={"spare": 1},

@@ -1,0 +1,322 @@
+"""Paged KV-cache invariants (serve/kv_cache.py + serve/decode.py).
+
+Pins the page-accounting contract that generative decode rides on:
+all-or-nothing allocation, alloc/free balance under churn, the
+eviction-safety rule (referenced prefix entries are never freed), the
+copy-on-write tail-page rule (no shared-page writes), prefix reuse
+reproducing the cold prefill's logits byte-identically, and the
+occupancy gauges matching pool ground truth. No cluster needed — these
+drive the scheduler and engines in-process.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.decode import DecodeScheduler, ToyEngine
+from ray_tpu.serve.kv_cache import (
+    PagePool,
+    PrefixCache,
+    SequenceKV,
+    flush_kv_gauges,
+    pages_for,
+)
+
+
+def _run_all(sched, reqs, eager=False):
+    """Submit requests and step the scheduler to completion; returns
+    {corr: [frames]} keyed by correlation id."""
+    frames = {}
+    for corr, req in reqs:
+        err = sched.submit(corr, req, eager=eager)
+        assert err is None, err
+    active = True
+    for _ in range(10_000):
+        out, active = sched.step()
+        for corr, kind, payload in out:
+            frames.setdefault(corr, []).append((kind, payload))
+        if not active:
+            break
+    assert not active, "scheduler never drained"
+    return frames
+
+
+# --------------------------------------------------------------------------
+# PagePool
+# --------------------------------------------------------------------------
+
+
+class TestPagePool:
+    def test_alloc_is_all_or_nothing(self):
+        pool = PagePool(4, 8)
+        assert pool.alloc(5) is None
+        assert pool.used == 0, "failed alloc must not strand pages"
+        got = pool.alloc(4)
+        assert sorted(got) == [0, 1, 2, 3]
+        assert pool.alloc(1) is None
+        pool.release(got)
+        assert pool.used == 0
+
+    def test_release_rejects_double_free_and_bad_ids(self):
+        pool = PagePool(2, 4)
+        pages = pool.alloc(1)
+        pool.release(pages)
+        with pytest.raises(ValueError, match="double free"):
+            pool.release(pages)
+        with pytest.raises(ValueError, match="out of range"):
+            pool.release([99])
+
+    def test_balance_under_random_churn(self):
+        """Seeded random alloc/release interleave: used + free always
+        equals capacity, the ledger totals reconcile, and a full drain
+        returns the pool to empty."""
+        pool = PagePool(32, 4)
+        rng = random.Random(7)
+        held = []
+        for _ in range(2000):
+            if held and rng.random() < 0.5:
+                pool.release(held.pop(rng.randrange(len(held))))
+            else:
+                got = pool.alloc(rng.randint(1, 5))
+                if got is not None:
+                    held.append(got)
+            assert pool.used + pool.free_count == pool.n_pages
+            assert pool.alloc_total - pool.free_total == pool.used
+        for pages in held:
+            pool.release(pages)
+        assert pool.used == 0
+        assert pool.alloc_total == pool.free_total
+
+    def test_pages_for(self):
+        assert pages_for(0, 8) == 0
+        assert pages_for(1, 8) == 1
+        assert pages_for(8, 8) == 1
+        assert pages_for(9, 8) == 2
+
+
+# --------------------------------------------------------------------------
+# PrefixCache: refcounts and eviction safety
+# --------------------------------------------------------------------------
+
+
+class TestPrefixCache:
+    def test_eviction_never_frees_referenced_entries(self):
+        """The RUNNING-sequence safety rule: evict_lru only frees
+        refcount-0 entries, even when that means failing the
+        allocation."""
+        pool = PagePool(4, 4)
+        cache = PrefixCache(pool)
+        busy = cache.insert((1,), 4, pool.alloc(2))   # refs=1 (caller)
+        idle = cache.insert((2,), 4, pool.alloc(2))
+        cache.release(idle)                           # refs=0: evictable
+        got = cache.alloc_with_evict(2)
+        assert got is not None, "idle entry should have been evicted"
+        assert sorted(got) == sorted(idle.pages)
+        assert (1,) in cache._entries and (2,) not in cache._entries
+        # only the referenced entry remains; nothing can be evicted for
+        # a request that needs more than the free pages
+        pool.release(got)
+        assert cache.alloc_with_evict(3) is None
+        assert (1,) in cache._entries, \
+            "referenced entry must survive allocation pressure"
+        assert busy.refs == 1
+
+    def test_lru_order_and_hit_refcount(self):
+        pool = PagePool(6, 4)
+        cache = PrefixCache(pool)
+        a = cache.insert((1,), 4, pool.alloc(2))
+        b = cache.insert((2,), 4, pool.alloc(2))
+        cache.release(a)
+        cache.release(b)
+        # touching a makes b the LRU entry
+        assert cache.lookup((1,)) is a
+        cache.release(a)
+        cache.evict_lru(4)
+        assert (2,) not in cache._entries and (1,) in cache._entries
+        assert cache.hit_rate == 1.0
+        assert cache.lookup((9,)) is None
+        assert cache.hit_rate == 0.5
+
+    def test_insert_replacing_idle_duplicate_releases_pages(self):
+        pool = PagePool(4, 4)
+        cache = PrefixCache(pool)
+        first = cache.insert((1,), 4, pool.alloc(2))
+        cache.release(first)
+        cache.insert((1,), 4, pool.alloc(2))
+        # the idle duplicate's pages went back to the pool
+        assert pool.used == 2
+
+
+class TestSequenceKV:
+    def test_write_never_lands_in_shared_page(self):
+        kv = SequenceKV(page_size=4, shared=[7], owned=[3])
+        assert kv.page_for(2) == (7, 2)
+        assert kv.page_for(5) == (3, 1)
+        with pytest.raises(ValueError, match="copy-on-write"):
+            kv.writable_for(1)
+        assert kv.writable_for(4) == (3, 0)
+        with pytest.raises(IndexError):
+            kv.page_for(8)
+
+
+# --------------------------------------------------------------------------
+# Scheduler-level invariants (ToyEngine)
+# --------------------------------------------------------------------------
+
+
+class TestSchedulerAccounting:
+    def test_alloc_free_balance_under_request_churn(self):
+        """After many generations complete, every page is either free or
+        pinned by a prefix entry — sequences leak nothing."""
+        eng = ToyEngine(n_pages=32, page_size=4)
+        sched = DecodeScheduler(eng, max_batch=4)
+        rng = random.Random(3)
+        reqs = [(f"c{i}", {"prompt": [rng.randrange(50) for _ in
+                                      range(rng.randint(1, 9))],
+                           "max_tokens": rng.randint(1, 12)})
+                for i in range(40)]
+        frames = _run_all(sched, reqs)
+        assert len(frames) == 40
+        for corr, fs in frames.items():
+            assert fs[-1][0] == "final", (corr, fs[-1])
+        prefix_pages = sum(len(e.pages)
+                           for e in eng.prefix_cache._entries.values())
+        assert eng.pool.used == prefix_pages, \
+            "pages outside the prefix cache leaked"
+        assert all(e.refs == 0 for e in eng.prefix_cache._entries.values())
+        # evicting everything drains the pool completely
+        eng.prefix_cache.evict_lru(eng.pool.n_pages)
+        assert eng.pool.used == 0
+        assert eng.pool.alloc_total == eng.pool.free_total
+
+    def test_running_prefix_pages_survive_pressure(self):
+        """A long-running sequence's prefix pages are never evicted out
+        from under it, even while later admissions force evictions —
+        its history stays intact (ToyEngine recomputes from the paged
+        history, so a freed page would corrupt the output)."""
+        eng = ToyEngine(n_pages=8, page_size=2)
+        sched = DecodeScheduler(eng, max_batch=2)
+        # peak footprint: 2 prefix pages + 4 owned decode pages = 6 of 8,
+        # leaving 2 pages for the churn to fight over
+        long_req = {"prompt": [5, 6, 7, 8], "max_tokens": 8}
+        # reference run, no contention
+        ref = _run_all(DecodeScheduler(ToyEngine(n_pages=8, page_size=2)),
+                       [("ref", long_req)])
+        assert sched.submit("long", long_req) is None
+        sched.step()  # admit the long sequence
+        frames = {"long": []}
+        # churn short requests through the remaining pool space
+        for i in range(12):
+            sched.submit(f"s{i}", {"prompt": [i + 1], "max_tokens": 2})
+        active = True
+        while active:
+            out, active = sched.step()
+            for corr, kind, payload in out:
+                frames.setdefault(corr, []).append((kind, payload))
+        assert frames["long"][-1][0] == "final"
+        import json as _json
+
+        got = _json.loads(frames["long"][-1][1])
+        want = _json.loads(ref["ref"][-1][1])
+        assert got["tokens"] == want["tokens"], \
+            "contention changed the long sequence's output: a page it " \
+            "was using was freed or overwritten"
+
+    def test_oversized_prompt_errors_instead_of_queueing_forever(self):
+        eng = ToyEngine(n_pages=4, page_size=2)
+        sched = DecodeScheduler(eng)
+        sched.submit("big", {"prompt": list(range(20)), "max_tokens": 2})
+        out, active = sched.step()
+        assert not active
+        assert out[0][1] == "error"
+        assert "can never fit" in str(out[0][2])
+
+    def test_occupancy_gauge_matches_ground_truth(self):
+        from ray_tpu.util.metrics import registry
+
+        eng = ToyEngine(n_pages=16, page_size=4)
+        sched = DecodeScheduler(eng, deployment="gaugedep")
+        sched.submit("a", {"prompt": [1, 2, 3, 4, 5], "max_tokens": 4})
+        sched.step()
+        flush_kv_gauges("gaugedep", eng.pool, eng.prefix_cache)
+        snap = registry().snapshot()
+        tags = (("deployment", "gaugedep"),)
+        assert snap["ray_tpu_serve_kv_pages_used"]["values"][tags] \
+            == float(eng.pool.used) != 0.0
+        assert snap["ray_tpu_serve_kv_pages_capacity"]["values"][tags] \
+            == 16.0
+        assert snap["ray_tpu_serve_kv_prefix_hit_rate"]["values"][tags] \
+            == eng.prefix_cache.hit_rate
+
+
+class TestPrefixReuse:
+    def test_hit_skips_prefill_and_output_is_identical(self):
+        eng = ToyEngine(n_pages=32, page_size=4)
+        sched = DecodeScheduler(eng)
+        req = {"prompt": [3, 1, 4, 1, 5, 9], "max_tokens": 8}
+        import json as _json
+
+        cold = _run_all(sched, [("cold", req)])
+        prefills = eng.prefill_calls
+        warm = _run_all(sched, [("warm", req)])
+        assert eng.prefill_calls == prefills, "hit must skip prefill"
+        c = _json.loads(cold["cold"][-1][1])
+        w = _json.loads(warm["warm"][-1][1])
+        assert w["tokens"] == c["tokens"]
+        assert w["cached_prefix"] is True and c["cached_prefix"] is False
+        assert eng.prefix_cache.hit_rate > 0
+
+    def test_concurrent_same_prompt_sequences_do_not_cross_write(self):
+        """Two sequences sharing a prefix with a partial tail page decode
+        together: copy-on-write keeps their tail writes on different
+        physical pages, so both match the solo reference output."""
+        import json as _json
+
+        req = {"prompt": [2, 7, 1], "max_tokens": 10}   # 3 % 4 != 0: COW
+        ref = _json.loads(_run_all(
+            DecodeScheduler(ToyEngine(n_pages=32, page_size=4)),
+            [("r", req)])["r"][-1][1])
+        eng = ToyEngine(n_pages=32, page_size=4)
+        sched = DecodeScheduler(eng, max_batch=4)
+        frames = _run_all(sched, [("a", req), ("b", req)])
+        for corr in ("a", "b"):
+            got = _json.loads(frames[corr][-1][1])
+            assert got["tokens"] == ref["tokens"], corr
+
+
+# --------------------------------------------------------------------------
+# Llama engine: byte-identical logits on prefix hit
+# --------------------------------------------------------------------------
+
+
+class TestLlamaEngine:
+    @pytest.fixture
+    def engine(self):
+        from ray_tpu.models.llama import LlamaDecodeEngine
+
+        # default cfg is LlamaConfig.debug() — tiny, CPU-friendly
+        return LlamaDecodeEngine(n_pages=16, page_size=4, seed=0)
+
+    def test_prefix_hit_blob_is_cold_prefill_logits(self, engine):
+        sched = DecodeScheduler(engine)
+        prompt = [3, 1, 4, 1, 5]
+        cold = engine.prefill(
+            prompt, engine.prefix_cache.alloc_with_evict(
+                pages_for(len(prompt), engine.page_size)))
+        entry = engine.prefix_cache._entries.get(tuple(prompt))
+        if entry is None:  # prefill alone doesn't insert; go via sched
+            _run_all(sched, [("c", {"prompt": prompt, "max_tokens": 1})])
+            entry = engine.prefix_cache._entries[tuple(prompt)]
+        np.testing.assert_array_equal(np.asarray(entry.blob),
+                                      np.asarray(cold))
+
+    def test_generation_identical_with_and_without_cache_hit(self, engine):
+        import json as _json
+
+        sched = DecodeScheduler(engine)
+        req = {"prompt": [7, 8, 9], "max_tokens": 6}
+        cold = _json.loads(_run_all(sched, [("c", req)])["c"][-1][1])
+        warm = _json.loads(_run_all(sched, [("w", req)])["w"][-1][1])
+        assert warm["cached_prefix"] is True
+        assert warm["tokens"] == cold["tokens"]

@@ -141,6 +141,7 @@ def _random_baseline(n_episodes=40):
 
 
 class TestMultiAgentLearningGate:
+    @pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
     def test_two_policies_learn_simple_spread(self):
         """Two independent PPO policies must jointly beat the random
         baseline by a wide margin (reference:

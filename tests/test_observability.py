@@ -124,6 +124,7 @@ def test_render_prometheus_escapes_help_text():
                for ln in text.split("\n"))
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_metrics_endpoint_scrape_parses_end_to_end(ray_start_regular):
     """Scrape the head /metrics endpoint and validate EVERY line against
     the exposition grammar (guards the escaping fix and any future

@@ -41,6 +41,7 @@ def test_record_transitions_roundtrip(cluster, tmp_path):
     assert mb["actions"].dtype == np.int32
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_bc_learns_cartpole_from_offline_data(cluster, tmp_path):
     """Learning gate: BC on 10k expert CartPole steps reaches >=400
     (expert = 500, random ~= 20)."""

@@ -75,6 +75,7 @@ def test_mpmd_matches_single_process_reference(ray_start_regular):
         trainer.shutdown()
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_mpmd_three_stages(ray_start_regular):
     """Deeper pipeline: one layer per stage across 3 stages."""
     layers = [6, 12, 12, 3]
@@ -124,6 +125,7 @@ def test_1f1b_bounds_activation_stash_at_k(ray_start_regular):
         trainer.shutdown()
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_1f1b_and_gpipe_match_reference_and_each_other(ray_start_regular):
     """1F1B reorders execution and overlaps the weight update into the
     drain — the MATH is still full-batch GD, so both schedules must
@@ -148,6 +150,7 @@ def test_1f1b_and_gpipe_match_reference_and_each_other(ray_start_regular):
             trainer.shutdown()
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_llama_stage_pipeline_matches_reference(ray_start_regular):
     """Transformer-block stages (models/llama.py blocks): stage 0 owns
     embedding+blocks, the last stage owns blocks+norm+head+xent; the

@@ -45,6 +45,7 @@ def test_multiple_subscribers_fanout(cluster):
     assert s2.poll(timeout=5) == list(range(5))
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_worker_and_actor_participation(cluster):
     """Tasks publish, actors subscribe (and vice versa) — the channel is
     cluster-global, not process-local."""

@@ -8,8 +8,10 @@ tuned_examples budgets).
 """
 
 from ray_tpu.rllib import APPOConfig, DQNConfig
+import pytest
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_appo_learns_cartpole():
     config = (APPOConfig()
               .environment("CartPole-v1")
@@ -28,6 +30,7 @@ def test_appo_learns_cartpole():
     assert best >= 400, f"APPO failed to learn CartPole: best={best}"
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_dqn_learns_cartpole():
     config = (DQNConfig()
               .environment("CartPole-v1")

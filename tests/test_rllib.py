@@ -134,6 +134,7 @@ def test_ppo_remote_env_runners(ray_start_regular):
     algo.cleanup()
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_ppo_env_runner_death_tolerated(ray_start_regular):
     """Kill an env-runner actor mid-training: iteration completes on the
     survivor and the dead runner is restored for the next one (reference:
@@ -155,6 +156,7 @@ def test_ppo_env_runner_death_tolerated(ray_start_regular):
     algo.cleanup()
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_ppo_multi_learner_grad_sync(ray_start_regular):
     """num_learners=2: batch sharded across learner actors, gradients
     averaged via ray_tpu.collective allreduce (reference: LearnerGroup's
@@ -180,6 +182,7 @@ def test_ppo_multi_learner_grad_sync(ray_start_regular):
                                    rtol=1e-6)
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_ppo_under_tune(ray_start_regular, tmp_path):
     """Algorithm is a Tune Trainable (reference: Algorithm(Trainable))."""
     from ray_tpu import tune
@@ -254,6 +257,7 @@ def test_impala_vtrace_gradient_direction():
     assert p0 > 0.9, f"policy failed to prefer the paying arm: P(a0)={p0}"
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_impala_learns_cartpole():
     from ray_tpu.rllib import IMPALAConfig
 

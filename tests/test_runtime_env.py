@@ -6,6 +6,8 @@ zip-through-GCS materialization, env var application).
 
 import os
 
+import pytest
+
 import ray_tpu
 
 
@@ -63,6 +65,7 @@ def test_py_modules(ray_start_regular, tmp_path):
     assert ray_tpu.get(use_module.remote()) == 99
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_pip_runtime_env_offline(tmp_path):
     """Per-task pip venv (reference: runtime_env/pip.py): a local package
     installs into a content-addressed venv once per host and activates

@@ -1,0 +1,364 @@
+"""Generative decode on the compiled serve plane (serve/decode.py,
+serve/compiled_dispatch.py decode lanes, TAG_STREAM framing).
+
+Covers the decode request path end to end: token streaming over compiled
+stream lanes (no eager fallback after warm-up), iteration-level
+continuous batching (admissions between decode steps, short requests
+finishing first), prefix-affinity routing across replicas, SSE at the
+HTTP proxy, the TAG_BYTES bytes-body fast lane, the eager fallback
+parity path, replica death mid-stream (attributed error, survivor
+retry), and the prewarmed-worker pool that kills the scale-out
+cold-start tail.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.core.config import global_config
+
+PORT = 18493
+
+
+@pytest.fixture
+def serve_instance():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    serve.start(serve.HTTPOptions(port=PORT))
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _planes(deployment):
+    from ray_tpu.serve import observability as obs
+
+    obs.drain_deferred()
+    return serve.status().get(deployment, {}).get("dispatch_planes", {})
+
+
+def _toy_lm(**opts):
+    @serve.deployment(decode=True, **opts)
+    class ToyLM:
+        def create_decode_engine(self):
+            from ray_tpu.serve.decode import ToyEngine
+
+            return ToyEngine(n_pages=64, page_size=4)
+
+    return ToyLM
+
+
+def _warm_stream(handle, deployment, plane="compiled_stream",
+                 rounds=10):
+    """Issue tiny streams until one rides the compiled plane (the first
+    lands eager while the lane compiles)."""
+    for _ in range(rounds):
+        list(handle.options(stream=True).remote(
+            {"prompt": [99, 98], "max_tokens": 1}))
+        if _planes(deployment).get(plane, 0) >= 1:
+            return
+        time.sleep(0.5)
+    raise AssertionError(
+        f"stream never rode {plane}: {_planes(deployment)}")
+
+
+def _reference_tokens(prompt, max_tokens, n_pages=64, page_size=4):
+    """Ground-truth token sequence from an in-process scheduler."""
+    from ray_tpu.serve.decode import DecodeScheduler, ToyEngine
+
+    sched = DecodeScheduler(ToyEngine(n_pages=n_pages,
+                                      page_size=page_size))
+    assert sched.submit("r", {"prompt": list(prompt),
+                              "max_tokens": max_tokens}) is None
+    frames, active = [], True
+    while active:
+        out, active = sched.step()
+        frames.extend(out)
+    assert frames[-1][1] == "final", frames[-1]
+    return json.loads(frames[-1][2])["tokens"]
+
+
+# --------------------------------------------------------------------------
+# iteration-level continuous batching (scheduler, no cluster)
+# --------------------------------------------------------------------------
+
+
+class TestIterationLevelAdmission:
+    def test_short_admitted_mid_decode_finishes_first(self):
+        """The Orca property: admission happens between decode
+        iterations, so a short request that arrives while a long one is
+        mid-generation joins the running batch immediately and retires
+        first — batch membership is fluid, not epoch-based."""
+        from ray_tpu.serve.decode import DecodeScheduler, ToyEngine
+
+        sched = DecodeScheduler(ToyEngine(n_pages=64, page_size=4),
+                                max_batch=4)
+        sched.submit("long", {"prompt": [1, 2, 3], "max_tokens": 24})
+        for _ in range(3):  # long is now mid-decode
+            sched.step()
+        assert [c for c, _ in sched.retired] == []
+        sched.submit("short", {"prompt": [5], "max_tokens": 2})
+        active = True
+        while active:
+            _, active = sched.step()
+        retired = [c for c, _ in sched.retired]
+        assert retired == ["short", "long"], \
+            "short request must finish before the long one it joined"
+        assert dict(sched.retired)["long"] == 24, \
+            "the long sequence must be unaffected by the mid-flight join"
+
+    def test_admission_capped_by_max_batch(self):
+        from ray_tpu.serve.decode import DecodeScheduler, ToyEngine
+
+        sched = DecodeScheduler(ToyEngine(n_pages=64, page_size=4),
+                                max_batch=2)
+        for i in range(4):
+            sched.submit(f"c{i}", {"prompt": [i + 1], "max_tokens": 8})
+        sched.step()
+        st = sched.stats()
+        assert st["running"] == 2 and st["waiting"] == 2
+
+
+# --------------------------------------------------------------------------
+# streaming over the compiled plane
+# --------------------------------------------------------------------------
+
+
+class TestCompiledDecodeStream:
+    def test_stream_rides_rings_and_matches_reference(self, serve_instance):
+        h = serve.run(_toy_lm(route_prefix=None).bind())
+        _warm_stream(h, "ToyLM")
+        before = _planes("ToyLM")
+        items = list(h.options(stream=True).remote(
+            {"prompt": [3, 1, 4], "max_tokens": 12}))
+        # per-token chunks followed by the final summary frame
+        chunks, final = items[:-1], items[-1]
+        assert final["done"] is True and final["n_generated"] == 12
+        assert [c["token"] for c in chunks] == final["tokens"]
+        assert [c["i"] for c in chunks] == list(range(12))
+        assert final["tokens"] == _reference_tokens([3, 1, 4], 12)
+        after = _planes("ToyLM")
+        assert after.get("compiled_stream", 0) \
+            == before.get("compiled_stream", 0) + 1
+        # zero eager fallbacks once warm
+        assert after.get("eager", 0) == before.get("eager", 0)
+
+    def test_concurrent_streams_share_the_running_batch(
+            self, serve_instance):
+        """Two streams in flight at once continuous-batch on one
+        replica; both outputs match their solo references."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        h = serve.run(_toy_lm(route_prefix=None).bind())
+        _warm_stream(h, "ToyLM")
+
+        def run(prompt):
+            return list(h.options(stream=True).remote(
+                {"prompt": prompt, "max_tokens": 10}))[-1]["tokens"]
+
+        with ThreadPoolExecutor(2) as ex:
+            fa = ex.submit(run, [1, 2])
+            fb = ex.submit(run, [7, 8, 9])
+            assert fa.result(timeout=60) == _reference_tokens([1, 2], 10)
+            assert fb.result(timeout=60) == _reference_tokens([7, 8, 9], 10)
+
+    def test_prefix_affinity_routes_repeat_prompts_to_warm_replica(
+            self, serve_instance):
+        """With two replicas, the router pins a prompt hash to the lane
+        that served it: the repeat request lands on the cache-warm
+        replica and reports cached_prefix — skipping its prefill."""
+        h = serve.run(_toy_lm(route_prefix=None,
+                              num_replicas=2).bind())
+        _warm_stream(h, "ToyLM")
+        prompt = {"prompt": [42, 43, 44, 45], "max_tokens": 3}
+        first = list(h.options(stream=True).remote(dict(prompt)))[-1]
+        hits = 0
+        for _ in range(3):
+            final = list(h.options(stream=True).remote(dict(prompt)))[-1]
+            hits += bool(final.get("cached_prefix"))
+        assert hits == 3, \
+            "repeat prompts must ride the prefix-affinity lane " \
+            f"(first={first}, hits={hits}/3)"
+
+    def test_malformed_request_fails_fast(self, serve_instance):
+        h = serve.run(_toy_lm(route_prefix=None).bind())
+        _warm_stream(h, "ToyLM")
+        with pytest.raises(Exception, match="prompt"):
+            list(h.options(stream=True).remote({"prompt": []}))
+
+
+# --------------------------------------------------------------------------
+# HTTP: SSE + bytes-body fast lane
+# --------------------------------------------------------------------------
+
+
+class TestHTTPDecodeAndBytes:
+    def test_sse_stream_over_http(self, serve_instance):
+        serve.run(_toy_lm(route_prefix="/lm").bind())
+        body = json.dumps({"prompt": [3, 1, 4],
+                           "max_tokens": 6}).encode()
+        # warm: the first request may ride eager; the payload path (raw
+        # TAG_BYTES body) and the SSE framing are identical either way
+        for _ in range(2):
+            resp = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{PORT}/lm", data=body), timeout=30)
+        assert resp.headers["content-type"] == "text/event-stream"
+        records = [json.loads(line[len(b"data: "):])
+                   for line in resp.read().split(b"\n\n")
+                   if line.startswith(b"data: ")]
+        assert records[-1]["done"] is True
+        assert records[-1]["tokens"] == _reference_tokens([3, 1, 4], 6)
+        assert [r["token"] for r in records[:-1]] == records[-1]["tokens"]
+
+    def test_bytes_body_rides_tag_bytes_lane(self, serve_instance):
+        @serve.deployment(bytes_body=True, route_prefix="/raw")
+        class Shout:
+            def __call__(self, body):
+                assert isinstance(body, bytes), type(body)
+                return body.upper()
+
+        h = serve.run(Shout.bind())
+        # warm until a call rides the bytes lane (first may land eager)
+        for _ in range(10):
+            assert h.remote(b"abc").result(timeout=30) == b"ABC"
+            if _planes("Shout").get("compiled_bytes", 0) >= 1:
+                break
+            time.sleep(0.5)
+        planes = _planes("Shout")
+        assert planes.get("compiled_bytes", 0) >= 1, planes
+        # HTTP: the raw request body goes straight to __call__
+        resp = urllib.request.urlopen(urllib.request.Request(
+            f"http://127.0.0.1:{PORT}/raw", data=b"hello"), timeout=30)
+        assert resp.read() == b"HELLO"
+
+    def test_eager_fallback_parity_when_compiled_disabled(
+            self, serve_instance):
+        """compiled_dispatch=False: decode streams ride the eager actor
+        plane (num_returns="streaming") with identical frames."""
+        h = serve.run(_toy_lm(route_prefix=None, name="ToyLMEager",
+                              compiled_dispatch=False).bind())
+        items = list(h.options(stream=True).remote(
+            {"prompt": [3, 1, 4], "max_tokens": 5}))
+        assert items[-1]["tokens"] == _reference_tokens([3, 1, 4], 5)
+        planes = _planes("ToyLMEager")
+        assert planes.get("compiled_stream", 0) == 0, planes
+        assert planes.get("eager", 0) >= 1, planes
+
+
+# --------------------------------------------------------------------------
+# chaos: replica dies mid-stream
+# --------------------------------------------------------------------------
+
+
+class TestDecodeStreamChaos:
+    @pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
+    def test_replica_death_mid_stream_attributed_then_survivor_serves(
+            self):
+        """Kill the replica worker at a decode iteration mid-stream (the
+        dag.exec chaos point). The consumer gets an attributed
+        ActorDiedError promptly — never a wedge or bare timeout — and
+        once the controller restarts the replica, a retry re-prefills
+        and completes."""
+        from ray_tpu.core.exceptions import ActorDiedError
+
+        cfg = global_config()
+        # every dag.exec invoke from the 25th on crashes the worker:
+        # warm-up streams (~2 invokes each) stay under the threshold,
+        # the long stream crosses it mid-generation
+        cfg.test_fault_spec = "dag.exec.handle_request_decode=crash@25+"
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+        serve.start(serve.HTTPOptions(port=PORT + 1))
+        try:
+            h = serve.run(_toy_lm(route_prefix=None).bind())
+            _warm_stream(h, "ToyLM")
+            it = h.options(stream=True).remote(
+                {"prompt": [1, 2, 3], "max_tokens": 50})
+            got, err, t0 = [], None, time.monotonic()
+            try:
+                for item in it:
+                    got.append(item)
+            except ActorDiedError as e:
+                err = e
+            elapsed = time.monotonic() - t0
+            assert err is not None, \
+                f"stream completed without error: {got[-1:]}"
+            assert elapsed < 30, "wedged instead of failing fast"
+            # attribution: node + worker pid, never a bare timeout
+            msg = str(err)
+            assert "node" in msg and "pid" in msg, msg
+            # the restarted replica (fresh process, hit counter at 0)
+            # serves a retry with a fresh prefill
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    out = list(h.options(stream=True).remote(
+                        {"prompt": [1, 2, 3], "max_tokens": 3}))
+                    if out and out[-1].get("done"):
+                        break
+                except Exception:
+                    pass
+                assert time.monotonic() < deadline, \
+                    "no survivor served the retry"
+                time.sleep(0.5)
+            assert out[-1]["tokens"] == _reference_tokens([1, 2, 3], 3)
+        finally:
+            cfg.test_fault_spec = ""
+            from ray_tpu.core import fault_injection
+
+            fault_injection.reset()
+            serve.shutdown()
+            ray_tpu.shutdown()
+
+
+# --------------------------------------------------------------------------
+# prewarmed worker pool
+# --------------------------------------------------------------------------
+
+
+class TestPrewarmPool:
+    def test_node_maintains_spare_workers_and_refills(self):
+        """serve_prewarm_pool_size keeps N idle-or-starting workers
+        beyond demand, so a scale-out replica binds to a live process
+        instead of paying fork+import. Consuming the spares triggers a
+        refill."""
+        from ray_tpu.core import runtime as runtime_mod
+
+        cfg = global_config()
+        cfg.serve_prewarm_pool_size = 2
+        try:
+            ray_tpu.init(num_cpus=4, num_tpus=0)
+            rt = runtime_mod.get_current_runtime()
+            nodes = list(rt.head.nodes.values())
+
+            def warm():
+                return sum(
+                    sum(1 for w in n._idle if w.state == "idle")
+                    + n._num_starting for n in nodes)
+
+            deadline = time.monotonic() + 30
+            while warm() < 2:
+                assert time.monotonic() < deadline, \
+                    f"prewarm pool never filled: {warm()}"
+                time.sleep(0.1)
+
+            # occupy workers with long-lived actors; the pump refills
+            # the spare pool behind them
+            @ray_tpu.remote
+            class Hold:
+                def ping(self):
+                    return "ok"
+
+            actors = [Hold.remote() for _ in range(2)]
+            assert all(ray_tpu.get(a.ping.remote(), timeout=60) == "ok"
+                       for a in actors)
+            deadline = time.monotonic() + 30
+            while warm() < 2:
+                assert time.monotonic() < deadline, \
+                    f"prewarm pool never refilled: {warm()}"
+                time.sleep(0.1)
+        finally:
+            cfg.serve_prewarm_pool_size = 0
+            ray_tpu.shutdown()

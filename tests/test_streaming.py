@@ -67,6 +67,7 @@ def test_stream_empty(ray_start_regular):
     assert list(empty.remote()) == []
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_stream_empty_stress(ray_start_regular):
     """Regression: empty-stream EOF delivery under GC + task load.
 
@@ -227,6 +228,7 @@ def test_stream_across_daemon_nodes(ray_start_cluster):
     assert [int(v[0]) for v in vals] == [0, 1]
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_serve_streaming_and_data_split_head_free(ray_start_regular):
     """Round-5 verdict ask #1 "done" criteria: a Serve streaming response
     and a Data streaming_split iterator both run with zero new head task

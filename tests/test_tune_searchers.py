@@ -104,6 +104,7 @@ class TestOptunaSearchAdapter:
         bests = [_drive(OptunaSearch(seed=s), 100) for s in range(4)]
         assert np.mean(bests) < 2.5, bests
 
+    @pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
     def test_adapter_in_a_real_tune_run(self):
         """End-to-end: Tuner + OptunaSearch, bounded by num_samples."""
         ray_tpu.init(num_cpus=2)

@@ -35,6 +35,7 @@ def _run_burst(n2):
     return set(nodes)
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_idle_inprocess_peer_steals(no_submit_spill):
     cluster = Cluster(head_node_args={"num_cpus": 1})
     n2 = cluster.add_node(num_cpus=2)
@@ -45,6 +46,7 @@ def test_idle_inprocess_peer_steals(no_submit_spill):
         cluster.shutdown()
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_idle_daemon_steals_over_tcp(no_submit_spill):
     cluster = Cluster(head_node_args={"num_cpus": 1})
     n2 = cluster.add_node(num_cpus=2, separate_process=True)
@@ -55,6 +57,7 @@ def test_idle_daemon_steals_over_tcp(no_submit_spill):
         cluster.shutdown()
 
 
+@pytest.mark.slow  # >5s on the 1-core box: full-tier only (tier-1 wall budget)
 def test_stealing_disabled_keeps_work_local(no_submit_spill):
     cfg = global_config()
     cfg.direct_steal_enabled = False
