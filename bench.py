@@ -76,25 +76,16 @@ def run_with_compile_retries(fn, attempts: int = 3, cleanup=_compile_cleanup,
 
 
 def peak_flops_per_chip() -> float:
-    """bf16 peak FLOPs of the local accelerator."""
+    """bf16 peak FLOPs of the local accelerator (the observatory's table
+    — one source of truth with the /api/xla roofline). The historical
+    ``RAY_TPU_PEAK_FLOPS`` env override still wins; ``xla_peak_flops``
+    in Config is the knob the rest of the tree uses."""
     env = os.environ.get("RAY_TPU_PEAK_FLOPS")
     if env:
         return float(env)
-    import jax
+    from ray_tpu.util.xla_observatory import peak_flops_per_chip as peak
 
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "").lower()
-    table = {
-        "tpu v5 lite": 197e12,   # v5e
-        "tpu v5e": 197e12,
-        "tpu v5": 459e12,        # v5p
-        "tpu v4": 275e12,
-        "tpu v6 lite": 918e12,   # v6e (Trillium)
-    }
-    for k, v in table.items():
-        if k in kind:
-            return v
-    return 197e12 if d.platform == "tpu" else 1e12  # CPU: nominal
+    return peak()
 
 
 def measure_sharded(cfg, mesh, batch, seq, steps, donate=True,

@@ -162,7 +162,7 @@ def run_decode_phase(port: int, streams: int, concurrency: int,
     # small prompt pool with repeats: admissions after the first visit
     # of each prompt hit the prefix cache and skip prefill
     prompts = [[p + 1, p + 2, p + 3, p + 4] for p in range(4)]
-    ttfts, finals, errors = [], [], [0]
+    ttfts, itls, finals, errors = [], [], [], [0]
     lock = threading.Lock()
     todo = list(range(streams))
 
@@ -178,16 +178,25 @@ def run_decode_phase(port: int, streams: int, concurrency: int,
                     {"prompt": prompts[i % len(prompts)],
                      "max_tokens": max_tokens})
                 first = next(iter_ := iter(it))
-                ttft = time.perf_counter() - t0
+                t_chunk = time.perf_counter()
+                ttft = t_chunk - t0
+                # client-observed inter-token gaps between consecutive
+                # streamed chunks of this sequence
+                gaps = []
                 last = first
                 for last in iter_:
-                    pass
+                    now = time.perf_counter()
+                    if isinstance(last, dict) and last.get("done"):
+                        break
+                    gaps.append(now - t_chunk)
+                    t_chunk = now
             except Exception:
                 with lock:
                     errors[0] += 1
                 continue
             with lock:
                 ttfts.append(ttft)
+                itls.extend(gaps)
                 finals.append(last)
 
     t_start = time.perf_counter()
@@ -202,6 +211,8 @@ def run_decode_phase(port: int, streams: int, concurrency: int,
     tokens_total = sum(f.get("n_generated", 0) for f in finals)
     hits = sum(1 for f in finals if f.get("cached_prefix"))
     planes_after = planes()
+    obs.drain_deferred()
+    server_row = serve.status().get("ToyLM", {})
     result = {
         "streams": len(finals),
         "concurrency": concurrency,
@@ -212,6 +223,12 @@ def run_decode_phase(port: int, streams: int, concurrency: int,
         "tokens_per_s": round(tokens_total / elapsed, 1),
         "ttft_p50_ms": _pct(ttfts, 0.50) if ttfts else None,
         "ttft_p99_ms": _pct(ttfts, 0.99) if ttfts else None,
+        # client-observed inter-token latency + the server-side
+        # histogram's view of the same (serve.status() itl_ms)
+        "itl_p50_ms": _pct(itls, 0.50) if itls else None,
+        "itl_p99_ms": _pct(itls, 0.99) if itls else None,
+        "server_itl_ms": server_row.get("itl_ms", {}),
+        "server_tokens_generated": server_row.get("tokens_generated", 0),
         "prefix_hit_rate": round(hits / len(finals), 3) if finals
         else 0.0,
         "eager_after_warm": planes_after.get("eager", 0) - eager_before,

@@ -202,6 +202,34 @@ def _cmd_goodput(args) -> int:
     return 0
 
 
+def _cmd_xla(args) -> int:
+    """Render the XLA compile observatory from /api/xla."""
+    import urllib.request
+
+    from ray_tpu.util.xla_observatory import format_xla
+
+    base = args.address
+    if not base.startswith("http"):
+        base = "http://" + base
+    with urllib.request.urlopen(f"{base}/api/xla", timeout=30) as resp:
+        report = json.loads(resp.read().decode())
+    if args.program:
+        progs = report.get("programs", {})
+        rec = progs.get(args.program)
+        if rec is None:
+            print(f"no program {args.program!r} in the registry "
+                  f"(known: {', '.join(sorted(progs)) or 'none'})",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps({args.program: rec}, indent=2))
+        return 0
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(format_xla(report))
+    return 0
+
+
 def _cmd_stack(args) -> int:
     """Cluster-wide collapsed-stack dump from /api/stacks: one bounded
     sampling round per process, printed per-process (or merged with
@@ -303,6 +331,18 @@ def main(argv=None) -> int:
     gp.add_argument("--json", action="store_true",
                     help="print the raw ledger JSON")
 
+    xl = sub.add_parser("xla",
+                        help="XLA compile observatory: per-program "
+                             "compiles/recompiles, FLOPs, roofline "
+                             "verdict, MFU")
+    xl.add_argument("--address", default="http://127.0.0.1:8265",
+                    help="dashboard address serving /api/xla")
+    xl.add_argument("--json", action="store_true",
+                    help="print the raw report JSON")
+    xl.add_argument("--program", default=None, metavar="NAME",
+                    help="print one program's full registry record "
+                         "(avals, shardings, churn) as JSON")
+
     st = sub.add_parser("stack",
                         help="cluster-wide collapsed-stack dump (one "
                              "bounded sample round per process)")
@@ -361,6 +401,8 @@ def main(argv=None) -> int:
         return _cmd_timeline(args)
     if args.cmd == "goodput":
         return _cmd_goodput(args)
+    if args.cmd == "xla":
+        return _cmd_xla(args)
     if args.cmd == "stack":
         return _cmd_stack(args)
     if args.cmd == "up":

@@ -98,8 +98,10 @@ def init(
     object_ref_mod.set_runtime(rt)
     if global_config().device_telemetry_enabled:
         # driver-process JAX device gauges land in the head registry
-        from ray_tpu.util.device_telemetry import start_device_telemetry
+        from ray_tpu.util.device_telemetry import (observe_jax_import,
+                                                    start_device_telemetry)
 
+        observe_jax_import()  # compile events from process start, not tick 1
         _head._device_telemetry_stop = start_device_telemetry(
             node_hex=_head.head_node.hex)
     return rt

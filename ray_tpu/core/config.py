@@ -175,6 +175,24 @@ class Config:
     # per-process JAX/TPU device telemetry (HBM gauges + jax.monitoring)
     device_telemetry_enabled: bool = True
     device_telemetry_interval_ms: int = 10_000
+    # XLA compile observatory (util/xla_observatory.py): per-process
+    # registry of observed jitted executables (compile wall time,
+    # cost/memory analyses, aval fingerprints) feeding the standard
+    # metrics/span channels. The kill switch exists so bench.py
+    # --xla-bench can measure the observation cost (BENCH_XLA.json,
+    # <=1% of the spmd step)
+    xla_observatory_enabled: bool = True
+    # recompile-storm detector (train/health.py): >= trigger NEW-aval
+    # recompiles of one program within a monitor tick raises one
+    # WARNING naming the program and the shape churn; it clears after
+    # clear_ticks consecutive quiet ticks (hysteresis — no flapping)
+    xla_storm_trigger_recompiles: int = 3
+    xla_storm_clear_ticks: int = 2
+    # roofline ceiling overrides for the xla report, in FLOP/s and
+    # bytes/s per chip; 0 = auto-detect from the device kind (TPU
+    # table) or fall back to nominal trend-only CPU values
+    xla_peak_flops: float = 0.0
+    xla_peak_hbm_bytes: float = 0.0
     # object/memory observability (core/ref_tracker.py): per-process
     # ObjectRef accounting joined head-side into the `ray memory` analog
     # (util/state.memory_summary, /api/memory). The kill switch exists so

@@ -672,8 +672,10 @@ def main(argv=None) -> int:
         threading.Thread(target=_span_report_loop, daemon=True,
                          name="flightrec-report").start()
     if cfg.device_telemetry_enabled:
-        from ray_tpu.util.device_telemetry import start_device_telemetry
+        from ray_tpu.util.device_telemetry import (observe_jax_import,
+                                                    start_device_telemetry)
 
+        observe_jax_import()  # compile events from process start, not tick 1
         start_device_telemetry(node_hex=node.hex)
     try:
         head.stopped.wait()

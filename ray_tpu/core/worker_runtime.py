@@ -1358,8 +1358,10 @@ def worker_main(argv=None) -> None:
         lambda evs: channel.send("cevents", evs),
         global_config().cluster_event_flush_ms / 1000.0)
     if global_config().device_telemetry_enabled:
-        from ray_tpu.util.device_telemetry import start_device_telemetry
+        from ray_tpu.util.device_telemetry import (observe_jax_import,
+                                                    start_device_telemetry)
 
+        observe_jax_import()  # compile events from process start, not tick 1
         start_device_telemetry(node_hex=runtime.node_hex)
     from ray_tpu.util.sampling_profiler import start_from_env
 

@@ -23,6 +23,7 @@ control plane — with:
     GET  /api/metrics/history?name=   sampled metric time-series rings
                                 (name may be a prefix* or regex -> multi)
     GET  /api/goodput           badput ledger + straggler/regression/TTRT
+    GET  /api/xla               XLA compiled-program registry + roofline
     GET  /api/stacks?duration_ms=     cluster collapsed-stack dump
     GET  /api/pubsub?channel=&cursor=&timeout=   poll a pubsub channel
     GET  /api/nodes/<hex>/logs[/<name>]     per-node agent: log browse/tail
@@ -274,6 +275,13 @@ class DashboardServer:
             from ray_tpu.util.goodput import goodput_report
 
             h._json(goodput_report(self.head))
+        elif path == "/api/xla":
+            # the XLA compile observatory: per-program registry fold +
+            # roofline/MFU join (same dict `python -m ray_tpu xla`
+            # renders)
+            from ray_tpu.util.xla_observatory import xla_report
+
+            h._json(xla_report(self.head))
         elif path == "/api/stacks":
             # cluster-wide collapsed-stack dump (`python -m ray_tpu
             # stack`): blocks for the sample duration + daemon round

@@ -31,6 +31,17 @@ import jax.numpy as jnp
 from ray_tpu.ops.layers import rms_norm, rotary_embedding
 from ray_tpu.parallel.ring_attention import plain_attention, ring_attention_local
 from ray_tpu.parallel.sharding import DEFAULT_RULES, logical_sharding
+from ray_tpu.util.metrics import Gauge
+from ray_tpu.util.xla_observatory import observe_compiled
+
+# the decode engine's padded-bucket contract made measurable: distinct
+# padded KV lengths each cost one compilation (decode_step_with_cache
+# docstring) — this gauge is the decode-side churn-attribution signal
+# next to ray_tpu_xla_program_variants{program=llama.decode}
+_g_decode_buckets = Gauge(
+    "ray_tpu_serve_decode_buckets",
+    "Distinct padded KV lengths (compile buckets) the decode engine "
+    "has served", tag_keys=("kind",))
 
 
 @dataclass(frozen=True)
@@ -509,16 +520,29 @@ class LlamaDecodeEngine:
         self._np = np
         self.k_store = np.zeros(shape, np.float32)
         self.v_store = np.zeros(shape, np.float32)
-        self._prefill_fn = jax.jit(partial(prefill_with_cache, self.cfg))
-        self._decode_fn = jax.jit(partial(decode_step_with_cache, self.cfg))
+        self._prefill_fn = observe_compiled(
+            jax.jit(partial(prefill_with_cache, self.cfg)),
+            "llama.prefill")
+        self._decode_fn = observe_compiled(
+            jax.jit(partial(decode_step_with_cache, self.cfg)),
+            "llama.decode")
         self.prefill_calls = 0
         self.decode_calls = 0
+        self._buckets: Dict[str, set] = {"prefill": set(), "decode": set()}
+
+    def _note_bucket(self, kind: str, tpad: int) -> None:
+        buckets = self._buckets[kind]
+        if tpad not in buckets:
+            buckets.add(tpad)
+            _g_decode_buckets.set(float(len(buckets)),
+                                  tags={"kind": kind})
 
     def prefill(self, tokens, pages):
         np = self._np
         self.prefill_calls += 1
         T = len(tokens)
         tpad = len(pages) * self.page_size
+        self._note_bucket("prefill", tpad)
         toks = np.zeros((1, tpad), np.int32)
         toks[0, :T] = tokens
         logits, ks, vs = self._prefill_fn(self.params, jnp.asarray(toks))
@@ -540,6 +564,7 @@ class LlamaDecodeEngine:
         np = self._np
         self.decode_calls += 1
         tpad = len(pages) * self.page_size
+        self._note_bucket("decode", tpad)
         # gather [n_seq_pages, page_size, L, nkv, hd] -> [L, Tpad, nkv, hd]
         kc = np.transpose(
             self.k_store[pages].reshape(tpad, *self.k_store.shape[2:]),
@@ -612,7 +637,9 @@ def make_train_step(cfg: LlamaConfig, mesh, optimizer=None, rules=None):
         "step": repl,
     }
 
-    init_jit = jax.jit(init_state, out_shardings=state_shardings)
+    init_jit = observe_compiled(
+        jax.jit(init_state, out_shardings=state_shardings),
+        "llama.gspmd_init")
 
     def step_fn(state, tokens):
         loss, grads = jax.value_and_grad(
@@ -623,12 +650,14 @@ def make_train_step(cfg: LlamaConfig, mesh, optimizer=None, rules=None):
         return ({"params": new_params, "opt_state": new_opt,
                  "step": state["step"] + 1}, loss)
 
-    train_step = jax.jit(
-        step_fn,
-        in_shardings=(state_shardings, data_sharding),
-        out_shardings=(state_shardings, repl),
-        donate_argnums=(0,),
-    )
+    train_step = observe_compiled(
+        jax.jit(
+            step_fn,
+            in_shardings=(state_shardings, data_sharding),
+            out_shardings=(state_shardings, repl),
+            donate_argnums=(0,),
+        ),
+        "llama.gspmd_train_step")
     return init_jit, train_step, data_sharding, state_shardings
 
 
@@ -835,7 +864,9 @@ def make_pipeline_train_step(cfg: LlamaConfig, mesh, num_microbatches: int,
         "step": repl,
     }
 
-    init_jit = jax.jit(init_state, out_shardings=state_shardings)
+    init_jit = observe_compiled(
+        jax.jit(init_state, out_shardings=state_shardings),
+        "llama.pipe_init")
 
     act_spec = {"x": P(bspec, None, None), "pos": P(bspec, None)}
 
@@ -889,10 +920,12 @@ def make_pipeline_train_step(cfg: LlamaConfig, mesh, num_microbatches: int,
         return ({"params": new_params, "opt_state": new_opt,
                  "step": state["step"] + 1}, l)
 
-    train_step = jax.jit(
-        step_fn,
-        in_shardings=(state_shardings, data_sharding),
-        out_shardings=(state_shardings, repl),
-        donate_argnums=(0,),
-    )
+    train_step = observe_compiled(
+        jax.jit(
+            step_fn,
+            in_shardings=(state_shardings, data_sharding),
+            out_shardings=(state_shardings, repl),
+            donate_argnums=(0,),
+        ),
+        "llama.pipe_train_step")
     return init_jit, train_step, data_sharding, state_shardings

@@ -148,6 +148,19 @@ def constraint(x, logical_axes, mesh=None, rules=None):
         x, logical_sharding(logical_axes, mesh, rules))
 
 
+def observed_placement_jit(fn, sharding, program: str):
+    """jit ``fn`` with ``out_shardings=sharding``, registered with the
+    XLA compile observatory under ``program`` — the jit-entry seam the
+    placement/gather helpers (and ``train/spmd.py``) share, so every
+    placement executable lands in the compiled-program registry with
+    its compile time and cost/memory analyses."""
+    import jax
+
+    from ray_tpu.util.xla_observatory import observe_compiled
+
+    return observe_compiled(jax.jit(fn, out_shardings=sharding), program)
+
+
 def shard_device_put(x, sharding):
     """Per-shard host→device placement for ingest.
 

@@ -43,6 +43,7 @@ from ray_tpu.serve.kv_cache import (
     flush_kv_gauges,
     pages_for,
 )
+from ray_tpu.serve import observability as _obs
 from ray_tpu.util import flight_recorder as _fr
 
 # one registration site per span name (graftlint metrics-hygiene)
@@ -55,7 +56,7 @@ _GAUGE_INTERVAL_S = 0.25
 
 class _Seq:
     __slots__ = ("corr", "prompt", "max_tokens", "eos", "kv", "pos",
-                 "generated", "eager", "cached_prefix")
+                 "generated", "eager", "cached_prefix", "last_chunk_ts")
 
     def __init__(self, corr, prompt, max_tokens, eos, kv, pos, eager,
                  cached_prefix):
@@ -68,6 +69,7 @@ class _Seq:
         self.generated: List[int] = []
         self.eager = eager
         self.cached_prefix = cached_prefix
+        self.last_chunk_ts: Optional[float] = None  # ITL anchor
 
 
 def parse_decode_request(value) -> dict:
@@ -255,6 +257,10 @@ class DecodeScheduler:
             self.running[corr] = seq
             self.admitted += 1
             _sp_prefill.end(_t0, self.deployment)
+            seq.last_chunk_ts = _fr.now()
+            if _obs.enabled():
+                _obs.TOKENS_GENERATED.inc(
+                    tag_key=_obs.dep_key(self.deployment))
             replies.append((corr, "chunk", _chunk_payload(seq, first, 0)))
             if self._finished(seq, first):
                 self._retire_locked(seq, replies)
@@ -283,6 +289,8 @@ class DecodeScheduler:
         if not self.running:
             return
         _t0 = _fr.now()
+        itl_samples: List[float] = []
+        n_tokens = 0
         for corr in list(self.running):
             seq = self.running[corr]
             if seq.pos >= seq.kv.capacity():
@@ -304,12 +312,22 @@ class DecodeScheduler:
             seq.pos += 1
             nxt = int(np.argmax(logits))
             seq.generated.append(nxt)
+            _now = _fr.now()
+            if seq.last_chunk_ts is not None:
+                itl_samples.append(_now - seq.last_chunk_ts)
+            seq.last_chunk_ts = _now
+            n_tokens += 1
             replies.append((corr, "chunk",
                             _chunk_payload(seq, nxt,
                                            len(seq.generated) - 1)))
             if self._finished(seq, nxt):
                 self._retire_locked(seq, replies)
         _sp_decode_step.end(_t0, self.deployment)
+        if n_tokens and _obs.enabled():
+            key = _obs.dep_key(self.deployment)
+            _obs.TOKENS_GENERATED.inc(float(n_tokens), tag_key=key)
+            for s in itl_samples:
+                _obs.ITL.observe(s, tag_key=key)
 
     def _finished(self, seq: _Seq, token: int) -> bool:
         if seq.eos is not None and token == seq.eos:

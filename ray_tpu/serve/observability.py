@@ -89,6 +89,15 @@ DISPATCH_TIME = Histogram(
     "Time to hand a request to its transport (compiled ring write or "
     "eager remote() submit) — the dispatch-plane overhead, per plane",
     boundaries=_DISPATCH_BUCKETS, tag_keys=("deployment", "plane"))
+ITL = Histogram(
+    "ray_tpu_serve_itl_seconds",
+    "Inter-token latency: gap between consecutive token chunks "
+    "streamed for one decode sequence",
+    boundaries=_WAIT_BUCKETS, tag_keys=("deployment",))
+TOKENS_GENERATED = Counter(
+    "ray_tpu_serve_tokens_generated_total",
+    "Tokens emitted by the generative-decode plane",
+    tag_keys=("deployment",))
 SHED = Counter(
     "ray_tpu_serve_shed_total",
     "Requests shed at the dispatching process: concurrency budget "
@@ -471,8 +480,9 @@ def serve_stats(percentiles=(0.5, 0.95, 0.99)) -> Dict[str, dict]:
 
     def ent(dep: str) -> dict:
         return out.setdefault(dep, {
-            "latency_ms": {}, "dispatch_ms": {}, "requests": 0,
-            "errors": 0, "timeouts": 0, "shed": 0, "error_rate": 0.0,
+            "latency_ms": {}, "dispatch_ms": {}, "itl_ms": {},
+            "requests": 0, "errors": 0, "timeouts": 0, "shed": 0,
+            "tokens_generated": 0, "error_rate": 0.0,
             "queue_depth": 0.0})
 
     # latency/dispatch percentiles: merge bucket counts across tags and
@@ -506,6 +516,9 @@ def serve_stats(percentiles=(0.5, 0.95, 0.99)) -> Dict[str, dict]:
     # dispatch-plane overhead (compiled ring write vs eager submit),
     # merged across planes; per-plane counts ride alongside
     fill_percentiles("dispatch_ms", "ray_tpu_serve_dispatch_seconds")
+    # generative-decode inter-token latency (p50/p99 are the numbers a
+    # streaming SLO is written against)
+    fill_percentiles("itl_ms", "ray_tpu_serve_itl_seconds")
     for tags, v in aggregate_histogram(
             "ray_tpu_serve_dispatch_seconds").items():
         t = dict(tags)
@@ -521,7 +534,9 @@ def serve_stats(percentiles=(0.5, 0.95, 0.99)) -> Dict[str, dict]:
     for name, field in (("ray_tpu_serve_requests_total", "requests"),
                         ("ray_tpu_serve_errors_total", "errors"),
                         ("ray_tpu_serve_timeouts_total", "timeouts"),
-                        ("ray_tpu_serve_shed_total", "shed")):
+                        ("ray_tpu_serve_shed_total", "shed"),
+                        ("ray_tpu_serve_tokens_generated_total",
+                         "tokens_generated")):
         for tags, value in flat.get(name, []):
             dep = dict(tags).get("deployment", "")
             ent(dep)[field] += value

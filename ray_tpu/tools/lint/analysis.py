@@ -312,6 +312,11 @@ class ModuleInfo:
         field(default_factory=list)
     env_reads: List[EnvRead] = field(default_factory=list)
     metrics: List[MetricReg] = field(default_factory=list)
+    # registrations through the dynamic `registry().record(name, mtype,…)`
+    # API — kept separate from `metrics` so metrics-hygiene's
+    # one-registration-site rule does not fire on intentional record-style
+    # call sites; doc-sync consumes both lists
+    dynamic_metrics: List[MetricReg] = field(default_factory=list)
     suppressions: Dict[int, Set[str]] = field(default_factory=dict)
     protocol_version: Optional[int] = None
     config_fields: List[str] = field(default_factory=list)
@@ -324,6 +329,9 @@ class TreeIndex:
     modules: Dict[str, ModuleInfo] = field(default_factory=dict)
     parse_errors: List[Tuple[str, str]] = field(default_factory=list)
     doc_text: str = ""     # concatenated docs/README text for mention checks
+    # per-file doc lines (path relative to the repo dir -> lines), so
+    # doc-sync findings can point at the exact doc file and line
+    doc_files: Dict[str, List[str]] = field(default_factory=dict)
 
     def suppressed(self, path: str, line: int, check: str) -> bool:
         mod = self.modules.get(path)
@@ -655,7 +663,12 @@ class _ModuleCollector:
         fn = call.func
         name = fn.attr if isinstance(fn, ast.Attribute) else (
             fn.id if isinstance(fn, ast.Name) else "")
+        # `from …metrics import Counter as _Counter` is the private-alias
+        # idiom several modules use; strip the underscore prefix so those
+        # registration sites are still seen
+        name = name.lstrip("_")
         if name not in METRIC_CTORS and name not in SPAN_CTORS:
+            self._maybe_dynamic_metric(call)
             return
         if not (call.args and isinstance(call.args[0], ast.Constant)
                 and isinstance(call.args[0].value, str)):
@@ -674,6 +687,23 @@ class _ModuleCollector:
             name=call.args[0].value,
             mtype="span" if name in SPAN_CTORS else name.lower(),
             tag_keys=tag_keys, line=call.lineno))
+
+    def _maybe_dynamic_metric(self, call: ast.Call):
+        """`registry().record("name", "counter", …)` — the inline
+        registration API used where constructing a module-level handle is
+        not worth it (the head's RPC/task counters)."""
+        fn = call.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "record"):
+            return
+        if len(call.args) < 2:
+            return
+        a0, a1 = call.args[0], call.args[1]
+        if not (isinstance(a0, ast.Constant) and isinstance(a0.value, str)
+                and isinstance(a1, ast.Constant)
+                and a1.value in ("counter", "gauge", "histogram")):
+            return
+        self.mod.dynamic_metrics.append(MetricReg(
+            name=a0.value, mtype=a1.value, tag_keys=None, line=call.lineno))
 
     def _maybe_weakref(self, call: ast.Call, fi: Optional[FunctionInfo]):
         if fi is None:
@@ -1541,8 +1571,12 @@ def collect_tree(root: str, doc_roots: Optional[List[str]] = None,
         for fpath in files:
             try:
                 with open(fpath, "r", encoding="utf-8") as f:
-                    texts.append(f.read())
+                    text = f.read()
             except OSError:
-                pass
+                continue
+            texts.append(text)
+            rel_doc = os.path.relpath(os.path.abspath(fpath),
+                                      os.path.dirname(root))
+            idx.doc_files[rel_doc] = text.splitlines()
     idx.doc_text = "\n".join(texts)
     return idx

@@ -7,6 +7,7 @@ keys, and docs.  Never rename one; add a new id instead.
 from __future__ import annotations
 
 import hashlib
+import re
 from collections import defaultdict, deque
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Set, Tuple
@@ -33,6 +34,7 @@ CHECK_RPC_CYCLE = "rpc-cycle"
 CHECK_REPLY = "reply-completeness"
 CHECK_DEATH_PATH = "death-path-completeness"
 CHECK_RING_NET = "ring-protocol-net"
+CHECK_DOC_SYNC = "doc-sync"
 
 ALL_CHECKS = (
     CHECK_LOCK_ORDER,
@@ -49,6 +51,7 @@ ALL_CHECKS = (
     CHECK_REPLY,
     CHECK_DEATH_PATH,
     CHECK_RING_NET,
+    CHECK_DOC_SYNC,
 )
 
 # Blocking kinds that also count as "channel send" for gc-reentrancy.
@@ -529,6 +532,80 @@ def check_metrics_hygiene(idx: TreeIndex) -> List[Finding]:
     return findings
 
 
+# ------------------------------------------------------------------ doc-sync
+
+# A metric mention in prose: lowercase `ray_tpu_…` with nothing
+# identifier-ish (or a path/module separator) immediately before it.
+# The lowercase requirement excludes RAY_TPU_* env vars; the lookbehind
+# excludes `ray_tpu/util/...` paths and `foo.ray_tpu_x` attribute spells;
+# `ray_tpu://` URLs and `ray_tpu.util` module paths never match because
+# the literal `ray_tpu_` (with the trailing underscore) never appears in
+# them.  A token may end in `_` — that is a documented *family prefix*
+# (`ray_tpu_train_*`, or a long name split across a line break).
+_DOC_METRIC_TOKEN = re.compile(r"(?<![A-Za-z0-9_/.])ray_tpu_[a-z0-9_]+")
+
+# Histogram registrations fan out to these series suffixes at export
+# time, so docs legitimately reference `<base>_count` / `_sum` /
+# `_bucket` names that have no registration site of their own.
+_HIST_SUFFIXES = ("_count", "_sum", "_bucket")
+
+
+def check_doc_sync(idx: TreeIndex) -> List[Finding]:
+    """Docs and the metric/span registry must agree.
+
+    Forward: every ``ray_tpu_*`` metric token in the scanned docs must
+    resolve to a registered metric (exactly, as a documented family
+    prefix ending in ``_``, or as a histogram export suffix).  Reverse:
+    every registered metric/span name must be mentioned somewhere in the
+    docs — the stale-doc detector that keeps newly registered names from
+    shipping undocumented.  Skipped entirely when no docs were scanned
+    (fixture trees run with ``doc_roots=[]``)."""
+    if not idx.doc_files:
+        return []
+    regs: Dict[str, Tuple[str, str, int]] = {}  # name -> (mtype, path, line)
+    for path in sorted(idx.modules):
+        mod = idx.modules[path]
+        for m in list(mod.metrics) + list(mod.dynamic_metrics):
+            regs.setdefault(m.name, (m.mtype, path, m.line))
+    metric_names = {n for n, (t, _p, _l) in regs.items() if t != "span"}
+    hist_names = {n for n, (t, _p, _l) in regs.items() if t == "histogram"}
+    findings: List[Finding] = []
+    for doc_path in sorted(idx.doc_files):
+        for lineno, line in enumerate(idx.doc_files[doc_path], 1):
+            for tok in _DOC_METRIC_TOKEN.findall(line):
+                if tok in metric_names:
+                    continue
+                if tok.endswith("_") and any(
+                        n.startswith(tok) for n in metric_names):
+                    continue
+                if any(tok.endswith(s) and tok[:-len(s)] in hist_names
+                       for s in _HIST_SUFFIXES):
+                    continue
+                findings.append(Finding(
+                    check=CHECK_DOC_SYNC, path=doc_path, line=lineno,
+                    context="-", detail=f"unknown-name:{tok}",
+                    message=(f"docs reference metric {tok!r} but no such "
+                             "metric is registered anywhere in the tree — "
+                             "fix the stale doc name or register the "
+                             "metric")))
+    doc_text = idx.doc_text
+    prefixes = {t for t in _DOC_METRIC_TOKEN.findall(doc_text)
+                if t.endswith("_")}
+    for name in sorted(regs):
+        mtype, path, line = regs[name]
+        if name in doc_text:
+            continue
+        if any(name.startswith(p) for p in prefixes):
+            continue
+        findings.append(Finding(
+            check=CHECK_DOC_SYNC, path=path, line=line,
+            context="-", detail=f"undocumented:{name}",
+            message=(f"{mtype} {name!r} is registered here but never "
+                     "mentioned in the docs — document it in "
+                     "docs/observability.md (or the owning surface doc)")))
+    return findings
+
+
 # --------------------------------------------------------- resource-lifecycle
 
 
@@ -820,6 +897,8 @@ def run_checks(idx: TreeIndex,
         findings += check_config_hygiene(idx)
     if CHECK_METRICS in wanted:
         findings += check_metrics_hygiene(idx)
+    if CHECK_DOC_SYNC in wanted:
+        findings += check_doc_sync(idx)
     if CHECK_RESOURCE in wanted:
         findings += check_resource_lifecycle(idx)
     if CHECK_THREAD_HYGIENE in wanted:

@@ -1,7 +1,7 @@
 """Active run-health detectors over the goodput planes.
 
 ``util/goodput.py`` computes *where the wall clock went*; this module
-*watches* — three detectors riding telemetry the runtime already
+*watches* — detectors riding telemetry the runtime already
 collects, each emitting edge-triggered cluster events so a degrading
 run announces itself instead of waiting for a human with ``timeline
 --attribute``:
@@ -18,8 +18,12 @@ run announces itself instead of waiting for a human with ``timeline
 - :class:`TTRTTracker` — time-to-recovered-throughput: on a death
   event, how long until throughput is back within
   ``ttrt_recovery_fraction`` of the pre-fault rolling baseline.
+- :class:`RecompileStormDetector` — per-program recompile-rate watch
+  over the XLA observatory counters: a program re-lowered under
+  churning aval fingerprints raises a WARNING naming the program, the
+  shape delta, and the compile seconds burned.
 
-:class:`HealthMonitor` composes all three into one head-service tick
+:class:`HealthMonitor` composes them all into one head-service tick
 (``Head._health_monitor_loop``, cadence ``health_monitor_interval_ms``)
 and feeds ``goodput_report``'s ``health`` section.
 """
@@ -37,6 +41,7 @@ from ray_tpu.util.goodput import (BADPUT_CATEGORIES, LedgerAccumulator,
 __all__ = [
     "StragglerDetector",
     "RegressionDetector",
+    "RecompileStormDetector",
     "TTRTTracker",
     "HealthMonitor",
 ]
@@ -231,6 +236,93 @@ class RegressionDetector:
         return changes
 
 
+class RecompileStormDetector:
+    """Edge-triggered watch over the XLA observatory's recompile
+    counters (``util/xla_observatory.py``).
+
+    A *recompile storm* — the same program name re-lowered under churning
+    aval fingerprints, silently burning step time on compiles — shows up
+    as the per-program ``ray_tpu_xla_recompiles_total`` counter climbing
+    tick over tick. ``update()`` reads the head's merged registry (worker
+    snaps already folded in by the report plane — no extra wire ops):
+    a program that recompiled >= ``xla_storm_trigger_recompiles`` times
+    since the last tick raises one WARNING naming the program, the shape
+    churn (old -> new avals, from the ``ray_tpu_xla_shape_churn`` gauge)
+    and the compile seconds burned; it clears after
+    ``xla_storm_clear_ticks`` consecutive quiet ticks (hysteresis, same
+    discipline as the straggler watch)."""
+
+    def __init__(self, cfg: Optional[Config] = None):
+        cfg = cfg or global_config()
+        self.trigger = max(1, cfg.xla_storm_trigger_recompiles)
+        self.clear_ticks = max(1, cfg.xla_storm_clear_ticks)
+        self.active: Dict[str, float] = {}   # program -> recompiles/tick
+        self._prev: Dict[str, float] = {}    # program -> last total
+        self._prev_s: Dict[str, float] = {}  # program -> last compile s
+        self._quiet: Dict[str, int] = {}     # program -> quiet ticks
+
+    @staticmethod
+    def _by_program(series) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for tags, v in series:
+            prog = dict(tags).get("program")
+            if prog is not None:
+                out[prog] = out.get(prog, 0.0) + float(v)
+        return out
+
+    def update(self, flat: Optional[Dict[str, Any]] = None) -> List[dict]:
+        if flat is None:
+            from ray_tpu.util.metrics import aggregate_series, registry
+            flat = aggregate_series(registry())
+        totals = self._by_program(
+            flat.get("ray_tpu_xla_recompiles_total", ()))
+        compile_s = self._by_program(
+            flat.get("ray_tpu_xla_compile_seconds_total", ()))
+        # latest old->new aval transition per program, for the event text
+        churn: Dict[str, Tuple[str, str]] = {}
+        for tags, _v in flat.get("ray_tpu_xla_shape_churn", ()):
+            d = dict(tags)
+            if "program" in d:
+                churn[d["program"]] = (d.get("from", "?"), d.get("to", "?"))
+        changes: List[dict] = []
+        for prog, total in totals.items():
+            delta = total - self._prev.get(prog, 0.0)
+            self._prev[prog] = total
+            burn = compile_s.get(prog, 0.0) - self._prev_s.get(prog, 0.0)
+            self._prev_s[prog] = compile_s.get(prog, 0.0)
+            if prog not in self.active and delta >= self.trigger:
+                self.active[prog] = delta
+                self._quiet[prog] = 0
+                old, new = churn.get(prog, ("?", "?"))
+                events_mod.emit(
+                    "WARNING", events_mod.SOURCE_TRAIN,
+                    f"recompile storm: {prog} recompiled {int(delta)}x "
+                    f"since last tick (shapes {old} -> {new}, "
+                    f"{burn:.3f}s compiling)",
+                    entity_id=prog, recompiles=int(delta),
+                    recompiles_total=int(total),
+                    churn_from=old, churn_to=new,
+                    compile_s=round(burn, 6))
+                changes.append({"key": prog, "state": "triggered",
+                                "recompiles": int(delta)})
+            elif prog in self.active and delta <= 0:
+                q = self._quiet.get(prog, 0) + 1
+                self._quiet[prog] = q
+                if q >= self.clear_ticks:
+                    del self.active[prog]
+                    events_mod.emit(
+                        "INFO", events_mod.SOURCE_TRAIN,
+                        f"recompile storm cleared: {prog} stable for "
+                        f"{q} tick(s)",
+                        entity_id=prog,
+                        recompiles_total=int(total))
+                    changes.append({"key": prog, "state": "cleared"})
+            elif prog in self.active:
+                self.active[prog] = delta
+                self._quiet[prog] = 0
+        return changes
+
+
 class TTRTTracker:
     """Time-to-recovered-throughput after node/worker death events."""
 
@@ -286,7 +378,7 @@ class TTRTTracker:
 
 
 class HealthMonitor:
-    """One tick = ledger + all three detectors, over head-local state.
+    """One tick = ledger + all detectors, over head-local state.
 
     Runs inside the head process (``Head._health_monitor_loop``); every
     input is already buffered head-side (span payloads, event ring,
@@ -305,6 +397,7 @@ class HealthMonitor:
         self.head = head
         self.straggler = StragglerDetector(cfg)
         self.regression = RegressionDetector(cfg)
+        self.recompile = RecompileStormDetector(cfg)
         self.ttrt = TTRTTracker(cfg)
         self.ledger_acc = LedgerAccumulator()
         self.last_ledger: Optional[Dict[str, Any]] = None
@@ -346,6 +439,7 @@ class HealthMonitor:
         self.straggler.update(new_events)
         self.regression.update(getattr(self.head, "metrics_history", None),
                                attribution=grew)
+        self.recompile.update()
 
         # new death events since the last tick open TTRT records
         pts = self._throughput_points()
@@ -364,4 +458,5 @@ class HealthMonitor:
             "ttrt": self.ttrt.summary(),
             "stragglers": sorted(self.straggler.active),
             "regressions": sorted(self.regression.active),
+            "recompile_storms": sorted(self.recompile.active),
         }

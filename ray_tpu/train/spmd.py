@@ -64,6 +64,7 @@ import numpy as np
 
 from ray_tpu.util import flight_recorder as _fr
 from ray_tpu.util.metrics import Gauge
+from ray_tpu.util.xla_observatory import observe_compiled
 
 _sp_ingest = _fr.register_span("spmd.ingest_wait")
 _sp_compute = _fr.register_span("spmd.compute")
@@ -206,6 +207,8 @@ def make_shard_and_gather_fns(partition_specs, mesh, dtype_specs=None):
             return x.astype(dtype_specs)
         return x
 
+    from ray_tpu.parallel.sharding import observed_placement_jit
+
     # one jitted callable per DISTINCT sharding (jax's jit cache keys on
     # the callable identity first, so a fresh wrapper per leaf would
     # compile per leaf even when dozens share (shape, dtype, spec))
@@ -213,7 +216,8 @@ def make_shard_and_gather_fns(partition_specs, mesh, dtype_specs=None):
 
     def placement_fn(sharding):
         if sharding not in jitted:
-            jitted[sharding] = jax.jit(to_dtype, out_shardings=sharding)
+            jitted[sharding] = observed_placement_jit(
+                to_dtype, sharding, "spmd.shard_put")
         return jitted[sharding]
 
     def make_shard(spec):
@@ -224,8 +228,8 @@ def make_shard_and_gather_fns(partition_specs, mesh, dtype_specs=None):
 
         return shard
 
-    gather_jit = jax.jit(lambda x: x,
-                         out_shardings=NamedSharding(mesh, P()))
+    gather_jit = observed_placement_jit(
+        lambda x: x, NamedSharding(mesh, P()), "spmd.gather_replicate")
 
     def make_gather(spec):
         def gather(x):
@@ -394,7 +398,9 @@ def make_spmd_train_step(cfg, mesh, optimizer=None, rules=None,
             optimizer, sample["params"], param_shardings, repl),
         "step": repl,
     }
-    init_jit = jax.jit(init_state, out_shardings=state_shardings)
+    init_jit = observe_compiled(
+        jax.jit(init_state, out_shardings=state_shardings),
+        "spmd.init_state")
 
     state_specs = jax.tree.map(lambda s: s.spec, state_shardings,
                                is_leaf=lambda x: isinstance(x, NamedSharding))
@@ -551,12 +557,12 @@ def make_spmd_train_step(cfg, mesh, optimizer=None, rules=None,
         out_specs=(state_specs, P()),
         check=False)
 
-    train_step = jax.jit(
+    train_step = observe_compiled(jax.jit(
         sharded_step,
         in_shardings=(state_shardings, data_sharding),
         out_shardings=(state_shardings, repl),
         donate_argnums=(0,) if donate else (),
-    )
+    ), "spmd.train_step")
     return init_jit, train_step, data_sharding, state_shardings
 
 

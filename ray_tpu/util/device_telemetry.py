@@ -15,7 +15,10 @@ registry flushed by ``start_report_thread``), so the head's /metrics and
 
 Laziness is load-bearing: the collector never imports jax itself — it waits
 until user code has (``"jax" in sys.modules``), so CPU-only workers that
-never touch jax pay nothing.
+never touch jax pay nothing. Event listeners are nonetheless installed at
+jax-import time (``observe_jax_import``'s meta-path hook), not on the first
+collection tick: compiles that fire between import and the first tick —
+the first train step's JIT, typically — would otherwise never be counted.
 """
 
 from __future__ import annotations
@@ -40,10 +43,27 @@ _JAX_DURATIONS = Histogram(
 _listener_lock = threading.Lock()
 _listeners_installed = False
 
+# node hex prefix stamped onto the jax event series: counters SUM across
+# sources at the head, so without this tag two workers' compile counts
+# merge into one anonymous series
+_node_tag = [""]
+
+
+def set_node_tag(node_hex: str) -> None:
+    if node_hex:
+        _node_tag[0] = node_hex[:8]
+
+
+def _event_tags(event: str) -> dict:
+    tags = {"event": str(event)}
+    if _node_tag[0]:
+        tags["node"] = _node_tag[0]
+    return tags
+
 
 def _on_jax_event(event: str, *args, **kwargs) -> None:
     try:
-        _JAX_EVENTS.inc(1.0, tags={"event": str(event)})
+        _JAX_EVENTS.inc(1.0, tags=_event_tags(event))
     except Exception:
         pass
 
@@ -51,8 +71,7 @@ def _on_jax_event(event: str, *args, **kwargs) -> None:
 def _on_jax_event_duration(event: str, duration: float,
                            *args, **kwargs) -> None:
     try:
-        _JAX_DURATIONS.observe(float(duration),
-                               tags={"event": str(event)})
+        _JAX_DURATIONS.observe(float(duration), tags=_event_tags(event))
     except Exception:
         pass
 
@@ -82,6 +101,87 @@ def install_jax_listeners() -> bool:
             return True
         except Exception:
             return False
+
+
+class _ListenerInstallingLoader:
+    """Loader proxy: run the real jax exec_module, then install the
+    monitoring listeners before anyone gets to call into jax."""
+
+    def __init__(self, loader):
+        self._loader = loader
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+    def create_module(self, spec):
+        return self._loader.create_module(spec)
+
+    def exec_module(self, module):
+        try:
+            self._loader.exec_module(module)
+        finally:
+            _unobserve_jax_import()
+            install_jax_listeners()
+
+
+class _JaxImportObserver:
+    """Meta-path finder that observes (never itself loads) the top-level
+    ``jax`` import, so the jax.monitoring listeners install the moment
+    jax finishes importing — not on the first telemetry tick."""
+
+    def __init__(self):
+        self._in_find = False
+
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname != "jax" or self._in_find:
+            return None
+        import importlib.util
+
+        self._in_find = True
+        try:
+            spec = importlib.util.find_spec(fullname)
+        finally:
+            self._in_find = False
+        if spec is None or spec.loader is None:
+            return None
+        spec.loader = _ListenerInstallingLoader(spec.loader)
+        return spec
+
+
+_observer_lock = threading.Lock()
+_import_observer: Optional[_JaxImportObserver] = None
+
+
+def observe_jax_import() -> bool:
+    """Arm listener installation at the instant jax gets imported.
+
+    The collector thread only installs listeners on its periodic tick,
+    which misses every compile that fires before the first tick — the
+    common case, since the first train step compiles immediately after
+    jax import. Called at worker/daemon/driver runtime start: if jax is
+    already loaded the listeners install now (returns True); otherwise
+    a meta-path observer installs them the moment the ``jax`` import
+    completes (returns False). Processes that never import jax never
+    trigger it — laziness stays load-bearing."""
+    global _import_observer
+    if install_jax_listeners():
+        return True
+    with _observer_lock:
+        if _import_observer is None:
+            _import_observer = _JaxImportObserver()
+            sys.meta_path.insert(0, _import_observer)
+    return False
+
+
+def _unobserve_jax_import() -> None:
+    global _import_observer
+    with _observer_lock:
+        if _import_observer is not None:
+            try:
+                sys.meta_path.remove(_import_observer)
+            except ValueError:
+                pass
+            _import_observer = None
 
 
 def collect_device_stats(devices: List, node_hex: str = "") -> int:
@@ -127,6 +227,7 @@ def start_device_telemetry(node_hex: str = "",
                            interval_s: Optional[float] = None
                            ) -> threading.Event:
     """Start the per-process collector thread; returns its stop event."""
+    set_node_tag(node_hex)
     if interval_s is None:
         from ray_tpu.core.config import global_config
 

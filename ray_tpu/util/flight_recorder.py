@@ -558,6 +558,14 @@ def attribute_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     serve_s = total_s(("serve.batch_drain",))
     compile_s = total_s(("spmd.compile",))
     ckpt_s = total_s(("ckpt.save", "ckpt.restore"))
+    # per-program XLA compile rows (observatory xla.compile spans carry a
+    # `program` tag) — where the compile seconds went, by executable
+    xla_compile: Dict[str, Dict[str, float]] = {}
+    for ev in by_name.get("xla.compile", ()):
+        prog = str((ev.get("args") or {}).get("program", "?"))
+        rec = xla_compile.setdefault(prog, {"compiles": 0, "compile_s": 0.0})
+        rec["compiles"] += 1
+        rec["compile_s"] += ev.get("dur", 0.0) / 1e6
     denom = wall_s or (spmd_compute_s + ingest_s) or None
     report: Dict[str, Any] = {
         "step_wall_s": round(wall_s, 6),
@@ -577,6 +585,10 @@ def attribute_trace(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "serve_batch_s": round(serve_s, 6),
         "compile_s": round(compile_s, 6),
         "checkpoint_s": round(ckpt_s, 6),
+        "xla_compile_s": {
+            p: {"compiles": int(r["compiles"]),
+                "compile_s": round(r["compile_s"], 6)}
+            for p, r in sorted(xla_compile.items())},
     }
     # spmd.gather/spmd.scatter are ONE-SHOT probe timings of the full
     # param-tree collectives (train/spmd.py make_collective_probes),
@@ -630,6 +642,9 @@ def format_attribution(report: Dict[str, Any]) -> str:
             f"one compute span (probe cost; streamed hides it in compute)")
     if report.get("compile_s"):
         lines.append(f"compile (1st step) : {report['compile_s']:.4f}s")
+    for prog, rec in (report.get("xla_compile_s") or {}).items():
+        lines.append(f"  xla {prog:<14}: {rec['compile_s']:.4f}s "
+                     f"({rec['compiles']} compile(s))")
     if report.get("checkpoint_s"):
         lines.append(f"checkpoint io      : {report['checkpoint_s']:.4f}s")
     if report.get("dag_exec_s"):

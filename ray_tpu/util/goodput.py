@@ -123,20 +123,37 @@ def classify_badput(events: Sequence[Dict[str, Any]],
     t_hi = max(ev["ts"] + ev.get("dur", 0.0) for ev in spans)
     wall_s = max((t_hi - t_lo) / 1e6, 1e-9)
 
-    def per_source_mean(names) -> float:
-        # sum per recording process, then average across processes:
-        # N hosts each stalling 2s is a 2s column, not 2N
+    def per_source(names, pool=spans) -> Dict[str, float]:
         per: Dict[str, float] = {}
-        for ev in spans:
+        for ev in pool:
             if ev["name"] in names:
                 src = str((ev.get("args") or {}).get("source", ev.get("pid")))
                 per[src] = per.get(src, 0.0) + ev.get("dur", 0.0) / 1e6
+        return per
+
+    def per_source_mean(names) -> float:
+        # sum per recording process, then average across processes:
+        # N hosts each stalling 2s is a 2s column, not 2N
+        per = per_source(names)
         return sum(per.values()) / len(per) if per else 0.0
 
     compute_s = per_source_mean(_PRODUCTIVE)
     ingest_s = per_source_mean(_INGEST)
-    compile_s = per_source_mean(_COMPILE)
     ckpt_s = per_source_mean(_CKPT)
+    # compile column: spmd.compile is the train-step compile wall; the
+    # observatory's per-program xla.compile spans back-fill sources that
+    # never hit the spmd seam (serve decode, placement jits). A source
+    # with spmd.compile keeps that number — its xla.compile spans are
+    # the same wall time seen program-by-program, not additional badput.
+    # xla.compile does NOT define the window (serve-only clusters would
+    # otherwise grow a fake "train window" out of compile spans alone).
+    xla_pool = [ev for ev in events
+                if ev.get("ph") == "X" and ev.get("cat") == "span"
+                and ev.get("name") == "xla.compile"]
+    compile_per = per_source(("xla.compile",), pool=xla_pool)
+    compile_per.update(per_source(_COMPILE))
+    compile_s = (sum(compile_per.values()) / len(compile_per)
+                 if compile_per else 0.0)
 
     # pipeline plane: productive = busy averaged over stages; bubble is
     # the stepped wall the stages spent idle (same K-normalized
@@ -224,6 +241,9 @@ class LedgerAccumulator:
         self._steps = 0        # spmd.compute spans folded
         self._pipe_steps = 0   # pipe.step spans folded
         self._sources: set = set()
+        # xla.compile seconds per source — compile-column back-fill for
+        # sources with no spmd.compile (see classify_badput)
+        self._xla_compile: Dict[str, float] = {}
         self._t_lo: Optional[float] = None   # wall seconds
         self._t_hi: Optional[float] = None
 
@@ -243,6 +263,14 @@ class LedgerAccumulator:
             if ev.get("ph") != "X" or ev.get("cat") != "span":
                 continue
             name = ev.get("name")
+            if name == "xla.compile":
+                # tracked for the compile column, but never widens the
+                # train window or the source census
+                args = ev.get("args") or {}
+                src = str(args.get("source", ev.get("pid")))
+                self._xla_compile[src] = (self._xla_compile.get(src, 0.0)
+                                          + ev.get("dur", 0.0) / 1e6)
+                continue
             if name not in _WINDOW_SPANS:
                 continue
             ts = ev["ts"] / 1e6
@@ -284,8 +312,14 @@ class LedgerAccumulator:
 
         compute_s = fam_mean("compute")
         ingest_s = fam_mean("ingest")
-        compile_s = fam_mean("compile")
         ckpt_s = fam_mean("checkpoint")
+        # spmd.compile wins per source; xla.compile back-fills the rest
+        compile_per = dict(self._xla_compile)
+        for src, d in self._fam.items():
+            if "compile" in d:
+                compile_per[src] = d["compile"]
+        compile_s = (sum(compile_per.values()) / len(compile_per)
+                     if compile_per else 0.0)
         k = len(self._stages) or 1
         pipe_productive_s = self._busy_s / k
         bubble_s = max(self._step_wall_s - pipe_productive_s, 0.0) \

@@ -1,0 +1,66 @@
+"""Planted bugs for ``doc-sync``: a metric registered but never
+mentioned in the fixture docs (stale-doc detector must flag the
+registration site), next to registrations that the docs resolve through
+every supported spelling — exact name, ``_``-terminated family prefix,
+histogram export suffix, aliased constructor import, and the dynamic
+``registry().record(...)`` API.
+
+Never imported or executed; parsed by tests/test_static_analysis.py.
+"""
+
+
+def Counter(name, description="", tag_keys=()):  # noqa: N802 (AST stub)
+    pass
+
+
+def Gauge(name, description="", tag_keys=()):  # noqa: N802 (AST stub)
+    pass
+
+
+def Histogram(name, description="", tag_keys=()):  # noqa: N802 (AST stub)
+    pass
+
+
+_Counter = Counter  # the `import Counter as _Counter` private-alias idiom
+
+
+def register_span(name, tag_keys=()):  # AST stub
+    pass
+
+
+class _Registry:
+    def record(self, name, mtype, description, tags, value, mode="add"):
+        pass
+
+
+def registry():
+    return _Registry()
+
+
+# documented by exact name in docs/observability.md
+m_requests = Counter("ray_tpu_fixture_requests_total", "requests",
+                     tag_keys=("route",))
+
+# documented through the aliased-ctor registration site
+m_alias = _Counter("ray_tpu_fixture_alias_total", "alias-registered")
+
+# documented as the family prefix `ray_tpu_fixture_fam_*`
+m_fam_a = Counter("ray_tpu_fixture_fam_a_total", "family member a")
+m_fam_b = Counter("ray_tpu_fixture_fam_b_total", "family member b")
+
+# documented via the `_count` histogram export suffix
+m_latency = Histogram("ray_tpu_fixture_latency_seconds", "latency")
+
+# dynamic registration: docs reference the name, the record() tap
+# must resolve it
+registry().record("ray_tpu_fixture_dyn_total", "counter",
+                  "dynamically registered", (), 1.0, mode="add")
+
+# documented span
+sp_step = register_span("fixture.step_span", tag_keys=("stage",))
+
+# BUG: registered but never mentioned anywhere in the fixture docs
+m_orphan = Counter("ray_tpu_fixture_orphan_total", "undocumented")
+
+# BUG: span registered but never mentioned in the fixture docs
+sp_orphan = register_span("fixture.orphan_span")

@@ -71,12 +71,16 @@ def test_jax_monitoring_listeners_count_events():
     monitoring.record_event("/raytpu/test/event")
     snap = registry().snapshot()
     vals = snap["ray_tpu_jax_events_total"]["values"]
-    assert vals[(("event", "/raytpu/test/event"),)] == 2.0
+    # key shape is ("event", ...) plus a ("node", ...) tag once any
+    # runtime has stamped this process's node hex
+    assert sum(v for k, v in vals.items()
+               if ("event", "/raytpu/test/event") in k) == 2.0
     if hasattr(monitoring, "record_event_duration_secs"):
         monitoring.record_event_duration_secs("/raytpu/test/duration", 0.5)
         snap = registry().snapshot()
         hv = snap["ray_tpu_jax_event_duration_seconds"]["values"]
-        entry = hv[(("event", "/raytpu/test/duration"),)]
+        entry = next(v for k, v in hv.items()
+                     if ("event", "/raytpu/test/duration") in k)
         assert entry["count"] == 1 and entry["sum"] == 0.5
 
 
@@ -106,6 +110,122 @@ def _total_jax_events() -> float:
     if m is None:
         return 0.0
     return sum(m["values"].values())
+
+
+def test_two_daemon_compile_telemetry_reaches_head_history():
+    """2-daemon e2e: worker jit compiles fire jax.monitoring events
+    (listeners armed at process start via the import-observation hook)
+    and HBM gauges; both ride the existing metrics channel and land in
+    the head's /api/metrics/history rings with per-node tags."""
+    import json
+    import os
+    import time
+    import urllib.request
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.util.metrics import aggregate_series
+
+    def wait_for(cond, timeout=90.0, msg="condition"):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(0.2)
+        raise TimeoutError(f"timed out waiting for {msg}")
+
+    os.environ["RAY_TPU_METRICS_REPORT_INTERVAL_MS"] = "200"
+    c = Cluster(head_node_args={"num_cpus": 1})
+    dash = None
+    try:
+        c.add_node(num_cpus=1, resources={"gdt1": 1},
+                   separate_process=True)
+        c.add_node(num_cpus=1, resources={"gdt2": 1},
+                   separate_process=True)
+        head = c.head
+
+        # defined in-test so it cloudpickles BY VALUE (daemon workers
+        # cannot import the test module)
+        @ray_tpu.remote
+        def compile_and_report():
+            """Worker-side: a real jit compile (monitoring listeners
+            were armed at runtime start by observe_jax_import, BEFORE
+            jax loaded) plus one fake-HBM gauge stamped with this
+            worker's real node hex."""
+            import jax
+            import jax.numpy as jnp
+
+            jax.jit(lambda x: x * 3 + 1)(jnp.arange(8)) \
+                .block_until_ready()
+
+            from ray_tpu.core.runtime import get_current_runtime
+            from ray_tpu.util import device_telemetry as dt
+
+            node = get_current_runtime().node_hex
+
+            class Dev:  # CPU devices report no memory_stats; fake one
+                platform = "tpu"
+                id = 0
+
+                def memory_stats(self):
+                    return {"bytes_in_use": 12345.0,
+                            "peak_bytes_in_use": 23456.0}
+
+            dt.collect_device_stats([Dev()], node_hex=node)
+            return node[:8]
+
+        hex1 = ray_tpu.get(
+            compile_and_report.options(resources={"gdt1": 1}).remote(),
+            timeout=120)
+        hex2 = ray_tpu.get(
+            compile_and_report.options(resources={"gdt2": 1}).remote(),
+            timeout=120)
+        assert hex1 and hex2 and hex1 != hex2
+
+        def compile_nodes():
+            flat = aggregate_series(registry())
+            nodes = set()
+            for tags, v in flat.get("ray_tpu_jax_events_total", ()):
+                d = dict(tags)
+                if v > 0 and d.get("node") and "compil" in d.get(
+                        "event", ""):
+                    nodes.add(d["node"])
+            return nodes
+
+        def hbm_nodes():
+            flat = aggregate_series(registry())
+            return {dict(t).get("node")
+                    for t, v in flat.get("ray_tpu_device_bytes_in_use", ())
+                    if v == 12345.0}
+
+        wait_for(lambda: {hex1, hex2} <= compile_nodes(),
+                 msg="per-node compile events reported to head")
+        wait_for(lambda: {hex1, hex2} <= hbm_nodes(),
+                 msg="per-node HBM gauges reported to head")
+
+        head.sample_metrics_history()
+        dash = start_dashboard(port=0, with_jobs=False)
+        base = f"http://127.0.0.1:{dash.address[1]}"
+
+        def hist(name):
+            url = f"{base}/api/metrics/history?name={name}"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.status == 200
+                return json.loads(r.read().decode())
+
+        ev = hist("ray_tpu_jax_events_total")
+        ev_nodes = {s["tags"].get("node") for s in ev["series"]}
+        assert {hex1, hex2} <= ev_nodes
+        hbm = hist("ray_tpu_device_bytes_in_use")
+        hbm_by_node = {s["tags"].get("node"): s for s in hbm["series"]
+                       if s["tags"].get("device") == "tpu:0"}
+        assert {hex1, hex2} <= set(hbm_by_node)
+        assert hbm_by_node[hex1]["points"][-1][1] == 12345.0
+    finally:
+        if dash is not None:
+            dash.stop()
+        os.environ.pop("RAY_TPU_METRICS_REPORT_INTERVAL_MS", None)
+        c.shutdown()
 
 
 def test_worker_device_telemetry_reaches_head(ray_start_regular):

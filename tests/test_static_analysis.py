@@ -191,6 +191,63 @@ def test_metrics_hygiene_covers_flight_recorder_spans():
     assert not any("fixture.step" in d for d in details)
 
 
+def _doc_sync_report():
+    return lint_fixture(
+        os.path.join("doc_sync", "pkg"),
+        doc_roots=[os.path.join(FIXTURES, "doc_sync", "docs")],
+        checks=["doc-sync"])
+
+
+def test_doc_sync_flags_stale_docs_and_undocumented_registrations():
+    report = _doc_sync_report()
+    found = by_check(report, "doc-sync")
+    details = {f.detail for f in found}
+    assert "unknown-name:ray_tpu_fixture_bogus_total" in details
+    assert "unknown-name:ray_tpu_fixture_missing_count" in details
+    assert "undocumented:ray_tpu_fixture_orphan_total" in details
+    assert "undocumented:fixture.orphan_span" in details
+    assert len(found) == 4, "\n".join(f.render() for f in found)
+    stale = next(f for f in found
+                 if f.detail == "unknown-name:ray_tpu_fixture_bogus_total")
+    assert stale.path == os.path.join("docs", "observability.md")
+    assert stale.line > 0
+    orphan = next(f for f in found
+                  if f.detail == "undocumented:ray_tpu_fixture_orphan_total")
+    assert orphan.path == "case.py"
+
+
+def test_doc_sync_resolution_rules():
+    """Exact names, `_`-terminated family prefixes, histogram export
+    suffixes, aliased-ctor imports, spans, and registry().record
+    registrations all resolve; env vars, ray_tpu:// URLs, and module or
+    file paths never parse as metric tokens."""
+    report = _doc_sync_report()
+    details = {f.detail for f in by_check(report, "doc-sync")}
+    for resolved in ("ray_tpu_fixture_requests_total",
+                     "ray_tpu_fixture_alias_total",
+                     "ray_tpu_fixture_dyn_total",
+                     "ray_tpu_fixture_fam_a_total",
+                     "ray_tpu_fixture_fam_b_total",
+                     "ray_tpu_fixture_latency_seconds",
+                     "fixture.step_span"):
+        assert not any(resolved in d for d in details), (resolved, details)
+
+
+def test_doc_sync_skips_trees_scanned_without_docs():
+    """Every other fixture runs with doc_roots=[]; doc-sync must not
+    declare their registrations undocumented against an empty corpus."""
+    report = lint_fixture(os.path.join("doc_sync", "pkg"),
+                          checks=["doc-sync"])
+    assert not report.findings, [f.render() for f in report.findings]
+
+
+def test_doc_sync_clean_on_real_tree():
+    """The zero-findings gate for the real docs/ <-> registry surface."""
+    report = run_lint(checks=["doc-sync"], use_baseline=False)
+    assert not report.findings, "\n".join(
+        f.render() for f in report.findings)
+
+
 def test_suppressions_inline_and_line_above():
     report = lint_fixture("suppress")
     found = by_check(report, "blocking-under-lock")
